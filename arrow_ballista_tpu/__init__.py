@@ -1,0 +1,25 @@
+"""arrow-ballista-tpu: a TPU-native distributed SQL query engine.
+
+A from-scratch rebuild of Apache Arrow Ballista's capability set
+(reference at /root/reference) on a JAX/XLA/TPU execution backend:
+eligible per-stage subplans run as fused XLA kernels on TPU, partial
+aggregates reduce across chips over ICI, and an Arrow Flight data plane
+moves shuffle partitions between executors over DCN.
+"""
+
+__version__ = "0.1.0"
+
+from .config import BallistaConfig, TaskSchedulingPolicy
+from .context import DataFrame, SessionContext
+from .errors import BallistaError
+from .plan.expressions import col, lit
+
+__all__ = [
+    "BallistaConfig",
+    "TaskSchedulingPolicy",
+    "SessionContext",
+    "DataFrame",
+    "BallistaError",
+    "col",
+    "lit",
+]

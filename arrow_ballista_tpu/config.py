@@ -1,0 +1,191 @@
+"""Session configuration.
+
+Counterpart of ``BallistaConfig`` (``ballista/rust/core/src/config.rs:30-187``
+in /root/reference): validated string key/value settings with typed defaults,
+shipped with every query and materialized into the per-session execution
+context.  New TPU-specific knobs are added for the accelerated stage path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable
+
+from .errors import ConfigError
+
+# Settings keys (reference: core/src/config.rs:30-38)
+SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+BATCH_SIZE = "ballista.batch.size"
+REPARTITION_JOINS = "ballista.repartition.joins"
+REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
+REPARTITION_WINDOWS = "ballista.repartition.windows"
+PARQUET_PRUNING = "ballista.parquet.pruning"
+WITH_INFORMATION_SCHEMA = "ballista.with_information_schema"
+PLUGIN_DIR = "ballista.plugin_dir"
+# TPU-native additions
+TPU_ENABLE = "ballista.tpu.enable"
+TPU_SEGMENT_CAPACITY = "ballista.tpu.segment_capacity"
+TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
+TPU_DTYPE = "ballista.tpu.dtype"
+
+
+class TaskSchedulingPolicy(str, Enum):
+    """Reference: core/src/config.rs (TaskSchedulingPolicy enum)."""
+
+    PULL_STAGED = "pull-staged"
+    PUSH_STAGED = "push-staged"
+
+
+def _parse_bool(v: str) -> bool:
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean: {v!r}")
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    key: str
+    description: str
+    parse: Callable[[str], Any]
+    default: str
+
+
+_ENTRIES: dict[str, ConfigEntry] = {
+    e.key: e
+    for e in [
+        ConfigEntry(
+            SHUFFLE_PARTITIONS,
+            "number of output partitions for shuffle stages",
+            int,
+            "2",
+        ),
+        ConfigEntry(BATCH_SIZE, "rows per record batch", int, "8192"),
+        ConfigEntry(
+            REPARTITION_JOINS, "repartition inputs of joins", _parse_bool, "true"
+        ),
+        ConfigEntry(
+            REPARTITION_AGGREGATIONS,
+            "repartition inputs of aggregations",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            REPARTITION_WINDOWS, "repartition inputs of windows", _parse_bool, "true"
+        ),
+        ConfigEntry(PARQUET_PRUNING, "enable parquet row-group pruning", _parse_bool, "true"),
+        ConfigEntry(
+            WITH_INFORMATION_SCHEMA,
+            "provide information_schema tables (SHOW ...)",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(PLUGIN_DIR, "directory of UDF plugins", str, ""),
+        ConfigEntry(
+            TPU_ENABLE,
+            "compile eligible stage subplans to fused XLA kernels on TPU",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            TPU_SEGMENT_CAPACITY,
+            "fixed group-table capacity for on-device hash aggregation",
+            int,
+            "4096",
+        ),
+        ConfigEntry(
+            TPU_BATCH_ROWS,
+            "row count each fused device invocation is padded/bucketed to",
+            int,
+            "1048576",
+        ),
+        ConfigEntry(TPU_DTYPE, "accumulation dtype on device", str, "float64"),
+    ]
+}
+
+
+@dataclass
+class BallistaConfig:
+    """Validated k/v session settings (reference: core/src/config.rs:96-130)."""
+
+    settings: dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for k, v in self.settings.items():
+            entry = _ENTRIES.get(k)
+            if entry is None:
+                # Unknown keys are preserved (forward compatibility) but not
+                # validated, mirroring the reference's behavior for
+                # extension settings.
+                continue
+            try:
+                entry.parse(v)
+            except Exception as e:  # noqa: BLE001
+                raise ConfigError(f"invalid value for {k}: {v!r} ({e})") from e
+
+    @staticmethod
+    def builder() -> "BallistaConfigBuilder":
+        return BallistaConfigBuilder()
+
+    def _get(self, key: str) -> Any:
+        entry = _ENTRIES[key]
+        raw = self.settings.get(key, entry.default)
+        return entry.parse(raw)
+
+    # Typed accessors
+    @property
+    def shuffle_partitions(self) -> int:
+        return self._get(SHUFFLE_PARTITIONS)
+
+    @property
+    def batch_size(self) -> int:
+        return self._get(BATCH_SIZE)
+
+    @property
+    def repartition_joins(self) -> bool:
+        return self._get(REPARTITION_JOINS)
+
+    @property
+    def repartition_aggregations(self) -> bool:
+        return self._get(REPARTITION_AGGREGATIONS)
+
+    @property
+    def parquet_pruning(self) -> bool:
+        return self._get(PARQUET_PRUNING)
+
+    @property
+    def with_information_schema(self) -> bool:
+        return self._get(WITH_INFORMATION_SCHEMA)
+
+    @property
+    def tpu_enable(self) -> bool:
+        return self._get(TPU_ENABLE)
+
+    @property
+    def tpu_segment_capacity(self) -> int:
+        return self._get(TPU_SEGMENT_CAPACITY)
+
+    @property
+    def tpu_batch_rows(self) -> int:
+        return self._get(TPU_BATCH_ROWS)
+
+    def to_dict(self) -> dict[str, str]:
+        return dict(self.settings)
+
+    @staticmethod
+    def from_dict(d: dict[str, str]) -> "BallistaConfig":
+        return BallistaConfig(dict(d))
+
+
+class BallistaConfigBuilder:
+    def __init__(self) -> None:
+        self._settings: dict[str, str] = {}
+
+    def set(self, key: str, value: str) -> "BallistaConfigBuilder":
+        self._settings[key] = str(value)
+        return self
+
+    def build(self) -> BallistaConfig:
+        return BallistaConfig(self._settings)

@@ -1,0 +1,271 @@
+"""Local (single-process) session context.
+
+This is the single-node engine entry point — the role DataFusion's
+``SessionContext`` plays under the reference's ``BallistaContext``
+(``client/src/context.rs:78-460``).  The distributed ``BallistaContext``
+(client/context.py) delegates planning here and swaps execution for the
+scheduler path.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Optional
+
+import pyarrow as pa
+
+from .catalog import Catalog, CsvTable, MemoryTable, ParquetTable, TableProvider
+from .config import BallistaConfig
+from .errors import PlanError, SqlError
+from .exec.operators import ExecutionPlan, TaskContext, collect
+from .exec.planner import PhysicalPlanner
+from .plan import logical as lp
+from .plan.builder import PlanBuilder, sql_type_to_arrow
+from .plan.optimizer import optimize
+from .sql import ast
+from .sql.parser import parse_sql
+
+
+class DataFrame:
+    """Lazy query handle (reference: DataFusion DataFrame via
+    BallistaContext::sql / read_parquet)."""
+
+    def __init__(self, ctx: "SessionContext", plan: lp.LogicalPlan):
+        self.ctx = ctx
+        self.plan = plan
+
+    # -- transformations -------------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        from .plan import expressions as ex
+
+        exprs = [ex.col(e) if isinstance(e, str) else e for e in exprs]
+        return DataFrame(self.ctx, lp.Projection(list(exprs), self.plan))
+
+    def filter(self, predicate) -> "DataFrame":
+        return DataFrame(self.ctx, lp.Filter(predicate, self.plan))
+
+    def aggregate(self, group_by: list, aggs: list) -> "DataFrame":
+        return DataFrame(self.ctx, lp.Aggregate(list(group_by), list(aggs), self.plan))
+
+    def sort(self, *sort_exprs) -> "DataFrame":
+        return DataFrame(self.ctx, lp.Sort(list(sort_exprs), self.plan))
+
+    def limit(self, n: int, offset: int = 0) -> "DataFrame":
+        return DataFrame(self.ctx, lp.Limit(self.plan, offset, n))
+
+    def join(self, right: "DataFrame", on: list, how: str = "inner") -> "DataFrame":
+        from .plan import expressions as ex
+
+        pairs = []
+        for item in on:
+            if isinstance(item, str):
+                pairs.append((ex.col(item), ex.col(item)))
+            else:
+                l, r = item
+                pairs.append(
+                    (
+                        ex.col(l) if isinstance(l, str) else l,
+                        ex.col(r) if isinstance(r, str) else r,
+                    )
+                )
+        return DataFrame(self.ctx, lp.Join(self.plan, right.plan, pairs, how, None))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.ctx, lp.Union([self.plan, other.plan]))
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(self.ctx, lp.Distinct(self.plan))
+
+    # -- actions ---------------------------------------------------------
+    @property
+    def schema(self) -> pa.Schema:
+        return self.plan.schema
+
+    def logical_plan(self) -> lp.LogicalPlan:
+        return self.plan
+
+    def optimized_plan(self) -> lp.LogicalPlan:
+        return optimize(self.plan)
+
+    def physical_plan(self) -> ExecutionPlan:
+        return self.ctx.create_physical_plan(self.optimized_plan())
+
+    def collect(self) -> pa.Table:
+        return _unqualify(self.ctx.execute(self.physical_plan()))
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def explain(self) -> str:
+        phys = self.physical_plan()
+        return (
+            "== Logical Plan ==\n"
+            + self.optimized_plan().display()
+            + "\n== Physical Plan ==\n"
+            + phys.display()
+        )
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).collect().to_pandas().to_string())
+
+
+class SessionContext:
+    def __init__(self, config: Optional[BallistaConfig] = None):
+        self.config = config or BallistaConfig()
+        self.catalog = Catalog()
+        self.session_id = _gen_id()
+        self.variables: dict[str, str] = {}
+
+    # -- registration ----------------------------------------------------
+    def register_table(self, name: str, provider: TableProvider) -> None:
+        self.catalog.register(name, provider)
+
+    def register_parquet(self, name: str, path: str) -> None:
+        self.catalog.register(name, ParquetTable(path))
+
+    def register_csv(
+        self,
+        name: str,
+        path: str,
+        schema: Optional[pa.Schema] = None,
+        has_header: bool = True,
+        delimiter: str = ",",
+    ) -> None:
+        self.catalog.register(name, CsvTable(path, schema, has_header, delimiter))
+
+    def register_record_batches(
+        self, name: str, partitions: list[list[pa.RecordBatch]]
+    ) -> None:
+        self.catalog.register(name, MemoryTable(partitions))
+
+    def register_arrow_table(self, name: str, table: pa.Table, partitions: int = 1) -> None:
+        self.catalog.register(name, MemoryTable.from_table(table, partitions))
+
+    def deregister_table(self, name: str) -> None:
+        self.catalog.deregister(name)
+
+    def read_parquet(self, path: str) -> DataFrame:
+        name = f"__anon_parquet_{_gen_id()[:6]}"
+        self.register_parquet(name, path)
+        return self.table(name)
+
+    def read_csv(self, path: str, **kw) -> DataFrame:
+        name = f"__anon_csv_{_gen_id()[:6]}"
+        self.register_csv(name, path, **kw)
+        return self.table(name)
+
+    def table(self, name: str) -> DataFrame:
+        provider = self.catalog.get(name)
+        return DataFrame(self, lp.TableScan(name.lower(), provider))
+
+    # -- SQL -------------------------------------------------------------
+    def sql(self, query: str) -> DataFrame:
+        stmt = parse_sql(query)
+        if isinstance(stmt, ast.Query):
+            builder = PlanBuilder(self.catalog)
+            return DataFrame(self, builder.build_query(stmt))
+        if isinstance(stmt, ast.CreateExternalTable):
+            return self._create_external_table(stmt)
+        if isinstance(stmt, ast.ShowStmt):
+            return self._show(stmt)
+        if isinstance(stmt, ast.SetVariable):
+            self.variables[stmt.name] = stmt.value
+            if stmt.name.startswith("ballista."):
+                settings = self.config.to_dict()
+                settings[stmt.name] = stmt.value
+                self.config = BallistaConfig.from_dict(settings)
+            return self._values_df(pa.table({"result": pa.array(["ok"])}))
+        if isinstance(stmt, ast.Explain):
+            builder = PlanBuilder(self.catalog)
+            df = DataFrame(self, builder.build_query(stmt.query))
+            text = df.explain()
+            return self._values_df(
+                pa.table({"plan_type": ["explain"], "plan": [text]})
+            )
+        if isinstance(stmt, ast.DropTable):
+            if stmt.name.lower() not in self.catalog.tables and not stmt.if_exists:
+                raise PlanError(f"table {stmt.name!r} does not exist")
+            self.deregister_table(stmt.name)
+            return self._values_df(pa.table({"result": pa.array(["ok"])}))
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _create_external_table(self, stmt: ast.CreateExternalTable) -> DataFrame:
+        if stmt.name.lower() in self.catalog.tables and stmt.if_not_exists:
+            return self._values_df(pa.table({"result": pa.array(["exists"])}))
+        schema = None
+        if stmt.columns:
+            schema = pa.schema(
+                [pa.field(n, sql_type_to_arrow(t)) for n, t in stmt.columns]
+            )
+        ft = stmt.file_type.upper()
+        if ft == "PARQUET":
+            self.register_parquet(stmt.name, stmt.location)
+        elif ft == "CSV":
+            self.catalog.register(
+                stmt.name,
+                CsvTable(stmt.location, schema, stmt.has_header, stmt.delimiter),
+            )
+        else:
+            raise SqlError(f"unsupported file type {stmt.file_type}")
+        return self._values_df(pa.table({"result": pa.array(["ok"])}))
+
+    def _show(self, stmt: ast.ShowStmt) -> DataFrame:
+        what = [p.upper() for p in stmt.variable]
+        if what[:1] == ["TABLES"]:
+            return self._values_df(
+                pa.table({"table_name": pa.array(self.catalog.names())})
+            )
+        if what[:1] == ["COLUMNS"]:
+            tname = stmt.variable[-1]
+            schema = self.catalog.get(tname).schema
+            return self._values_df(
+                pa.table(
+                    {
+                        "column_name": pa.array(schema.names),
+                        "data_type": pa.array([str(f.type) for f in schema]),
+                        "is_nullable": pa.array(
+                            ["YES" if f.nullable else "NO" for f in schema]
+                        ),
+                    }
+                )
+            )
+        raise SqlError(f"unsupported SHOW {' '.join(stmt.variable)}")
+
+    def _values_df(self, tbl: pa.Table) -> DataFrame:
+        # ephemeral relation: not registered in the catalog so it never
+        # leaks into SHOW TABLES or error messages
+        provider = MemoryTable.from_table(tbl)
+        return DataFrame(self, lp.TableScan("__result", provider))
+
+    # -- execution -------------------------------------------------------
+    def create_physical_plan(self, logical: lp.LogicalPlan) -> ExecutionPlan:
+        phys = PhysicalPlanner(self.config).create_physical_plan(logical)
+        from .ops.stage_compiler import maybe_accelerate
+
+        return maybe_accelerate(phys, self.config)
+
+    def execute(self, plan: ExecutionPlan) -> pa.Table:
+        return collect(plan, self.task_context())
+
+    def task_context(self) -> TaskContext:
+        return TaskContext(session_id=self.session_id, config=self.config)
+
+
+def _unqualify(tbl: pa.Table) -> pa.Table:
+    """Strip relation qualifiers from output column names (user-facing
+    results use bare names, like DataFusion's RecordBatch output)."""
+    new = [n.split(".")[-1] for n in tbl.schema.names]
+    if len(set(new)) != len(new):
+        return tbl
+    return tbl.rename_columns(new)
+
+
+def _gen_id() -> str:
+    """7-char alphanumeric id (reference: task_manager.rs:544-551)."""
+    import random
+    import string
+
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=7))

@@ -1,0 +1,48 @@
+"""Unified error hierarchy for the framework.
+
+Counterpart of the reference's ``BallistaError`` enum
+(``ballista/rust/core/src/error.rs:35-51`` in /root/reference), redesigned as a
+Python exception tree instead of a Rust enum.
+"""
+
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base class for all framework errors."""
+
+
+class PlanError(BallistaError):
+    """Logical/physical planning failed."""
+
+
+class SqlError(PlanError):
+    """SQL parse or analysis error."""
+
+
+class NotImplementedYet(BallistaError):
+    """Feature recognized but not supported yet."""
+
+
+class ExecutionError(BallistaError):
+    """Runtime failure while executing an operator."""
+
+
+class SerdeError(BallistaError):
+    """Plan (de)serialization failure."""
+
+
+class SchedulerError(BallistaError):
+    """Scheduler-side state machine failure."""
+
+
+class ConfigError(BallistaError):
+    """Invalid configuration value."""
+
+
+class Cancelled(BallistaError):
+    """Task was cancelled."""
+
+
+class InternalError(BallistaError):
+    """Invariant violation — a bug in the framework."""

@@ -1,0 +1,332 @@
+"""Hash aggregation with Partial/Final split.
+
+Counterpart of DataFusion's ``AggregateExec`` with ``AggregateMode`` as
+serialized by the reference (``core/proto/ballista.proto:316-320``): the
+Partial stage computes per-partition accumulator states, a shuffle hashes
+rows by group key, and the Final stage merges states.  This split is exactly
+what lets the TPU path reduce partials with ``psum`` across chips
+(SURVEY.md §2.5) before the shuffle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..errors import ExecutionError
+from .expressions import PhysicalExpr
+from .operators import ExecutionPlan, Partitioning, TaskContext
+
+PARTIAL = "partial"
+FINAL = "final"
+SINGLE = "single"
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    func: str  # sum | avg | min | max | count | count_distinct
+    arg: Optional[PhysicalExpr]  # None for count(*)
+    name: str  # output column name
+    out_type: pa.DataType
+
+    def state_fields(self) -> list[pa.Field]:
+        """Partial-state columns this aggregate contributes."""
+        if self.func == "avg":
+            return [
+                pa.field(f"{self.name}#sum", pa.float64()),
+                pa.field(f"{self.name}#count", pa.int64()),
+            ]
+        if self.func in ("count", "count_distinct"):
+            return [pa.field(self.name, pa.int64())]
+        if self.func == "sum":
+            t = self.out_type
+            return [pa.field(self.name, t)]
+        return [pa.field(self.name, self.out_type)]  # min / max
+
+
+class HashAggregateExec(ExecutionPlan):
+    def __init__(
+        self,
+        mode: str,
+        group_exprs: list[tuple[PhysicalExpr, str]],
+        aggs: list[AggSpec],
+        input: ExecutionPlan,
+    ):
+        super().__init__()
+        assert mode in (PARTIAL, FINAL, SINGLE)
+        self.mode = mode
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.input = input
+        in_schema = input.schema
+        gfields = []
+        for e, name in group_exprs:
+            from .operators import _infer_type
+
+            gfields.append(pa.field(name, _infer_type(e, in_schema), True))
+        if mode == PARTIAL:
+            afields = [f for a in aggs for f in a.state_fields()]
+        else:
+            afields = [pa.field(a.name, a.out_type, True) for a in aggs]
+        self._schema = pa.schema(gfields + afields)
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return self.input.output_partitioning()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return HashAggregateExec(self.mode, self.group_exprs, self.aggs, children[0])
+
+    def __str__(self) -> str:
+        return (
+            f"HashAggregateExec: mode={self.mode}, "
+            f"gby=[{', '.join(n for _, n in self.group_exprs)}], "
+            f"aggr=[{', '.join(a.name for a in self.aggs)}]"
+        )
+
+    # ------------------------------------------------------------ execution
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        batches = list(self.input.execute(partition, ctx))
+        with self.metrics.timer("agg_time_ns"):
+            if self.mode == FINAL:
+                out = self._execute_final(batches)
+            else:
+                out = self._execute_partial_or_single(batches)
+        self.metrics.add("output_rows", out.num_rows)
+        for b in out.to_batches(max_chunksize=ctx.batch_size):
+            yield b
+
+    def _prepared_table(self, batches: list[pa.RecordBatch]) -> Optional[pa.Table]:
+        """Evaluate group + arg exprs into a flat table g0..gk, a0..am."""
+        if not batches:
+            return None
+        cols: dict[str, pa.ChunkedArray] = {}
+        for i, (e, name) in enumerate(self.group_exprs):
+            cols[f"__g{i}"] = pa.chunked_array(
+                [_as_array(e.evaluate(b), b.num_rows) for b in batches]
+            )
+        for j, a in enumerate(self.aggs):
+            if a.arg is not None:
+                cols[f"__a{j}"] = pa.chunked_array(
+                    [_as_array(a.arg.evaluate(b), b.num_rows) for b in batches]
+                )
+        if not cols:  # count(*) with no groups
+            return pa.table({"__dummy": pa.array([0] * sum(b.num_rows for b in batches))})
+        return pa.table(cols)
+
+    def _execute_partial_or_single(self, batches: list[pa.RecordBatch]) -> pa.Table:
+        tbl = self._prepared_table(batches)
+        n_groups = len(self.group_exprs)
+        partial = self.mode == PARTIAL
+
+        if tbl is None or tbl.num_rows == 0:
+            if n_groups == 0:
+                return self._empty_global_result(partial)
+            return pa.Table.from_batches([], schema=self._schema)
+
+        if n_groups == 0:
+            return self._global_agg(tbl, partial)
+
+        gkeys = [f"__g{i}" for i in range(n_groups)]
+        agg_requests: list[tuple[str, str]] = []
+        out_names: list[str] = []
+        for j, a in enumerate(self.aggs):
+            src = f"__a{j}"
+            if a.func == "sum":
+                agg_requests.append((src, "sum"))
+                out_names.append(a.name)
+            elif a.func == "avg":
+                if partial:
+                    agg_requests.append((src, "sum"))
+                    out_names.append(f"{a.name}#sum")
+                    agg_requests.append((src, "count"))
+                    out_names.append(f"{a.name}#count")
+                else:
+                    agg_requests.append((src, "mean"))
+                    out_names.append(a.name)
+            elif a.func == "min":
+                agg_requests.append((src, "min"))
+                out_names.append(a.name)
+            elif a.func == "max":
+                agg_requests.append((src, "max"))
+                out_names.append(a.name)
+            elif a.func == "count":
+                if a.arg is None:
+                    # count(*) counts rows including nulls in the key column
+                    agg_requests.append(
+                        (gkeys[0], "count", pc.CountOptions(mode="all"))
+                    )
+                else:
+                    agg_requests.append((src, "count"))
+                out_names.append(a.name)
+            elif a.func == "count_distinct":
+                if partial:
+                    raise ExecutionError(
+                        "count_distinct must run single-stage after key repartition"
+                    )
+                agg_requests.append((src, "count_distinct"))
+                out_names.append(a.name)
+            else:
+                raise ExecutionError(f"unsupported aggregate {a.func}")
+
+        result = pa.TableGroupBy(tbl, gkeys).aggregate(agg_requests)
+        # group_by output columns are named "<src>_<func>", keys keep names
+        out_cols: list[pa.ChunkedArray] = []
+        fields = list(self._schema)
+        for i in range(len(self.group_exprs)):
+            out_cols.append(result.column(f"__g{i}"))
+        for req, f in zip(agg_requests, fields[len(self.group_exprs):]):
+            src, func = req[0], req[1]
+            col = result.column(f"{src}_{func}")
+            if not col.type.equals(f.type):
+                col = pc.cast(col, f.type, safe=False)
+            out_cols.append(col)
+        return pa.Table.from_arrays(out_cols, schema=self._schema)
+
+    def _global_agg(self, tbl: pa.Table, partial: bool) -> pa.Table:
+        cols: list[pa.Array] = []
+        for j, a in enumerate(self.aggs):
+            src = tbl.column(f"__a{j}") if a.arg is not None else None
+            if a.func == "sum":
+                v = pc.sum(src)
+                cols.append(_scalar_col(v, self._field_for(a.name).type))
+            elif a.func == "avg":
+                if partial:
+                    cols.append(_scalar_col(pc.sum(src), pa.float64()))
+                    cols.append(_scalar_col(pc.count(src), pa.int64()))
+                else:
+                    cols.append(_scalar_col(pc.mean(src), pa.float64()))
+            elif a.func == "min":
+                cols.append(_scalar_col(pc.min(src), self._field_for(a.name).type))
+            elif a.func == "max":
+                cols.append(_scalar_col(pc.max(src), self._field_for(a.name).type))
+            elif a.func == "count":
+                n = tbl.num_rows if a.arg is None else pc.count(src).as_py()
+                cols.append(pa.array([n], pa.int64()))
+            elif a.func == "count_distinct":
+                cols.append(
+                    pa.array([pc.count_distinct(src).as_py()], pa.int64())
+                )
+            else:
+                raise ExecutionError(f"unsupported aggregate {a.func}")
+        return pa.Table.from_arrays(cols, schema=self._schema)
+
+    def _field_for(self, name: str) -> pa.Field:
+        return self._schema.field(name)
+
+    def _empty_global_result(self, partial: bool) -> pa.Table:
+        """Zero-row input, no GROUP BY → one row: counts 0, everything else
+        NULL (SQL semantics for global aggregates over empty input)."""
+        count_fields = set()
+        for a in self.aggs:
+            if a.func in ("count", "count_distinct"):
+                count_fields.add(a.name)
+            if a.func == "avg" and partial:
+                count_fields.add(f"{a.name}#count")
+        cols = []
+        for f in self._schema:
+            if f.name in count_fields:
+                cols.append(pa.array([0], f.type))
+            else:
+                cols.append(pa.nulls(1, f.type))
+        return pa.Table.from_arrays(cols, schema=self._schema)
+
+    def _execute_final(self, batches: list[pa.RecordBatch]) -> pa.Table:
+        """Merge partial states (input schema = partial output schema)."""
+        n_groups = len(self.group_exprs)
+        in_schema = self.input.schema
+        if not batches:
+            if n_groups == 0:
+                return self._empty_global_result(False)
+            return pa.Table.from_batches([], schema=self._schema)
+        tbl = pa.Table.from_batches(batches, schema=in_schema)
+        gkeys = [in_schema.field(i).name for i in range(n_groups)]
+
+        if n_groups == 0:
+            cols = []
+            for a in self.aggs:
+                if a.func == "avg":
+                    s = pc.sum(tbl.column(f"{a.name}#sum")).as_py() or 0.0
+                    c = pc.sum(tbl.column(f"{a.name}#count")).as_py() or 0
+                    cols.append(pa.array([s / c if c else None], pa.float64()))
+                elif a.func in ("count", "count_distinct"):
+                    cols.append(_scalar_col(pc.sum(tbl.column(a.name)), pa.int64()))
+                elif a.func == "sum":
+                    cols.append(
+                        _scalar_col(pc.sum(tbl.column(a.name)), self._field_for(a.name).type)
+                    )
+                elif a.func == "min":
+                    cols.append(
+                        _scalar_col(pc.min(tbl.column(a.name)), self._field_for(a.name).type)
+                    )
+                elif a.func == "max":
+                    cols.append(
+                        _scalar_col(pc.max(tbl.column(a.name)), self._field_for(a.name).type)
+                    )
+                else:
+                    raise ExecutionError(f"unsupported aggregate {a.func}")
+            return pa.Table.from_arrays(cols, schema=self._schema)
+
+        agg_requests: list[tuple[str, str]] = []
+        for a in self.aggs:
+            if a.func == "avg":
+                agg_requests.append((f"{a.name}#sum", "sum"))
+                agg_requests.append((f"{a.name}#count", "sum"))
+            elif a.func in ("count", "count_distinct"):
+                agg_requests.append((a.name, "sum"))
+            elif a.func == "sum":
+                agg_requests.append((a.name, "sum"))
+            elif a.func == "min":
+                agg_requests.append((a.name, "min"))
+            elif a.func == "max":
+                agg_requests.append((a.name, "max"))
+            else:
+                raise ExecutionError(f"unsupported aggregate {a.func}")
+        result = pa.TableGroupBy(tbl, gkeys).aggregate(agg_requests)
+
+        out_cols: list = []
+        for g in gkeys:
+            out_cols.append(result.column(g))
+        # merged columns are named "<src>_<func>"
+        for a in self.aggs:
+            f = self._field_for(a.name)
+            if a.func == "avg":
+                s = result.column(f"{a.name}#sum_sum")
+                c = result.column(f"{a.name}#count_sum")
+                col = pc.divide(pc.cast(s, pa.float64()), pc.cast(c, pa.float64()))
+            elif a.func in ("count", "count_distinct"):
+                col = result.column(f"{a.name}_sum")
+            elif a.func == "sum":
+                col = result.column(f"{a.name}_sum")
+            elif a.func == "min":
+                col = result.column(f"{a.name}_min")
+            else:
+                col = result.column(f"{a.name}_max")
+            if not col.type.equals(f.type):
+                col = pc.cast(col, f.type, safe=False)
+            out_cols.append(col)
+        return pa.Table.from_arrays(out_cols, schema=self._schema)
+
+
+def _as_array(v, n: int) -> pa.Array:
+    if isinstance(v, pa.ChunkedArray):
+        return v.combine_chunks()
+    if isinstance(v, pa.Scalar):
+        return pa.array([v.as_py()] * n, v.type)
+    if isinstance(v, pa.Array):
+        return v
+    return pa.array([v] * n)
+
+
+def _scalar_col(s: pa.Scalar, t: pa.DataType) -> pa.Array:
+    v = s.as_py()
+    return pa.array([v], t)

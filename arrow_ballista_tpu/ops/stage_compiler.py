@@ -1,0 +1,6 @@
+"""TPU stage compiler (placeholder wired from SessionContext; real
+implementation lands with ops/kernels.py)."""
+
+
+def maybe_accelerate(plan, config):
+    return plan

@@ -1,0 +1,450 @@
+"""AST → logical plan builder (name resolution & analysis).
+
+Counterpart of DataFusion's SQL planner as used by the reference's
+``BallistaContext::sql`` (``client/src/context.rs:346-460``).  Resolves table
+names against the catalog, extracts aggregates out of SELECT/HAVING/ORDER BY,
+decorrelates ``IN (subquery)`` into semi/anti joins, and plans uncorrelated
+scalar subqueries as :class:`~..plan.expressions.ScalarSubqueryExpr`.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Optional
+
+import pyarrow as pa
+
+from ..catalog import Catalog
+from ..errors import NotImplementedYet, PlanError, SqlError
+from ..sql import ast
+from . import expressions as ex
+from . import logical as lp
+
+
+def sql_type_to_arrow(name: str) -> pa.DataType:
+    n = name.strip().upper()
+    base = n.split("(")[0].strip()
+    if base in ("INT", "INTEGER"):
+        return pa.int32()
+    if base in ("BIGINT", "LONG"):
+        return pa.int64()
+    if base == "SMALLINT":
+        return pa.int16()
+    if base == "TINYINT":
+        return pa.int8()
+    if base in ("FLOAT", "REAL"):
+        return pa.float32()
+    if base in ("DOUBLE", "DOUBLE PRECISION"):
+        return pa.float64()
+    if base in ("DECIMAL", "NUMERIC"):
+        # decimals execute as float64 on the TPU path (MXU/VPU have no
+        # decimal unit); precision-sensitive users can cast explicitly
+        return pa.float64()
+    if base in ("VARCHAR", "CHAR", "TEXT", "STRING"):
+        return pa.string()
+    if base in ("BOOLEAN", "BOOL"):
+        return pa.bool_()
+    if base == "DATE":
+        return pa.date32()
+    if base in ("TIMESTAMP", "DATETIME"):
+        return pa.timestamp("us")
+    raise SqlError(f"unsupported SQL type {name!r}")
+
+
+_INTERVAL_UNIT_MONTHS = {"YEAR": 12, "MONTH": 1}
+_INTERVAL_UNIT_DAYS = {"DAY": 1, "WEEK": 7}
+
+
+def _split_conjuncts(e: ast.SqlExpr) -> list[ast.SqlExpr]:
+    if isinstance(e, ast.Binary) and e.op == "AND":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _conjoin(exprs: list[ex.Expr]) -> Optional[ex.Expr]:
+    if not exprs:
+        return None
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = ex.BinaryExpr(out, "AND", e)
+    return out
+
+
+class PlanBuilder:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    # ------------------------------------------------------------- queries
+    def build_query(self, q: ast.Query) -> lp.LogicalPlan:
+        # FROM
+        if q.from_:
+            plan = self._plan_table_ref(q.from_[0])
+            for ref in q.from_[1:]:
+                plan = lp.CrossJoin(plan, self._plan_table_ref(ref))
+        else:
+            plan = lp.EmptyRelation(produce_one_row=True)
+
+        # WHERE — peel IN/EXISTS-subquery conjuncts into semi/anti joins
+        if q.where is not None:
+            plain: list[ex.Expr] = []
+            for conj in _split_conjuncts(q.where):
+                if isinstance(conj, ast.InSubquery):
+                    plan = self._plan_in_subquery(plan, conj)
+                elif isinstance(conj, ast.Exists):
+                    raise NotImplementedYet(
+                        "correlated EXISTS subqueries (TPC-H q4/q21/q22) not yet supported"
+                    )
+                else:
+                    plain.append(self._expr(conj, plan.schema))
+            pred = _conjoin(plain)
+            if pred is not None:
+                plan = lp.Filter(pred, plan)
+
+        in_schema = plan.schema
+
+        # SELECT list with * expansion
+        select_exprs: list[ex.Expr] = []
+        for item in q.select:
+            if isinstance(item.expr, ast.Star):
+                qual = item.expr.qualifier
+                for f in in_schema:
+                    parts = f.name.split(".")
+                    if qual is None or (len(parts) == 2 and parts[0] == qual):
+                        select_exprs.append(
+                            ex.Column(parts[-1], parts[0] if len(parts) == 2 else None)
+                        )
+            else:
+                e = self._expr(item.expr, in_schema)
+                if item.alias:
+                    e = ex.Alias(e, item.alias)
+                select_exprs.append(e)
+
+        alias_map = {e.name: e for e in select_exprs}
+
+        # GROUP BY (supports ordinals and select aliases)
+        group_exprs: list[ex.Expr] = []
+        for g in q.group_by:
+            if isinstance(g, ast.NumberLit):
+                idx = int(g.value) - 1
+                if idx < 0 or idx >= len(select_exprs):
+                    raise SqlError(f"GROUP BY position {g.value} out of range")
+                ge = select_exprs[idx]
+                ge = ge.expr if isinstance(ge, ex.Alias) else ge
+            else:
+                ge = self._expr(g, in_schema, alias_map)
+            group_exprs.append(ge)
+
+        # aggregates appearing anywhere in select / having / order by
+        agg_exprs: list[ex.AggregateExpr] = []
+
+        def _collect(e: ex.Expr) -> None:
+            for a in ex.find_aggregates(e):
+                if not any(str(a) == str(b) for b in agg_exprs):
+                    agg_exprs.append(a)
+
+        for e in select_exprs:
+            _collect(e)
+        having_expr = (
+            self._expr(q.having, in_schema, alias_map) if q.having is not None else None
+        )
+        if having_expr is not None:
+            _collect(having_expr)
+        order_exprs: list[ex.SortExpr] = []
+        for oi in q.order_by:
+            if isinstance(oi.expr, ast.NumberLit):
+                idx = int(oi.expr.value) - 1
+                if idx < 0 or idx >= len(select_exprs):
+                    raise SqlError(f"ORDER BY position {oi.expr.value} out of range")
+                base = select_exprs[idx]
+                base = base.expr if isinstance(base, ex.Alias) else base
+            else:
+                base = self._expr(oi.expr, in_schema, alias_map)
+            _collect(base)
+            order_exprs.append(ex.SortExpr(base, oi.asc, oi.nulls_first))
+
+        if group_exprs or agg_exprs:
+            plan = lp.Aggregate(group_exprs, list(agg_exprs), plan)
+            agg_schema = plan.schema
+
+            # rewrite select/having/order exprs: aggregate and group-expr
+            # occurrences become column refs into the aggregate output
+            rewrite_map: dict[str, str] = {}
+            for i, g in enumerate(group_exprs):
+                rewrite_map[str(g)] = agg_schema.field(i).name
+            for j, a in enumerate(agg_exprs):
+                rewrite_map[str(a)] = agg_schema.field(len(group_exprs) + j).name
+
+            def _rw(e: ex.Expr) -> ex.Expr:
+                def fn(node: ex.Expr) -> ex.Expr:
+                    key = str(node)
+                    if key in rewrite_map and not isinstance(node, ex.Column):
+                        return ex.col(rewrite_map[key])
+                    return node
+
+                return ex.transform(e, fn)
+
+            select_exprs = [
+                ex.Alias(_rw(e.expr), e.alias_name) if isinstance(e, ex.Alias) else _rw(e)
+                for e in select_exprs
+            ]
+            # validate: non-aggregate select exprs must be grouping exprs
+            for e in select_exprs:
+                inner = e.expr if isinstance(e, ex.Alias) else e
+                for c in ex.find_columns(inner):
+                    try:
+                        c.resolve_index(agg_schema)
+                    except PlanError as err:
+                        raise PlanError(
+                            f"expression {e} is neither aggregated nor grouped"
+                        ) from err
+            if having_expr is not None:
+                plan = lp.Filter(_rw(having_expr), plan)
+            order_exprs = [
+                ex.SortExpr(_rw(s.expr), s.asc, s.nulls_first) for s in order_exprs
+            ]
+
+        plan = lp.Projection(select_exprs, plan)
+
+        if q.distinct:
+            plan = lp.Distinct(plan)
+
+        if order_exprs:
+            # a top-k sort may keep at most limit+offset rows — the Limit
+            # above still applies the skip
+            topk = (q.limit + (q.offset or 0)) if q.limit is not None else None
+            # resolve sort keys against projection output where possible;
+            # otherwise extend the projection, sort, and re-project
+            proj_schema = plan.schema
+            missing: list[ex.Expr] = []
+            resolved: list[ex.SortExpr] = []
+            for s in order_exprs:
+                try:
+                    s.expr.data_type(proj_schema)
+                    resolved.append(s)
+                except PlanError:
+                    missing.append(s.expr)
+                    # downstream of the widened projection the computed sort
+                    # key exists as a named column — reference it by name
+                    resolved.append(ex.SortExpr(ex.col(s.expr.name), s.asc, s.nulls_first))
+            if missing and isinstance(plan, lp.Projection):
+                wide = lp.Projection(plan.exprs + missing, plan.input)
+                keep = [f.name for f in proj_schema]
+                plan = lp.Projection(
+                    [ex.col(n) for n in keep], lp.Sort(resolved, wide, fetch=topk)
+                )
+            else:
+                plan = lp.Sort(resolved, plan, fetch=topk)
+
+        if q.limit is not None or q.offset is not None:
+            plan = lp.Limit(plan, q.offset or 0, q.limit)
+        return plan
+
+    # ----------------------------------------------------------- table refs
+    def _plan_table_ref(self, ref: ast.TableRef) -> lp.LogicalPlan:
+        if isinstance(ref, ast.NamedTable):
+            provider = self.catalog.get(ref.name)
+            scan = lp.TableScan(ref.name, provider)
+            if ref.alias and ref.alias != ref.name:
+                return lp.SubqueryAlias(scan, ref.alias)
+            return scan
+        if isinstance(ref, ast.DerivedTable):
+            sub = self.build_query(ref.query)
+            return lp.SubqueryAlias(sub, ref.alias)
+        if isinstance(ref, ast.JoinClause):
+            left = self._plan_table_ref(ref.left)
+            right = self._plan_table_ref(ref.right)
+            if ref.kind == "CROSS":
+                return lp.CrossJoin(left, right)
+            schema = pa.schema(list(left.schema) + list(right.schema))
+            on_pairs, residual = self._extract_equijoin(
+                ref.on, left.schema, right.schema, schema
+            )
+            if not on_pairs:
+                raise NotImplementedYet("non-equi joins require an equality condition")
+            jt = ref.kind.lower()
+            return lp.Join(left, right, on_pairs, jt, residual)
+        raise PlanError(f"unhandled table ref {ref}")
+
+    def _extract_equijoin(
+        self,
+        on: Optional[ast.SqlExpr],
+        left_schema: pa.Schema,
+        right_schema: pa.Schema,
+        joint: pa.Schema,
+    ) -> tuple[list[tuple[ex.Column, ex.Column]], Optional[ex.Expr]]:
+        pairs: list[tuple[ex.Column, ex.Column]] = []
+        residual: list[ex.Expr] = []
+        if on is None:
+            return pairs, None
+        for conj in _split_conjuncts(on):
+            done = False
+            if isinstance(conj, ast.Binary) and conj.op == "=":
+                l = self._expr(conj.left, joint)
+                r = self._expr(conj.right, joint)
+                if isinstance(l, ex.Column) and isinstance(r, ex.Column):
+                    l_in_left = _column_in(l, left_schema)
+                    r_in_left = _column_in(r, left_schema)
+                    if l_in_left and not r_in_left:
+                        pairs.append((l, r))
+                        done = True
+                    elif r_in_left and not l_in_left:
+                        pairs.append((r, l))
+                        done = True
+            if not done:
+                residual.append(self._expr(conj, joint))
+        return pairs, _conjoin(residual)
+
+    def _plan_in_subquery(
+        self, plan: lp.LogicalPlan, conj: ast.InSubquery
+    ) -> lp.LogicalPlan:
+        sub = self.build_query(conj.query)
+        if len(sub.schema) != 1:
+            raise SqlError("IN subquery must return one column")
+        left_key = self._expr(conj.operand, plan.schema)
+        if not isinstance(left_key, ex.Column):
+            raise NotImplementedYet("IN subquery on computed expressions")
+        right_field = sub.schema.field(0).name
+        right_key = ex.col(right_field)
+        jt = "anti" if conj.negated else "semi"
+        return lp.Join(plan, sub, [(left_key, right_key)], jt, None)
+
+    # ---------------------------------------------------------- expressions
+    def _expr(
+        self,
+        e: ast.SqlExpr,
+        schema: pa.Schema,
+        alias_map: Optional[dict[str, ex.Expr]] = None,
+    ) -> ex.Expr:
+        if isinstance(e, ast.ColumnRef):
+            c = ex.Column(e.name, e.qualifier)
+            try:
+                c.resolve_index(schema)
+                return c
+            except PlanError:
+                if alias_map and e.qualifier is None and e.name in alias_map:
+                    a = alias_map[e.name]
+                    return a.expr if isinstance(a, ex.Alias) else a
+                raise
+        if isinstance(e, ast.NumberLit):
+            if "." in e.value or "e" in e.value.lower():
+                return ex.lit(float(e.value))
+            return ex.lit(int(e.value))
+        if isinstance(e, ast.StringLit):
+            return ex.lit(e.value)
+        if isinstance(e, ast.BoolLit):
+            return ex.lit(e.value)
+        if isinstance(e, ast.NullLit):
+            return ex.lit(None)
+        if isinstance(e, ast.DateLit):
+            try:
+                return ex.lit(_dt.date.fromisoformat(e.value))
+            except ValueError as err:
+                raise SqlError(f"bad date literal {e.value!r}") from err
+        if isinstance(e, ast.IntervalLit):
+            amount = int(float(e.value))
+            if e.unit in _INTERVAL_UNIT_MONTHS:
+                return ex.IntervalLiteral(months=amount * _INTERVAL_UNIT_MONTHS[e.unit])
+            if e.unit in _INTERVAL_UNIT_DAYS:
+                return ex.IntervalLiteral(days=amount * _INTERVAL_UNIT_DAYS[e.unit])
+            raise NotImplementedYet(f"interval unit {e.unit}")
+        if isinstance(e, ast.Binary):
+            if e.op in ("AND", "OR"):
+                return ex.BinaryExpr(
+                    self._expr(e.left, schema, alias_map),
+                    e.op,
+                    self._expr(e.right, schema, alias_map),
+                )
+            return ex.BinaryExpr(
+                self._expr(e.left, schema, alias_map),
+                e.op,
+                self._expr(e.right, schema, alias_map),
+            )
+        if isinstance(e, ast.Unary):
+            if e.op == "NOT":
+                return ex.NotExpr(self._expr(e.operand, schema, alias_map))
+            inner = self._expr(e.operand, schema, alias_map)
+            if isinstance(inner, ex.Literal) and isinstance(inner.value, (int, float)):
+                return ex.Literal(-inner.value, inner.dtype)
+            return ex.NegativeExpr(inner)
+        if isinstance(e, ast.IsNull):
+            return ex.IsNullExpr(self._expr(e.operand, schema, alias_map), e.negated)
+        if isinstance(e, ast.Between):
+            return ex.BetweenExpr(
+                self._expr(e.operand, schema, alias_map),
+                self._expr(e.low, schema, alias_map),
+                self._expr(e.high, schema, alias_map),
+                e.negated,
+            )
+        if isinstance(e, ast.InList):
+            return ex.InListExpr(
+                self._expr(e.operand, schema, alias_map),
+                tuple(self._expr(i, schema, alias_map) for i in e.items),
+                e.negated,
+            )
+        if isinstance(e, ast.Like):
+            return ex.LikeExpr(
+                self._expr(e.operand, schema, alias_map),
+                self._expr(e.pattern, schema, alias_map),
+                e.negated,
+            )
+        if isinstance(e, ast.Case):
+            return ex.CaseExpr(
+                self._expr(e.operand, schema, alias_map) if e.operand else None,
+                tuple(
+                    (self._expr(w, schema, alias_map), self._expr(t, schema, alias_map))
+                    for w, t in e.whens
+                ),
+                self._expr(e.else_expr, schema, alias_map) if e.else_expr else None,
+            )
+        if isinstance(e, ast.CastExpr):
+            return ex.CastExpr(
+                self._expr(e.operand, schema, alias_map), sql_type_to_arrow(e.type_name)
+            )
+        if isinstance(e, ast.Extract):
+            return ex.ScalarFunction(
+                "date_part",
+                (ex.lit(e.field.lower()), self._expr(e.operand, schema, alias_map)),
+            )
+        if isinstance(e, ast.Substring):
+            args = [
+                self._expr(e.operand, schema, alias_map),
+                self._expr(e.start, schema, alias_map),
+            ]
+            if e.length is not None:
+                args.append(self._expr(e.length, schema, alias_map))
+            return ex.ScalarFunction("substr", tuple(args))
+        if isinstance(e, ast.FunctionCall):
+            fname = e.name
+            if fname == "count" and e.distinct:
+                fname = "count_distinct"
+            if fname in ex.AGGREGATE_FUNCTIONS:
+                if len(e.args) == 1 and isinstance(e.args[0], ast.Star):
+                    return ex.AggregateExpr(fname, None, e.distinct)
+                if len(e.args) != 1:
+                    raise SqlError(f"{fname} takes one argument")
+                return ex.AggregateExpr(
+                    fname, self._expr(e.args[0], schema, alias_map), e.distinct
+                )
+            if fname in ex.SCALAR_FUNCTIONS:
+                return ex.ScalarFunction(
+                    fname, tuple(self._expr(a, schema, alias_map) for a in e.args)
+                )
+            raise SqlError(f"unknown function {fname!r}")
+        if isinstance(e, ast.ScalarSubquery):
+            sub = self.build_query(e.query)
+            if len(sub.schema) != 1:
+                raise SqlError("scalar subquery must return one column")
+            return ex.ScalarSubqueryExpr(sub)
+        if isinstance(e, ast.Exists):
+            raise NotImplementedYet("EXISTS outside of top-level WHERE conjunct")
+        if isinstance(e, ast.Star):
+            raise SqlError("* not allowed here")
+        raise PlanError(f"unhandled AST expression {e}")
+
+
+def _column_in(c: ex.Column, schema: pa.Schema) -> bool:
+    try:
+        c.resolve_index(schema)
+        return True
+    except PlanError:
+        return False

@@ -1,0 +1,272 @@
+"""Single-process engine: operators, aggregates, joins, TPC-H CPU answers."""
+
+import datetime as dt
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import SessionContext, col, lit
+
+
+@pytest.fixture()
+def simple_ctx():
+    ctx = SessionContext()
+    tbl = pa.table(
+        {
+            "a": pa.array([1, 2, 3, 4, 5], pa.int64()),
+            "b": pa.array([1.0, 2.5, 3.0, 4.5, 5.0], pa.float64()),
+            "c": pa.array(["x", "y", "x", "y", "x"], pa.string()),
+            "d": pa.array(
+                [dt.date(2020, 1, i + 1) for i in range(5)], pa.date32()
+            ),
+        }
+    )
+    ctx.register_arrow_table("t", tbl, partitions=2)
+    return ctx
+
+
+def test_select_filter(simple_ctx):
+    out = simple_ctx.sql("select a, b from t where a > 2").collect()
+    assert out.column("a").to_pylist() == [3, 4, 5]
+
+
+def test_projection_arithmetic(simple_ctx):
+    out = simple_ctx.sql("select a * 2 + 1 as x from t where c = 'x'").collect()
+    assert out.column("x").to_pylist() == [3, 7, 11]
+
+
+def test_aggregate_group_by(simple_ctx):
+    out = (
+        simple_ctx.sql(
+            "select c, sum(b) as s, count(*) as n, avg(a) as m from t group by c order by c"
+        ).collect()
+    )
+    assert out.column("c").to_pylist() == ["x", "y"]
+    assert out.column("s").to_pylist() == [pytest.approx(9.0), pytest.approx(7.0)]
+    assert out.column("n").to_pylist() == [3, 2]
+    assert out.column("m").to_pylist() == [pytest.approx(3.0), pytest.approx(3.0)]
+
+
+def test_aggregate_no_groups(simple_ctx):
+    out = simple_ctx.sql("select sum(a) as s, min(b) as lo, max(b) as hi from t").collect()
+    assert out.column("s").to_pylist() == [15]
+    assert out.column("lo").to_pylist() == [1.0]
+    assert out.column("hi").to_pylist() == [5.0]
+
+
+def test_count_distinct(simple_ctx):
+    out = simple_ctx.sql("select count(distinct c) as n from t").collect()
+    assert out.column("n").to_pylist() == [2]
+
+
+def test_order_by_limit(simple_ctx):
+    out = simple_ctx.sql("select a from t order by a desc limit 2").collect()
+    assert out.column("a").to_pylist() == [5, 4]
+
+
+def test_case_when(simple_ctx):
+    out = simple_ctx.sql(
+        "select sum(case when c = 'x' then 1 else 0 end) as nx from t"
+    ).collect()
+    assert out.column("nx").to_pylist() == [3]
+
+
+def test_date_filter(simple_ctx):
+    out = simple_ctx.sql(
+        "select count(*) as n from t where d >= date '2020-01-03'"
+    ).collect()
+    assert out.column("n").to_pylist() == [3]
+
+
+def test_distinct(simple_ctx):
+    out = simple_ctx.sql("select distinct c from t order by c").collect()
+    assert out.column("c").to_pylist() == ["x", "y"]
+
+
+def test_dataframe_api(simple_ctx):
+    df = (
+        simple_ctx.table("t")
+        .filter(col("a") > lit(1))
+        .select(col("a"), (col("b") * lit(2.0)).alias("b2"))
+        .sort(col("a").sort(asc=False))
+        .limit(2)
+    )
+    out = df.collect()
+    assert out.column("a").to_pylist() == [5, 4]
+    assert out.column("b2").to_pylist() == [10.0, 9.0]
+
+
+def test_join_inner():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "l", pa.table({"id": pa.array([1, 2, 3], pa.int64()), "v": ["a", "b", "c"]})
+    )
+    ctx.register_arrow_table(
+        "r", pa.table({"rid": pa.array([2, 3, 4], pa.int64()), "w": ["B", "C", "D"]})
+    )
+    out = ctx.sql(
+        "select v, w from l join r on id = rid order by v"
+    ).collect()
+    assert out.column("v").to_pylist() == ["b", "c"]
+    assert out.column("w").to_pylist() == ["B", "C"]
+
+
+def test_join_left_outer():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "l", pa.table({"id": pa.array([1, 2], pa.int64()), "v": ["a", "b"]})
+    )
+    ctx.register_arrow_table(
+        "r", pa.table({"rid": pa.array([2], pa.int64()), "w": ["B"]})
+    )
+    out = ctx.sql(
+        "select id, w from l left join r on id = rid order by id"
+    ).collect()
+    assert out.column("id").to_pylist() == [1, 2]
+    assert out.column("w").to_pylist() == [None, "B"]
+
+
+def test_semi_join_via_in_subquery():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "l", pa.table({"id": pa.array([1, 2, 3], pa.int64())})
+    )
+    ctx.register_arrow_table(
+        "r", pa.table({"rid": pa.array([2, 2, 3], pa.int64())})
+    )
+    out = ctx.sql(
+        "select id from l where id in (select rid from r) order by id"
+    ).collect()
+    assert out.column("id").to_pylist() == [2, 3]
+    out = ctx.sql(
+        "select id from l where id not in (select rid from r)"
+    ).collect()
+    assert out.column("id").to_pylist() == [1]
+
+
+def test_scalar_subquery():
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"a": pa.array([1.0, 2.0, 3.0, 4.0])}))
+    out = ctx.sql(
+        "select a from t where a > (select avg(a) from t) order by a"
+    ).collect()
+    assert out.column("a").to_pylist() == [3.0, 4.0]
+
+
+def test_union(simple_ctx):
+    out = simple_ctx.sql(
+        "select a from t where a < 2"
+    ).union(simple_ctx.sql("select a from t where a > 4")).collect()
+    assert sorted(out.column("a").to_pylist()) == [1, 5]
+
+
+def test_show_and_ddl(tmp_path):
+    ctx = SessionContext()
+    tbl = pa.table({"x": pa.array([1, 2], pa.int64())})
+    import pyarrow.parquet as pq
+
+    pq.write_table(tbl, str(tmp_path / "x.parquet"))
+    ctx.sql(
+        f"CREATE EXTERNAL TABLE px STORED AS PARQUET LOCATION '{tmp_path}/x.parquet'"
+    )
+    names = ctx.sql("SHOW TABLES").collect().column("table_name").to_pylist()
+    assert "px" in names
+    out = ctx.sql("select sum(x) as s from px").collect()
+    assert out.column("s").to_pylist() == [3]
+
+
+# ------------------------------------------------------------------ TPC-H
+def _pandas_q1(lineitem: pa.Table):
+    df = lineitem.to_pandas()
+    cutoff = dt.date(1998, 12, 1) - dt.timedelta(days=90)
+    df = df[df["l_shipdate"] <= cutoff]
+    df["disc_price"] = df["l_extendedprice"] * (1 - df["l_discount"])
+    df["charge"] = df["disc_price"] * (1 + df["l_tax"])
+    g = (
+        df.groupby(["l_returnflag", "l_linestatus"], as_index=False)
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "count"),
+        )
+        .sort_values(["l_returnflag", "l_linestatus"])
+    )
+    return g
+
+
+def test_tpch_q1_matches_pandas(tpch_ctx):
+    from benchmarks.tpch.queries import QUERIES
+
+    out = tpch_ctx.sql(QUERIES[1]).collect().to_pandas()
+    lineitem = pa.Table.from_batches(
+        [b for part in tpch_ctx.catalog.get("lineitem").partitions for b in part]
+    )
+    expected = _pandas_q1(lineitem)
+    assert len(out) == len(expected)
+    for col_ in ["sum_qty", "sum_disc_price", "sum_charge", "avg_disc"]:
+        assert out[col_].to_list() == pytest.approx(expected[col_].to_list(), rel=1e-9)
+    assert out["count_order"].to_list() == expected["count_order"].to_list()
+
+
+def test_tpch_q6_matches_pandas(tpch_ctx):
+    from benchmarks.tpch.queries import QUERIES
+
+    out = tpch_ctx.sql(QUERIES[6]).collect()
+    lineitem = pa.Table.from_batches(
+        [b for part in tpch_ctx.catalog.get("lineitem").partitions for b in part]
+    ).to_pandas()
+    m = (
+        (lineitem["l_shipdate"] >= dt.date(1994, 1, 1))
+        & (lineitem["l_shipdate"] < dt.date(1995, 1, 1))
+        & (lineitem["l_discount"] >= 0.05)
+        & (lineitem["l_discount"] <= 0.07)
+        & (lineitem["l_quantity"] < 24)
+    )
+    expected = (lineitem[m]["l_extendedprice"] * lineitem[m]["l_discount"]).sum()
+    assert out.column("revenue").to_pylist()[0] == pytest.approx(expected, rel=1e-9)
+
+
+@pytest.mark.parametrize("qnum", [3, 5, 10, 12, 14, 19])
+def test_tpch_queries_run(tpch_ctx, qnum):
+    from benchmarks.tpch.queries import QUERIES
+
+    out = tpch_ctx.sql(QUERIES[qnum]).collect()
+    assert out.num_columns > 0
+
+
+def test_tpch_q3_matches_pandas(tpch_ctx):
+    from benchmarks.tpch.queries import QUERIES
+
+    out = tpch_ctx.sql(QUERIES[3]).collect().to_pandas()
+
+    cust = pa.Table.from_batches(
+        [b for p in tpch_ctx.catalog.get("customer").partitions for b in p]
+    ).to_pandas()
+    orders = pa.Table.from_batches(
+        [b for p in tpch_ctx.catalog.get("orders").partitions for b in p]
+    ).to_pandas()
+    li = pa.Table.from_batches(
+        [b for p in tpch_ctx.catalog.get("lineitem").partitions for b in p]
+    ).to_pandas()
+    cust = cust[cust["c_mktsegment"] == "BUILDING"]
+    orders = orders[orders["o_orderdate"] < dt.date(1995, 3, 15)]
+    li = li[li["l_shipdate"] > dt.date(1995, 3, 15)]
+    j = cust.merge(orders, left_on="c_custkey", right_on="o_custkey").merge(
+        li, left_on="o_orderkey", right_on="l_orderkey"
+    )
+    j["revenue"] = j["l_extendedprice"] * (1 - j["l_discount"])
+    g = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"], as_index=False)[
+            "revenue"
+        ]
+        .sum()
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)
+    )
+    assert out["l_orderkey"].to_list() == g["l_orderkey"].to_list()
+    assert out["revenue"].to_list() == pytest.approx(g["revenue"].to_list(), rel=1e-9)

@@ -1,0 +1,107 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import datetime as dt
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+
+
+def _ctx(**settings):
+    cfg = BallistaConfig({k: str(v) for k, v in settings.items()})
+    return SessionContext(cfg)
+
+
+def test_same_named_join_keys_resolve_by_qualifier():
+    ctx = SessionContext()
+    ctx.register_arrow_table("l", pa.table({"k": pa.array([1, 2], pa.int64()), "v": ["a", "b"]}))
+    ctx.register_arrow_table("r", pa.table({"k": pa.array([2, 3], pa.int64()), "w": ["B", "C"]}))
+    out = ctx.sql("select l.v, r.w from l join r on l.k = r.k").collect()
+    assert out.column("v").to_pylist() == ["b"]
+    assert out.column("w").to_pylist() == ["B"]
+
+
+def test_null_group_keys_hash_to_one_partition():
+    ctx = _ctx(**{"ballista.shuffle.partitions": 4})
+    tbl = pa.table(
+        {
+            "g": pa.array(["apple", None, "zebra", None, "apple", None], pa.string()),
+            "v": pa.array([1, 1, 1, 1, 1, 1], pa.int64()),
+        }
+    )
+    ctx.register_arrow_table("t", tbl, partitions=3)
+    out = ctx.sql("select g, sum(v) as s from t group by g order by g nulls last").collect()
+    assert out.column("g").to_pylist() == ["apple", "zebra", None]
+    assert out.column("s").to_pylist() == [2, 1, 3]
+
+
+def test_anti_join_correct_without_repartition():
+    ctx = _ctx(**{"ballista.repartition.joins": "false"})
+    ctx.register_arrow_table("l", pa.table({"k": pa.array([1, 2, 3], pa.int64())}))
+    ctx.register_arrow_table(
+        "r", pa.table({"k": pa.array([1, 1, 2, 2], pa.int64())}), partitions=2
+    )
+    out = ctx.sql("select k from l where k not in (select k from r)").collect()
+    assert out.column("k").to_pylist() == [3]
+
+
+def test_left_join_correct_without_repartition():
+    ctx = _ctx(**{"ballista.repartition.joins": "false"})
+    ctx.register_arrow_table("l", pa.table({"k": pa.array([1, 2], pa.int64())}))
+    ctx.register_arrow_table(
+        "r", pa.table({"rk": pa.array([1, 1], pa.int64()), "w": ["x", "y"]}), partitions=2
+    )
+    out = ctx.sql("select k, w from l left join r on k = rk order by k, w").collect()
+    assert out.column("k").to_pylist() == [1, 1, 2]
+    assert out.column("w").to_pylist() == ["x", "y", None]
+
+
+def test_limit_with_offset_after_sort():
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"x": pa.array(range(1, 21), pa.int64())}))
+    out = ctx.sql("select x from t order by x limit 10 offset 5").collect()
+    assert out.column("x").to_pylist() == list(range(6, 16))
+
+
+def test_grouped_count_star_counts_null_group():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "t", pa.table({"g": pa.array(["a", None, None, "a"], pa.string())})
+    )
+    out = ctx.sql("select g, count(*) as n from t group by g order by g nulls last").collect()
+    assert out.column("n").to_pylist() == [2, 2]
+
+
+def test_empty_input_global_aggregates_are_null():
+    ctx = SessionContext()
+    ctx.register_arrow_table("e", pa.table({"x": pa.array([], pa.int64())}))
+    out = ctx.sql("select min(x) as lo, max(x) as hi, sum(x) as s, count(x) as n from e").collect()
+    assert out.column("lo").to_pylist() == [None]
+    assert out.column("hi").to_pylist() == [None]
+    assert out.column("s").to_pylist() == [None]
+    assert out.column("n").to_pylist() == [0]
+
+
+def test_order_by_computed_unselected_expr():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "t", pa.table({"g": ["a", "b", "c"], "v": pa.array([3, 1, 2], pa.int64())})
+    )
+    out = ctx.sql("select g from t order by v * 2").collect()
+    assert out.column("g").to_pylist() == ["b", "c", "a"]
+    assert out.schema.names == ["g"]
+
+
+def test_date_trunc_subday_keeps_time():
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {"ts": pa.array([dt.datetime(2024, 5, 1, 13, 45, 30)], pa.timestamp("us"))}
+        ),
+    )
+    out = ctx.sql("select date_trunc('hour', ts) as h from t").collect()
+    assert out.column("h").to_pylist() == [dt.datetime(2024, 5, 1, 13, 0, 0)]
+    out2 = ctx.sql("select date_trunc('day', ts) as d from t").collect()
+    assert out2.column("d").to_pylist() == [dt.date(2024, 5, 1)]

@@ -1,0 +1,113 @@
+"""SQL lexer/parser/builder tests."""
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.errors import SqlError
+from arrow_ballista_tpu.sql import ast
+from arrow_ballista_tpu.sql.lexer import TokType, tokenize
+from arrow_ballista_tpu.sql.parser import parse_sql
+
+
+def test_tokenize_basic():
+    toks = tokenize("SELECT a, b FROM t WHERE x >= 1.5 -- comment\n AND y <> 'it''s'")
+    vals = [t.value for t in toks if t.type is not TokType.EOF]
+    assert "SELECT" in vals
+    assert ">=" in vals
+    assert "1.5" in vals
+    assert "it's" in vals
+
+
+def test_tokenize_errors():
+    with pytest.raises(SqlError):
+        tokenize("select 'unterminated")
+
+
+def test_parse_simple_select():
+    q = parse_sql("select a, b as bee from t where a > 3 limit 5")
+    assert isinstance(q, ast.Query)
+    assert len(q.select) == 2
+    assert q.select[1].alias == "bee"
+    assert q.limit == 5
+
+
+def test_parse_joins():
+    q = parse_sql(
+        "select * from a join b on a.x = b.y left join c on b.z = c.z"
+    )
+    j = q.from_[0]
+    assert isinstance(j, ast.JoinClause)
+    assert j.kind == "LEFT"
+    assert isinstance(j.left, ast.JoinClause)
+    assert j.left.kind == "INNER"
+
+
+def test_parse_case_cast_extract():
+    q = parse_sql(
+        "select case when a = 1 then 'x' else 'y' end, cast(b as double), "
+        "extract(year from d) from t"
+    )
+    assert isinstance(q.select[0].expr, ast.Case)
+    assert isinstance(q.select[1].expr, ast.CastExpr)
+    assert isinstance(q.select[2].expr, ast.Extract)
+
+
+def test_parse_date_interval():
+    q = parse_sql(
+        "select 1 from t where d <= date '1998-12-01' - interval '90' day"
+    )
+    w = q.where
+    assert isinstance(w, ast.Binary)
+    assert isinstance(w.right, ast.Binary)
+    assert isinstance(w.right.right, ast.IntervalLit)
+    assert w.right.right.unit == "DAY"
+
+
+def test_parse_in_subquery_and_between():
+    q = parse_sql(
+        "select * from t where x in (select y from u) and z between 1 and 2 "
+        "and w not in ('a', 'b')"
+    )
+    conj = q.where
+    assert isinstance(conj, ast.Binary)
+
+
+def test_parse_create_external_table():
+    s = parse_sql(
+        "CREATE EXTERNAL TABLE lineitem (l_orderkey BIGINT, l_price DECIMAL(12,2)) "
+        "STORED AS CSV WITH HEADER ROW LOCATION '/data/lineitem.csv'"
+    )
+    assert isinstance(s, ast.CreateExternalTable)
+    assert s.name == "lineitem"
+    assert s.has_header
+    assert s.columns[1][1].upper().startswith("DECIMAL")
+
+
+def test_parse_show_set():
+    assert isinstance(parse_sql("SHOW TABLES"), ast.ShowStmt)
+    s = parse_sql("SET ballista.shuffle.partitions = 4")
+    assert isinstance(s, ast.SetVariable)
+    assert s.name == "ballista.shuffle.partitions"
+    assert s.value == "4"
+
+
+def test_builder_resolves_columns(tpch_ctx):
+    df = tpch_ctx.sql("select l_orderkey, l_quantity from lineitem where l_quantity > 10")
+    schema = df.schema
+    assert schema.names == ["l_orderkey", "l_quantity"]
+
+
+def test_builder_aggregate_schema(tpch_ctx):
+    df = tpch_ctx.sql(
+        "select l_returnflag, sum(l_quantity) as s, count(*) as c "
+        "from lineitem group by l_returnflag"
+    )
+    assert df.schema.names == ["l_returnflag", "s", "c"]
+    assert df.schema.field("c").type == pa.int64()
+
+
+def test_builder_unknown_column_errors(tpch_ctx):
+    from arrow_ballista_tpu.errors import PlanError
+
+    with pytest.raises(PlanError):
+        tpch_ctx.sql("select nope from lineitem").collect()
