@@ -28,6 +28,7 @@ TPU_ENABLE = "ballista.tpu.enable"
 TPU_SEGMENT_CAPACITY = "ballista.tpu.segment_capacity"
 TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
 TPU_DTYPE = "ballista.tpu.dtype"
+TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -102,6 +103,13 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "1048576",
         ),
         ConfigEntry(TPU_DTYPE, "accumulation dtype on device", str, "float64"),
+        ConfigEntry(
+            TPU_CACHE_COLUMNS,
+            "pin prepared scan inputs (columns, masks, group ids) in device "
+            "memory so repeated queries skip host→HBM transfer",
+            _parse_bool,
+            "true",
+        ),
     ]
 }
 
@@ -170,6 +178,10 @@ class BallistaConfig:
     @property
     def tpu_batch_rows(self) -> int:
         return self._get(TPU_BATCH_ROWS)
+
+    @property
+    def tpu_cache_columns(self) -> bool:
+        return self._get(TPU_CACHE_COLUMNS)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -1,0 +1,11 @@
+"""TPU compute plane: fused relational kernels over JAX/XLA.
+
+f64 is enabled globally: TPC-H aggregates sum ~1e10-magnitude values over
+millions of rows, beyond f32 precision; XLA emulates f64 on TPU at a cost
+the (tiny) aggregate FLOP count absorbs easily — the stage bottleneck is
+host→HBM transfer, not VPU math.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,141 @@
+"""Arrow ⇄ device (HBM) column bridge.
+
+The reference keeps data in Arrow RecordBatches end-to-end; the TPU path
+(BASELINE.json north star) moves columns across an Arrow → numpy → jax
+bridge into HBM.  Design rules, per the TPU memory model:
+
+* numeric / date columns transfer zero-copy where Arrow's buffer layout
+  allows (no nulls → plain numpy view);
+* validity bitmaps become separate float/bool masks — downstream kernels
+  use masking, never compaction, so shapes stay static for XLA;
+* strings never cross to the device raw: they are dictionary-encoded on
+  host and only the int32 codes transfer (group keys / comparisons work on
+  codes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..errors import ExecutionError
+
+
+def _is_device_friendly(t: pa.DataType) -> bool:
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_date(t)
+        or pa.types.is_timestamp(t)
+    )
+
+
+def arrow_to_numpy(arr: pa.Array) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    """Arrow array → (values ndarray, validity bool ndarray or None).
+
+    Nulls are filled with 0 in the value buffer; the validity mask carries
+    the null information to the device.
+    """
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(pc.is_valid(arr))
+        arr = arr.fill_null(_zero_for(t))
+    if pa.types.is_date32(t):
+        values = np.asarray(arr.cast(pa.int32()))
+    elif pa.types.is_date64(t) or pa.types.is_timestamp(t):
+        values = np.asarray(arr.cast(pa.int64()))
+    elif pa.types.is_boolean(t):
+        values = np.asarray(arr)
+    elif _is_device_friendly(t):
+        values = np.asarray(arr)
+    else:
+        raise ExecutionError(f"type {t} cannot cross the device bridge directly")
+    return values, validity
+
+
+def _zero_for(t: pa.DataType):
+    if pa.types.is_date32(t):
+        import datetime
+
+        return datetime.date(1970, 1, 1)
+    if pa.types.is_timestamp(t):
+        import datetime
+
+        return datetime.datetime(1970, 1, 1)
+    if pa.types.is_boolean(t):
+        return False
+    if pa.types.is_floating(t):
+        return 0.0
+    return 0
+
+
+@dataclass
+class DictEncoder:
+    """Stable host-side dictionary encoder shared across batches.
+
+    Per-batch ``dictionary_encode`` yields batch-local codes; group keys
+    must agree across every batch of a stage (and across partitions when
+    the codes feed a device segment-sum), so this encoder owns the global
+    value → code map.  The reverse table materializes the key column of the
+    aggregate output.
+    """
+
+    values: dict = None  # value -> code
+    reverse: list = None
+
+    def __post_init__(self) -> None:
+        self.values = {}
+        self.reverse = []
+
+    def encode(self, arr: pa.Array) -> np.ndarray:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        enc = arr.dictionary_encode()
+        local_dict = enc.dictionary.to_pylist()
+        mapping = np.empty(len(local_dict), dtype=np.int32)
+        for i, v in enumerate(local_dict):
+            code = self.values.get(v)
+            if code is None:
+                code = len(self.reverse)
+                self.values[v] = code
+                self.reverse.append(v)
+            mapping[i] = code
+        idx = enc.indices
+        has_null = idx.null_count > 0 or arr.null_count > 0
+        codes = np.asarray(idx.fill_null(0))
+        out = mapping[codes] if len(mapping) else np.zeros(len(arr), np.int32)
+        if has_null:
+            null_code = self.values.get(None)
+            if null_code is None:
+                null_code = len(self.reverse)
+                self.values[None] = null_code
+                self.reverse.append(None)
+            mask = np.asarray(pc.is_null(arr))
+            out = np.where(mask, np.int32(null_code), out)
+        return out.astype(np.int32)
+
+    @property
+    def size(self) -> int:
+        return len(self.reverse)
+
+    def to_arrow(self, dtype: pa.DataType) -> pa.Array:
+        return pa.array(self.reverse, dtype)
+
+
+def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad 1-D array to the next multiple of ``bucket`` so XLA sees a small
+    fixed set of shapes (bucketed padding beats per-length recompiles)."""
+    n = len(x)
+    target = max(bucket, ((n + bucket - 1) // bucket) * bucket)
+    if target == n:
+        return x
+    pad = np.zeros(target - n, dtype=x.dtype)
+    return np.concatenate([x, pad])

@@ -1,0 +1,535 @@
+"""JAX lowering of physical expressions + fused segment-aggregate kernels.
+
+This is the TPU replacement for the reference's per-stage DataFusion
+operator pipeline (the hot loop at ``shuffle_writer.rs:214-256`` /
+``executor.rs:97-134``): instead of streaming 8K-row batches through
+interpreted operators, the eligible stage subtree (filter → project →
+partial aggregate) compiles ONCE to a fused XLA kernel and each large
+batch is a single device invocation.
+
+TPU-first design rules (see /opt/skills/guides/pallas_guide.md):
+* static shapes only — rows are padded to power-of-two buckets, filters are
+  boolean masks (multiply, never compact);
+* group-by is ``segment_sum`` over host-assigned dense group ids with a
+  fixed segment capacity — no device-side hash table, no dynamic growth;
+* nulls ride as separate validity masks and fold into the row mask;
+* strings never reach the device — host dictionary codes stand in.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from ..errors import ExecutionError
+from ..exec import expressions as pe
+from .bridge import arrow_to_numpy
+
+# A lowered node evaluates to (value, validity-or-None) in a leaf env.
+JaxClosure = Callable[[dict], tuple[jnp.ndarray, Optional[jnp.ndarray]]]
+
+
+class NotLowerable(Exception):
+    """Subtree cannot run on device (string compute, unsupported fn)."""
+
+
+@dataclass
+class LeafSpec:
+    """One host-supplied input array of the fused kernel."""
+
+    name: str
+    kind: str  # "column" | "cpu_expr"
+    col_index: int = -1
+    cpu_expr: Optional[pe.PhysicalExpr] = None
+
+
+@dataclass
+class CompiledExpr:
+    closure: JaxClosure
+    leaves: dict[str, LeafSpec] = field(default_factory=dict)
+
+
+_F = jnp.float64
+_I = jnp.int64
+
+
+def _pa_to_jnp_dtype(t: pa.DataType):
+    if pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return _F
+    if pa.types.is_boolean(t):
+        return jnp.bool_
+    return _I
+
+
+class JaxExprCompiler:
+    """Lower PhysicalExpr trees to jax closures over a shared leaf env.
+
+    Any subtree that cannot lower (LIKE, string functions, …) but whose
+    OUTPUT is device-friendly becomes a ``cpu_expr`` leaf: the engine
+    evaluates it with pyarrow per batch and ships the resulting
+    numeric/bool array to the device alongside the raw columns.
+    """
+
+    def __init__(self, schema: pa.Schema):
+        self.schema = schema
+        self.leaves: dict[str, LeafSpec] = {}
+
+    def compile(self, expr: pe.PhysicalExpr) -> CompiledExpr:
+        closure = self._lower_or_leaf(expr)
+        return CompiledExpr(closure, self.leaves)
+
+    # ------------------------------------------------------------ helpers
+    def _leaf_column(self, e: pe.Col) -> JaxClosure:
+        t = self.schema.field(e.index).type
+        # keep in sync with bridge._is_device_friendly — anything accepted
+        # here must actually cross the bridge at runtime
+        if not (
+            pa.types.is_integer(t)
+            or pa.types.is_floating(t)
+            or pa.types.is_boolean(t)
+            or pa.types.is_date(t)
+            or pa.types.is_timestamp(t)
+        ):
+            raise NotLowerable(f"column {e.colname}: type {t}")
+        name = f"col_{e.index}"
+        self.leaves[name] = LeafSpec(name, "column", col_index=e.index)
+        vname = f"{name}__valid"
+
+        def run(env: dict):
+            return env[name], env[vname]
+
+        return run
+
+    def _cpu_leaf(self, e: pe.PhysicalExpr) -> JaxClosure:
+        out_t = _infer_pa_type(e, self.schema)
+        if not (
+            pa.types.is_boolean(out_t)
+            or pa.types.is_integer(out_t)
+            or pa.types.is_floating(out_t)
+            or pa.types.is_date(out_t)
+        ):
+            raise NotLowerable(f"cpu-leaf output type {out_t} for {e}")
+        name = f"cpu_{len(self.leaves)}"
+        self.leaves[name] = LeafSpec(name, "cpu_expr", cpu_expr=e)
+        vname = f"{name}__valid"
+
+        def run(env: dict):
+            return env[name], env[vname]
+
+        return run
+
+    def _lower_or_leaf(self, e: pe.PhysicalExpr) -> JaxClosure:
+        try:
+            return self._lower(e)
+        except NotLowerable:
+            return self._cpu_leaf(e)
+
+    # ------------------------------------------------------------ lowering
+    def _lower(self, e: pe.PhysicalExpr) -> JaxClosure:
+        if isinstance(e, pe.Col):
+            return self._leaf_column(e)
+
+        if isinstance(e, pe.Lit):
+            v = e.value
+            if v is None:
+                raise NotLowerable("null literal")
+            if isinstance(v, bool):
+                const = jnp.asarray(v)
+            elif isinstance(v, int):
+                const = jnp.asarray(v, _I)
+            elif isinstance(v, float):
+                const = jnp.asarray(v, _F)
+            else:
+                import datetime
+
+                if isinstance(v, datetime.date):
+                    const = jnp.asarray(
+                        (v - datetime.date(1970, 1, 1)).days, _I
+                    )
+                else:
+                    raise NotLowerable(f"literal {v!r}")
+            return lambda env: (const, None)
+
+        if isinstance(e, pe.Binary):
+            op = e.op
+            if op in ("AND", "OR"):
+                lf, rf = self._lower_or_leaf(e.left), self._lower_or_leaf(e.right)
+
+                def run_bool(env, lf=lf, rf=rf, op=op):
+                    lv, lval = lf(env)
+                    rv, rval = rf(env)
+                    # Kleene: null treated as False for filter masks, which
+                    # matches WHERE semantics (null predicate drops the row)
+                    lv = lv if lval is None else jnp.logical_and(lv, lval)
+                    rv = rv if rval is None else jnp.logical_and(rv, rval)
+                    if op == "AND":
+                        return jnp.logical_and(lv, rv), None
+                    return jnp.logical_or(lv, rv), None
+
+                return run_bool
+            lf, rf = self._lower(e.left), self._lower(e.right)
+            fns = {
+                "=": jnp.equal, "<>": jnp.not_equal, "<": jnp.less,
+                "<=": jnp.less_equal, ">": jnp.greater, ">=": jnp.greater_equal,
+                "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+            }
+            if op in fns:
+                f = fns[op]
+
+                def run_bin(env, lf=lf, rf=rf, f=f):
+                    lv, lval = lf(env)
+                    rv, rval = rf(env)
+                    lv, rv = _numeric_align(lv, rv)
+                    return f(lv, rv), _merge_valid(lval, rval)
+
+                return run_bin
+            if op == "/":
+
+                def run_div(env, lf=lf, rf=rf):
+                    lv, lval = lf(env)
+                    rv, rval = rf(env)
+                    return (
+                        lv.astype(_F) / rv.astype(_F),
+                        _merge_valid(lval, rval),
+                    )
+
+                return run_div
+            if op == "%":
+
+                def run_mod(env, lf=lf, rf=rf):
+                    lv, lval = lf(env)
+                    rv, rval = rf(env)
+                    return jnp.mod(lv, rv), _merge_valid(lval, rval)
+
+                return run_mod
+            raise NotLowerable(f"binary op {op}")
+
+        if isinstance(e, pe.Not):
+            f = self._lower_or_leaf(e.expr)
+
+            def run_not(env, f=f):
+                v, val = f(env)
+                v = v if val is None else jnp.logical_and(v, val)
+                return jnp.logical_not(v), None
+
+            return run_not
+
+        if isinstance(e, pe.Negative):
+            f = self._lower(e.expr)
+
+            def run_neg(env, f=f):
+                v, val = f(env)
+                return -v, val
+
+            return run_neg
+
+        if isinstance(e, pe.IsNull):
+            f = self._lower_or_leaf(e.expr)
+            negated = e.negated
+
+            def run_isnull(env, f=f, negated=negated):
+                _, val = f(env)
+                if val is None:
+                    out = jnp.zeros((), jnp.bool_)
+                    return (jnp.logical_not(out) if negated else out), None
+                return (val if negated else jnp.logical_not(val)), None
+
+            return run_isnull
+
+        if isinstance(e, pe.InList):
+            f = self._lower(e.expr)
+            items = e.items
+            if not all(isinstance(i, (int, float)) or _is_date(i) for i in items):
+                raise NotLowerable("IN list with non-numeric items")
+            consts = jnp.asarray([_to_num(i) for i in items], _F)
+            negated = e.negated
+
+            def run_in(env, f=f, consts=consts, negated=negated):
+                v, val = f(env)
+                m = jnp.any(
+                    jnp.equal(v.astype(_F)[:, None], consts[None, :]), axis=1
+                )
+                if negated:
+                    m = jnp.logical_not(m)
+                return m, val
+
+            return run_in
+
+        if isinstance(e, pe.Case):
+            whens = [
+                (self._lower_or_leaf(w), self._lower(t)) for w, t in e.whens
+            ]
+            else_f = self._lower(e.else_expr) if e.else_expr is not None else None
+            out_dtype = _pa_to_jnp_dtype(e.out_type)
+
+            def run_case(env, whens=whens, else_f=else_f, out_dtype=out_dtype):
+                # per-row branch selection: both the value AND the validity
+                # follow the selected branch (SQL CASE); a no-ELSE CASE is
+                # NULL on rows no WHEN matches
+                if else_f is not None:
+                    acc, ev = else_f(env)
+                    acc = acc.astype(out_dtype)
+                    acc_val = jnp.asarray(True) if ev is None else ev
+                else:
+                    acc = jnp.zeros((), out_dtype)
+                    acc_val = jnp.asarray(False)
+                for wf, tf in reversed(whens):
+                    c, cval = wf(env)
+                    c = c if cval is None else jnp.logical_and(c, cval)
+                    t, tval = tf(env)
+                    acc = jnp.where(c, t.astype(out_dtype), acc)
+                    tv = jnp.asarray(True) if tval is None else tval
+                    acc_val = jnp.where(c, tv, acc_val)
+                return acc, acc_val
+
+            return run_case
+
+        if isinstance(e, pe.Cast):
+            f = self._lower(e.expr)
+            dt = _pa_to_jnp_dtype(e.to_type)
+
+            def run_cast(env, f=f, dt=dt):
+                v, val = f(env)
+                return v.astype(dt), val
+
+            return run_cast
+
+        if isinstance(e, pe.ScalarFn):
+            mapping = {
+                "abs": jnp.abs, "sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log,
+                "log10": lambda x: jnp.log10(x), "log2": jnp.log2,
+                "ceil": jnp.ceil, "floor": jnp.floor, "sin": jnp.sin,
+                "cos": jnp.cos, "tan": jnp.tan, "signum": jnp.sign,
+            }
+            if e.fname in mapping and len(e.args) == 1:
+                f = self._lower(e.args[0])
+                fn = mapping[e.fname]
+
+                def run_fn(env, f=f, fn=fn):
+                    v, val = f(env)
+                    return fn(v.astype(_F)), val
+
+                return run_fn
+            if e.fname == "power" and len(e.args) == 2:
+                a = self._lower(e.args[0])
+                b = self._lower(e.args[1])
+
+                def run_pow(env, a=a, b=b):
+                    av, aval = a(env)
+                    bv, bval = b(env)
+                    return jnp.power(av.astype(_F), bv.astype(_F)), _merge_valid(aval, bval)
+
+                return run_pow
+            if e.fname == "round":
+                f = self._lower(e.args[0])
+
+                def run_round(env, f=f):
+                    v, val = f(env)
+                    return jnp.round(v.astype(_F)), val
+
+                return run_round
+            raise NotLowerable(f"scalar fn {e.fname}")
+
+        raise NotLowerable(f"node {type(e).__name__}")
+
+
+def _merge_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jnp.logical_and(a, b)
+
+
+def _numeric_align(lv, rv):
+    if lv.dtype == jnp.bool_ or rv.dtype == jnp.bool_:
+        return lv, rv
+    if jnp.issubdtype(lv.dtype, jnp.floating) or jnp.issubdtype(
+        rv.dtype, jnp.floating
+    ):
+        return lv.astype(_F), rv.astype(_F)
+    return lv.astype(_I), rv.astype(_I)
+
+
+def _is_date(v) -> bool:
+    import datetime
+
+    return isinstance(v, datetime.date)
+
+
+def _to_num(v):
+    import datetime
+
+    if isinstance(v, datetime.date):
+        return float((v - datetime.date(1970, 1, 1)).days)
+    return float(v)
+
+
+def _infer_pa_type(e: pe.PhysicalExpr, schema: pa.Schema) -> pa.DataType:
+    empty = pa.RecordBatch.from_arrays(
+        [pa.nulls(0, f.type) for f in schema], schema=schema
+    )
+    v = e.evaluate(empty)
+    return v.type
+
+
+# ---------------------------------------------------------------- env build
+def build_env(
+    batch: pa.RecordBatch, leaves: dict[str, LeafSpec], n_padded: int
+) -> dict[str, np.ndarray]:
+    """Evaluate/extract all leaf arrays for one batch, padded to n_padded.
+
+    Every leaf ALWAYS ships a validity companion (all-true when the batch
+    has no nulls) so the fused kernel's positional signature is identical
+    across batches — nulls appearing mid-stream must not trigger an XLA
+    recompile.
+    """
+    env: dict[str, np.ndarray] = {}
+    for name, spec in leaves.items():
+        if spec.kind == "column":
+            arr = batch.column(spec.col_index)
+        else:
+            arr = spec.cpu_expr.evaluate(batch)
+            if isinstance(arr, pa.Scalar):
+                arr = pa.array([arr.as_py()] * batch.num_rows, arr.type)
+        values, validity = arrow_to_numpy(
+            arr if isinstance(arr, pa.Array) else arr.combine_chunks()
+        )
+        env[name] = _pad(values, n_padded)
+        if validity is None:
+            validity = np.ones(len(values), dtype=bool)
+        env[f"{name}__valid"] = _pad(validity, n_padded)
+    return env
+
+
+def flat_arg_names(leaf_names: list[str]) -> list[str]:
+    """Positional arg order of the fused kernel: value, validity per leaf."""
+    out = []
+    for n in leaf_names:
+        out.append(n)
+        out.append(f"{n}__valid")
+    return out
+
+
+def _pad(x: np.ndarray, n: int) -> np.ndarray:
+    if len(x) == n:
+        return x
+    out = np.zeros(n, dtype=x.dtype)
+    out[: len(x)] = x
+    return out
+
+
+def bucket_rows(n: int, floor: int = 1024) -> int:
+    """Power-of-two bucketing caps distinct XLA shapes at ~log2(max rows)."""
+    return max(floor, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+# ------------------------------------------------------------- fused kernel
+@dataclass(frozen=True)
+class KernelAggSpec:
+    func: str  # sum | count | avg | min | max | count_star
+    has_arg: bool
+
+
+def make_partial_agg_kernel(
+    filter_closure: Optional[JaxClosure],
+    arg_closures: list[Optional[JaxClosure]],
+    specs: list[KernelAggSpec],
+    capacity: int,
+    flat_names: list[str],
+):
+    """Build the fused filter→project→segment-aggregate device function.
+
+    Returns ``fn(seg_ids, valid, *leaf_arrays) -> (states..., presence)``
+    where every output is a [capacity] array.  States per agg:
+      sum/min/max → (value[cap], n[cap]);  count/count_star → (n[cap],);
+      avg → (sum[cap], n[cap]).
+    ``presence`` counts mask-passing rows per group: groups whose presence
+    is 0 are dropped on host (their rows were all filtered out).
+    """
+
+    def fn(seg_ids, valid, *arrays):
+        env = dict(zip(flat_names, arrays))
+        mask = valid
+        if filter_closure is not None:
+            pred, pvalid = filter_closure(env)
+            if pvalid is not None:
+                pred = jnp.logical_and(pred, pvalid)
+            mask = jnp.logical_and(mask, pred)
+        maskf = mask
+        outs = []
+        for spec, closure in zip(specs, arg_closures):
+            if spec.func == "count_star":
+                outs.append(
+                    jax.ops.segment_sum(
+                        maskf.astype(_I), seg_ids, num_segments=capacity
+                    )
+                )
+                continue
+            val, avalid = closure(env)
+            m = maskf if avalid is None else jnp.logical_and(maskf, avalid)
+            n = jax.ops.segment_sum(m.astype(_I), seg_ids, num_segments=capacity)
+            if spec.func == "count":
+                outs.append(n)
+                continue
+            if spec.func in ("sum", "avg"):
+                v = jnp.where(m, val.astype(_F), jnp.zeros((), _F))
+                s = jax.ops.segment_sum(v, seg_ids, num_segments=capacity)
+                outs.append(s)
+                outs.append(n)
+                continue
+            if spec.func == "min":
+                v = jnp.where(m, val.astype(_F), jnp.asarray(jnp.inf, _F))
+                outs.append(
+                    jax.ops.segment_min(v, seg_ids, num_segments=capacity)
+                )
+                outs.append(n)
+                continue
+            if spec.func == "max":
+                v = jnp.where(m, val.astype(_F), jnp.asarray(-jnp.inf, _F))
+                outs.append(
+                    jax.ops.segment_max(v, seg_ids, num_segments=capacity)
+                )
+                outs.append(n)
+                continue
+            raise ExecutionError(f"kernel agg {spec.func}")
+        presence = jax.ops.segment_sum(
+            maskf.astype(_I), seg_ids, num_segments=capacity
+        )
+        return tuple(outs) + (presence,)
+
+    return fn
+
+
+def combine_states(
+    specs: list[KernelAggSpec], acc: Optional[tuple], new: tuple
+) -> tuple:
+    """Merge per-batch kernel outputs (device-side, cheap elementwise)."""
+    if acc is None:
+        return new
+    out = []
+    i = 0
+    for spec in specs:
+        if spec.func in ("count", "count_star"):
+            out.append(acc[i] + new[i])
+            i += 1
+        elif spec.func in ("sum", "avg"):
+            out.append(acc[i] + new[i])
+            out.append(acc[i + 1] + new[i + 1])
+            i += 2
+        elif spec.func == "min":
+            out.append(jnp.minimum(acc[i], new[i]))
+            out.append(acc[i + 1] + new[i + 1])
+            i += 2
+        elif spec.func == "max":
+            out.append(jnp.maximum(acc[i], new[i]))
+            out.append(acc[i + 1] + new[i + 1])
+            i += 2
+    out.append(acc[-1] + new[-1])  # presence
+    return tuple(out)

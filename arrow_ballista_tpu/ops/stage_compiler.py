@@ -1,6 +1,460 @@
-"""TPU stage compiler (placeholder wired from SessionContext; real
-implementation lands with ops/kernels.py)."""
+"""TPU stage compiler: swap eligible subtrees for fused XLA kernels.
+
+This is the north-star component (BASELINE.json): the counterpart of a
+DataFusion ``PhysicalOptimizerRule`` + extension ``ExecutionPlan`` that
+intercepts eligible Filter→Project→HashAggregate subplans inside the stage
+runner.  ``maybe_accelerate`` walks a physical plan and replaces each
+eligible ``HashAggregateExec`` (plus its filter/projection chain) with a
+:class:`TpuStageExec`; everything else stays on the CPU operator path, so
+the TPU path is a pure operator-level plugin gated by session config
+(``ballista.tpu.enable``) — the same role the reference's extension-codec
+hook plays for third-party operators (``core/src/serde/mod.rs:82-95``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..config import BallistaConfig
+from ..errors import ExecutionError
+from ..exec import expressions as pe
+from ..exec.aggregates import PARTIAL, SINGLE, AggSpec, HashAggregateExec
+from ..exec.operators import (
+    ExecutionPlan,
+    FilterExec,
+    Partitioning,
+    ProjectionExec,
+    TaskContext,
+)
+from ..exec.planner import RenameSchemaExec
+from . import kernels as K
 
 
-def maybe_accelerate(plan, config):
+class _CapacityExceeded(Exception):
+    pass
+
+
+# Compiled-kernel cache: plans are rebuilt per query, but the fused kernel
+# is a pure function of the stage's structural signature — reuse the jitted
+# callable (and with it XLA's compilation cache) across plan instances.
+_KERNEL_CACHE: dict[tuple, object] = {}
+
+
+# ----------------------------------------------------------- substitution
+def _subst(e: pe.PhysicalExpr, mapping: list[pe.PhysicalExpr]) -> pe.PhysicalExpr:
+    """Rewrite ``e`` (defined over an intermediate projection schema) onto
+    the stage source schema by inlining the producing expressions."""
+    if isinstance(e, pe.Col):
+        return mapping[e.index]
+    if isinstance(e, pe.Binary):
+        return pe.Binary(_subst(e.left, mapping), e.op, _subst(e.right, mapping))
+    if isinstance(e, pe.Not):
+        return pe.Not(_subst(e.expr, mapping))
+    if isinstance(e, pe.Negative):
+        return pe.Negative(_subst(e.expr, mapping))
+    if isinstance(e, pe.IsNull):
+        return pe.IsNull(_subst(e.expr, mapping), e.negated)
+    if isinstance(e, pe.InList):
+        return pe.InList(_subst(e.expr, mapping), e.items, e.negated)
+    if isinstance(e, pe.Like):
+        return pe.Like(_subst(e.expr, mapping), e.pattern, e.negated)
+    if isinstance(e, pe.Case):
+        return pe.Case(
+            tuple((_subst(w, mapping), _subst(t, mapping)) for w, t in e.whens),
+            _subst(e.else_expr, mapping) if e.else_expr is not None else None,
+            e.out_type,
+        )
+    if isinstance(e, pe.Cast):
+        return pe.Cast(_subst(e.expr, mapping), e.to_type)
+    if isinstance(e, pe.ScalarFn):
+        return pe.ScalarFn(
+            e.fname, tuple(_subst(a, mapping) for a in e.args), e.out_type
+        )
+    if isinstance(e, (pe.Lit, pe.IntervalLit)):
+        return e
+    raise ExecutionError(f"cannot substitute through {type(e).__name__}")
+
+
+@dataclasses.dataclass
+class _FusedStage:
+    """The flattened eligible subtree, rewritten onto the source schema."""
+
+    source: ExecutionPlan
+    filters: list[pe.PhysicalExpr]
+    group_exprs: list[tuple[pe.PhysicalExpr, str]]
+    aggs: list[AggSpec]
+    mode: str
+
+
+def _flatten(agg: HashAggregateExec) -> Optional[_FusedStage]:
+    chain: list[ExecutionPlan] = []
+    node = agg.input
+    while isinstance(node, (FilterExec, ProjectionExec, RenameSchemaExec)):
+        chain.append(node)
+        node = node.children()[0]
+    source = node
+    mapping: list[pe.PhysicalExpr] = [
+        pe.Col(i, f.name) for i, f in enumerate(source.schema)
+    ]
+    filters: list[pe.PhysicalExpr] = []
+    try:
+        for op in reversed(chain):
+            if isinstance(op, RenameSchemaExec):
+                continue
+            if isinstance(op, FilterExec):
+                filters.append(_subst(op.predicate, mapping))
+            else:
+                mapping = [_subst(e, mapping) for e, _ in op.exprs]
+        group_exprs = [(_subst(g, mapping), name) for g, name in agg.group_exprs]
+        aggs = [
+            dataclasses.replace(
+                a, arg=_subst(a.arg, mapping) if a.arg is not None else None
+            )
+            for a in agg.aggs
+        ]
+    except ExecutionError:
+        return None
+    return _FusedStage(source, filters, group_exprs, aggs, agg.mode)
+
+
+class TpuStageExec(ExecutionPlan):
+    """Fused scan→filter→project→aggregate stage on device.
+
+    Replaces the interpreted per-batch operator chain (the reference's hot
+    loop, ``shuffle_writer.rs:214-256``) with one jit-compiled XLA kernel
+    invoked once per batch; partial states accumulate on device and only
+    [num_groups]-sized results return to host.  Runtime group-capacity
+    overflow falls back to re-executing the original CPU subtree.
+    """
+
+    def __init__(
+        self, original: HashAggregateExec, fused: _FusedStage, config: BallistaConfig
+    ):
+        super().__init__()
+        self.original = original
+        self.fused = fused
+        self.config = config
+        self._schema = original.schema
+
+        compiler = K.JaxExprCompiler(fused.source.schema)
+        filter_closure = None
+        if fused.filters:
+            pred = fused.filters[0]
+            for f in fused.filters[1:]:
+                pred = pe.Binary(pred, "AND", f)
+            filter_closure = compiler._lower_or_leaf(pred)
+        arg_closures: list[Optional[K.JaxClosure]] = []
+        specs: list[K.KernelAggSpec] = []
+        if len(fused.group_exprs) > 3:
+            # the 21-bit key fold covers 3 keys in an int64; wider GROUP BY
+            # stays on the CPU path until hierarchical folding lands
+            raise K.NotLowerable(">3 group keys")
+        for a in fused.aggs:
+            if a.func == "count_distinct":
+                raise K.NotLowerable("count_distinct")
+            if a.arg is None:
+                specs.append(K.KernelAggSpec("count_star", False))
+                arg_closures.append(None)
+            else:
+                specs.append(K.KernelAggSpec(a.func, True))
+                arg_closures.append(compiler._lower(a.arg))
+        self.leaves = compiler.leaves
+        self.specs = specs
+        self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
+        self._leaf_names = list(self.leaves.keys())
+        self._flat_names = K.flat_arg_names(self._leaf_names)
+        sig = (
+            tuple(str(f) for f in fused.filters),
+            tuple((s.func, str(a.arg)) for s, a in zip(specs, fused.aggs)),
+            self.capacity,
+            tuple(self._flat_names),
+            str(fused.source.schema),
+        )
+        cached = _KERNEL_CACHE.get(sig)
+        if cached is None:
+            import jax
+
+            kernel = K.make_partial_agg_kernel(
+                filter_closure, arg_closures, specs, self.capacity, self._flat_names
+            )
+            cached = jax.jit(kernel)
+            _KERNEL_CACHE[sig] = cached
+        self._jit_kernel = cached
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return self.fused.source.output_partitioning()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.fused.source]
+
+    def with_new_children(self, children):
+        new_original = self.original.with_new_children(
+            [_replace_leaf(self.original.input, self.fused.source, children[0])]
+        )
+        fused = _flatten(new_original)
+        if fused is None:
+            return new_original
+        return TpuStageExec(new_original, fused, self.config)
+
+    def __str__(self) -> str:
+        return (
+            f"TpuStageExec: mode={self.fused.mode}, "
+            f"gby={[n for _, n in self.fused.group_exprs]}, "
+            f"aggr={[a.name for a in self.fused.aggs]}, "
+            f"filters={len(self.fused.filters)}, capacity={self.capacity}"
+        )
+
+    # ------------------------------------------------------------ execute
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        try:
+            yield from self._execute_device(partition, ctx)
+        except (_CapacityExceeded, ExecutionError):
+            # group cardinality exceeded the device segment table, or a
+            # column type slipped past plan-time lowering checks — re-run
+            # this partition on the CPU operator path
+            self.metrics.add("tpu_fallback", 1)
+            yield from self.original.execute(partition, ctx)
+
+    def _cache_key(self, ctx: TaskContext):
+        """(provider, signature) when the stage source is a cacheable scan."""
+        if not ctx.config.tpu_cache_columns:
+            return None
+        from ..exec.operators import ScanExec
+
+        node = self.fused.source
+        while isinstance(node, RenameSchemaExec):
+            node = node.children()[0]
+        if not isinstance(node, ScanExec):
+            return None
+        sig = "|".join(
+            [
+                f"{s.kind}:{s.col_index}:{s.cpu_expr}" for s in self.leaves.values()
+            ]
+            + [str(g) for g, _ in self.fused.group_exprs]
+            + [str(ctx.batch_size), f"cap={self.capacity}"]
+        )
+        return node.provider, sig
+
+    def _execute_device(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        from . import device_cache
+        from .bridge import DictEncoder
+
+        fused = self.fused
+        ck = self._cache_key(ctx)
+        if ck is not None:
+            cached = device_cache.get(ck[0], partition, ck[1])
+            if cached is not None:
+                entries, key_encoders, gid_tuples, n_rows_in = cached
+                acc = None
+                with self.metrics.timer("tpu_stage_time_ns"):
+                    with self.metrics.timer("device_time_ns"):
+                        for seg, valid, args in entries:
+                            out = self._jit_kernel(seg, valid, *args)
+                            acc = K.combine_states(self.specs, acc, out)
+                self.metrics.add("cache_hits", 1)
+                yield from self._materialize(
+                    acc, key_encoders, gid_tuples, n_rows_in, ctx, partition
+                )
+                return
+
+        key_encoders = [DictEncoder() for _ in fused.group_exprs]
+        tuple_gids: dict[tuple, int] = {}
+        gid_tuples: list[tuple] = []
+        entries = []
+
+        acc = None
+        n_rows_in = 0
+        with self.metrics.timer("tpu_stage_time_ns"):
+            for batch in fused.source.execute(partition, ctx):
+                if batch.num_rows == 0:
+                    continue
+                n = batch.num_rows
+                n_rows_in += n
+                n_pad = K.bucket_rows(n)
+
+                if fused.group_exprs:
+                    with self.metrics.timer("key_encode_time_ns"):
+                        seg = self._encode_groups(
+                            batch, key_encoders, tuple_gids, gid_tuples
+                        )
+                else:
+                    seg = np.zeros(n, dtype=np.int32)
+                seg = K._pad(seg, n_pad)
+                valid = np.zeros(n_pad, dtype=bool)
+                valid[:n] = True
+
+                with self.metrics.timer("bridge_time_ns"):
+                    env = K.build_env(batch, self.leaves, n_pad)
+                    args = [env[nm] for nm in self._flat_names]
+                with self.metrics.timer("device_time_ns"):
+                    if ck is not None:
+                        import jax
+
+                        seg = jax.device_put(seg)
+                        valid = jax.device_put(valid)
+                        args = [jax.device_put(a) for a in args]
+                        entries.append((seg, valid, args))
+                    out = self._jit_kernel(seg, valid, *args)
+                    acc = K.combine_states(self.specs, acc, out)
+
+        if ck is not None and acc is not None:
+            device_cache.put(
+                ck[0], partition, ck[1],
+                (entries, key_encoders, gid_tuples, n_rows_in),
+            )
+        yield from self._materialize(
+            acc, key_encoders, gid_tuples, n_rows_in, ctx, partition
+        )
+
+    def _encode_groups(self, batch, key_encoders, tuple_gids, gid_tuples):
+        """Vectorized multi-key → dense group id encoding.
+
+        Per-key global dictionary codes fold into one int64 (21 bits per
+        key), deduped with a single 1-D np.unique; only the (few) distinct
+        combinations touch Python.
+        """
+        code_arrays = [
+            enc.encode(_eval_arr(g, batch))
+            for (g, _), enc in zip(self.fused.group_exprs, key_encoders)
+        ]
+        for enc in key_encoders:
+            if enc.size >= (1 << 21):
+                raise _CapacityExceeded()
+        combined = code_arrays[0].astype(np.int64)
+        for c in code_arrays[1:]:
+            combined = (combined << 21) | c.astype(np.int64)
+        uniq, inverse = np.unique(combined, return_inverse=True)
+        n_keys = len(code_arrays)
+        local_gids = np.empty(len(uniq), dtype=np.int32)
+        for j, folded in enumerate(uniq.tolist()):
+            t = []
+            f = folded
+            for _ in range(n_keys):
+                t.append(f & ((1 << 21) - 1))
+                f >>= 21
+            t = tuple(reversed(t))
+            gid = tuple_gids.get(t)
+            if gid is None:
+                gid = len(gid_tuples)
+                if gid >= self.capacity:
+                    raise _CapacityExceeded()
+                tuple_gids[t] = gid
+                gid_tuples.append(t)
+            local_gids[j] = gid
+        return local_gids[inverse].astype(np.int32)
+
+    # ------------------------------------------------------- materialize
+    def _materialize(
+        self, acc, key_encoders, gid_tuples, n_rows_in, ctx: TaskContext,
+        partition: int,
+    ) -> Iterator[pa.RecordBatch]:
+        fused = self.fused
+        schema = self._schema
+
+        if acc is None:
+            if not fused.group_exprs:
+                # empty input, global aggregate: the CPU operator supplies
+                # the exact SQL empty-input row for THIS (empty) partition
+                yield from self.original.execute(partition, ctx)
+            return
+
+        n_groups = len(gid_tuples) if fused.group_exprs else 1
+        host = [np.asarray(a)[:n_groups] for a in acc]
+        presence = host[-1]
+        keep = np.nonzero(presence > 0)[0] if fused.group_exprs else np.arange(1)
+
+        cols: list[pa.Array] = []
+        for i, ((_, _name), enc) in enumerate(zip(fused.group_exprs, key_encoders)):
+            vals = [enc.reverse[gid_tuples[g][i]] for g in keep]
+            cols.append(pa.array(vals, schema.field(len(cols)).type))
+
+        partial = fused.mode == PARTIAL
+        i = 0
+        for spec, a in zip(self.specs, fused.aggs):
+            if spec.func in ("count", "count_star"):
+                cols.append(pa.array(host[i][keep], pa.int64()))
+                i += 1
+                continue
+            v = host[i][keep]
+            n_arr = host[i + 1][keep]
+            i += 2
+            if spec.func == "avg":
+                if partial:
+                    cols.append(pa.array(v, pa.float64()))
+                    cols.append(pa.array(n_arr, pa.int64()))
+                else:
+                    cols.append(
+                        pa.array(
+                            [
+                                None if c == 0 else float(x) / c
+                                for x, c in zip(v.tolist(), n_arr.tolist())
+                            ],
+                            pa.float64(),
+                        )
+                    )
+                continue
+            field_t = schema.field(len(cols)).type
+            pyvals = [
+                None if c == 0 else x for x, c in zip(v.tolist(), n_arr.tolist())
+            ]
+            if pa.types.is_integer(field_t):
+                # device accumulates in f64; exact for |sum| < 2^53
+                pyvals = [None if x is None else int(round(x)) for x in pyvals]
+            cols.append(pa.array(pyvals, field_t))
+
+        out = pa.RecordBatch.from_arrays(cols, schema=schema)
+        self.metrics.add("output_rows", out.num_rows)
+        self.metrics.add("input_rows", n_rows_in)
+        yield out
+
+
+def _eval_arr(e: pe.PhysicalExpr, batch: pa.RecordBatch) -> pa.Array:
+    v = e.evaluate(batch)
+    if isinstance(v, pa.ChunkedArray):
+        v = v.combine_chunks()
+    if isinstance(v, pa.Scalar):
+        v = pa.array([v.as_py()] * batch.num_rows, v.type)
+    return v
+
+
+def _replace_leaf(
+    plan: ExecutionPlan, old: ExecutionPlan, new: ExecutionPlan
+) -> ExecutionPlan:
+    if plan is old:
+        return new
+    kids = plan.children()
+    if not kids:
+        return plan
+    return plan.with_new_children([_replace_leaf(c, old, new) for c in kids])
+
+
+# ------------------------------------------------------------------ rule
+def maybe_accelerate(plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
+    """PhysicalOptimizerRule: replace eligible aggregates with TpuStageExec
+    (counterpart of the north star's operator-level TPU plugin)."""
+    if not config.tpu_enable:
+        return plan
+    kids = plan.children()
+    if kids:
+        plan = plan.with_new_children([maybe_accelerate(c, config) for c in kids])
+    if isinstance(plan, HashAggregateExec) and plan.mode in (PARTIAL, SINGLE):
+        if any(a.func == "count_distinct" for a in plan.aggs):
+            return plan
+        fused = _flatten(plan)
+        if fused is None:
+            return plan
+        try:
+            return TpuStageExec(plan, fused, config)
+        except K.NotLowerable:
+            return plan
     return plan

@@ -1,0 +1,173 @@
+"""Multi-chip execution: device mesh, ICI collectives, sharded stages.
+
+The reference scales with one task per partition over executors connected
+by gRPC/Flight (SURVEY.md §2.5).  On a TPU pod slice, partitions that live
+on the same mesh become SHARDS: a stage runs as ONE ``shard_map``-ped
+program over the mesh's data axis, and the cross-partition exchange that
+Ballista does via disk+Flight becomes an XLA collective over ICI —
+``psum`` for partial-aggregate reduction, ``all_to_all`` for hash
+repartition.  Cross-host/cross-pod exchange stays on the Arrow Flight data
+plane (flight/, shuffle/).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+# ------------------------------------------------------- distributed agg
+def make_distributed_agg_step(
+    kernel: Callable,
+    specs,
+    mesh: Mesh,
+    capacity: int,
+):
+    """Wrap a fused partial-agg kernel so it runs sharded over the mesh.
+
+    Inputs (seg, valid, *leaf arrays) are sharded on the row axis; each
+    device reduces its shard to [capacity] states, then the states reduce
+    across the mesh over ICI (psum / pmin / pmax per aggregate) — the
+    TPU-native replacement for the reference's map-side shuffle write +
+    reduce-side Flight fetch when all shards share a mesh.
+
+    Returns a jitted fn producing fully-reduced (replicated) states.
+    """
+    from jax import shard_map
+
+    def reduce_states(states):
+        out = []
+        i = 0
+        for spec in specs:
+            if spec.func in ("count", "count_star"):
+                out.append(jax.lax.psum(states[i], DATA_AXIS))
+                i += 1
+            elif spec.func in ("sum", "avg"):
+                out.append(jax.lax.psum(states[i], DATA_AXIS))
+                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
+                i += 2
+            elif spec.func == "min":
+                out.append(jax.lax.pmin(states[i], DATA_AXIS))
+                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
+                i += 2
+            elif spec.func == "max":
+                out.append(jax.lax.pmax(states[i], DATA_AXIS))
+                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
+                i += 2
+        out.append(jax.lax.psum(states[-1], DATA_AXIS))  # presence
+        return tuple(out)
+
+    def sharded_step(seg, valid, *arrays):
+        local = kernel(seg, valid, *arrays)
+        return reduce_states(local)
+
+    # built once: a per-call jit would retrace and recompile every batch
+    fn = jax.jit(
+        shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),  # prefix spec: every arg row-sharded
+            out_specs=P(),  # replicated after the cross-chip reduction
+            check_vma=False,
+        )
+    )
+    return fn
+
+
+# ------------------------------------------------- on-device repartition
+def ici_all_to_all_repartition(
+    mesh: Mesh, n_parts_per_dev: int, capacity: int
+):
+    """Build a sharded hash-repartition exchange over ICI.
+
+    Each device holds rows plus a destination-device id per row.  Rows
+    route to their destination with a single ``all_to_all`` on a
+    [n_dev, capacity] staging buffer (capacity-padded, mask-carrying — the
+    static-shape answer to Ballista's variable-size shuffle files).
+
+    Returns fn(values f64[rows], dest i32[rows], valid bool[rows]) →
+    (recv_values f64[n_dev*capacity], recv_valid bool[n_dev*capacity])
+    where each device ends holding every row whose dest == its index.
+    """
+    from jax import shard_map
+
+    n_dev = mesh.devices.size
+
+    def local_exchange(values, dest, valid):
+        # values/dest/valid: this device's shard [rows_local]
+        rows = values.shape[0]
+        # invalid rows sort to a sentinel destination past every real one,
+        # so each real destination's run contains only valid rows and the
+        # within-run index is dense
+        dest_m = jnp.where(valid, dest, n_dev)
+        order = jnp.argsort(dest_m, stable=True)
+        values_s = values[order]
+        dest_s = dest_m[order]
+        # per-destination staging buffer [n_dev, capacity]
+        counts = jax.ops.segment_sum(
+            jnp.ones(rows, jnp.int32), dest_s, num_segments=n_dev + 1
+        )[:n_dev]
+        offsets = jnp.cumsum(counts) - counts  # start of each dest run
+        safe_dest = jnp.minimum(dest_s, n_dev - 1)
+        idx_within = jnp.arange(rows) - offsets[safe_dest]
+        ok = (
+            (dest_s < n_dev) & (idx_within >= 0) & (idx_within < capacity)
+        )
+        # rows that don't belong (sentinel dest / over capacity) scatter
+        # into a spill column that is sliced away — they can never clobber
+        # a real slot
+        slot = jnp.where(ok, idx_within, capacity)
+        stage_vals = jnp.zeros((n_dev, capacity + 1), values.dtype)
+        stage_valid = jnp.zeros((n_dev, capacity + 1), jnp.bool_)
+        stage_vals = stage_vals.at[safe_dest, slot].set(values_s, mode="drop")
+        stage_valid = stage_valid.at[safe_dest, slot].set(ok, mode="drop")
+        stage_vals = stage_vals[:, :capacity]
+        stage_valid = stage_valid[:, :capacity]
+        # the collective: swap staging rows so device d receives every
+        # other device's bucket d — Ballista's shuffle in one ICI op
+        recv_vals = jax.lax.all_to_all(
+            stage_vals, DATA_AXIS, split_axis=0, concat_axis=0, tiled=False
+        )
+        recv_valid = jax.lax.all_to_all(
+            stage_valid, DATA_AXIS, split_axis=0, concat_axis=0, tiled=False
+        )
+        return recv_vals.reshape(-1), recv_valid.reshape(-1)
+
+    fn = shard_map(
+        local_exchange,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def shard_batch(
+    mesh: Mesh, arrays: Sequence[np.ndarray]
+) -> list[jax.Array]:
+    """Place host arrays onto the mesh sharded along the row axis."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out = []
+    for a in arrays:
+        n_dev = mesh.devices.size
+        n = len(a)
+        padded = ((n + n_dev - 1) // n_dev) * n_dev
+        if padded != n:
+            pad = np.zeros(padded - n, dtype=a.dtype)
+            a = np.concatenate([a, pad])
+        out.append(jax.device_put(a, sharding))
+    return out

@@ -1,0 +1,74 @@
+"""Benchmark: TPC-H q1 fused TPU stage vs the CPU operator path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rows/sec on the TPU path, "unit": "rows/s",
+   "vs_baseline": speedup over the CPU (reference-architecture) path}
+
+Scale factor via BENCH_SF (default 1 → 6M lineitem rows); iterations via
+BENCH_ITERS (default 3, best-of).  Runs on whatever jax platform the
+environment provides (real TPU under the driver).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from benchmarks.tpch.datagen import gen_lineitem
+    from benchmarks.tpch.queries import QUERIES
+
+    lineitem = gen_lineitem(sf)
+    n_rows = lineitem.num_rows
+
+    def run(tpu: bool) -> float:
+        cfg = BallistaConfig(
+            {
+                "ballista.tpu.enable": "true" if tpu else "false",
+                # one big batch per partition: the fused kernel wants large
+                # device invocations; the CPU path is batch-size agnostic
+                "ballista.batch.size": str(1 << 22),
+                "ballista.shuffle.partitions": "1",
+            }
+        )
+        ctx = SessionContext(cfg)
+        ctx.register_table("lineitem", MemoryTable.from_table(lineitem, 1))
+        df = ctx.sql(QUERIES[1])
+        best = float("inf")
+        result = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            result = df.collect()
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+        assert result is not None and result.num_rows > 0
+        return best
+
+    # warm up device + compile cache outside timing
+    cpu_t = run(False)
+    tpu_warm = run(True)  # first call pays jit compile
+    tpu_t = run(True)
+
+    rows_per_sec = n_rows / tpu_t
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q1_sf%g_tpu_rows_per_sec" % sf,
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(cpu_t / tpu_t, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

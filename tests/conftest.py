@@ -5,7 +5,6 @@ implication: single-host multi-chip tests replace docker-compose)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,6 +12,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# The session environment pins JAX_PLATFORMS to the TPU plugin, which wins
+# over the env var — the config API is the reliable override.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,229 @@
+"""TPU fused-stage path vs CPU operator path: results must match exactly.
+
+Runs on the virtual CPU backend (conftest) — the same jax code path runs
+on real TPU hardware, minus device placement.
+"""
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+
+
+def _ctx(tpu: bool, **extra) -> SessionContext:
+    settings = {"ballista.tpu.enable": "true" if tpu else "false"}
+    settings.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(settings))
+
+
+def _both(sql: str, register) -> tuple[pa.Table, pa.Table]:
+    c_cpu, c_tpu = _ctx(False), _ctx(True)
+    register(c_cpu)
+    register(c_tpu)
+    return c_cpu.sql(sql).collect(), c_tpu.sql(sql).collect()
+
+
+def _assert_tables_equal(a: pa.Table, b: pa.Table, rel=1e-9):
+    assert a.schema.names == b.schema.names
+    assert a.num_rows == b.num_rows
+    for name in a.schema.names:
+        av, bv = a.column(name).to_pylist(), b.column(name).to_pylist()
+        for x, y in zip(av, bv):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=rel), name
+            else:
+                assert x == y, name
+
+
+def _register_tpch(ctx):
+    from benchmarks.tpch.datagen import register_all
+
+    register_all(ctx, sf=0.01, partitions=2)
+
+
+def _plan_has_tpu(ctx, sql: str) -> bool:
+    return "TpuStageExec" in ctx.sql(sql).explain()
+
+
+def test_q6_tpu_matches_cpu():
+    from benchmarks.tpch.queries import QUERIES
+
+    cpu, tpu = _both(QUERIES[6], _register_tpch)
+    _assert_tables_equal(cpu, tpu)
+
+
+def test_q6_plan_uses_tpu_stage():
+    from benchmarks.tpch.queries import QUERIES
+
+    ctx = _ctx(True)
+    _register_tpch(ctx)
+    assert _plan_has_tpu(ctx, QUERIES[6])
+
+
+def test_q1_tpu_matches_cpu():
+    from benchmarks.tpch.queries import QUERIES
+
+    cpu, tpu = _both(QUERIES[1], _register_tpch)
+    _assert_tables_equal(cpu, tpu)
+    ctx = _ctx(True)
+    _register_tpch(ctx)
+    assert _plan_has_tpu(ctx, QUERIES[1])
+
+
+def test_q12_case_when_on_device():
+    # CASE WHEN over a string column → string comparison becomes a CPU
+    # leaf, arithmetic stays on device
+    from benchmarks.tpch.queries import QUERIES
+
+    cpu, tpu = _both(QUERIES[12], _register_tpch)
+    _assert_tables_equal(cpu, tpu)
+
+
+def test_nulls_in_agg_args_and_keys():
+    tbl = pa.table(
+        {
+            "g": pa.array(["a", None, "a", "b", None, "b"], pa.string()),
+            "v": pa.array([1.0, 2.0, None, 4.0, None, 6.0], pa.float64()),
+        }
+    )
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl, partitions=2)
+
+    sql = (
+        "select g, sum(v) as s, count(v) as cv, count(*) as c, avg(v) as m, "
+        "min(v) as lo, max(v) as hi from t group by g order by g nulls last"
+    )
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+    assert tpu.column("s").to_pylist() == [1.0, 10.0, 2.0]
+    assert tpu.column("c").to_pylist() == [2, 2, 2]
+    assert tpu.column("cv").to_pylist() == [1, 2, 1]
+
+
+def test_all_rows_filtered_group_dropped():
+    tbl = pa.table(
+        {
+            "g": pa.array(["x", "y"], pa.string()),
+            "v": pa.array([1.0, 100.0], pa.float64()),
+        }
+    )
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl)
+
+    sql = "select g, sum(v) as s from t where v < 50 group by g"
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+    assert tpu.num_rows == 1
+
+
+def test_global_agg_empty_input():
+    tbl = pa.table({"v": pa.array([], pa.float64())})
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl)
+
+    sql = "select sum(v) as s, count(*) as c from t"
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+    assert tpu.column("s").to_pylist() == [None]
+    assert tpu.column("c").to_pylist() == [0]
+
+
+def test_capacity_overflow_falls_back_to_cpu():
+    import numpy as np
+
+    n = 5000
+    tbl = pa.table(
+        {
+            "g": pa.array(np.arange(n) % 3000, pa.int64()),  # 3000 groups
+            "v": pa.array(np.ones(n), pa.float64()),
+        }
+    )
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl, partitions=2)
+
+    c_cpu = _ctx(False)
+    c_tpu = _ctx(True, **{"ballista.tpu.segment_capacity": 256})
+    reg(c_cpu)
+    reg(c_tpu)
+    sql = "select g, sum(v) as s from t group by g order by g"
+    cpu = c_cpu.sql(sql).collect()
+    tpu = c_tpu.sql(sql).collect()
+    _assert_tables_equal(cpu, tpu)
+
+
+def test_int_sum_exact():
+    import numpy as np
+
+    tbl = pa.table({"v": pa.array(np.arange(1, 100001, dtype=np.int64))})
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl, partitions=3)
+
+    sql = "select sum(v) as s from t"
+    cpu, tpu = _both(sql, reg)
+    assert tpu.column("s").to_pylist() == [100000 * 100001 // 2]
+    _assert_tables_equal(cpu, tpu)
+
+
+def test_tpu_disable_flag():
+    ctx = _ctx(False)
+    _register_tpch(ctx)
+    from benchmarks.tpch.queries import QUERIES
+
+    assert not _plan_has_tpu(ctx, QUERIES[6])
+
+
+def test_case_null_semantics_match_cpu():
+    # CASE selects branch validity per-row; no-ELSE unmatched rows are NULL
+    tbl = pa.table(
+        {
+            "p": pa.array([1, 0, 1, 0], pa.int64()),
+            "a": pa.array([None, 2.0, 3.0, None], pa.float64()),
+        }
+    )
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl)
+
+    sql = (
+        "select sum(case when p = 1 then a else 0 end) as s, "
+        "count(case when p = 1 then a end) as c from t"
+    )
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+    # ELSE-branch rows with null `a` still contribute their 0
+    assert tpu.column("s").to_pylist() == [3.0]
+    # no-ELSE: only matched, non-null rows counted
+    assert tpu.column("c").to_pylist() == [1]
+
+
+def test_empty_partition_global_agg_not_duplicated():
+    tbl = pa.table({"v": pa.array([1.0, 2.0, 3.0], pa.float64())})
+
+    def reg(ctx):
+        # partition 1 of 4 will be empty
+        ctx.register_arrow_table("t", tbl, partitions=4)
+
+    sql = "select sum(v) as s, count(*) as c from t"
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+    assert tpu.column("s").to_pylist() == [6.0]
+    assert tpu.column("c").to_pylist() == [3]
+
+
+def test_four_group_keys_stay_on_cpu():
+    ctx = _ctx(True)
+    tbl = pa.table(
+        {
+            "a": ["x", "y"], "b": ["p", "q"], "c": ["m", "n"], "d": ["u", "v"],
+            "v": pa.array([1.0, 2.0], pa.float64()),
+        }
+    )
+    ctx.register_arrow_table("t", tbl)
+    df = ctx.sql("select a, b, c, d, sum(v) as s from t group by a, b, c, d")
+    assert "TpuStageExec" not in df.explain()
+    assert df.collect().num_rows == 2
