@@ -175,13 +175,15 @@ class MemoryTable(TableProvider):
     def describe(self) -> dict:
         # Memory tables are serialized inline (small tables only: Values, test fixtures)
         sink = pa.BufferOutputStream()
+        part_batches = []  # batches per partition, to rebuild partitioning
         with pa.ipc.new_stream(sink, self._schema) as w:
             for part in self.partitions:
+                part_batches.append(len(part))
                 for b in part:
                     w.write_batch(b)
         return {
             "kind": "memory",
-            "n_partitions": self.num_partitions(),
+            "partition_batches": part_batches,
             "data": sink.getvalue().to_pybytes().hex(),
         }
 
@@ -200,7 +202,16 @@ def provider_from_description(d: dict) -> TableProvider:
         with pa.ipc.open_stream(buf) as r:
             batches = [b for b in r]
             schema = r.schema
-        return MemoryTable([batches] if batches else [[]], schema)
+        counts = d.get("partition_batches")
+        if counts:
+            parts: list[list[pa.RecordBatch]] = []
+            i = 0
+            for c in counts:
+                parts.append(batches[i : i + c])
+                i += c
+        else:
+            parts = [batches] if batches else [[]]
+        return MemoryTable(parts, schema)
     raise PlanError(f"unknown provider kind {kind!r}")
 
 

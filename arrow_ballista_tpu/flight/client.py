@@ -1,0 +1,71 @@
+"""Arrow Flight client for fetching shuffle partitions.
+
+Counterpart of the reference's ``BallistaClient``
+(``core/src/client.rs:51-179``): connects to an executor's Flight port and
+issues a DoGet whose ticket is a protobuf ``FetchPartitionTicket``; the
+response stream is the partition's record batches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from ..errors import ExecutionError
+from ..proto import pb
+
+
+class BallistaClient:
+    """Per-(host,port) cached Flight connections (the reference caches
+    clients similarly in executor_manager.rs:219-246)."""
+
+    _cache: dict[tuple[str, int], "BallistaClient"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._client = flight.FlightClient(f"grpc://{host}:{port}")
+
+    @classmethod
+    def get(cls, host: str, port: int) -> "BallistaClient":
+        key = (host, port)
+        with cls._lock:
+            c = cls._cache.get(key)
+            if c is None:
+                c = cls(host, port)
+                cls._cache[key] = c
+            return c
+
+    @classmethod
+    def clear_cache(cls) -> None:
+        with cls._lock:
+            for c in cls._cache.values():
+                try:
+                    c._client.close()
+                except Exception:
+                    pass
+            cls._cache.clear()
+
+    def fetch_partition(
+        self, job_id: str, stage_id: int, partition_id: int, path: str
+    ) -> Iterator[pa.RecordBatch]:
+        ticket_proto = pb.FetchPartitionTicket(
+            job_id=job_id,
+            stage_id=stage_id,
+            partition_id=partition_id,
+            path=path,
+        )
+        ticket = flight.Ticket(ticket_proto.SerializeToString())
+        try:
+            reader = self._client.do_get(ticket)
+            for chunk in reader:
+                yield chunk.data
+        except flight.FlightError as e:
+            raise ExecutionError(
+                f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
+                f"{self.host}:{self.port} failed: {e}"
+            ) from e

@@ -1,0 +1,71 @@
+"""Executor-side Arrow Flight service serving shuffle partitions.
+
+Counterpart of the reference's ``executor/src/flight_service.rs``: DoGet
+only — the ticket is a protobuf ``FetchPartitionTicket`` whose ``path``
+points at an Arrow IPC file under this executor's work_dir; the file is
+streamed schema-first then batch-by-batch.  All other Flight methods are
+unimplemented, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from ..proto import pb
+
+
+class ShuffleFlightService(flight.FlightServerBase):
+    def __init__(self, work_dir: str, host: str = "0.0.0.0", port: int = 0):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self.work_dir = os.path.abspath(work_dir)
+
+    def do_get(self, context, ticket: flight.Ticket):
+        msg = pb.FetchPartitionTicket()
+        try:
+            msg.ParseFromString(ticket.ticket)
+        except Exception as e:
+            raise flight.FlightServerError(f"invalid ticket: {e}")
+        path = os.path.abspath(msg.path)
+        # only serve files inside the work dir (the ticket's path originates
+        # from this executor's own shuffle-write stats, but never trust it)
+        if not path.startswith(self.work_dir + os.sep):
+            raise flight.FlightServerError(f"path {path!r} outside work dir")
+        if not os.path.exists(path):
+            raise flight.FlightServerError(f"no such partition file {path!r}")
+        reader = pa.ipc.open_file(path)
+
+        def gen():
+            for i in range(reader.num_record_batches):
+                yield reader.get_batch(i)
+
+        return flight.GeneratorStream(reader.schema, gen())
+
+
+class FlightServerHandle:
+    """Owns a running Flight service on its own thread."""
+
+    def __init__(self, work_dir: str, host: str = "0.0.0.0", port: int = 0):
+        self.service = ShuffleFlightService(work_dir, host, port)
+        self.port = self.service.port  # resolved if port was 0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FlightServerHandle":
+        self._thread = threading.Thread(
+            target=self.service.serve, name="flight-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        try:
+            self.service.shutdown()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -129,13 +129,3 @@ class DictEncoder:
     def to_arrow(self, dtype: pa.DataType) -> pa.Array:
         return pa.array(self.reverse, dtype)
 
-
-def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
-    """Pad 1-D array to the next multiple of ``bucket`` so XLA sees a small
-    fixed set of shapes (bucketed padding beats per-length recompiles)."""
-    n = len(x)
-    target = max(bucket, ((n + bucket - 1) // bucket) * bucket)
-    if target == n:
-        return x
-    pad = np.zeros(target - n, dtype=x.dtype)
-    return np.concatenate([x, pad])

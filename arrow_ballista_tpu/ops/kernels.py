@@ -194,6 +194,17 @@ class JaxExprCompiler:
                 def run_div(env, lf=lf, rf=rf):
                     lv, lval = lf(env)
                     rv, rval = rf(env)
+                    if (
+                        jnp.issubdtype(lv.dtype, jnp.integer)
+                        and jnp.issubdtype(rv.dtype, jnp.integer)
+                    ):
+                        # SQL / Arrow integer division truncates toward zero
+                        # (pc.divide on ints); lax.div matches, floor_divide
+                        # and float division do not
+                        import jax.lax as lax
+
+                        rv_safe = jnp.where(rv == 0, 1, rv)
+                        return lax.div(lv, rv_safe), _merge_valid(lval, rval)
                     return (
                         lv.astype(_F) / rv.astype(_F),
                         _merge_valid(lval, rval),
@@ -247,14 +258,27 @@ class JaxExprCompiler:
             items = e.items
             if not all(isinstance(i, (int, float)) or _is_date(i) for i in items):
                 raise NotLowerable("IN list with non-numeric items")
-            consts = jnp.asarray([_to_num(i) for i in items], _F)
+            # integer membership must compare in int64: casting an int64 id
+            # to f64 loses precision above 2^53 and admits adjacent values
+            all_int = all(
+                isinstance(i, int) and not isinstance(i, bool) for i in items
+            )
+            consts = (
+                jnp.asarray(list(items), _I)
+                if all_int
+                else jnp.asarray([_to_num(i) for i in items], _F)
+            )
             negated = e.negated
 
-            def run_in(env, f=f, consts=consts, negated=negated):
+            def run_in(env, f=f, consts=consts, negated=negated, all_int=all_int):
                 v, val = f(env)
-                m = jnp.any(
-                    jnp.equal(v.astype(_F)[:, None], consts[None, :]), axis=1
-                )
+                if all_int and jnp.issubdtype(v.dtype, jnp.integer):
+                    lhs = v.astype(_I)
+                    rhs = consts
+                else:
+                    lhs = v.astype(_F)
+                    rhs = consts.astype(_F)
+                m = jnp.any(jnp.equal(lhs[:, None], rhs[None, :]), axis=1)
                 if negated:
                     m = jnp.logical_not(m)
                 return m, val

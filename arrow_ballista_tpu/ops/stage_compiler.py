@@ -234,11 +234,16 @@ class TpuStageExec(ExecutionPlan):
             node = node.children()[0]
         if not isinstance(node, ScanExec):
             return None
+        # leaf col_index values are scan-relative, so the signature must pin
+        # the scan's actual column identity (projection / schema names) or two
+        # queries over different columns of the same provider would collide
+        source_cols = ",".join(self.fused.source.schema.names)
         sig = "|".join(
             [
                 f"{s.kind}:{s.col_index}:{s.cpu_expr}" for s in self.leaves.values()
             ]
             + [str(g) for g, _ in self.fused.group_exprs]
+            + [f"proj={node.projection}", f"cols={source_cols}"]
             + [str(ctx.batch_size), f"cap={self.capacity}"]
         )
         return node.provider, sig

@@ -88,9 +88,7 @@ def make_distributed_agg_step(
 
 
 # ------------------------------------------------- on-device repartition
-def ici_all_to_all_repartition(
-    mesh: Mesh, n_parts_per_dev: int, capacity: int
-):
+def ici_all_to_all_repartition(mesh: Mesh, capacity: int):
     """Build a sharded hash-repartition exchange over ICI.
 
     Each device holds rows plus a destination-device id per row.  Rows
@@ -99,8 +97,13 @@ def ici_all_to_all_repartition(
     static-shape answer to Ballista's variable-size shuffle files).
 
     Returns fn(values f64[rows], dest i32[rows], valid bool[rows]) →
-    (recv_values f64[n_dev*capacity], recv_valid bool[n_dev*capacity])
-    where each device ends holding every row whose dest == its index.
+    (recv_values f64[n_dev*capacity], recv_valid bool[n_dev*capacity],
+    n_dropped i32 scalar).  Each device ends holding every row whose
+    dest == its index.  ``n_dropped`` is the GLOBAL count of valid rows
+    that exceeded a (source, destination) bucket's capacity and were not
+    delivered — callers MUST check it and re-run with a larger capacity
+    (or fall back to the Flight shuffle) when it is non-zero; silent loss
+    would corrupt downstream aggregates.
     """
     from jax import shard_map
 
@@ -126,6 +129,11 @@ def ici_all_to_all_repartition(
         ok = (
             (dest_s < n_dev) & (idx_within >= 0) & (idx_within < capacity)
         )
+        # valid rows that overflowed their bucket: surfaced to the caller
+        overflow = (dest_s < n_dev) & (idx_within >= capacity)
+        n_dropped = jax.lax.psum(
+            jnp.sum(overflow.astype(jnp.int32)), DATA_AXIS
+        )
         # rows that don't belong (sentinel dest / over capacity) scatter
         # into a spill column that is sliced away — they can never clobber
         # a real slot
@@ -144,13 +152,13 @@ def ici_all_to_all_repartition(
         recv_valid = jax.lax.all_to_all(
             stage_valid, DATA_AXIS, split_axis=0, concat_axis=0, tiled=False
         )
-        return recv_vals.reshape(-1), recv_valid.reshape(-1)
+        return recv_vals.reshape(-1), recv_valid.reshape(-1), n_dropped
 
     fn = shard_map(
         local_exchange,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
         check_vma=False,
     )
     return jax.jit(fn)

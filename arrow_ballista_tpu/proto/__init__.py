@@ -1,0 +1,44 @@
+"""Wire protocol package.
+
+``ballista.proto`` is the single protocol definition (counterpart of the
+reference's ``core/proto/ballista.proto``); generated code is committed
+under ``gen/`` and regenerated automatically when the .proto is newer and
+``protoc`` is available.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PROTO = os.path.join(_HERE, "ballista.proto")
+_GEN = os.path.join(_HERE, "gen")
+_PB2 = os.path.join(_GEN, "ballista_pb2.py")
+
+
+def _maybe_regen() -> None:
+    if not os.path.exists(_PROTO):
+        return
+    if os.path.exists(_PB2) and os.path.getmtime(_PB2) >= os.path.getmtime(_PROTO):
+        return
+    try:
+        subprocess.run(
+            ["protoc", f"--python_out={_GEN}", f"-I{_HERE}", _PROTO],
+            check=True,
+            capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        if not os.path.exists(_PB2):
+            raise
+
+
+_maybe_regen()
+
+if _GEN not in sys.path:
+    sys.path.insert(0, _GEN)
+
+import ballista_pb2 as pb  # noqa: E402
+
+__all__ = ["pb"]

@@ -1,0 +1,15 @@
+from .execution_plans import (
+    WRITE_STATS_SCHEMA,
+    ShuffleReaderExec,
+    ShuffleWriterExec,
+    UnresolvedShuffleExec,
+    partition_indices,
+)
+
+__all__ = [
+    "ShuffleReaderExec",
+    "ShuffleWriterExec",
+    "UnresolvedShuffleExec",
+    "WRITE_STATS_SCHEMA",
+    "partition_indices",
+]

@@ -1,0 +1,318 @@
+"""Distributed shuffle operators.
+
+Counterparts of the reference's ``core/src/execution_plans/{shuffle_writer,
+shuffle_reader,unresolved_shuffle}.rs``:
+
+* :class:`ShuffleWriterExec` — stage-root operator; executes the stage
+  subplan for one input partition, hash-repartitions batches, persists each
+  output partition as an Arrow IPC file under
+  ``work_dir/<job>/<stage>/<out_part>/data-<in_part>.arrow`` and returns
+  per-partition :class:`ShuffleWritePartition` stats.
+* :class:`ShuffleReaderExec` — leaf operator of downstream stages; fetches
+  the map-side partitions (local file fast path, Arrow Flight otherwise).
+* :class:`UnresolvedShuffleExec` — placeholder leaf marking a dependency on
+  a not-yet-completed stage; refuses to execute.
+
+Hash partitioning runs through the native C++ kernel when available
+(:mod:`arrow_ballista_tpu.native`), falling back to the vectorized numpy
+implementation; both produce identical assignments by construction.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import pyarrow as pa
+
+from ..errors import ExecutionError
+from ..exec.expressions import PhysicalExpr
+from ..exec.operators import (
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+    hash_partition_indices,
+)
+from ..serde.scheduler_types import PartitionLocation, ShuffleWritePartition
+
+try:  # native partitioner (C++); optional
+    from ..native import native_hash_partition_indices
+except Exception:  # pragma: no cover - toolchain-less environments
+    native_hash_partition_indices = None
+
+
+def partition_indices(batch: pa.RecordBatch, exprs: list[PhysicalExpr], n: int):
+    """Partition id per row; native kernel with Python fallback."""
+    if native_hash_partition_indices is not None:
+        out = native_hash_partition_indices(batch, exprs, n)
+        if out is not None:
+            return out
+    return hash_partition_indices(batch, exprs, n)
+
+
+# The stats schema ShuffleWriterExec yields from execute() — one row per
+# written output partition (reference: shuffle_writer.rs:295+ returns an
+# equivalent stats batch).
+WRITE_STATS_SCHEMA = pa.schema(
+    [
+        pa.field("partition_id", pa.int64()),
+        pa.field("path", pa.string()),
+        pa.field("num_batches", pa.int64()),
+        pa.field("num_rows", pa.int64()),
+        pa.field("num_bytes", pa.int64()),
+    ]
+)
+
+
+class _IpcFileSink:
+    """Arrow IPC file writer with write stats (reference:
+    core/src/utils.rs:60-97 write_stream_to_disk)."""
+
+    def __init__(self, path: str, schema: pa.Schema):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.path = path
+        self.num_rows = 0
+        self.num_batches = 0
+        self._sink = pa.OSFile(path, "wb")
+        self._writer = pa.ipc.new_file(self._sink, schema)
+
+    def write(self, batch: pa.RecordBatch) -> None:
+        self._writer.write_batch(batch)
+        self.num_rows += batch.num_rows
+        self.num_batches += 1
+
+    def close(self) -> int:
+        self._writer.close()
+        self._sink.close()
+        return os.path.getsize(self.path)
+
+
+class ShuffleWriterExec(ExecutionPlan):
+    def __init__(
+        self,
+        job_id: str,
+        stage_id: int,
+        input: ExecutionPlan,
+        work_dir: str,
+        shuffle_output_partitioning: Optional[Partitioning] = None,
+    ):
+        super().__init__()
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.input = input
+        self.work_dir = work_dir
+        self.shuffle_output_partitioning = shuffle_output_partitioning
+
+    @property
+    def schema(self) -> pa.Schema:
+        return WRITE_STATS_SCHEMA
+
+    @property
+    def input_schema(self) -> pa.Schema:
+        return self.input.schema
+
+    def output_partitioning(self) -> Partitioning:
+        # one write task per *input* partition
+        return Partitioning.unknown(self.input.output_partitioning().n)
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return ShuffleWriterExec(
+            self.job_id,
+            self.stage_id,
+            children[0],
+            self.work_dir,
+            self.shuffle_output_partitioning,
+        )
+
+    # ------------------------------------------------------------- core
+    def execute_shuffle_write(
+        self, input_partition: int, ctx: TaskContext
+    ) -> list[ShuffleWritePartition]:
+        """Run the stage subplan for ``input_partition`` and persist its
+        output (reference: shuffle_writer.rs:142-292)."""
+        stage_dir = os.path.join(self.work_dir, self.job_id, str(self.stage_id))
+        part = self.shuffle_output_partitioning
+
+        if part is None:
+            # no repartition: single output file for this input partition
+            path = os.path.join(stage_dir, str(input_partition), "data.arrow")
+            sink: Optional[_IpcFileSink] = None
+            with self.metrics.timer("write_time_ns"):
+                for batch in self.input.execute(input_partition, ctx):
+                    if sink is None:
+                        sink = _IpcFileSink(path, batch.schema)
+                    sink.write(batch)
+                if sink is None:
+                    sink = _IpcFileSink(path, self.input.schema)
+                nbytes = sink.close()
+            self.metrics.add("output_rows", sink.num_rows)
+            return [
+                ShuffleWritePartition(
+                    input_partition, path, sink.num_batches, sink.num_rows, nbytes
+                )
+            ]
+
+        if part.kind != "hash":
+            raise ExecutionError(f"unsupported shuffle partitioning {part.kind}")
+
+        import numpy as np
+
+        n_out = part.n
+        exprs = list(part.exprs)
+        sinks: list[Optional[_IpcFileSink]] = [None] * n_out
+        paths = [
+            os.path.join(stage_dir, str(p), f"data-{input_partition}.arrow")
+            for p in range(n_out)
+        ]
+        in_schema = self.input.schema
+        for batch in self.input.execute(input_partition, ctx):
+            with self.metrics.timer("repart_time_ns"):
+                idx = partition_indices(batch, exprs, n_out)
+                order = np.argsort(idx, kind="stable")
+                sorted_idx = idx[order]
+                shuffled = batch.take(pa.array(order))
+                bounds = np.searchsorted(sorted_idx, np.arange(n_out + 1))
+            with self.metrics.timer("write_time_ns"):
+                for p in range(n_out):
+                    lo, hi = int(bounds[p]), int(bounds[p + 1])
+                    if hi <= lo:
+                        continue
+                    if sinks[p] is None:
+                        sinks[p] = _IpcFileSink(paths[p], batch.schema)
+                    sinks[p].write(shuffled.slice(lo, hi - lo))
+        out = []
+        with self.metrics.timer("write_time_ns"):
+            for p in range(n_out):
+                s = sinks[p]
+                if s is None:
+                    # write an empty file so readers need no existence probe
+                    s = _IpcFileSink(paths[p], in_schema)
+                nbytes = s.close()
+                self.metrics.add("output_rows", s.num_rows)
+                out.append(
+                    ShuffleWritePartition(p, s.path, s.num_batches, s.num_rows, nbytes)
+                )
+        return out
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        stats = self.execute_shuffle_write(partition, ctx)
+        yield pa.RecordBatch.from_arrays(
+            [
+                pa.array([s.partition_id for s in stats], pa.int64()),
+                pa.array([s.path for s in stats], pa.string()),
+                pa.array([s.num_batches for s in stats], pa.int64()),
+                pa.array([s.num_rows for s in stats], pa.int64()),
+                pa.array([s.num_bytes for s in stats], pa.int64()),
+            ],
+            schema=WRITE_STATS_SCHEMA,
+        )
+
+    def __str__(self) -> str:
+        p = self.shuffle_output_partitioning
+        desc = f"hash({p.n})" if p is not None else "none"
+        return f"ShuffleWriterExec: job={self.job_id} stage={self.stage_id} partitioning={desc}"
+
+
+class ShuffleReaderExec(ExecutionPlan):
+    """Reads shuffle partitions written by upstream ShuffleWriter tasks.
+
+    ``partition[p]`` lists every map-side location contributing to output
+    partition ``p`` (reference: shuffle_reader.rs:44-130).
+    """
+
+    def __init__(
+        self,
+        stage_id: int,
+        schema: pa.Schema,
+        partition: list[list[PartitionLocation]],
+    ):
+        super().__init__()
+        self.stage_id = stage_id
+        self._schema = schema
+        self.partition = partition
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(len(self.partition))
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        locations = self.partition[partition]
+        for loc in locations:
+            with self.metrics.timer("fetch_time_ns"):
+                batches = list(self._fetch(loc))
+            for b in batches:
+                self.metrics.add("output_rows", b.num_rows)
+                yield b
+
+    def _fetch(self, loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+        # local fast path: the file is on this machine's filesystem
+        if loc.path and os.path.exists(loc.path):
+            with pa.OSFile(loc.path, "rb") as f:
+                reader = pa.ipc.open_file(f)
+                for i in range(reader.num_record_batches):
+                    yield reader.get_batch(i)
+            return
+        from ..flight.client import BallistaClient
+
+        client = BallistaClient.get(loc.executor_meta.host, loc.executor_meta.flight_port)
+        yield from client.fetch_partition(
+            loc.partition_id.job_id,
+            loc.partition_id.stage_id,
+            loc.partition_id.partition_id,
+            loc.path,
+        )
+
+    def with_new_children(self, children):
+        assert not children
+        return self
+
+    def __str__(self) -> str:
+        n_loc = sum(len(p) for p in self.partition)
+        return (
+            f"ShuffleReaderExec: stage={self.stage_id} "
+            f"partitions={len(self.partition)} locations={n_loc}"
+        )
+
+
+class UnresolvedShuffleExec(ExecutionPlan):
+    """Placeholder for a dependency on stage ``stage_id`` that has not been
+    computed yet (reference: unresolved_shuffle.rs:33-110)."""
+
+    def __init__(
+        self,
+        stage_id: int,
+        schema: pa.Schema,
+        input_partition_count: int,
+        output_partition_count: int,
+    ):
+        super().__init__()
+        self.stage_id = stage_id
+        self._schema = schema
+        self.input_partition_count = input_partition_count
+        self.output_partition_count = output_partition_count
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(self.output_partition_count)
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        raise ExecutionError(
+            "UnresolvedShuffleExec cannot execute; it must be replaced with a "
+            "ShuffleReaderExec once the producing stage completes"
+        )
+
+    def with_new_children(self, children):
+        assert not children
+        return self
+
+    def __str__(self) -> str:
+        return f"UnresolvedShuffleExec: stage={self.stage_id}"

@@ -45,14 +45,15 @@ def test_distributed_partial_agg_psum(mesh8):
 def test_ici_all_to_all_repartition(mesh8):
     n_dev = 8
     cap = 64
-    fn = M.ici_all_to_all_repartition(mesh8, 1, cap)
+    fn = M.ici_all_to_all_repartition(mesh8, cap)
     n = n_dev * 100
     rng = np.random.default_rng(1)
     values = rng.normal(size=n)
     dest = rng.integers(0, n_dev, n).astype(np.int32)
     valid = np.ones(n, dtype=bool)
     v_d, d_d, ok_d = M.shard_batch(mesh8, [values, dest, valid])
-    recv_vals, recv_valid = fn(v_d, d_d, ok_d)
+    recv_vals, recv_valid, n_dropped = fn(v_d, d_d, ok_d)
+    assert int(n_dropped) == 0
 
     # device d's shard of the output must hold exactly the rows with dest==d
     rv = np.asarray(recv_vals).reshape(n_dev, n_dev * cap)
@@ -92,14 +93,15 @@ def test_repartition_with_invalid_rows(mesh8):
     # masked-out rows must not displace valid rows past the capacity bound
     n_dev = 8
     cap = 32
-    fn = M.ici_all_to_all_repartition(mesh8, 1, cap)
+    fn = M.ici_all_to_all_repartition(mesh8, cap)
     n = n_dev * 64
     rng = np.random.default_rng(3)
     values = rng.normal(size=n)
     dest = rng.integers(0, n_dev, n).astype(np.int32)
     valid = rng.random(n) < 0.5  # half the rows are masked out
     v_d, d_d, ok_d = M.shard_batch(mesh8, [values, dest, valid])
-    recv_vals, recv_valid = fn(v_d, d_d, ok_d)
+    recv_vals, recv_valid, n_dropped = fn(v_d, d_d, ok_d)
+    assert int(n_dropped) == 0
     rv = np.asarray(recv_vals).reshape(n_dev, n_dev * cap)
     rm = np.asarray(recv_valid).reshape(n_dev, n_dev * cap)
     for d in range(n_dev):

@@ -105,3 +105,82 @@ def test_date_trunc_subday_keeps_time():
     assert out.column("h").to_pylist() == [dt.datetime(2024, 5, 1, 13, 0, 0)]
     out2 = ctx.sql("select date_trunc('day', ts) as d from t").collect()
     assert out2.column("d").to_pylist() == [dt.date(2024, 5, 1)]
+
+
+def test_device_cache_distinguishes_projected_columns():
+    """Two queries over DIFFERENT columns of the same table must not share
+    a device-cache entry (scan-relative leaf indices collide)."""
+    ctx = _ctx(**{"ballista.tpu.enable": "true", "ballista.tpu.cache_columns": "true"})
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array([1, 1, 2], pa.int64()),
+                "v": pa.array([1.0, 2.0, 3.0], pa.float64()),
+                "w": pa.array([100.0, 200.0, 300.0], pa.float64()),
+            }
+        ),
+    )
+    out_v = ctx.sql("select g, sum(v) as s from t group by g order by g").collect()
+    out_w = ctx.sql("select g, sum(w) as s from t group by g order by g").collect()
+    assert out_v.column("s").to_pylist() == [pytest.approx(3.0), pytest.approx(3.0)]
+    assert out_w.column("s").to_pylist() == [pytest.approx(300.0), pytest.approx(300.0)]
+
+
+def test_integer_division_truncates_on_tpu_path():
+    """TPU lowering of `/` must match Arrow's truncating integer division."""
+    for enable in ("false", "true"):
+        ctx = _ctx(**{"ballista.tpu.enable": enable})
+        ctx.register_arrow_table(
+            "t",
+            pa.table(
+                {
+                    "g": pa.array([0, 0], pa.int64()),
+                    "a": pa.array([7, -7], pa.int64()),
+                    "b": pa.array([2, 2], pa.int64()),
+                }
+            ),
+        )
+        out = ctx.sql("select g, sum(a / b) as s from t group by g").collect()
+        # trunc(7/2) + trunc(-7/2) = 3 + (-3) = 0
+        assert out.column("s").to_pylist() == [0], f"tpu.enable={enable}"
+
+
+def test_in_list_int64_precision_on_tpu_path():
+    """IN-list over int64 must compare exactly above 2^53 (no f64 cast)."""
+    big = 9007199254740993  # 2^53 + 1: adjacent to 2^53 in f64
+    for enable in ("false", "true"):
+        ctx = _ctx(**{"ballista.tpu.enable": enable})
+        ctx.register_arrow_table(
+            "t",
+            pa.table(
+                {
+                    "id": pa.array([big, big - 1], pa.int64()),
+                    "v": pa.array([1.0, 1.0], pa.float64()),
+                }
+            ),
+        )
+        out = ctx.sql(
+            f"select count(*) as n from t where id in ({big})"
+        ).collect()
+        assert out.column("n").to_pylist() == [1], f"tpu.enable={enable}"
+
+
+def test_all_to_all_reports_overflow():
+    """Bucket overflow in the ICI shuffle must be reported, not silent."""
+    import jax
+    import numpy as np
+
+    from arrow_ballista_tpu.parallel import mesh as M
+
+    mesh = M.make_mesh(8)
+    cap = 4
+    fn = M.ici_all_to_all_repartition(mesh, cap)
+    n = 8 * 64
+    values = np.arange(n, dtype=np.float64)
+    dest = np.zeros(n, dtype=np.int32)  # everyone routes to device 0 → overflow
+    valid = np.ones(n, dtype=bool)
+    v_d, d_d, ok_d = M.shard_batch(mesh, [values, dest, valid])
+    _, recv_valid, n_dropped = fn(v_d, d_d, ok_d)
+    delivered = int(np.asarray(recv_valid).sum())
+    assert int(n_dropped) == n - delivered > 0
