@@ -1,0 +1,510 @@
+"""Per-job DAG of stages.
+
+Counterpart of the reference's ``scheduler/src/state/execution_graph.rs``:
+tracks job status, drives stage transitions as task statuses arrive, hands
+out tasks (`pop_next_task`), pushes completed map-output locations into
+consumer stages (`update_stage_output_links`), and supports executor-loss
+rollback (`reset_stages`).  Protobuf persistence follows the reference's
+rule that Running stages are stored as Resolved so a restarted scheduler
+re-dispatches in-flight work (`execution_graph.rs:867-920`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+from ..errors import SchedulerError
+from ..exec.operators import ExecutionPlan
+from ..proto import pb
+from ..serde.scheduler_types import (
+    ExecutorMetadata,
+    PartitionId,
+    PartitionLocation,
+    PartitionStats,
+    ShuffleWritePartition,
+)
+from ..shuffle import ShuffleWriterExec, UnresolvedShuffleExec
+from .execution_stage import (
+    CompletedStage,
+    FailedStage,
+    ResolvedStage,
+    RunningStage,
+    StageInput,
+    TaskInfo,
+    UnresolvedStage,
+)
+from .planner import DistributedPlanner, find_unresolved_shuffles
+
+Stage = Union[UnresolvedStage, ResolvedStage, RunningStage, CompletedStage, FailedStage]
+
+
+@dataclass
+class Task:
+    """A runnable task handed to an executor (reference:
+    execution_graph.rs:1052-1058)."""
+
+    session_id: str
+    partition: PartitionId
+    plan: ShuffleWriterExec
+    output_partitioning: Optional[object]  # Partitioning of the shuffle write
+
+
+# Job status values
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+FAILED = "failed"
+
+
+class ExecutionGraph:
+    def __init__(
+        self,
+        scheduler_id: str,
+        job_id: str,
+        session_id: str,
+        plan: ExecutionPlan,
+        work_dir: str = "/tmp/ballista-tpu",
+    ):
+        self.scheduler_id = scheduler_id
+        self.job_id = job_id
+        self.session_id = session_id
+        self.status: str = QUEUED
+        self.error: str = ""
+        self.stages: Dict[int, Stage] = {}
+        self.output_locations: List[PartitionLocation] = []
+
+        planner = DistributedPlanner(work_dir)
+        stage_plans = planner.plan_query_stages(job_id, plan)
+        self._final_stage_id = stage_plans[-1].stage_id
+        self.output_partitions = stage_plans[-1].output_partitioning().n
+        self.stages = _build_stages(stage_plans)
+
+    # ------------------------------------------------------------- intro
+    @property
+    def final_stage_id(self) -> int:
+        return self._final_stage_id
+
+    def stage_count(self) -> int:
+        return len(self.stages)
+
+    def is_successful(self) -> bool:
+        return self.status == COMPLETED
+
+    def is_complete(self) -> bool:
+        return all(isinstance(s, CompletedStage) for s in self.stages.values())
+
+    def available_tasks(self) -> int:
+        return sum(
+            s.available_tasks()
+            for s in self.stages.values()
+            if isinstance(s, RunningStage)
+        )
+
+    # ------------------------------------------------------------ revive
+    def revive(self) -> bool:
+        """Resolve every resolvable stage and start every resolved stage
+        (reference: execution_graph.rs:169-193).  Returns True if anything
+        changed."""
+        changed = False
+        for sid, stage in list(self.stages.items()):
+            if isinstance(stage, UnresolvedStage) and stage.resolvable():
+                self.stages[sid] = stage.to_resolved()
+                changed = True
+        for sid, stage in list(self.stages.items()):
+            if isinstance(stage, ResolvedStage):
+                self.stages[sid] = stage.to_running()
+                changed = True
+        if changed and self.status == QUEUED:
+            self.status = RUNNING
+        return changed
+
+    # ----------------------------------------------------------- dispatch
+    def pop_next_task(self, executor_id: str) -> Optional[Task]:
+        """Find a Running stage with an unclaimed partition, mark it
+        running on ``executor_id`` and return it
+        (reference: execution_graph.rs:418-471)."""
+        for sid in sorted(self.stages):
+            stage = self.stages[sid]
+            if not isinstance(stage, RunningStage):
+                continue
+            for p, t in enumerate(stage.task_statuses):
+                if t is None:
+                    pid = PartitionId(self.job_id, sid, p)
+                    stage.task_statuses[p] = TaskInfo(pid, "running", executor_id)
+                    return Task(
+                        self.session_id,
+                        pid,
+                        stage.plan,
+                        stage.plan.shuffle_output_partitioning,
+                    )
+        return None
+
+    def reset_task_status(self, partition: PartitionId) -> None:
+        """Return a handed-out task to the pool (launch failed / reservation
+        cancelled)."""
+        stage = self.stages.get(partition.stage_id)
+        if isinstance(stage, RunningStage):
+            t = stage.task_statuses[partition.partition_id]
+            if t is not None and t.state == "running":
+                stage.task_statuses[partition.partition_id] = None
+
+    # ------------------------------------------------------ status updates
+    def update_task_status(
+        self,
+        info: TaskInfo,
+        executor: Optional[ExecutorMetadata] = None,
+    ) -> List[str]:
+        """Apply one task status; returns job-level events out of
+        ("job_updated", "job_completed", "job_failed")
+        (reference: execution_graph.rs:197-318)."""
+        stage = self.stages.get(info.partition_id.stage_id)
+        if stage is None:
+            raise SchedulerError(
+                f"job {self.job_id}: unknown stage {info.partition_id.stage_id}"
+            )
+        if not isinstance(stage, RunningStage):
+            # late status for a stage already rolled back or completed
+            return []
+
+        events: List[str] = []
+        if info.state == "failed":
+            self.stages[info.partition_id.stage_id] = stage.to_failed(info.error)
+            self.status = FAILED
+            self.error = (
+                f"stage {info.partition_id.stage_id} task "
+                f"{info.partition_id.partition_id} failed: {info.error}"
+            )
+            return ["job_failed"]
+
+        stage.update_task_status(info)
+        if info.state == "completed":
+            stage.update_task_metrics(info)
+            if executor is not None:
+                self._propagate_output(stage, info, executor)
+            if stage.is_completed():
+                sid = info.partition_id.stage_id
+                completed = stage.to_completed()
+                self.stages[sid] = completed
+                for link in completed.output_links:
+                    consumer = self.stages.get(link)
+                    if isinstance(consumer, UnresolvedStage):
+                        consumer.complete_input(sid)
+                if sid == self._final_stage_id:
+                    self._collect_job_output(completed, executor)
+                    self.status = COMPLETED
+                    events.append("job_completed")
+                else:
+                    self.revive()
+                    events.append("job_updated")
+            else:
+                events.append("job_updated")
+        return events
+
+    def _propagate_output(
+        self, stage: RunningStage, info: TaskInfo, executor: ExecutorMetadata
+    ) -> None:
+        """Push one completed map task's shuffle partitions into consumer
+        stages' inputs (reference: execution_graph.rs:320-369)."""
+        locations = [
+            PartitionLocation(
+                PartitionId(self.job_id, stage.stage_id, p.partition_id),
+                executor,
+                PartitionStats(p.num_rows, p.num_batches, p.num_bytes),
+                p.path,
+            )
+            for p in info.partitions
+        ]
+        for link in stage.output_links:
+            consumer = self.stages.get(link)
+            if isinstance(consumer, UnresolvedStage):
+                consumer.add_input_partitions(stage.stage_id, locations)
+
+    def _collect_job_output(
+        self, stage: CompletedStage, executor: Optional[ExecutorMetadata]
+    ) -> None:
+        self.output_locations = []
+        for t in stage.task_statuses:
+            if t is None:
+                continue
+            meta = executor
+            for p in t.partitions:
+                self.output_locations.append(
+                    PartitionLocation(
+                        PartitionId(self.job_id, stage.stage_id, p.partition_id),
+                        meta if meta is not None else ExecutorMetadata("", "", 0),
+                        PartitionStats(p.num_rows, p.num_batches, p.num_bytes),
+                        p.path,
+                    )
+                )
+
+    # ------------------------------------------------------------- failure
+    def fail_job(self, error: str) -> None:
+        self.status = FAILED
+        self.error = error
+
+    def reset_stages(self, executor_id: str) -> int:
+        """Executor-loss rollback (reference: execution_graph.rs:499-622):
+
+        * clear running tasks assigned to the executor;
+        * strip its partition locations from unresolved stages' inputs;
+        * roll Running/Resolved stages whose inputs lost data back to
+          UnResolved;
+        * re-run Completed stages whose map outputs were lost.
+
+        Returns the number of affected stages."""
+        affected = set()
+
+        # 1) running stages: reset that executor's tasks
+        for sid, stage in list(self.stages.items()):
+            if isinstance(stage, RunningStage):
+                if stage.reset_tasks(executor_id):
+                    affected.add(sid)
+
+        # 2) strip lost input locations everywhere; find consumers that lost
+        #    data and must re-resolve
+        rollback_consumers = set()
+        for sid, stage in list(self.stages.items()):
+            if isinstance(stage, UnresolvedStage):
+                before = _locations_of(stage, executor_id)
+                if before:
+                    stage.remove_input_partitions(executor_id)
+                    affected.add(sid)
+            elif isinstance(stage, (ResolvedStage, RunningStage)):
+                lost = any(
+                    any(
+                        l.executor_meta.id == executor_id
+                        for locs in inp.partition_locations.values()
+                        for l in locs
+                    )
+                    for inp in stage.inputs.values()
+                )
+                if lost:
+                    rollback_consumers.add(sid)
+
+        # 3) roll back consumers to unresolved
+        rerun_producers = set()
+        for sid in rollback_consumers:
+            stage = self.stages[sid]
+            if isinstance(stage, RunningStage):
+                stage = stage.to_resolved()
+            assert isinstance(stage, ResolvedStage)
+            unresolved = stage.to_unresolved()
+            unresolved.remove_input_partitions(executor_id)
+            # any input stage whose data was lost must re-run
+            for in_sid, inp in unresolved.inputs.items():
+                if not inp.complete:
+                    rerun_producers.add(in_sid)
+            self.stages[sid] = unresolved
+            affected.add(sid)
+
+        # 4) completed producers with lost map output re-run their lost tasks
+        for sid in sorted(rerun_producers):
+            stage = self.stages.get(sid)
+            if isinstance(stage, CompletedStage):
+                running = stage.to_running()
+                running.reset_tasks(executor_id)
+                self.stages[sid] = running
+                affected.add(sid)
+
+        # 5) also re-run completed stages whose own output files lived on
+        #    the lost executor and feed a still-unresolved consumer
+        if affected and self.status == COMPLETED:
+            self.status = RUNNING
+        self.revive()
+        return len(affected)
+
+    # -------------------------------------------------------- persistence
+    def encode(self) -> bytes:
+        from ..serde import BallistaCodec
+
+        g = pb.ExecutionGraphProto()
+        g.job_id = self.job_id
+        g.session_id = self.session_id
+        g.scheduler_id = self.scheduler_id
+        g.output_partitions = self.output_partitions
+        if self.status == QUEUED:
+            g.status.queued.SetInParent()
+        elif self.status == RUNNING:
+            g.status.running.SetInParent()
+        elif self.status == FAILED:
+            g.status.failed.error = self.error
+        else:
+            for loc in self.output_locations:
+                g.status.completed.partition_location.add().CopyFrom(loc.to_proto())
+        for sid in sorted(self.stages):
+            stage = self.stages[sid]
+            sp = g.stages.add()
+            if isinstance(stage, RunningStage):
+                stage = stage.to_resolved()  # re-dispatch on restart
+            if isinstance(stage, UnresolvedStage):
+                sp.unresolved.stage_id = sid
+                sp.unresolved.plan = BallistaCodec.encode_physical(stage.plan)
+                sp.unresolved.output_links.extend(stage.output_links)
+                _encode_inputs(sp.unresolved.inputs, stage.inputs)
+            elif isinstance(stage, ResolvedStage):
+                sp.resolved.stage_id = sid
+                sp.resolved.partitions = stage.partitions
+                sp.resolved.plan = BallistaCodec.encode_physical(stage.plan)
+                sp.resolved.output_links.extend(stage.output_links)
+                _encode_inputs(sp.resolved.inputs, stage.inputs)
+            elif isinstance(stage, CompletedStage):
+                sp.completed.stage_id = sid
+                sp.completed.partitions = stage.partitions
+                sp.completed.plan = BallistaCodec.encode_physical(stage.plan)
+                sp.completed.output_links.extend(stage.output_links)
+                _encode_inputs(sp.completed.inputs, stage.inputs)
+                for t in stage.task_statuses:
+                    if t is None:
+                        continue
+                    ts = sp.completed.task_statuses.add()
+                    ts.task_id.CopyFrom(t.partition_id.to_proto())
+                    ts.completed.executor_id = t.executor_id
+                    for p in t.partitions:
+                        ts.completed.partitions.add().CopyFrom(p.to_proto())
+            elif isinstance(stage, FailedStage):
+                sp.failed.stage_id = sid
+                sp.failed.partitions = stage.partitions
+                sp.failed.plan = BallistaCodec.encode_physical(stage.plan)
+                sp.failed.output_links.extend(stage.output_links)
+                sp.failed.error = stage.error
+        return g.SerializeToString()
+
+    @classmethod
+    def decode(cls, data: bytes, work_dir: str = "/tmp/ballista-tpu") -> "ExecutionGraph":
+        from ..serde import BallistaCodec
+
+        g = pb.ExecutionGraphProto.FromString(data)
+        self = cls.__new__(cls)
+        self.scheduler_id = g.scheduler_id
+        self.job_id = g.job_id
+        self.session_id = g.session_id
+        self.output_partitions = g.output_partitions
+        self.output_locations = []
+        self.error = ""
+        which = g.status.WhichOneof("status")
+        if which == "queued":
+            self.status = QUEUED
+        elif which == "running":
+            self.status = RUNNING
+        elif which == "failed":
+            self.status = FAILED
+            self.error = g.status.failed.error
+        else:
+            self.status = COMPLETED
+            self.output_locations = [
+                PartitionLocation.from_proto(l)
+                for l in g.status.completed.partition_location
+            ]
+        self.stages = {}
+        max_sid = 0
+        for sp in g.stages:
+            which = sp.WhichOneof("stage")
+            if which == "unresolved":
+                s = sp.unresolved
+                stage: Stage = UnresolvedStage(
+                    s.stage_id,
+                    BallistaCodec.decode_physical(s.plan, work_dir),
+                    list(s.output_links),
+                    _decode_inputs(s.inputs),
+                )
+            elif which == "resolved":
+                s = sp.resolved
+                stage = ResolvedStage(
+                    s.stage_id,
+                    BallistaCodec.decode_physical(s.plan, work_dir),
+                    list(s.output_links),
+                    _decode_inputs(s.inputs),
+                )
+            elif which == "completed":
+                s = sp.completed
+                statuses: List[Optional[TaskInfo]] = [None] * s.partitions
+                for ts in s.task_statuses:
+                    pid = PartitionId.from_proto(ts.task_id)
+                    statuses[pid.partition_id] = TaskInfo(
+                        pid,
+                        "completed",
+                        ts.completed.executor_id,
+                        partitions=[
+                            ShuffleWritePartition.from_proto(p)
+                            for p in ts.completed.partitions
+                        ],
+                    )
+                stage = CompletedStage(
+                    s.stage_id,
+                    BallistaCodec.decode_physical(s.plan, work_dir),
+                    list(s.output_links),
+                    _decode_inputs(s.inputs),
+                    statuses,
+                )
+            else:
+                s = sp.failed
+                stage = FailedStage(
+                    s.stage_id,
+                    BallistaCodec.decode_physical(s.plan, work_dir),
+                    list(s.output_links),
+                    s.error,
+                )
+            self.stages[stage.stage_id] = stage
+            max_sid = max(max_sid, stage.stage_id)
+        self._final_stage_id = max_sid
+        return self
+
+
+def _encode_inputs(out, inputs: Dict[int, StageInput]) -> None:
+    for sid, inp in inputs.items():
+        m = out.add()
+        m.stage_id = sid
+        m.complete = inp.complete
+        for locs in inp.partition_locations.values():
+            for l in locs:
+                m.partition_locations.add().CopyFrom(l.to_proto())
+
+
+def _decode_inputs(msgs) -> Dict[int, StageInput]:
+    out: Dict[int, StageInput] = {}
+    for m in msgs:
+        inp = StageInput(complete=m.complete)
+        for l in m.partition_locations:
+            inp.add_partition(PartitionLocation.from_proto(l))
+        out[m.stage_id] = inp
+    return out
+
+
+def _locations_of(stage: UnresolvedStage, executor_id: str) -> int:
+    return sum(
+        1
+        for inp in stage.inputs.values()
+        for locs in inp.partition_locations.values()
+        for l in locs
+        if l.executor_meta.id == executor_id
+    )
+
+
+def _build_stages(stage_plans: List[ShuffleWriterExec]) -> Dict[int, Stage]:
+    """Infer the DAG from UnresolvedShuffleExec leaves
+    (reference: ExecutionStageBuilder, execution_graph.rs:941-1038)."""
+    dependencies: Dict[int, List[int]] = {}  # stage -> stages it reads
+    for sp in stage_plans:
+        dependencies[sp.stage_id] = [
+            sh.stage_id for sh in find_unresolved_shuffles(sp)
+        ]
+
+    output_links: Dict[int, List[int]] = {sp.stage_id: [] for sp in stage_plans}
+    for consumer, producers in dependencies.items():
+        for p in producers:
+            output_links[p].append(consumer)
+
+    stages: Dict[int, Stage] = {}
+    for sp in stage_plans:
+        inputs = {p: StageInput() for p in dependencies[sp.stage_id]}
+        if inputs:
+            stages[sp.stage_id] = UnresolvedStage(
+                sp.stage_id, sp, output_links[sp.stage_id], inputs
+            )
+        else:
+            # leaf stage: immediately resolvable
+            stages[sp.stage_id] = ResolvedStage(
+                sp.stage_id, sp, output_links[sp.stage_id], {}
+            )
+    return stages

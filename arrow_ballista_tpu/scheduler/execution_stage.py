@@ -1,0 +1,282 @@
+"""Stage state machine.
+
+Counterpart of the reference's
+``scheduler/src/state/execution_graph/execution_stage.rs:44-58``:
+
+              to_resolved()          start
+  UnResolved ────────────▶ Resolved ──────▶ Running ──▶ Completed
+      ▲                        ▲               │  ▲          │
+      │ rollback (lost input)  │ reset_tasks   │  │          │ re-run
+      └────────────────────────┴───────────────┘  └──────────┘
+                              Failed ◀── task failure
+
+A stage's *plan* is a ``ShuffleWriterExec`` subtree; its *tasks* are the
+plan's input partitions.  ``inputs`` tracks, per producing stage, the
+map-side partition locations accumulated so far.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..errors import SchedulerError
+from ..exec.operators import ExecutionPlan
+from ..serde.scheduler_types import (
+    PartitionId,
+    PartitionLocation,
+    ShuffleWritePartition,
+)
+from ..shuffle import ShuffleWriterExec
+from .planner import (
+    find_unresolved_shuffles,
+    remove_unresolved_shuffles,
+    rollback_resolved_shuffles,
+)
+
+
+# --------------------------------------------------------------- task status
+@dataclass
+class TaskInfo:
+    """Scheduler-side view of one task attempt (reference: proto TaskStatus)."""
+
+    partition_id: PartitionId
+    state: str  # "running" | "completed" | "failed"
+    executor_id: str = ""
+    error: str = ""
+    partitions: List[ShuffleWritePartition] = field(default_factory=list)
+    metrics: List[tuple] = field(default_factory=list)  # (operator, {k: v})
+
+
+@dataclass
+class StageInput:
+    """Accumulated output of one producing stage, as seen by a consumer
+    (reference: execution_stage.rs StageOutput)."""
+
+    complete: bool = False
+    # output partition index -> locations from each completed map task
+    partition_locations: Dict[int, List[PartitionLocation]] = field(
+        default_factory=dict
+    )
+
+    def add_partition(self, loc: PartitionLocation) -> None:
+        self.partition_locations.setdefault(loc.partition_id.partition_id, []).append(
+            loc
+        )
+
+
+# ------------------------------------------------------------------- stages
+@dataclass
+class UnresolvedStage:
+    stage_id: int
+    plan: ShuffleWriterExec
+    output_links: List[int] = field(default_factory=list)
+    inputs: Dict[int, StageInput] = field(default_factory=dict)
+
+    def add_input_partitions(
+        self, stage_id: int, locations: List[PartitionLocation]
+    ) -> None:
+        if stage_id not in self.inputs:
+            raise SchedulerError(
+                f"stage {self.stage_id} has no input from stage {stage_id}"
+            )
+        for loc in locations:
+            self.inputs[stage_id].add_partition(loc)
+
+    def remove_input_partitions(self, executor_id: str) -> None:
+        """Strip locations served by a lost executor and mark those inputs
+        incomplete (reference: execution_stage.rs remove_input_partitions)."""
+        for inp in self.inputs.values():
+            changed = False
+            for p, locs in inp.partition_locations.items():
+                kept = [l for l in locs if l.executor_meta.id != executor_id]
+                if len(kept) != len(locs):
+                    changed = True
+                inp.partition_locations[p] = kept
+            if changed:
+                inp.complete = False
+
+    def complete_input(self, stage_id: int) -> None:
+        if stage_id in self.inputs:
+            self.inputs[stage_id].complete = True
+
+    def resolvable(self) -> bool:
+        return all(i.complete for i in self.inputs.values())
+
+    def to_resolved(self) -> "ResolvedStage":
+        locations: Dict[int, List[List[PartitionLocation]]] = {}
+        for shuffle in find_unresolved_shuffles(self.plan):
+            inp = self.inputs.get(shuffle.stage_id)
+            if inp is None or not inp.complete:
+                raise SchedulerError(
+                    f"stage {self.stage_id}: input stage {shuffle.stage_id} "
+                    "is not complete"
+                )
+            locations[shuffle.stage_id] = [
+                sorted(
+                    inp.partition_locations.get(p, []),
+                    key=lambda l: l.path,
+                )
+                for p in range(shuffle.output_partition_count)
+            ]
+        resolved_plan = (
+            remove_unresolved_shuffles(self.plan, locations)
+            if locations
+            else self.plan
+        )
+        return ResolvedStage(
+            self.stage_id,
+            resolved_plan,
+            list(self.output_links),
+            dict(self.inputs),
+        )
+
+
+@dataclass
+class ResolvedStage:
+    stage_id: int
+    plan: ShuffleWriterExec
+    output_links: List[int] = field(default_factory=list)
+    inputs: Dict[int, StageInput] = field(default_factory=dict)
+
+    @property
+    def partitions(self) -> int:
+        return self.plan.output_partitioning().n
+
+    def to_running(self) -> "RunningStage":
+        return RunningStage(
+            self.stage_id,
+            self.plan,
+            list(self.output_links),
+            dict(self.inputs),
+            [None] * self.partitions,
+        )
+
+    def to_unresolved(self) -> UnresolvedStage:
+        """Roll back for executor-loss recovery."""
+        return UnresolvedStage(
+            self.stage_id,
+            rollback_resolved_shuffles(self.plan),
+            list(self.output_links),
+            dict(self.inputs),
+        )
+
+
+@dataclass
+class RunningStage:
+    stage_id: int
+    plan: ShuffleWriterExec
+    output_links: List[int]
+    inputs: Dict[int, StageInput]
+    task_statuses: List[Optional[TaskInfo]]
+    stage_metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    @property
+    def partitions(self) -> int:
+        return len(self.task_statuses)
+
+    def available_tasks(self) -> int:
+        return sum(1 for t in self.task_statuses if t is None)
+
+    def update_task_status(self, info: TaskInfo) -> None:
+        p = info.partition_id.partition_id
+        if not (0 <= p < self.partitions):
+            raise SchedulerError(
+                f"stage {self.stage_id}: task partition {p} out of range"
+            )
+        self.task_statuses[p] = info
+
+    def update_task_metrics(self, info: TaskInfo) -> None:
+        """Merge one task's per-operator metrics into the combined stage
+        metrics (reference: execution_stage.rs RunningStage::update_task_metrics)."""
+        for op_name, values in info.metrics:
+            agg = self.stage_metrics.setdefault(op_name, {})
+            for k, v in values.items():
+                agg[k] = agg.get(k, 0) + v
+
+    def is_completed(self) -> bool:
+        return all(t is not None and t.state == "completed" for t in self.task_statuses)
+
+    def completed_tasks(self) -> int:
+        return sum(
+            1 for t in self.task_statuses if t is not None and t.state == "completed"
+        )
+
+    def reset_tasks(self, executor_id: str) -> int:
+        """Clear every task that ran on a lost executor; returns count."""
+        n = 0
+        for i, t in enumerate(self.task_statuses):
+            if t is not None and t.executor_id == executor_id:
+                self.task_statuses[i] = None
+                n += 1
+        return n
+
+    def to_completed(self) -> "CompletedStage":
+        return CompletedStage(
+            self.stage_id,
+            self.plan,
+            list(self.output_links),
+            dict(self.inputs),
+            list(self.task_statuses),
+            dict(self.stage_metrics),
+        )
+
+    def to_failed(self, error: str) -> "FailedStage":
+        return FailedStage(
+            self.stage_id,
+            self.plan,
+            list(self.output_links),
+            error,
+        )
+
+    def to_resolved(self) -> ResolvedStage:
+        """Drop in-flight work (persistence rule: Running is stored as
+        Resolved so a restarted scheduler re-dispatches)."""
+        return ResolvedStage(
+            self.stage_id, self.plan, list(self.output_links), dict(self.inputs)
+        )
+
+
+@dataclass
+class CompletedStage:
+    stage_id: int
+    plan: ShuffleWriterExec
+    output_links: List[int]
+    inputs: Dict[int, StageInput]
+    task_statuses: List[Optional[TaskInfo]]
+    stage_metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    @property
+    def partitions(self) -> int:
+        return len(self.task_statuses)
+
+    def to_running(self) -> RunningStage:
+        """Re-run after its shuffle files were lost with an executor."""
+        return RunningStage(
+            self.stage_id,
+            self.plan,
+            list(self.output_links),
+            dict(self.inputs),
+            list(self.task_statuses),
+            dict(self.stage_metrics),
+        )
+
+    def reset_tasks(self, executor_id: str) -> int:
+        n = 0
+        for i, t in enumerate(self.task_statuses):
+            if t is not None and t.executor_id == executor_id:
+                self.task_statuses[i] = None
+                n += 1
+        return n
+
+
+@dataclass
+class FailedStage:
+    stage_id: int
+    plan: ShuffleWriterExec
+    output_links: List[int]
+    error: str
+
+    @property
+    def partitions(self) -> int:
+        return self.plan.output_partitioning().n

@@ -1,0 +1,148 @@
+"""Distributed planner: split a physical plan into shuffle-bounded stages.
+
+Counterpart of the reference's ``scheduler/src/planner.rs``:
+
+* recursive walk of the physical plan; at ``RepartitionExec(hash)`` insert a
+  ``ShuffleWriterExec`` with that hash partitioning and replace the subtree
+  with an ``UnresolvedShuffleExec`` placeholder (`planner.rs:127-156`);
+* at ``CoalescePartitionsExec`` insert a ``ShuffleWriterExec`` with no
+  repartitioning under the coalesce (`planner.rs:97-125`);
+* non-hash repartitions are dropped (`planner.rs:157-164`);
+* finally the root is wrapped in a ``ShuffleWriterExec`` with no
+  partitioning — its output files are the job's result (`planner.rs:61-76`).
+
+Also ``remove_unresolved_shuffles`` (swap placeholders for readers with real
+locations once producing stages complete, `planner.rs:199-247`) and
+``rollback_resolved_shuffles`` (the inverse, for executor-loss recovery,
+`planner.rs:252-275`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..errors import PlanError
+from ..exec.operators import (
+    CoalescePartitionsExec,
+    ExecutionPlan,
+    RepartitionExec,
+)
+from ..serde.scheduler_types import PartitionLocation
+from ..shuffle import ShuffleReaderExec, ShuffleWriterExec, UnresolvedShuffleExec
+
+
+class DistributedPlanner:
+    def __init__(self, work_dir: str = "/tmp/ballista-tpu"):
+        self.work_dir = work_dir
+        self._next_stage_id = 0
+
+    def _new_stage_id(self) -> int:
+        self._next_stage_id += 1
+        return self._next_stage_id
+
+    def plan_query_stages(
+        self, job_id: str, plan: ExecutionPlan
+    ) -> List[ShuffleWriterExec]:
+        """Return all stages; the last entry is the job's root stage."""
+        stages, root = self._plan(job_id, plan)
+        stages.append(self._create_shuffle_writer(job_id, root, None))
+        return stages
+
+    def _plan(
+        self, job_id: str, plan: ExecutionPlan
+    ) -> tuple[List[ShuffleWriterExec], ExecutionPlan]:
+        stages: List[ShuffleWriterExec] = []
+        children = []
+        for child in plan.children():
+            child_stages, child_plan = self._plan(job_id, child)
+            stages.extend(child_stages)
+            children.append(child_plan)
+
+        if isinstance(plan, CoalescePartitionsExec):
+            writer = self._create_shuffle_writer(job_id, children[0], None)
+            stages.append(writer)
+            placeholder = UnresolvedShuffleExec(
+                writer.stage_id,
+                writer.input_schema,
+                writer.output_partitioning().n,
+                # no repartition: one output file per input partition
+                writer.output_partitioning().n,
+            )
+            return stages, plan.with_new_children([placeholder])
+
+        if isinstance(plan, RepartitionExec):
+            part = plan.partitioning
+            if part.kind == "hash":
+                writer = self._create_shuffle_writer(job_id, children[0], part)
+                stages.append(writer)
+                placeholder = UnresolvedShuffleExec(
+                    writer.stage_id,
+                    writer.input_schema,
+                    writer.output_partitioning().n,
+                    part.n,
+                )
+                return stages, placeholder
+            # round-robin / unknown repartitions add nothing across a
+            # process boundary: drop the node (reference planner.rs:157-164)
+            return stages, children[0]
+
+        if children:
+            return stages, plan.with_new_children(children)
+        return stages, plan
+
+    def _create_shuffle_writer(
+        self, job_id: str, plan: ExecutionPlan, partitioning
+    ) -> ShuffleWriterExec:
+        return ShuffleWriterExec(
+            job_id, self._new_stage_id(), plan, self.work_dir, partitioning
+        )
+
+
+def find_unresolved_shuffles(plan: ExecutionPlan) -> List[UnresolvedShuffleExec]:
+    out: List[UnresolvedShuffleExec] = []
+    if isinstance(plan, UnresolvedShuffleExec):
+        out.append(plan)
+    for c in plan.children():
+        out.extend(find_unresolved_shuffles(c))
+    return out
+
+
+def remove_unresolved_shuffles(
+    plan: ExecutionPlan,
+    partition_locations: Dict[int, List[List[PartitionLocation]]],
+) -> ExecutionPlan:
+    """Swap every UnresolvedShuffleExec for a ShuffleReaderExec with the
+    producing stage's real output locations."""
+    if isinstance(plan, UnresolvedShuffleExec):
+        locs = partition_locations.get(plan.stage_id)
+        if locs is None:
+            raise PlanError(
+                f"no partition locations for stage {plan.stage_id}"
+            )
+        if len(locs) != plan.output_partition_count:
+            raise PlanError(
+                f"stage {plan.stage_id}: expected "
+                f"{plan.output_partition_count} output partitions, got {len(locs)}"
+            )
+        return ShuffleReaderExec(plan.stage_id, plan.schema, locs)
+    children = plan.children()
+    if not children:
+        return plan
+    return plan.with_new_children(
+        [remove_unresolved_shuffles(c, partition_locations) for c in children]
+    )
+
+
+def rollback_resolved_shuffles(plan: ExecutionPlan) -> ExecutionPlan:
+    """Inverse of remove_unresolved_shuffles (executor-loss recovery)."""
+    if isinstance(plan, ShuffleReaderExec):
+        n_out = len(plan.partition)
+        # input partition count is not recoverable from the reader alone and
+        # is not needed to re-resolve; re-derived when the stage re-completes
+        return UnresolvedShuffleExec(plan.stage_id, plan.schema, n_out, n_out)
+    children = plan.children()
+    if not children:
+        return plan
+    return plan.with_new_children(
+        [rollback_resolved_shuffles(c) for c in children]
+    )

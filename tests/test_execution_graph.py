@@ -1,0 +1,292 @@
+"""DistributedPlanner + ExecutionGraph state machine tests.
+
+Mirrors the reference's strategy (`execution_graph.rs:1117-1149`
+test_drain_tasks, `planner.rs:292-633` golden stage splits): build real
+plans through the SQL frontend, split into stages, then drain the graph to
+completion by hand-feeding completed TaskInfo messages the way a fake
+executor would (`scheduler_server/mod.rs:349-393`).
+"""
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    COMPLETED,
+    FAILED,
+    RUNNING,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.execution_stage import (
+    CompletedStage,
+    RunningStage,
+    TaskInfo,
+    UnresolvedStage,
+)
+from arrow_ballista_tpu.scheduler.planner import DistributedPlanner
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.shuffle import ShuffleWriterExec, UnresolvedShuffleExec
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052)
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052)
+
+
+def make_ctx(partitions=2):
+    ctx = SessionContext(
+        BallistaConfig(
+            {
+                "ballista.shuffle.partitions": str(partitions),
+                "ballista.tpu.enable": "false",
+            }
+        )
+    )
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a", "c"], pa.string()),
+                "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+                "k": pa.array([1, 2, 3, 4], pa.int64()),
+            }
+        ),
+        partitions=2,
+    )
+    ctx.register_arrow_table(
+        "u",
+        pa.table(
+            {
+                "k": pa.array([1, 2, 5], pa.int64()),
+                "w": pa.array(["x", "y", "z"], pa.string()),
+            }
+        ),
+        partitions=2,
+    )
+    return ctx
+
+
+def physical(ctx, sql):
+    df = ctx.sql(sql)
+    return PhysicalPlanner(ctx.config).create_physical_plan(df.optimized_plan())
+
+
+def make_graph(sql, partitions=2, job_id="job1"):
+    ctx = make_ctx(partitions)
+    return ExecutionGraph("sched-1", job_id, ctx.session_id, physical(ctx, sql))
+
+
+def complete_task(graph, task, executor):
+    """Simulate an executor finishing a shuffle-write task."""
+    part = task.output_partitioning
+    if part is not None:
+        partitions = [
+            ShuffleWritePartition(p, f"/fake/{task.partition}/{p}.arrow", 1, 10, 100)
+            for p in range(part.n)
+        ]
+    else:
+        partitions = [
+            ShuffleWritePartition(
+                task.partition.partition_id,
+                f"/fake/{task.partition}/data.arrow",
+                1,
+                10,
+                100,
+            )
+        ]
+    info = TaskInfo(task.partition, "completed", executor.id, partitions=partitions)
+    return graph.update_task_status(info, executor)
+
+
+def drain(graph, executor=EXEC1):
+    """Pull and complete tasks until the graph finishes; returns task count."""
+    graph.revive()
+    n = 0
+    for _ in range(1000):
+        task = graph.pop_next_task(executor.id)
+        if task is None:
+            if graph.status in (COMPLETED, FAILED):
+                break
+            graph.revive()
+            task = graph.pop_next_task(executor.id)
+            if task is None:
+                break
+        complete_task(graph, task, executor)
+        n += 1
+    return n
+
+
+# ------------------------------------------------------------ stage split
+def test_aggregate_splits_into_two_stages():
+    ctx = make_ctx()
+    plan = physical(ctx, "select g, sum(v) as s from t group by g")
+    stages = DistributedPlanner("/tmp/wd").plan_query_stages("j", plan)
+    assert len(stages) == 2
+    # map stage writes hash partitions; final stage has no repartition
+    assert stages[0].shuffle_output_partitioning is not None
+    assert stages[0].shuffle_output_partitioning.kind == "hash"
+    assert stages[-1].shuffle_output_partitioning is None
+    shuffles = [
+        s for s in _walk(stages[-1]) if isinstance(s, UnresolvedShuffleExec)
+    ]
+    assert len(shuffles) == 1
+    assert shuffles[0].stage_id == stages[0].stage_id
+
+
+def test_join_splits_into_three_stages():
+    ctx = make_ctx()
+    plan = physical(ctx, "select t.g, u.w from t join u on t.k = u.k")
+    stages = DistributedPlanner("/tmp/wd").plan_query_stages("j", plan)
+    # two map stages (left+right hash repartition) + probe stage
+    assert len(stages) == 3
+    assert stages[0].shuffle_output_partitioning.kind == "hash"
+    assert stages[1].shuffle_output_partitioning.kind == "hash"
+
+
+def test_sort_adds_coalesce_stage():
+    ctx = make_ctx()
+    plan = physical(ctx, "select g, sum(v) as s from t group by g order by s")
+    stages = DistributedPlanner("/tmp/wd").plan_query_stages("j", plan)
+    # partial agg -> shuffle -> final agg -> coalesce boundary -> sort
+    assert len(stages) == 3
+
+
+def _walk(plan):
+    yield plan
+    for c in plan.children():
+        yield from _walk(c)
+
+
+# ------------------------------------------------------------- graph drain
+@pytest.mark.parametrize(
+    "sql,expect_stages",
+    [
+        ("select g, sum(v) as s from t group by g", 2),
+        ("select t.g, u.w from t join u on t.k = u.k", 3),
+        ("select g, sum(v) as s from t group by g order by s limit 2", 3),
+        ("select count(*) as n from t", 2),
+    ],
+)
+def test_drain_tasks_to_completion(sql, expect_stages):
+    graph = make_graph(sql)
+    assert graph.stage_count() == expect_stages
+    n = drain(graph)
+    assert graph.status == COMPLETED, graph.error
+    assert graph.is_complete()
+    assert n >= expect_stages  # at least one task per stage
+    assert len(graph.output_locations) == graph.output_partitions
+
+
+def test_task_ordering_respects_dependencies():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    map_sid = min(graph.stages)
+    final_sid = graph.final_stage_id
+    # only the map stage is running; the final stage awaits its input
+    assert isinstance(graph.stages[map_sid], RunningStage)
+    assert isinstance(graph.stages[final_sid], UnresolvedStage)
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-1")
+    assert t1.partition.stage_id == map_sid
+    assert t2.partition.stage_id == map_sid
+    assert graph.pop_next_task("exec-1") is None  # nothing else runnable yet
+    complete_task(graph, t1, EXEC1)
+    assert graph.pop_next_task("exec-1") is None
+    complete_task(graph, t2, EXEC1)
+    # map stage complete -> final stage resolves and runs
+    t3 = graph.pop_next_task("exec-1")
+    assert t3 is not None and t3.partition.stage_id == final_sid
+
+
+def test_failed_task_fails_job():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    task = graph.pop_next_task("exec-1")
+    events = graph.update_task_status(
+        TaskInfo(task.partition, "failed", "exec-1", error="boom"), EXEC1
+    )
+    assert events == ["job_failed"]
+    assert graph.status == FAILED
+    assert "boom" in graph.error
+
+
+def test_reset_task_status_returns_task_to_pool():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    before = graph.available_tasks()
+    task = graph.pop_next_task("exec-1")
+    assert graph.available_tasks() == before - 1
+    graph.reset_task_status(task.partition)
+    assert graph.available_tasks() == before
+
+
+def test_multi_executor_locations_tracked():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-2")
+    complete_task(graph, t1, EXEC1)
+    complete_task(graph, t2, EXEC2)
+    final = graph.stages[graph.final_stage_id]
+    # final stage resolved+running with readers carrying both executors
+    assert isinstance(final, RunningStage)
+    readers = [
+        s
+        for s in _walk(final.plan)
+        if type(s).__name__ == "ShuffleReaderExec"
+    ]
+    assert readers
+    execs = {
+        l.executor_meta.id for p in readers[0].partition for l in p
+    }
+    assert execs == {"exec-1", "exec-2"}
+
+
+def test_reset_stages_on_executor_loss():
+    """Reference semantics (execution_graph.rs:499-622): losing an executor
+    mid-job rolls back dependent stages and re-runs lost map tasks."""
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    map_sid = min(graph.stages)
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-2")
+    complete_task(graph, t1, EXEC1)
+    complete_task(graph, t2, EXEC2)
+    # final stage now running; lose exec-1 (its map output is gone)
+    affected = graph.reset_stages("exec-1")
+    assert affected >= 1
+    # map stage re-runs only exec-1's task
+    map_stage = graph.stages[map_sid]
+    assert isinstance(map_stage, RunningStage)
+    assert map_stage.available_tasks() == 1
+    # drain on exec-2 completes the job
+    drain(graph, EXEC2)
+    assert graph.status == COMPLETED, graph.error
+
+
+def test_graph_persistence_roundtrip():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    t1 = graph.pop_next_task("exec-1")
+    complete_task(graph, t1, EXEC1)
+
+    data = graph.encode()
+    restored = ExecutionGraph.decode(data)
+    assert restored.job_id == graph.job_id
+    assert restored.status == RUNNING
+    assert restored.stage_count() == graph.stage_count()
+    # running map stage persisted as resolved: in-flight task re-dispatches
+    restored.revive()
+    n = drain(restored)
+    assert restored.status == COMPLETED, restored.error
+    assert n >= 1
+
+
+def test_completed_graph_persistence():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    drain(graph)
+    restored = ExecutionGraph.decode(graph.encode())
+    assert restored.status == COMPLETED
+    assert len(restored.output_locations) == len(graph.output_locations)
