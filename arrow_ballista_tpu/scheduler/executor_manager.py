@@ -1,0 +1,236 @@
+"""Cluster membership + task-slot accounting.
+
+Counterpart of the reference's ``scheduler/src/state/executor_manager.rs``:
+
+* ``ExecutorReservation`` — a slot held for a specific upcoming task,
+  invisible to other jobs, optionally job-affine (`:41-75`);
+* ``reserve_slots`` / ``cancel_reservations`` — atomic under the global
+  Slots lock with transactional writes (`:121-217`);
+* registration / removal, persisted heartbeats with an in-memory map kept
+  fresh by a backend watch (`:419-560`);
+* liveness = heartbeat within ``liveness_window_s`` (60s in the reference,
+  `:510-516`); expiry handled by the scheduler reaper.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from ..errors import SchedulerError
+from ..proto import pb
+from ..serde.scheduler_types import ExecutorMetadata
+from .backend import Keyspace, StateBackend, WatchEvent
+
+DEFAULT_LIVENESS_WINDOW_S = 60.0
+DEFAULT_EXECUTOR_TIMEOUT_S = 180.0
+
+
+@dataclass
+class ExecutorReservation:
+    executor_id: str
+    job_id: Optional[str] = None
+
+    def assign(self, job_id: str) -> "ExecutorReservation":
+        return ExecutorReservation(self.executor_id, job_id)
+
+
+@dataclass
+class ExecutorHeartbeat:
+    executor_id: str
+    timestamp: float
+    status: str = "active"  # active | dead
+
+    def to_bytes(self) -> bytes:
+        # stored in milliseconds: whole-second truncation would break
+        # sub-second liveness windows (tests shrink the 60s default)
+        msg = pb.ExecutorHeartbeat(
+            executor_id=self.executor_id, timestamp=int(self.timestamp * 1000)
+        )
+        if self.status == "active":
+            msg.status.active = ""
+        else:
+            msg.status.dead = ""
+        return msg.SerializeToString()
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "ExecutorHeartbeat":
+        msg = pb.ExecutorHeartbeat.FromString(b)
+        status = msg.status.WhichOneof("status") or "active"
+        return ExecutorHeartbeat(msg.executor_id, msg.timestamp / 1000.0, status)
+
+
+class ExecutorManager:
+    def __init__(
+        self,
+        backend: StateBackend,
+        liveness_window_s: float = DEFAULT_LIVENESS_WINDOW_S,
+    ):
+        self.backend = backend
+        self.liveness_window_s = liveness_window_s
+        self._heartbeats: Dict[str, ExecutorHeartbeat] = {}
+        self._dead: Set[str] = set()
+        self._hb_lock = threading.Lock()
+        self._unsubscribe = backend.watch(Keyspace.Heartbeats, "", self._on_hb_event)
+
+    def close(self) -> None:
+        self._unsubscribe()
+
+    # ------------------------------------------------------- registration
+    def register_executor(
+        self,
+        metadata: ExecutorMetadata,
+        reserve: bool = False,
+    ) -> List[ExecutorReservation]:
+        """Persist metadata + heartbeat + slots; in push mode immediately
+        reserve every slot for the offer cycle
+        (reference: executor_manager.rs:308-417)."""
+        slots = metadata.specification.task_slots
+        with self.backend.lock(Keyspace.Slots, "global"):
+            self.backend.put_txn(
+                [
+                    (
+                        Keyspace.Executors,
+                        metadata.id,
+                        metadata.to_proto().SerializeToString(),
+                    ),
+                    (
+                        Keyspace.Slots,
+                        metadata.id,
+                        _slots_bytes(0 if reserve else slots),
+                    ),
+                ]
+            )
+        self.save_heartbeat(
+            ExecutorHeartbeat(metadata.id, time.time(), "active")
+        )
+        with self._hb_lock:
+            self._dead.discard(metadata.id)
+        if reserve:
+            return [ExecutorReservation(metadata.id) for _ in range(slots)]
+        return []
+
+    def remove_executor(self, executor_id: str) -> None:
+        """Mark dead and zero its slots."""
+        with self.backend.lock(Keyspace.Slots, "global"):
+            self.backend.put(Keyspace.Slots, executor_id, _slots_bytes(0))
+        self.save_heartbeat(ExecutorHeartbeat(executor_id, time.time(), "dead"))
+        with self._hb_lock:
+            self._dead.add(executor_id)
+
+    def get_executor_metadata(self, executor_id: str) -> ExecutorMetadata:
+        raw = self.backend.get(Keyspace.Executors, executor_id)
+        if raw is None:
+            raise SchedulerError(f"unknown executor {executor_id!r}")
+        return ExecutorMetadata.from_proto(pb.ExecutorMetadata.FromString(raw))
+
+    def executors(self) -> List[ExecutorMetadata]:
+        return [
+            ExecutorMetadata.from_proto(pb.ExecutorMetadata.FromString(v))
+            for _, v in self.backend.scan(Keyspace.Executors)
+        ]
+
+    def is_dead_executor(self, executor_id: str) -> bool:
+        with self._hb_lock:
+            return executor_id in self._dead
+
+    # --------------------------------------------------------- heartbeats
+    def save_heartbeat(self, hb: ExecutorHeartbeat) -> None:
+        self.backend.put(Keyspace.Heartbeats, hb.executor_id, hb.to_bytes())
+
+    def _on_hb_event(self, event: WatchEvent) -> None:
+        if event.kind == WatchEvent.PUT and event.value is not None:
+            hb = ExecutorHeartbeat.from_bytes(event.value)
+            with self._hb_lock:
+                self._heartbeats[hb.executor_id] = hb
+                if hb.status == "dead":
+                    self._dead.add(hb.executor_id)
+
+    def get_alive_executors(self, now: Optional[float] = None) -> Set[str]:
+        now = time.time() if now is None else now
+        cutoff = now - self.liveness_window_s
+        with self._hb_lock:
+            return {
+                eid
+                for eid, hb in self._heartbeats.items()
+                if hb.status == "active" and hb.timestamp >= cutoff
+            }
+
+    def get_expired_executors(
+        self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
+    ) -> List[ExecutorHeartbeat]:
+        cutoff = time.time() - timeout_s
+        with self._hb_lock:
+            return [
+                hb
+                for hb in self._heartbeats.values()
+                if hb.status == "active" and hb.timestamp < cutoff
+            ]
+
+    def last_seen(self, executor_id: str) -> Optional[float]:
+        with self._hb_lock:
+            hb = self._heartbeats.get(executor_id)
+        return hb.timestamp if hb else None
+
+    # -------------------------------------------------------------- slots
+    def reserve_slots(
+        self, n: int, job_id: Optional[str] = None
+    ) -> List[ExecutorReservation]:
+        """Atomically grab up to ``n`` slots across alive executors
+        (reference: executor_manager.rs:121-167)."""
+        if n <= 0:
+            return []
+        alive = self.get_alive_executors()
+        reservations: List[ExecutorReservation] = []
+        with self.backend.lock(Keyspace.Slots, "global"):
+            txn = []
+            for eid, raw in self.backend.scan(Keyspace.Slots):
+                if eid not in alive:
+                    continue
+                avail = _slots_from(raw)
+                take = min(avail, n - len(reservations))
+                if take <= 0:
+                    continue
+                txn.append((Keyspace.Slots, eid, _slots_bytes(avail - take)))
+                reservations.extend(
+                    ExecutorReservation(eid, job_id) for _ in range(take)
+                )
+                if len(reservations) >= n:
+                    break
+            if txn:
+                self.backend.put_txn(txn)
+        return reservations
+
+    def cancel_reservations(self, reservations: List[ExecutorReservation]) -> None:
+        """Give slots back (reference: executor_manager.rs:169-217)."""
+        if not reservations:
+            return
+        per: Dict[str, int] = {}
+        for r in reservations:
+            per[r.executor_id] = per.get(r.executor_id, 0) + 1
+        with self.backend.lock(Keyspace.Slots, "global"):
+            txn = []
+            for eid, k in per.items():
+                raw = self.backend.get(Keyspace.Slots, eid)
+                avail = _slots_from(raw) if raw is not None else 0
+                txn.append((Keyspace.Slots, eid, _slots_bytes(avail + k)))
+            self.backend.put_txn(txn)
+
+    def available_slots(self) -> int:
+        alive = self.get_alive_executors()
+        return sum(
+            _slots_from(raw)
+            for eid, raw in self.backend.scan(Keyspace.Slots)
+            if eid in alive
+        )
+
+
+def _slots_bytes(n: int) -> bytes:
+    return json.dumps({"slots": n}).encode()
+
+
+def _slots_from(raw: bytes) -> int:
+    return json.loads(raw.decode())["slots"]

@@ -1,0 +1,190 @@
+"""The scheduler's brain: an event-driven job/stage state machine.
+
+Counterpart of the reference's
+``scheduler/src/scheduler_server/query_stage_scheduler.rs:65-202`` with the
+same event vocabulary (``event.rs:27-43``): JobQueued → planning →
+JobSubmitted → reservations → ReservationOffering → tasks launch;
+TaskUpdating drives stage transitions and re-offers freed slots;
+ExecutorLost rolls affected jobs back.  All mutations run on the single
+event-loop thread.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..config import TaskSchedulingPolicy
+from ..errors import BallistaError
+from ..plan import logical as lp
+from ..serde.scheduler_types import ExecutorMetadata
+from .event_loop import EventAction, EventSender
+from .execution_stage import TaskInfo
+from .executor_manager import ExecutorReservation
+from .state import SchedulerState
+
+log = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ events
+@dataclass
+class JobQueued:
+    job_id: str
+    session_id: str
+    plan: lp.LogicalPlan
+
+
+@dataclass
+class JobSubmitted:
+    job_id: str
+
+
+@dataclass
+class JobPlanningFailed:
+    job_id: str
+    error: str
+
+
+@dataclass
+class JobFinished:
+    job_id: str
+
+
+@dataclass
+class JobRunningFailed:
+    job_id: str
+    error: str
+
+
+@dataclass
+class JobUpdated:
+    job_id: str
+
+
+@dataclass
+class TaskUpdating:
+    executor: ExecutorMetadata
+    statuses: List[TaskInfo]
+
+
+@dataclass
+class ReservationOffering:
+    reservations: List[ExecutorReservation] = field(default_factory=list)
+
+
+@dataclass
+class ExecutorLost:
+    executor_id: str
+    reason: str = ""
+
+
+class QueryStageScheduler(EventAction):
+    def __init__(self, state: SchedulerState):
+        self.state = state
+
+    # ---------------------------------------------------------- dispatch
+    def on_receive(self, event, sender: EventSender) -> None:
+        if isinstance(event, JobQueued):
+            self._on_job_queued(event, sender)
+        elif isinstance(event, JobSubmitted):
+            self._on_job_submitted(event, sender)
+        elif isinstance(event, JobPlanningFailed):
+            log.error("job %s planning failed: %s", event.job_id, event.error)
+            self.state.task_manager.fail_job(event.job_id, event.error)
+        elif isinstance(event, JobFinished):
+            self.state.task_manager.complete_job(event.job_id)
+        elif isinstance(event, JobRunningFailed):
+            log.error("job %s failed: %s", event.job_id, event.error)
+            self.state.task_manager.fail_job(event.job_id, event.error)
+        elif isinstance(event, JobUpdated):
+            self.state.task_manager.update_job(event.job_id)
+        elif isinstance(event, TaskUpdating):
+            self._on_task_updating(event, sender)
+        elif isinstance(event, ReservationOffering):
+            self._on_reservation_offering(event, sender)
+        elif isinstance(event, ExecutorLost):
+            self._on_executor_lost(event, sender)
+        else:
+            log.warning("unknown scheduler event %r", event)
+
+    # ----------------------------------------------------------- handlers
+    def _on_job_queued(self, event: JobQueued, sender: EventSender) -> None:
+        session_ctx = self.state.session_manager.get_session(event.session_id)
+        if session_ctx is None:
+            sender.post(
+                JobPlanningFailed(event.job_id, f"unknown session {event.session_id}")
+            )
+            return
+        try:
+            self.state.submit_job(event.job_id, session_ctx, event.plan)
+        except BallistaError as e:
+            sender.post(JobPlanningFailed(event.job_id, str(e)))
+            return
+        except Exception as e:  # noqa: BLE001 - planning bugs must fail the job
+            sender.post(JobPlanningFailed(event.job_id, f"internal error: {e}"))
+            return
+        sender.post(JobSubmitted(event.job_id))
+
+    def _on_job_submitted(self, event: JobSubmitted, sender: EventSender) -> None:
+        if self.state.policy != TaskSchedulingPolicy.PUSH_STAGED:
+            return
+        status = self.state.task_manager.get_job_status(event.job_id)
+        if status is None:
+            return
+        # reserve as many slots as the job has runnable tasks right now
+        entry = self.state.task_manager._entry(event.job_id)
+        with entry.lock:
+            graph = self.state.task_manager._load(event.job_id, entry)
+            n = graph.available_tasks() if graph is not None else 0
+        if n <= 0:
+            return
+        reservations = self.state.executor_manager.reserve_slots(n, event.job_id)
+        if reservations:
+            sender.post(ReservationOffering(reservations))
+
+    def _on_task_updating(self, event: TaskUpdating, sender: EventSender) -> None:
+        events, reservations = self.state.update_task_statuses(
+            event.executor, event.statuses
+        )
+        for job_id, ev in events:
+            if ev == "job_completed":
+                sender.post(JobFinished(job_id))
+            elif ev == "job_failed":
+                status = self.state.task_manager.get_job_status(job_id) or {}
+                sender.post(
+                    JobRunningFailed(job_id, status.get("error", "task failed"))
+                )
+            else:
+                sender.post(JobUpdated(job_id))
+        if reservations:
+            sender.post(ReservationOffering(reservations))
+
+    def _on_reservation_offering(
+        self, event: ReservationOffering, sender: EventSender
+    ) -> None:
+        launched, leftover = self.state.offer_reservation(event.reservations)
+        if leftover:
+            # nothing runnable right now (tasks in flight gate the rest):
+            # give the slots back — the next TaskUpdating re-mints them.
+            # Re-posting here would spin the loop.
+            self.state.executor_manager.cancel_reservations(leftover)
+
+    def _on_executor_lost(self, event: ExecutorLost, sender: EventSender) -> None:
+        log.warning("executor %s lost: %s", event.executor_id, event.reason)
+        self.state.executor_manager.remove_executor(event.executor_id)
+        affected = self.state.task_manager.executor_lost(event.executor_id)
+        for job_id in affected:
+            sender.post(JobUpdated(job_id))
+        if affected and self.state.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            total = 0
+            for job_id in affected:
+                entry = self.state.task_manager._entry(job_id)
+                with entry.lock:
+                    graph = self.state.task_manager._load(job_id, entry)
+                    if graph is not None:
+                        total += graph.available_tasks()
+            if total > 0:
+                reservations = self.state.executor_manager.reserve_slots(total)
+                if reservations:
+                    sender.post(ReservationOffering(reservations))

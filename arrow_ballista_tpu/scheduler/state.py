@@ -1,0 +1,114 @@
+"""Scheduler state facade bundling the managers.
+
+Counterpart of the reference's ``scheduler/src/state/mod.rs``: owns the
+backend + executor/task/session managers, performs job planning on submit,
+and implements ``offer_reservation`` — the fill-and-launch cycle shared by
+push scheduling and the pull-mode poll handler.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..config import BallistaConfig, TaskSchedulingPolicy
+from ..context import SessionContext
+from ..errors import BallistaError
+from ..exec.operators import ExecutionPlan
+from ..exec.planner import PhysicalPlanner
+from ..plan import logical as lp
+from ..plan.optimizer import optimize
+from ..serde.scheduler_types import ExecutorMetadata
+from .backend import StateBackend
+from .execution_graph import Task
+from .execution_stage import TaskInfo
+from .executor_manager import ExecutorManager, ExecutorReservation
+from .session_manager import SessionBuilder, SessionManager, default_session_builder
+from .task_manager import TaskLauncher, TaskManager
+
+log = logging.getLogger(__name__)
+
+
+class SchedulerState:
+    def __init__(
+        self,
+        backend: StateBackend,
+        scheduler_id: str,
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+        session_builder: SessionBuilder = default_session_builder,
+        launcher: Optional[TaskLauncher] = None,
+        work_dir: str = "/tmp/ballista-tpu",
+        liveness_window_s: float = 60.0,
+    ):
+        self.backend = backend
+        self.scheduler_id = scheduler_id
+        self.policy = policy
+        self.executor_manager = ExecutorManager(backend, liveness_window_s)
+        self.task_manager = TaskManager(
+            backend, self.executor_manager, scheduler_id, launcher, work_dir
+        )
+        self.session_manager = SessionManager(backend, session_builder)
+
+    # ------------------------------------------------------------ planning
+    def plan_job(
+        self, session_ctx: SessionContext, plan: lp.LogicalPlan
+    ) -> ExecutionPlan:
+        """Logical → optimized → physical.  The TPU acceleration pass is NOT
+        applied here: stage plans travel unaccelerated and each executor
+        re-accelerates under its own session config."""
+        optimized = optimize(plan)
+        return PhysicalPlanner(session_ctx.config).create_physical_plan(optimized)
+
+    def submit_job(
+        self,
+        job_id: str,
+        session_ctx: SessionContext,
+        plan: lp.LogicalPlan,
+    ) -> None:
+        physical = self.plan_job(session_ctx, plan)
+        self.task_manager.submit_job(job_id, session_ctx.session_id, physical)
+
+    # ------------------------------------------------------------- updates
+    def update_task_statuses(
+        self, executor: ExecutorMetadata, statuses: List[TaskInfo]
+    ) -> Tuple[List[Tuple[str, str]], List[ExecutorReservation]]:
+        """Apply statuses; mint one reservation per finished task in push
+        mode so freed slots immediately re-offer
+        (reference: state/mod.rs:128-150)."""
+        events = self.task_manager.update_task_statuses(executor, statuses)
+        reservations = []
+        if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            finished = sum(1 for s in statuses if s.state in ("completed", "failed"))
+            reservations = [
+                ExecutorReservation(executor.id) for _ in range(finished)
+            ]
+        return events, reservations
+
+    # ------------------------------------------------------------ offering
+    def offer_reservation(
+        self, reservations: List[ExecutorReservation]
+    ) -> Tuple[int, List[ExecutorReservation]]:
+        """Fill reservations with tasks and launch them; returns
+        (n_launched, leftover reservations to cancel or re-offer)
+        (reference: state/mod.rs:188-248)."""
+        assignments, free, pending = self.task_manager.fill_reservations(reservations)
+
+        per_executor: Dict[str, List[Task]] = {}
+        for executor_id, task in assignments:
+            per_executor.setdefault(executor_id, []).append(task)
+
+        launched = 0
+        for executor_id, tasks in per_executor.items():
+            try:
+                meta = self.executor_manager.get_executor_metadata(executor_id)
+                self.task_manager.launch_tasks(meta, tasks)
+                launched += len(tasks)
+            except BallistaError as e:
+                log.warning("failed to launch tasks on %s: %s", executor_id, e)
+                # tasks were reset by launch_tasks; slots go back too
+                free.extend(ExecutorReservation(executor_id) for _ in tasks)
+
+        if free and pending <= 0:
+            self.executor_manager.cancel_reservations(free)
+            free = []
+        return launched, free

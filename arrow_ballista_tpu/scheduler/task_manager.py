@@ -1,0 +1,356 @@
+"""Job lifecycle + task dispatch.
+
+Counterpart of the reference's ``scheduler/src/state/task_manager.rs``:
+graphs are built on submit, persisted to the ActiveJobs keyspace and cached
+behind per-job locks; ``fill_reservations`` walks cached jobs popping tasks
+into reserved slots; completed/failed jobs move keyspaces; ``launch_task``
+pushes TaskDefinitions to executors through a pluggable launcher (a no-op
+launcher stands in for gRPC in tests, mirroring the reference's
+``#[cfg(test)]`` no-op, `task_manager.rs:440-449`).
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import SchedulerError
+from ..exec.operators import ExecutionPlan
+from ..proto import pb
+from ..serde import BallistaCodec, partitioning_to_proto
+from ..serde.scheduler_types import ExecutorMetadata, PartitionId
+from .backend import Keyspace, StateBackend
+from .execution_graph import COMPLETED, FAILED, ExecutionGraph, Task
+from .execution_stage import TaskInfo
+from .executor_manager import ExecutorManager, ExecutorReservation
+
+
+class TaskLauncher:
+    """Transport for pushing tasks to executors (push scheduling)."""
+
+    def launch(
+        self,
+        executor: ExecutorMetadata,
+        tasks: List[pb.TaskDefinition],
+        scheduler_id: str,
+    ) -> None:
+        raise NotImplementedError
+
+
+class NoopLauncher(TaskLauncher):
+    """Test stand-in; records what would have been sent."""
+
+    def __init__(self) -> None:
+        self.launched: List[Tuple[str, List[pb.TaskDefinition]]] = []
+
+    def launch(self, executor, tasks, scheduler_id):
+        self.launched.append((executor.id, tasks))
+
+
+class GrpcLauncher(TaskLauncher):
+    """Real transport: LaunchTask RPC on the executor's grpc port, with a
+    cached channel per executor (reference: task_manager.rs:416-438)."""
+
+    def __init__(self) -> None:
+        self._stubs: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def launch(self, executor, tasks, scheduler_id):
+        from ..proto.rpc import ExecutorGrpcStub, make_channel
+
+        key = f"{executor.host}:{executor.grpc_port}"
+        with self._lock:
+            stub = self._stubs.get(key)
+            if stub is None:
+                stub = ExecutorGrpcStub(make_channel(executor.host, executor.grpc_port))
+                self._stubs[key] = stub
+        stub.LaunchTask(
+            pb.LaunchTaskParams(tasks=tasks, scheduler_id=scheduler_id),
+            timeout=20,
+        )
+
+
+@dataclass
+class JobEntry:
+    lock: threading.RLock = field(default_factory=threading.RLock)
+    graph: Optional[ExecutionGraph] = None
+
+
+class TaskManager:
+    def __init__(
+        self,
+        backend: StateBackend,
+        executor_manager: ExecutorManager,
+        scheduler_id: str,
+        launcher: Optional[TaskLauncher] = None,
+        work_dir: str = "/tmp/ballista-tpu",
+    ):
+        self.backend = backend
+        self.executor_manager = executor_manager
+        self.scheduler_id = scheduler_id
+        self.launcher = launcher or GrpcLauncher()
+        self.work_dir = work_dir
+        self._cache: Dict[str, JobEntry] = {}
+        self._cache_lock = threading.Lock()
+
+    # ------------------------------------------------------------ helpers
+    def _entry(self, job_id: str) -> JobEntry:
+        with self._cache_lock:
+            e = self._cache.get(job_id)
+            if e is None:
+                e = JobEntry()
+                self._cache[job_id] = e
+            return e
+
+    def _load(self, job_id: str, entry: JobEntry) -> Optional[ExecutionGraph]:
+        if entry.graph is not None:
+            return entry.graph
+        raw = self.backend.get(Keyspace.ActiveJobs, job_id)
+        if raw is None:
+            return None
+        entry.graph = ExecutionGraph.decode(raw, self.work_dir)
+        return entry.graph
+
+    def _persist(self, graph: ExecutionGraph) -> None:
+        self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
+
+    # -------------------------------------------------------------- submit
+    def submit_job(
+        self,
+        job_id: str,
+        session_id: str,
+        plan: ExecutionPlan,
+    ) -> ExecutionGraph:
+        graph = ExecutionGraph(
+            self.scheduler_id, job_id, session_id, plan, self.work_dir
+        )
+        graph.revive()
+        entry = self._entry(job_id)
+        with entry.lock:
+            entry.graph = graph
+            self._persist(graph)
+        return graph
+
+    def get_job_status(self, job_id: str) -> Optional[dict]:
+        """Status snapshot: {state, error?, locations?}."""
+        entry = self._entry(job_id)
+        with entry.lock:
+            graph = self._load(job_id, entry)
+            if graph is not None:
+                return self._status_of(graph)
+        for ks in (Keyspace.CompletedJobs, Keyspace.FailedJobs):
+            raw = self.backend.get(ks, job_id)
+            if raw is not None:
+                g = ExecutionGraph.decode(raw, self.work_dir)
+                return self._status_of(g)
+        return None
+
+    @staticmethod
+    def _status_of(graph: ExecutionGraph) -> dict:
+        out = {"state": graph.status, "job_id": graph.job_id}
+        if graph.status == FAILED:
+            out["error"] = graph.error
+        if graph.status == COMPLETED:
+            out["locations"] = list(graph.output_locations)
+        return out
+
+    # ------------------------------------------------------------- updates
+    def update_task_statuses(
+        self,
+        executor: ExecutorMetadata,
+        statuses: List[TaskInfo],
+    ) -> List[Tuple[str, str]]:
+        """Group statuses per job, apply to graphs; returns
+        [(job_id, event)] with event in job_updated/job_completed/job_failed
+        (reference: task_manager.rs:132-170)."""
+        per_job: Dict[str, List[TaskInfo]] = {}
+        for s in statuses:
+            per_job.setdefault(s.partition_id.job_id, []).append(s)
+
+        events: List[Tuple[str, str]] = []
+        for job_id, infos in per_job.items():
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None:
+                    continue
+                for info in infos:
+                    for ev in graph.update_task_status(info, executor):
+                        events.append((job_id, ev))
+                self._persist(graph)
+        return events
+
+    # ------------------------------------------------------------ dispatch
+    def fill_reservations(
+        self, reservations: List[ExecutorReservation]
+    ) -> Tuple[List[Tuple[str, Task]], List[ExecutorReservation], int]:
+        """Assign tasks to reserved slots.  Returns (assignments as
+        (executor_id, task), unassigned reservations, pending tasks count)
+        (reference: task_manager.rs:184-221)."""
+        free = list(reservations)
+        assignments: List[Tuple[str, Task]] = []
+        pending = 0
+
+        with self._cache_lock:
+            job_ids = list(self._cache.keys())
+
+        for job_id in job_ids:
+            if not free:
+                break
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                graph.revive()
+                changed = False
+                still_free = []
+                for r in free:
+                    task = graph.pop_next_task(r.executor_id)
+                    if task is None:
+                        still_free.append(r)
+                        continue
+                    assignments.append((r.executor_id, task))
+                    changed = True
+                free = still_free
+                pending += graph.available_tasks()
+                if changed:
+                    self._persist(graph)
+        return assignments, free, pending
+
+    def prepare_task_definition(self, task: Task) -> pb.TaskDefinition:
+        td = pb.TaskDefinition()
+        td.task_id.CopyFrom(task.partition.to_proto())
+        td.plan = BallistaCodec.encode_physical(task.plan)
+        if task.output_partitioning is not None:
+            td.output_partitioning.CopyFrom(
+                partitioning_to_proto(task.output_partitioning)
+            )
+            td.has_output_partitioning = True
+        td.session_id = task.session_id
+        td.curator_scheduler_id = self.scheduler_id
+        return td
+
+    def launch_tasks(
+        self, executor: ExecutorMetadata, tasks: List[Task]
+    ) -> None:
+        defs = [self.prepare_task_definition(t) for t in tasks]
+        try:
+            self.launcher.launch(executor, defs, self.scheduler_id)
+        except Exception as e:
+            # hand the tasks back so they can re-dispatch elsewhere
+            for t in tasks:
+                self.reset_task(t.partition)
+            raise SchedulerError(
+                f"launching {len(tasks)} task(s) on {executor.id} failed: {e}"
+            ) from e
+
+    def reset_task(self, partition: PartitionId) -> None:
+        entry = self._entry(partition.job_id)
+        with entry.lock:
+            graph = self._load(partition.job_id, entry)
+            if graph is not None:
+                graph.reset_task_status(partition)
+                self._persist(graph)
+
+    # --------------------------------------------------------- transitions
+    def complete_job(self, job_id: str) -> None:
+        entry = self._entry(job_id)
+        with entry.lock:
+            graph = self._load(job_id, entry)
+            if graph is not None:
+                self._persist(graph)
+            self.backend.mv(Keyspace.ActiveJobs, Keyspace.CompletedJobs, job_id)
+            with self._cache_lock:
+                self._cache.pop(job_id, None)
+
+    def fail_job(self, job_id: str, error: str) -> None:
+        entry = self._entry(job_id)
+        with entry.lock:
+            graph = self._load(job_id, entry)
+            tombstone = graph is None
+            if graph is not None:
+                if graph.status != FAILED:
+                    graph.fail_job(error)
+                try:
+                    self._persist(graph)
+                except Exception:
+                    # the plan itself may be unserializable (that can be WHY
+                    # the job failed); fall back to a status-only tombstone
+                    tombstone = True
+            if tombstone:
+                msg = pb.ExecutionGraphProto(job_id=job_id)
+                msg.status.failed.error = error
+                self.backend.put(
+                    Keyspace.ActiveJobs, job_id, msg.SerializeToString()
+                )
+            self.backend.mv(Keyspace.ActiveJobs, Keyspace.FailedJobs, job_id)
+            with self._cache_lock:
+                self._cache.pop(job_id, None)
+
+    def update_job(self, job_id: str) -> None:
+        entry = self._entry(job_id)
+        with entry.lock:
+            graph = self._load(job_id, entry)
+            if graph is not None:
+                self._persist(graph)
+
+    def cancel_job(self, job_id: str) -> List[Tuple[ExecutorMetadata, List[PartitionId]]]:
+        """Fail the job; return the running tasks per executor so the caller
+        can issue CancelTasks RPCs (reference: task_manager.rs:225-303)."""
+        entry = self._entry(job_id)
+        running: Dict[str, List[PartitionId]] = {}
+        with entry.lock:
+            graph = self._load(job_id, entry)
+            if graph is None:
+                return []
+            from .execution_stage import RunningStage
+
+            for sid, stage in graph.stages.items():
+                if isinstance(stage, RunningStage):
+                    for t in stage.task_statuses:
+                        if t is not None and t.state == "running":
+                            running.setdefault(t.executor_id, []).append(
+                                t.partition_id
+                            )
+        self.fail_job(job_id, "job cancelled by user")
+        out = []
+        for eid, pids in running.items():
+            try:
+                meta = self.executor_manager.get_executor_metadata(eid)
+            except SchedulerError:
+                continue
+            out.append((meta, pids))
+        return out
+
+    def executor_lost(self, executor_id: str) -> List[str]:
+        """Roll back every cached graph; returns affected job ids
+        (reference: task_manager.rs:384-412)."""
+        with self._cache_lock:
+            job_ids = list(self._cache.keys())
+        affected = []
+        for job_id in job_ids:
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                if graph.reset_stages(executor_id):
+                    affected.append(job_id)
+                    self._persist(graph)
+        return affected
+
+    # -------------------------------------------------------------- misc
+    def active_job_ids(self) -> List[str]:
+        with self._cache_lock:
+            return list(self._cache.keys())
+
+    @staticmethod
+    def generate_job_id() -> str:
+        """7-char alphanumeric (reference: task_manager.rs:544-551)."""
+        return "".join(
+            random.choices(string.ascii_lowercase + string.digits, k=7)
+        )

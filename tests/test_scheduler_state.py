@@ -1,0 +1,422 @@
+"""Scheduler state-layer tests.
+
+Mirrors the reference's in-proc scheduler tests
+(`scheduler_server/mod.rs:309-733`, `state/mod.rs:306-476`): the full
+state machine runs against an in-memory (or sqlite) backend with task
+launches stubbed (NoopLauncher — the counterpart of the reference's
+`#[cfg(test)]` no-op launch) and executors simulated by hand-fed
+TaskInfo messages.
+"""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import TableProvider
+from arrow_ballista_tpu.config import TaskSchedulingPolicy
+from arrow_ballista_tpu.errors import ExecutionError
+from arrow_ballista_tpu.scheduler.backend import (
+    Keyspace,
+    MemoryBackend,
+    SqliteBackend,
+    WatchEvent,
+)
+from arrow_ballista_tpu.scheduler.event_loop import EventAction, EventLoop
+from arrow_ballista_tpu.scheduler.execution_stage import TaskInfo
+from arrow_ballista_tpu.scheduler.executor_manager import (
+    ExecutorHeartbeat,
+    ExecutorManager,
+)
+from arrow_ballista_tpu.scheduler.query_stage_scheduler import (
+    JobQueued,
+    QueryStageScheduler,
+    TaskUpdating,
+)
+from arrow_ballista_tpu.scheduler.state import SchedulerState
+from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ExecutorSpecification,
+    PartitionId,
+    ShuffleWritePartition,
+)
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052, ExecutorSpecification(4))
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052, ExecutorSpecification(4))
+
+
+# ------------------------------------------------------------- backends
+@pytest.mark.parametrize("make", [MemoryBackend, lambda: None])
+def test_backend_contract(make, tmp_path):
+    backend = make() if make is not MemoryBackend else MemoryBackend()
+    if backend is None:
+        backend = SqliteBackend(str(tmp_path / "state.db"))
+    backend.put(Keyspace.ActiveJobs, "j1", b"a")
+    backend.put(Keyspace.ActiveJobs, "j2", b"b")
+    backend.put(Keyspace.Sessions, "s1", b"c")
+    assert backend.get(Keyspace.ActiveJobs, "j1") == b"a"
+    assert backend.get(Keyspace.ActiveJobs, "zz") is None
+    assert sorted(backend.scan_keys(Keyspace.ActiveJobs)) == ["j1", "j2"]
+    assert backend.get_from_prefix(Keyspace.ActiveJobs, "j1") == [("j1", b"a")]
+    backend.mv(Keyspace.ActiveJobs, Keyspace.CompletedJobs, "j1")
+    assert backend.get(Keyspace.ActiveJobs, "j1") is None
+    assert backend.get(Keyspace.CompletedJobs, "j1") == b"a"
+    backend.delete(Keyspace.ActiveJobs, "j2")
+    assert backend.scan(Keyspace.ActiveJobs) == []
+    # txn
+    backend.put_txn([(Keyspace.Slots, "e1", b"1"), (Keyspace.Slots, "e2", b"2")])
+    assert backend.get(Keyspace.Slots, "e2") == b"2"
+
+
+def test_backend_watch():
+    backend = MemoryBackend()
+    events = []
+    unsub = backend.watch(Keyspace.Heartbeats, "", events.append)
+    backend.put(Keyspace.Heartbeats, "e1", b"x")
+    backend.delete(Keyspace.Heartbeats, "e1")
+    assert [e.kind for e in events] == [WatchEvent.PUT, WatchEvent.DELETE]
+    unsub()
+    backend.put(Keyspace.Heartbeats, "e2", b"y")
+    assert len(events) == 2
+
+
+def test_sqlite_backend_survives_reopen(tmp_path):
+    path = str(tmp_path / "state.db")
+    b1 = SqliteBackend(path)
+    b1.put(Keyspace.ActiveJobs, "job", b"graph-bytes")
+    b1.close()
+    b2 = SqliteBackend(path)
+    assert b2.get(Keyspace.ActiveJobs, "job") == b"graph-bytes"
+    b2.close()
+
+
+# ------------------------------------------------------------ event loop
+def test_event_loop_processes_and_reenters():
+    seen = []
+
+    class Action(EventAction):
+        def on_receive(self, event, sender):
+            seen.append(event)
+            if event == "first":
+                sender.post("second")
+
+    loop = EventLoop("test", 100, Action())
+    loop.start()
+    loop.get_sender().post("first")
+    assert loop.drain(2.0)
+    assert seen == ["first", "second"]
+    loop.stop()
+
+
+def test_event_loop_survives_handler_errors():
+    seen = []
+
+    class Action(EventAction):
+        def on_receive(self, event, sender):
+            if event == "boom":
+                raise RuntimeError("boom")
+            seen.append(event)
+
+    loop = EventLoop("test", 100, Action())
+    loop.start()
+    s = loop.get_sender()
+    s.post("boom")
+    s.post("ok")
+    assert loop.drain(2.0)
+    assert seen == ["ok"]
+    loop.stop()
+
+
+# ------------------------------------------------------- executor manager
+def test_register_reserve_cancel_slots():
+    em = ExecutorManager(MemoryBackend())
+    assert em.register_executor(EXEC1) == []
+    assert em.available_slots() == 4
+    res = em.reserve_slots(3)
+    assert len(res) == 3
+    assert em.available_slots() == 1
+    res2 = em.reserve_slots(5)
+    assert len(res2) == 1  # only one slot left
+    em.cancel_reservations(res + res2)
+    assert em.available_slots() == 4
+
+
+def test_register_with_reserve_returns_all_slots():
+    em = ExecutorManager(MemoryBackend())
+    res = em.register_executor(EXEC1, reserve=True)
+    assert len(res) == 4
+    assert em.available_slots() == 0
+
+
+def test_dead_executors_excluded_from_reservations():
+    em = ExecutorManager(MemoryBackend())
+    em.register_executor(EXEC1)
+    em.register_executor(EXEC2)
+    assert em.available_slots() == 8
+    em.remove_executor("exec-1")
+    assert em.is_dead_executor("exec-1")
+    assert em.available_slots() == 4
+    res = em.reserve_slots(8)
+    assert {r.executor_id for r in res} == {"exec-2"}
+
+
+def test_heartbeat_liveness_window():
+    em = ExecutorManager(MemoryBackend(), liveness_window_s=0.2)
+    em.register_executor(EXEC1)
+    assert em.get_alive_executors() == {"exec-1"}
+    time.sleep(0.3)
+    assert em.get_alive_executors() == set()
+    em.save_heartbeat(ExecutorHeartbeat("exec-1", time.time()))
+    assert em.get_alive_executors() == {"exec-1"}
+    assert em.get_expired_executors(timeout_s=0.0)  # stale by a 0s timeout
+
+
+# --------------------------------------------------------- full scheduling
+class Fixture:
+    """In-proc scheduler state + event loop + fake executors."""
+
+    def __init__(self, policy=TaskSchedulingPolicy.PULL_STAGED, backend=None):
+        self.backend = backend or MemoryBackend()
+        self.launcher = NoopLauncher()
+        self.state = SchedulerState(
+            self.backend,
+            "sched-1",
+            policy,
+            launcher=self.launcher,
+            work_dir="/tmp/abt-sched-test",
+        )
+        self.loop = EventLoop("qss", 10000, QueryStageScheduler(self.state))
+        self.loop.start()
+        self.sender = self.loop.get_sender()
+
+    def make_session(self):
+        ctx = self.state.session_manager.create_session(
+            {"ballista.shuffle.partitions": "2", "ballista.tpu.enable": "false"}
+        )
+        ctx.register_arrow_table(
+            "t",
+            pa.table(
+                {
+                    "g": pa.array(["a", "b", "a", "c"], pa.string()),
+                    "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+                }
+            ),
+            partitions=2,
+        )
+        return ctx
+
+    def submit(self, ctx, sql, job_id="job-1"):
+        plan = ctx.sql(sql).logical_plan()
+        self.sender.post(JobQueued(job_id, ctx.session_id, plan))
+        assert self.loop.drain(5.0)
+        return job_id
+
+    def run_tasks_like_executor(self, executor=EXEC1, max_rounds=50):
+        """Pull-style fake executor: reserve→fill→complete until done."""
+        from arrow_ballista_tpu.scheduler.executor_manager import ExecutorReservation
+
+        for _ in range(max_rounds):
+            assignments, free, pending = self.state.task_manager.fill_reservations(
+                [ExecutorReservation(executor.id)]
+            )
+            if not assignments:
+                if pending == 0:
+                    return
+                continue
+            _, task = assignments[0]
+            part = task.output_partitioning
+            if part is not None:
+                partitions = [
+                    ShuffleWritePartition(p, f"/fake/{task.partition}/{p}", 1, 5, 50)
+                    for p in range(part.n)
+                ]
+            else:
+                partitions = [
+                    ShuffleWritePartition(
+                        task.partition.partition_id, f"/fake/{task.partition}", 1, 5, 50
+                    )
+                ]
+            info = TaskInfo(
+                task.partition, "completed", executor.id, partitions=partitions
+            )
+            self.sender.post(TaskUpdating(executor, [info]))
+            assert self.loop.drain(5.0)
+
+    def stop(self):
+        self.loop.stop()
+        self.state.executor_manager.close()
+
+
+def test_pull_scheduling_end_to_end():
+    f = Fixture(TaskSchedulingPolicy.PULL_STAGED)
+    try:
+        f.state.executor_manager.register_executor(EXEC1)
+        ctx = f.make_session()
+        job_id = f.submit(ctx, "select g, sum(v) as s from t group by g")
+        status = f.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "running"
+        f.run_tasks_like_executor()
+        status = f.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "completed", status
+        assert status["locations"]
+        # job moved to CompletedJobs keyspace
+        assert f.backend.get(Keyspace.CompletedJobs, job_id) is not None
+        assert f.backend.get(Keyspace.ActiveJobs, job_id) is None
+    finally:
+        f.stop()
+
+
+def test_push_scheduling_launches_tasks():
+    f = Fixture(TaskSchedulingPolicy.PUSH_STAGED)
+    try:
+        reservations = f.state.executor_manager.register_executor(EXEC1, reserve=True)
+        f.state.executor_manager.cancel_reservations(reservations)
+        ctx = f.make_session()
+        f.submit(ctx, "select g, sum(v) as s from t group by g")
+        # push mode must have launched the two map tasks through the launcher
+        launched = [t for _, tasks in f.launcher.launched for t in tasks]
+        assert len(launched) == 2
+        assert all(t.curator_scheduler_id == "sched-1" for t in launched)
+        # simulate the executor finishing both tasks; freed slots re-offer
+        infos = []
+        for td in launched:
+            pid = PartitionId.from_proto(td.task_id)
+            n_out = td.output_partitioning.partition_count
+            infos.append(
+                TaskInfo(
+                    pid,
+                    "completed",
+                    "exec-1",
+                    partitions=[
+                        ShuffleWritePartition(p, f"/fake/{pid}/{p}", 1, 5, 50)
+                        for p in range(n_out)
+                    ],
+                )
+            )
+        f.sender.post(TaskUpdating(EXEC1, infos))
+        assert f.loop.drain(5.0)
+        # final-stage tasks (one per hash partition) launched in the same cycle
+        launched2 = [t for _, tasks in f.launcher.launched for t in tasks]
+        assert len(launched2) == 4
+        assert {t.task_id.stage_id for t in launched2} == {1, 2}
+    finally:
+        f.stop()
+
+
+class ExplodingProvider(TableProvider):
+    """Planning-failure fixture (reference: test_utils.rs:41-70)."""
+
+    @property
+    def schema(self):
+        return pa.schema([pa.field("x", pa.int64())])
+
+    def num_partitions(self):
+        return 1
+
+    def scan_partition(self, partition, projection, batch_size=8192):
+        raise ExecutionError("BOOM")
+
+    def describe(self):
+        raise ExecutionError("BOOM (not serializable)")
+
+
+def test_planning_failure_fails_job():
+    f = Fixture()
+    try:
+        ctx = f.state.session_manager.create_session({})
+        ctx.register_table("explode", ExplodingProvider())
+        job_id = f.submit(ctx, "select sum(x) as s from explode", "job-x")
+        status = f.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "failed"
+        assert f.backend.get(Keyspace.FailedJobs, job_id) is not None
+    finally:
+        f.stop()
+
+
+def test_task_failure_fails_job():
+    f = Fixture()
+    try:
+        f.state.executor_manager.register_executor(EXEC1)
+        ctx = f.make_session()
+        job_id = f.submit(ctx, "select g, sum(v) as s from t group by g")
+        from arrow_ballista_tpu.scheduler.executor_manager import ExecutorReservation
+
+        assignments, _, _ = f.state.task_manager.fill_reservations(
+            [ExecutorReservation("exec-1")]
+        )
+        _, task = assignments[0]
+        f.sender.post(
+            TaskUpdating(
+                EXEC1, [TaskInfo(task.partition, "failed", "exec-1", error="boom")]
+            )
+        )
+        assert f.loop.drain(5.0)
+        status = f.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "failed"
+        assert "boom" in status["error"]
+    finally:
+        f.stop()
+
+
+def test_executor_lost_mid_job_recovers_on_other_executor():
+    from arrow_ballista_tpu.scheduler.query_stage_scheduler import ExecutorLost
+
+    f = Fixture()
+    try:
+        f.state.executor_manager.register_executor(EXEC1)
+        f.state.executor_manager.register_executor(EXEC2)
+        ctx = f.make_session()
+        job_id = f.submit(ctx, "select g, sum(v) as s from t group by g")
+        # run the two map tasks on exec-1
+        from arrow_ballista_tpu.scheduler.executor_manager import ExecutorReservation
+
+        for _ in range(2):
+            assignments, _, _ = f.state.task_manager.fill_reservations(
+                [ExecutorReservation("exec-1")]
+            )
+            _, task = assignments[0]
+            n_out = task.output_partitioning.n
+            f.sender.post(
+                TaskUpdating(
+                    EXEC1,
+                    [
+                        TaskInfo(
+                            task.partition,
+                            "completed",
+                            "exec-1",
+                            partitions=[
+                                ShuffleWritePartition(p, f"/fake/{task.partition}/{p}", 1, 5, 50)
+                                for p in range(n_out)
+                            ],
+                        )
+                    ],
+                )
+            )
+            assert f.loop.drain(5.0)
+        # lose exec-1: its shuffle output is gone; job must roll back
+        f.sender.post(ExecutorLost("exec-1", "test kill"))
+        assert f.loop.drain(5.0)
+        assert f.state.executor_manager.is_dead_executor("exec-1")
+        # exec-2 finishes everything
+        f.run_tasks_like_executor(EXEC2)
+        status = f.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "completed", status
+    finally:
+        f.stop()
+
+
+def test_session_manager_persistence_and_rebuild():
+    backend = MemoryBackend()
+    from arrow_ballista_tpu.scheduler.session_manager import SessionManager
+
+    sm = SessionManager(backend)
+    ctx = sm.create_session({"ballista.shuffle.partitions": "7"})
+    sid = ctx.session_id
+    assert sm.get_session(sid) is ctx
+    # fresh manager on the same backend rebuilds from persisted settings
+    sm2 = SessionManager(backend)
+    rebuilt = sm2.get_session(sid)
+    assert rebuilt is not None
+    assert rebuilt.config.shuffle_partitions == 7
