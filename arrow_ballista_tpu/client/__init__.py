@@ -1,0 +1,3 @@
+from .context import BallistaContext, BallistaDataFrame
+
+__all__ = ["BallistaContext", "BallistaDataFrame"]

@@ -1,0 +1,257 @@
+"""BallistaContext: the user-facing distributed query entry point.
+
+Counterpart of the reference's ``client/src/context.rs``:
+
+* ``BallistaContext.remote(host, port, config)`` — calls ExecuteQuery with
+  no query to mint a server-side session id (`:85-138`), then every
+  DataFrame/SQL collect becomes a distributed job;
+* ``BallistaContext.standalone(...)`` — spins up an in-proc scheduler +
+  executor(s) (`:140-210`);
+* ``read_/register_{csv,parquet}`` keep a client-side table registry
+  (`:212-311`); ``sql()`` handles SHOW / CREATE EXTERNAL TABLE / SET
+  client-side (`:313-460`).
+
+The collect path is the counterpart of ``DistributedQueryExec``
+(``core/src/execution_plans/distributed_query.rs:161-333``): serialize the
+logical plan, ExecuteQuery, poll GetJobStatus every 100ms, then fetch the
+completed partitions (local-file fast path, Arrow Flight otherwise).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import List, Optional
+
+import pyarrow as pa
+
+from ..config import BallistaConfig, TaskSchedulingPolicy
+from ..context import DataFrame, SessionContext, _unqualify
+from ..errors import BallistaError, ExecutionError
+from ..proto import pb
+from ..proto.rpc import SchedulerGrpcStub, make_channel
+from ..serde import BallistaCodec
+from ..serde.scheduler_types import PartitionLocation
+
+log = logging.getLogger(__name__)
+
+JOB_POLL_INTERVAL_S = 0.1  # reference: distributed_query.rs:268
+
+
+class BallistaDataFrame(DataFrame):
+    """DataFrame whose collect() runs on the cluster.  Transformations
+    inherited from DataFrame stay lazy and preserve this type."""
+
+    def collect(self) -> pa.Table:
+        remote: BallistaContext = self.ctx.ballista_context
+        return remote._collect_distributed(self.plan)
+
+
+class BallistaContext:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        config: Optional[BallistaConfig] = None,
+        _standalone_handles: Optional[tuple] = None,
+    ):
+        self.host = host
+        self.port = port
+        self.config = config or BallistaConfig()
+        self.stub = SchedulerGrpcStub(make_channel(host, port))
+        self._session = SessionContext(self.config)
+        self._session.ballista_context = self
+        self._standalone_handles = _standalone_handles
+
+        # mint a server-side session id (reference: context.rs:103-119)
+        result = self.stub.ExecuteQuery(
+            pb.ExecuteQueryParams(settings=self._settings()), timeout=20
+        )
+        self.session_id = result.session_id
+        self._session.session_id = result.session_id
+
+    # ------------------------------------------------------------- factory
+    @staticmethod
+    def remote(
+        host: str, port: int, config: Optional[BallistaConfig] = None
+    ) -> "BallistaContext":
+        return BallistaContext(host, port, config)
+
+    @staticmethod
+    def standalone(
+        config: Optional[BallistaConfig] = None,
+        num_executors: int = 1,
+        concurrent_tasks: int = 4,
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+    ) -> "BallistaContext":
+        """In-proc cluster: scheduler + executors over real gRPC/Flight on
+        random localhost ports (reference: context.rs:140-210)."""
+        from ..executor.standalone import new_standalone_executor
+        from ..scheduler.standalone import new_standalone_scheduler
+
+        scheduler = new_standalone_scheduler(policy)
+        executors = [
+            new_standalone_executor(
+                scheduler.host,
+                scheduler.port,
+                concurrent_tasks=concurrent_tasks,
+                policy=policy,
+            )
+            for _ in range(num_executors)
+        ]
+        return BallistaContext(
+            scheduler.host,
+            scheduler.port,
+            config,
+            _standalone_handles=(scheduler, executors),
+        )
+
+    def close(self) -> None:
+        if self._standalone_handles is not None:
+            scheduler, executors = self._standalone_handles
+            for e in executors:
+                e.shutdown()
+            scheduler.shutdown()
+            self._standalone_handles = None
+
+    def __enter__(self) -> "BallistaContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------- registration
+    def register_parquet(self, name: str, path: str) -> None:
+        self._session.register_parquet(name, path)
+
+    def register_csv(self, name: str, path: str, **kw) -> None:
+        self._session.register_csv(name, path, **kw)
+
+    def register_table(self, name: str, provider) -> None:
+        self._session.register_table(name, provider)
+
+    def read_parquet(self, path: str) -> BallistaDataFrame:
+        return self._wrap(self._session.read_parquet(path))
+
+    def read_csv(self, path: str, **kw) -> BallistaDataFrame:
+        return self._wrap(self._session.read_csv(path, **kw))
+
+    def table(self, name: str) -> BallistaDataFrame:
+        return self._wrap(self._session.table(name))
+
+    def tables(self) -> List[str]:
+        return list(self._session.catalog.tables.keys())
+
+    # ---------------------------------------------------------------- sql
+    def sql(self, query: str) -> BallistaDataFrame:
+        """SQL → lazy distributed DataFrame.  DDL (CREATE EXTERNAL TABLE),
+        SHOW and SET are handled client-side by the wrapped SessionContext,
+        like the reference (context.rs:313-460)."""
+        df = self._session.sql(query)
+        # SET ballista.* mutates the session config; keep ours in sync so
+        # the next ExecuteQuery ships the updated settings
+        self.config = self._session.config
+        return self._wrap(df)
+
+    def _wrap(self, df: DataFrame) -> DataFrame:
+        """Distributed frame for real queries; client-side results (SHOW /
+        SET / EXPLAIN produce small in-memory values tables) stay local like
+        the reference (context.rs:313-460 handles them without a job)."""
+        from ..catalog import MemoryTable
+        from ..plan import logical as lp
+
+        plan = df.plan
+        if isinstance(plan, lp.TableScan) and isinstance(plan.provider, MemoryTable):
+            return DataFrame(self._session, plan)
+        return BallistaDataFrame(self._session, plan)
+
+    # ------------------------------------------------------------ internal
+    def _settings(self) -> List[pb.KeyValuePair]:
+        return [
+            pb.KeyValuePair(key=k, value=v)
+            for k, v in self.config.to_dict().items()
+        ]
+
+    def _collect_distributed(self, plan) -> pa.Table:
+        job_id = self.execute_logical_plan(plan)
+        status = self.wait_for_job(job_id)
+        return self.fetch_job_output(status)
+
+    def execute_logical_plan(self, plan) -> str:
+        import grpc
+
+        try:
+            result = self.stub.ExecuteQuery(
+                pb.ExecuteQueryParams(
+                    logical_plan=BallistaCodec.encode_logical(plan),
+                    settings=self._settings(),
+                    session_id=self.session_id,
+                ),
+                timeout=60,
+            )
+        except grpc.RpcError as e:
+            raise ExecutionError(
+                f"query submission failed: {e.details() if hasattr(e, 'details') else e}"
+            ) from e
+        return result.job_id
+
+    def wait_for_job(self, job_id: str, timeout_s: float = 300.0) -> dict:
+        """Poll GetJobStatus until terminal (reference:
+        distributed_query.rs:232-309)."""
+        from ..scheduler.task_status import job_status_from_proto
+
+        deadline = time.time() + timeout_s
+        while True:
+            result = self.stub.GetJobStatus(
+                pb.GetJobStatusParams(job_id=job_id), timeout=20
+            )
+            status = job_status_from_proto(result.status)
+            state = status["state"]
+            if state == "completed":
+                return status
+            if state == "failed":
+                raise ExecutionError(
+                    f"job {job_id} failed: {status.get('error', 'unknown error')}"
+                )
+            if time.time() > deadline:
+                raise ExecutionError(f"job {job_id} timed out after {timeout_s}s")
+            time.sleep(JOB_POLL_INTERVAL_S)
+
+    def fetch_job_output(self, status: dict) -> pa.Table:
+        """Fetch completed partitions (reference:
+        distributed_query.rs:311-333).  The schema comes from the partition
+        files themselves, so zero-row results collect cleanly."""
+        locations: List[PartitionLocation] = status.get("locations", [])
+        batches: List[pa.RecordBatch] = []
+        schema: Optional[pa.Schema] = None
+        for loc in locations:
+            part_schema, part_batches = _fetch_partition(loc)
+            schema = schema or part_schema
+            for batch in part_batches:
+                if batch.num_rows:
+                    batches.append(batch)
+        if schema is None:
+            raise BallistaError("completed job returned no partitions")
+        return _unqualify(pa.Table.from_batches(batches, schema=schema))
+
+
+def _fetch_partition(loc: PartitionLocation):
+    """Returns (schema, batches) for one completed partition."""
+    # local fast path (standalone mode shares the filesystem)
+    if loc.path and os.path.exists(loc.path):
+        with pa.OSFile(loc.path, "rb") as f:
+            reader = pa.ipc.open_file(f)
+            batches = [
+                reader.get_batch(i) for i in range(reader.num_record_batches)
+            ]
+        return reader.schema, batches
+    from ..flight.client import BallistaClient
+
+    client = BallistaClient.get(loc.executor_meta.host, loc.executor_meta.flight_port)
+    return client.fetch_partition_with_schema(
+        loc.partition_id.job_id,
+        loc.partition_id.stage_id,
+        loc.partition_id.partition_id,
+        loc.path,
+    )

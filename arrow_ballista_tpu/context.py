@@ -39,19 +39,28 @@ class DataFrame:
         from .plan import expressions as ex
 
         exprs = [ex.col(e) if isinstance(e, str) else e for e in exprs]
-        return DataFrame(self.ctx, lp.Projection(list(exprs), self.plan))
+        return type(self)(self.ctx, lp.Projection(list(exprs), self.plan))
 
     def filter(self, predicate) -> "DataFrame":
-        return DataFrame(self.ctx, lp.Filter(predicate, self.plan))
+        return type(self)(self.ctx, lp.Filter(predicate, self.plan))
 
     def aggregate(self, group_by: list, aggs: list) -> "DataFrame":
-        return DataFrame(self.ctx, lp.Aggregate(list(group_by), list(aggs), self.plan))
+        return type(self)(self.ctx, lp.Aggregate(list(group_by), list(aggs), self.plan))
 
     def sort(self, *sort_exprs) -> "DataFrame":
-        return DataFrame(self.ctx, lp.Sort(list(sort_exprs), self.plan))
+        from .plan import expressions as ex
+
+        fixed = []
+        for e in sort_exprs:
+            if isinstance(e, str):
+                e = ex.col(e).sort()
+            elif not isinstance(e, ex.SortExpr):
+                e = e.sort()
+            fixed.append(e)
+        return type(self)(self.ctx, lp.Sort(fixed, self.plan))
 
     def limit(self, n: int, offset: int = 0) -> "DataFrame":
-        return DataFrame(self.ctx, lp.Limit(self.plan, offset, n))
+        return type(self)(self.ctx, lp.Limit(self.plan, offset, n))
 
     def join(self, right: "DataFrame", on: list, how: str = "inner") -> "DataFrame":
         from .plan import expressions as ex
@@ -68,13 +77,13 @@ class DataFrame:
                         ex.col(r) if isinstance(r, str) else r,
                     )
                 )
-        return DataFrame(self.ctx, lp.Join(self.plan, right.plan, pairs, how, None))
+        return type(self)(self.ctx, lp.Join(self.plan, right.plan, pairs, how, None))
 
     def union(self, other: "DataFrame") -> "DataFrame":
-        return DataFrame(self.ctx, lp.Union([self.plan, other.plan]))
+        return type(self)(self.ctx, lp.Union([self.plan, other.plan]))
 
     def distinct(self) -> "DataFrame":
-        return DataFrame(self.ctx, lp.Distinct(self.plan))
+        return type(self)(self.ctx, lp.Distinct(self.plan))
 
     # -- actions ---------------------------------------------------------
     @property

@@ -82,10 +82,20 @@ class TaskContext:
     work_dir: str = "/tmp/ballista-tpu"
     job_id: str = ""
     stage_id: int = 0
+    # Cooperative cancellation: set by Executor.cancel_task, checked at batch
+    # granularity by the stage driver (the Python analogue of the reference's
+    # ``futures::abortable`` wrapper, executor/src/executor.rs:97-134).
+    cancel_event: Optional[threading.Event] = None
 
     @property
     def batch_size(self) -> int:
         return self.config.batch_size
+
+    def check_cancelled(self) -> None:
+        if self.cancel_event is not None and self.cancel_event.is_set():
+            from ..errors import Cancelled
+
+            raise Cancelled("task cancelled")
 
 
 class ExecutionPlan:

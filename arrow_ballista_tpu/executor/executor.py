@@ -1,0 +1,148 @@
+"""Executor core object: runs shuffle-write tasks, tracks abort handles.
+
+Counterpart of the reference's ``executor/src/executor.rs:44-179``: holds
+registration metadata, the local ``work_dir`` and concurrency budget;
+``execute_task`` decodes the stage plan, rebuilds the ShuffleWriterExec
+against the local work_dir (`:137-161` new_shuffle_writer), wraps execution
+with a cancellation handle keyed by PartitionId (`:97-134` abortable), and
+maps the outcome to a protobuf TaskStatus (``executor/src/lib.rs``
+as_task_status).  Panics/exceptions become Failed statuses like the
+reference's catch_unwind (``execution_loop.rs:120-130``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from ..config import BallistaConfig
+from ..exec.operators import TaskContext
+from ..proto import pb
+from ..scheduler.execution_stage import TaskInfo
+from ..scheduler.task_status import collect_plan_metrics, task_info_to_proto
+from ..serde import BallistaCodec, partitioning_from_proto
+from ..serde.scheduler_types import ExecutorMetadata, PartitionId
+from ..shuffle.execution_plans import ShuffleWriterExec
+
+log = logging.getLogger(__name__)
+
+
+class LoggingMetricsCollector:
+    """Prints the per-partition stage plan with metrics (reference:
+    executor/src/metrics/mod.rs:28-60)."""
+
+    def record_stage(
+        self, job_id: str, stage_id: int, partition: int, plan, metrics
+    ) -> None:
+        log.info(
+            "=== [%s/%s/%s] stage completed: %s metrics=%s ===",
+            job_id,
+            stage_id,
+            partition,
+            plan,
+            metrics,
+        )
+
+
+class Executor:
+    def __init__(
+        self,
+        metadata: ExecutorMetadata,
+        work_dir: str,
+        concurrent_tasks: int = 4,
+        metrics_collector: Optional[LoggingMetricsCollector] = None,
+    ):
+        self.metadata = metadata
+        self.work_dir = work_dir
+        self.concurrent_tasks = concurrent_tasks
+        self.metrics_collector = metrics_collector or LoggingMetricsCollector()
+        self._abort_handles: Dict[PartitionId, threading.Event] = {}
+        self._abort_lock = threading.Lock()
+
+    @property
+    def id(self) -> str:
+        return self.metadata.id
+
+    # ---------------------------------------------------------------- run
+    def execute_task(self, task: pb.TaskDefinition) -> pb.TaskStatus:
+        """Run one shuffle-write task to completion; never raises — any
+        error becomes a Failed TaskStatus."""
+        pid = PartitionId.from_proto(task.task_id)
+        cancel_event = threading.Event()
+        with self._abort_lock:
+            self._abort_handles[pid] = cancel_event
+        try:
+            plan = BallistaCodec.decode_physical(task.plan, self.work_dir)
+            config = BallistaConfig(dict(task.props))
+            writer = self._new_shuffle_writer(pid, plan, task, config)
+            ctx = TaskContext(
+                session_id=task.session_id or "default",
+                config=config,
+                work_dir=self.work_dir,
+                job_id=pid.job_id,
+                stage_id=pid.stage_id,
+                cancel_event=cancel_event,
+            )
+            partitions = writer.execute_shuffle_write(pid.partition_id, ctx)
+            metrics = collect_plan_metrics(writer)
+            self.metrics_collector.record_stage(
+                pid.job_id, pid.stage_id, pid.partition_id, writer, metrics
+            )
+            info = TaskInfo(
+                pid,
+                "completed",
+                executor_id=self.id,
+                partitions=partitions,
+                metrics=metrics,
+            )
+        except Exception as e:  # noqa: BLE001 - every failure must report
+            log.warning("task %s failed: %s", pid, e, exc_info=True)
+            info = TaskInfo(pid, "failed", error=f"{type(e).__name__}: {e}")
+        finally:
+            with self._abort_lock:
+                self._abort_handles.pop(pid, None)
+        return task_info_to_proto(info)
+
+    def _new_shuffle_writer(
+        self, pid: PartitionId, plan, task: pb.TaskDefinition, config: BallistaConfig
+    ) -> ShuffleWriterExec:
+        """Rebuild the stage root against the local work_dir (reference:
+        executor.rs:137-161), re-applying the TPU acceleration pass to the
+        stage subplan under this task's session config — acceleration is an
+        executor-local physical-optimizer rule, so plans travel
+        unaccelerated."""
+        from ..ops.stage_compiler import maybe_accelerate
+
+        partitioning = None
+        if task.has_output_partitioning:
+            partitioning = partitioning_from_proto(task.output_partitioning)
+        if isinstance(plan, ShuffleWriterExec):
+            inner = plan.input
+            partitioning = partitioning or plan.shuffle_output_partitioning
+        else:
+            inner = plan
+        inner = maybe_accelerate(inner, config)
+        return ShuffleWriterExec(
+            pid.job_id, pid.stage_id, inner, self.work_dir, partitioning
+        )
+
+    # --------------------------------------------------------------- abort
+    def cancel_task(self, pid: PartitionId) -> bool:
+        with self._abort_lock:
+            ev = self._abort_handles.get(pid)
+        if ev is None:
+            return False
+        ev.set()
+        return True
+
+    def active_task_count(self) -> int:
+        with self._abort_lock:
+            return len(self._abort_handles)
+
+    def cancel_all(self) -> int:
+        with self._abort_lock:
+            handles = list(self._abort_handles.values())
+        for ev in handles:
+            ev.set()
+        return len(handles)

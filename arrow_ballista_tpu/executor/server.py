@@ -1,0 +1,257 @@
+"""Push-mode executor server.
+
+Counterpart of the reference's ``executor/src/executor_server.rs``: starts
+an ExecutorGrpc server, registers with the scheduler (`:162-178`), runs a
+Heartbeater (60s, `:401-431`) and a TaskRunnerPool — a task-runner loop
+draining the LaunchTask channel onto worker threads (`:538-592`) and a
+status-reporter loop batching TaskStatus per curator scheduler
+(`:446-536`).  RPC handlers: LaunchTask / StopExecutor / CancelTasks
+(`:595-662`).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from ..proto import pb
+from ..proto.rpc import (
+    SchedulerGrpcStub,
+    add_executor_servicer,
+    make_channel,
+    make_server,
+)
+from ..serde.scheduler_types import PartitionId
+from .executor import Executor
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 60.0  # reference: executor_server.rs:421
+
+
+class ExecutorGrpcService:
+    """The three ExecutorGrpc RPC handlers (reference: `:595-662`)."""
+
+    def __init__(self, server: "ExecutorServer"):
+        self.server = server
+
+    def LaunchTask(self, request: pb.LaunchTaskParams, context) -> pb.LaunchTaskResult:
+        for task in request.tasks:
+            self.server.enqueue_task(task, request.scheduler_id)
+        return pb.LaunchTaskResult(success=True)
+
+    def StopExecutor(
+        self, request: pb.StopExecutorParams, context
+    ) -> pb.StopExecutorResult:
+        log.info(
+            "StopExecutor received (force=%s): %s", request.force, request.reason
+        )
+        if request.force:
+            self.server.executor.cancel_all()
+        self.server.trigger_shutdown(request.reason)
+        return pb.StopExecutorResult()
+
+    def CancelTasks(
+        self, request: pb.CancelTasksParams, context
+    ) -> pb.CancelTasksResult:
+        ok = True
+        for p in request.partition_ids:
+            pid = PartitionId.from_proto(p)
+            if not self.server.executor.cancel_task(pid):
+                ok = False
+        return pb.CancelTasksResult(cancelled=ok)
+
+
+class Heartbeater:
+    """Periodic HeartBeatFromExecutor (reference: `:401-431`)."""
+
+    def __init__(
+        self,
+        executor_id: str,
+        scheduler: SchedulerGrpcStub,
+        interval_s: float = HEARTBEAT_INTERVAL_S,
+    ):
+        self.executor_id = executor_id
+        self.scheduler = scheduler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeater":
+        self._send()  # immediate first beat so liveness starts now
+        self._thread = threading.Thread(
+            target=self._run, name="heartbeater", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._send()
+
+    def _send(self) -> None:
+        try:
+            status = pb.ExecutorStatus()
+            status.active = ""
+            self.scheduler.HeartBeatFromExecutor(
+                pb.HeartBeatParams(executor_id=self.executor_id, status=status),
+                timeout=10,
+            )
+        except grpc.RpcError as e:
+            log.warning("heartbeat failed: %s", e.code())
+
+
+class ExecutorServer:
+    """Owns the gRPC server + task runner pool + status reporter."""
+
+    def __init__(
+        self,
+        executor: Executor,
+        scheduler_host: str,
+        scheduler_port: int,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+        on_shutdown: Optional[Callable[[str], None]] = None,
+    ):
+        self.executor = executor
+        self.scheduler = SchedulerGrpcStub(
+            make_channel(scheduler_host, scheduler_port)
+        )
+        self._scheduler_stubs: Dict[str, SchedulerGrpcStub] = {
+            f"{scheduler_host}:{scheduler_port}": self.scheduler
+        }
+        self.heartbeater = Heartbeater(
+            executor.id, self.scheduler, heartbeat_interval_s
+        )
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._statuses: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._grpc_server: Optional[grpc.Server] = None
+        self.grpc_port: int = executor.metadata.grpc_port
+        self.on_shutdown = on_shutdown
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "ExecutorServer":
+        # 1. gRPC server first so the scheduler can push immediately
+        self._grpc_server = make_server()
+        add_executor_servicer(self._grpc_server, ExecutorGrpcService(self))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self.executor.metadata.host or '0.0.0.0'}:{self.grpc_port}"
+        )
+        if self.grpc_port == 0:
+            self.grpc_port = bound
+            meta = self.executor.metadata
+            object.__setattr__(meta, "grpc_port", bound)
+        self._grpc_server.start()
+
+        # 2. register with the scheduler (reference: `:162-178`)
+        meta = self.executor.metadata
+        registration = pb.ExecutorRegistration(
+            id=meta.id,
+            host=meta.host,
+            has_host=bool(meta.host),
+            flight_port=meta.flight_port,
+            grpc_port=self.grpc_port,
+            specification=meta.specification.to_proto(),
+        )
+        result = self.scheduler.RegisterExecutor(
+            pb.RegisterExecutorParams(metadata=registration), timeout=20
+        )
+        if not result.success:
+            raise RuntimeError("scheduler refused executor registration")
+
+        # 3. heartbeats + worker pool + status reporter
+        self.heartbeater.start()
+        for i in range(self.executor.concurrent_tasks):
+            t = threading.Thread(
+                target=self._task_runner, name=f"task-runner-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        reporter = threading.Thread(
+            target=self._status_reporter, name="status-reporter", daemon=True
+        )
+        reporter.start()
+        self._threads.append(reporter)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self.heartbeater.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1)
+
+    def trigger_shutdown(self, reason: str) -> None:
+        if self.on_shutdown is not None:
+            # shutdown must not run on the gRPC handler thread
+            threading.Thread(
+                target=self.on_shutdown, args=(reason,), daemon=True
+            ).start()
+
+    # ------------------------------------------------------------- running
+    def enqueue_task(self, task: pb.TaskDefinition, scheduler_id: str) -> None:
+        self._tasks.put((task, scheduler_id))
+
+    def _task_runner(self) -> None:
+        while not self._stop.is_set():
+            try:
+                task, scheduler_id = self._tasks.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            status = self.executor.execute_task(task)
+            self._statuses.put((scheduler_id, status))
+
+    def _status_reporter(self) -> None:
+        """Batch statuses per curator scheduler (reference: `:446-536`)."""
+        while not self._stop.is_set():
+            try:
+                scheduler_id, status = self._statuses.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch: Dict[str, List[pb.TaskStatus]] = {scheduler_id: [status]}
+            while True:
+                try:
+                    sid, s = self._statuses.get_nowait()
+                    batch.setdefault(sid, []).append(s)
+                except queue.Empty:
+                    break
+            for sid, statuses in batch.items():
+                stub = self._stub_for(sid)
+                try:
+                    stub.UpdateTaskStatus(
+                        pb.UpdateTaskStatusParams(
+                            executor_id=self.executor.id, task_status=statuses
+                        ),
+                        timeout=20,
+                    )
+                except grpc.RpcError as e:
+                    log.warning(
+                        "UpdateTaskStatus to %s failed (%s); retrying", sid, e.code()
+                    )
+                    for s in statuses:
+                        self._statuses.put((sid, s))
+                    # back off so a dead scheduler doesn't spin this thread
+                    self._stop.wait(0.5)
+
+    def _stub_for(self, scheduler_id: str) -> SchedulerGrpcStub:
+        """Curator scheduler ids are host:port strings; fall back to the
+        registration scheduler (reference: `:222-245` multi-scheduler cache)."""
+        stub = self._scheduler_stubs.get(scheduler_id)
+        if stub is not None:
+            return stub
+        if ":" in scheduler_id:
+            host, _, port = scheduler_id.rpartition(":")
+            try:
+                stub = SchedulerGrpcStub(make_channel(host, int(port)))
+                self._scheduler_stubs[scheduler_id] = stub
+                return stub
+            except Exception:  # noqa: BLE001
+                pass
+        return self.scheduler

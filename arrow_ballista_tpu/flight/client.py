@@ -53,6 +53,16 @@ class BallistaClient:
     def fetch_partition(
         self, job_id: str, stage_id: int, partition_id: int, path: str
     ) -> Iterator[pa.RecordBatch]:
+        _schema, batches = self.fetch_partition_with_schema(
+            job_id, stage_id, partition_id, path
+        )
+        return batches
+
+    def fetch_partition_with_schema(
+        self, job_id: str, stage_id: int, partition_id: int, path: str
+    ) -> tuple[pa.Schema, Iterator[pa.RecordBatch]]:
+        """Returns the partition schema up front (available even when the
+        partition holds zero batches) plus a lazy batch stream."""
         ticket_proto = pb.FetchPartitionTicket(
             job_id=job_id,
             stage_id=stage_id,
@@ -62,10 +72,21 @@ class BallistaClient:
         ticket = flight.Ticket(ticket_proto.SerializeToString())
         try:
             reader = self._client.do_get(ticket)
-            for chunk in reader:
-                yield chunk.data
+            schema = reader.schema
         except flight.FlightError as e:
             raise ExecutionError(
                 f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
                 f"{self.host}:{self.port} failed: {e}"
             ) from e
+
+        def gen() -> Iterator[pa.RecordBatch]:
+            try:
+                for chunk in reader:
+                    yield chunk.data
+            except flight.FlightError as e:
+                raise ExecutionError(
+                    f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
+                    f"{self.host}:{self.port} failed: {e}"
+                ) from e
+
+        return schema, gen()

@@ -1,0 +1,189 @@
+"""SchedulerGrpc servicer: the nine RPC handlers.
+
+Counterpart of the reference's ``scheduler/src/scheduler_server/grpc.rs``:
+
+* ``PollWork`` (pull mode, `:56-175`) — heartbeat + piggybacked statuses +
+  at most one task filled into the polling executor's slot;
+* ``RegisterExecutor`` (`:177-233`) — push mode reserves every slot and
+  offers them immediately;
+* ``HeartBeatFromExecutor`` / ``UpdateTaskStatus`` / ``ExecutorStopped`` /
+  ``CancelJob`` (`:235-292`, tail);
+* ``GetFileMetadata`` (`:294-345`) — schema inference for parquet/csv;
+* ``ExecuteQuery`` (`:347-460`) — session create/update, plan decode, job
+  id mint, submit; an empty query only mints a session id (how
+  ``BallistaContext::remote`` bootstraps);
+* ``GetJobStatus``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import pyarrow as pa
+
+from ..config import TaskSchedulingPolicy
+from ..proto import pb
+from ..serde import BallistaCodec, schema_to_bytes
+from ..serde.scheduler_types import ExecutorMetadata, ExecutorSpecification
+from .server import SchedulerServer
+from .task_status import job_status_to_proto, task_info_from_proto
+
+log = logging.getLogger(__name__)
+
+
+def _registration_to_metadata(reg: pb.ExecutorRegistration, peer: str) -> ExecutorMetadata:
+    """The executor may omit its host; fall back to the connection peer
+    (reference: grpc.rs optional_host handling)."""
+    host = reg.host if reg.has_host else (peer or "127.0.0.1")
+    return ExecutorMetadata(
+        id=reg.id,
+        host=host,
+        flight_port=reg.flight_port,
+        grpc_port=reg.grpc_port,
+        specification=ExecutorSpecification.from_proto(reg.specification),
+    )
+
+
+def _peer_host(context) -> str:
+    try:
+        peer = context.peer()  # e.g. "ipv4:127.0.0.1:53210"
+        if peer.startswith(("ipv4:", "ipv6:")):
+            hostport = peer.split(":", 1)[1]
+            return hostport.rsplit(":", 1)[0].strip("[]")
+    except Exception:  # noqa: BLE001
+        pass
+    return ""
+
+
+class SchedulerGrpcService:
+    """Bound to a grpc.Server via proto.rpc.add_scheduler_servicer."""
+
+    def __init__(self, server: SchedulerServer):
+        self.server = server
+
+    # ------------------------------------------------------------ pull mode
+    def PollWork(self, request: pb.PollWorkParams, context) -> pb.PollWorkResult:
+        meta = _registration_to_metadata(request.metadata, _peer_host(context))
+        statuses = [task_info_from_proto(s) for s in request.task_status]
+        task = self.server.poll_work(meta, request.can_accept_task, statuses)
+        result = pb.PollWorkResult()
+        if task is not None:
+            result.task.CopyFrom(task)
+            result.has_task = True
+        return result
+
+    # ------------------------------------------------------------ push mode
+    def RegisterExecutor(
+        self, request: pb.RegisterExecutorParams, context
+    ) -> pb.RegisterExecutorResult:
+        meta = _registration_to_metadata(request.metadata, _peer_host(context))
+        reserve = self.server.policy == TaskSchedulingPolicy.PUSH_STAGED
+        reservations = self.server.state.executor_manager.register_executor(
+            meta, reserve
+        )
+        if reservations:
+            self.server.offer_reservation(reservations)
+        log.info(
+            "registered executor %s at %s:%d (%d slots, policy=%s)",
+            meta.id,
+            meta.host,
+            meta.grpc_port or meta.flight_port,
+            meta.specification.task_slots,
+            self.server.policy.value,
+        )
+        return pb.RegisterExecutorResult(success=True)
+
+    def HeartBeatFromExecutor(
+        self, request: pb.HeartBeatParams, context
+    ) -> pb.HeartBeatResult:
+        import time
+
+        from .executor_manager import ExecutorHeartbeat
+
+        self.server.state.executor_manager.save_heartbeat(
+            ExecutorHeartbeat(request.executor_id, time.time(), "active")
+        )
+        return pb.HeartBeatResult(reregister=False)
+
+    def UpdateTaskStatus(
+        self, request: pb.UpdateTaskStatusParams, context
+    ) -> pb.UpdateTaskStatusResult:
+        statuses = [task_info_from_proto(s) for s in request.task_status]
+        self.server.update_task_status(request.executor_id, statuses)
+        return pb.UpdateTaskStatusResult(success=True)
+
+    # ------------------------------------------------------------- queries
+    def GetFileMetadata(
+        self, request: pb.GetFileMetadataParams, context
+    ) -> pb.GetFileMetadataResult:
+        ft = (request.file_type or "parquet").lower()
+        if ft == "parquet":
+            import pyarrow.parquet as pq
+
+            schema = pq.read_schema(request.path)
+        elif ft == "csv":
+            import pyarrow.csv as pcsv
+
+            reader = pcsv.open_csv(request.path)
+            schema = reader.schema
+        else:
+            context.abort(
+                __import__("grpc").StatusCode.INVALID_ARGUMENT,
+                f"unsupported file type {ft!r}",
+            )
+            return pb.GetFileMetadataResult()
+        return pb.GetFileMetadataResult(schema=schema_to_bytes(schema))
+
+    def ExecuteQuery(
+        self, request: pb.ExecuteQueryParams, context
+    ) -> pb.ExecuteQueryResult:
+        settings = {kv.key: kv.value for kv in request.settings}
+        sm = self.server.state.session_manager
+        if request.session_id:
+            session_ctx = sm.update_session(request.session_id, settings)
+        else:
+            session_ctx = sm.create_session(settings)
+
+        which = request.WhichOneof("query")
+        if which is None:
+            # session-bootstrap call (reference: client context.rs:103-119)
+            return pb.ExecuteQueryResult(
+                job_id="", session_id=session_ctx.session_id
+            )
+        if which == "logical_plan":
+            plan = BallistaCodec.decode_logical(request.logical_plan)
+        else:
+            plan = session_ctx.sql(request.sql).logical_plan()
+
+        job_id = self.server.state.task_manager.generate_job_id()
+        self.server.submit_job(job_id, session_ctx.session_id, plan)
+        log.info("queued job %s (session %s)", job_id, session_ctx.session_id)
+        return pb.ExecuteQueryResult(
+            job_id=job_id, session_id=session_ctx.session_id
+        )
+
+    def GetJobStatus(
+        self, request: pb.GetJobStatusParams, context
+    ) -> pb.GetJobStatusResult:
+        status = self.server.state.task_manager.get_job_status(request.job_id)
+        result = pb.GetJobStatusResult()
+        if status is None:
+            # unknown job: surface as queued (it may still be planning)
+            result.status.queued.SetInParent()
+        else:
+            result.status.CopyFrom(job_status_to_proto(status))
+        return result
+
+    # ------------------------------------------------------------ lifecycle
+    def ExecutorStopped(
+        self, request: pb.ExecutorStoppedParams, context
+    ) -> pb.ExecutorStoppedResult:
+        log.info(
+            "executor %s stopped: %s", request.executor_id, request.reason
+        )
+        self.server.executor_lost(request.executor_id, request.reason)
+        return pb.ExecutorStoppedResult()
+
+    def CancelJob(self, request: pb.CancelJobParams, context) -> pb.CancelJobResult:
+        self.server.cancel_job(request.job_id)
+        return pb.CancelJobResult(cancelled=True)

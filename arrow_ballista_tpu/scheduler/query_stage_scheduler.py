@@ -79,6 +79,19 @@ class ExecutorLost:
     reason: str = ""
 
 
+def post_job_events(state: SchedulerState, sender, events) -> None:
+    """Map task-manager job events onto scheduler events; shared by the
+    event-loop TaskUpdating handler and the pull-mode poll_work path."""
+    for job_id, ev in events:
+        if ev == "job_completed":
+            sender.post(JobFinished(job_id))
+        elif ev == "job_failed":
+            status = state.task_manager.get_job_status(job_id) or {}
+            sender.post(JobRunningFailed(job_id, status.get("error", "task failed")))
+        else:
+            sender.post(JobUpdated(job_id))
+
+
 class QueryStageScheduler(EventAction):
     def __init__(self, state: SchedulerState):
         self.state = state
@@ -147,16 +160,7 @@ class QueryStageScheduler(EventAction):
         events, reservations = self.state.update_task_statuses(
             event.executor, event.statuses
         )
-        for job_id, ev in events:
-            if ev == "job_completed":
-                sender.post(JobFinished(job_id))
-            elif ev == "job_failed":
-                status = self.state.task_manager.get_job_status(job_id) or {}
-                sender.post(
-                    JobRunningFailed(job_id, status.get("error", "task failed"))
-                )
-            else:
-                sender.post(JobUpdated(job_id))
+        post_job_events(self.state, sender, events)
         if reservations:
             sender.post(ReservationOffering(reservations))
 

@@ -1,0 +1,246 @@
+"""SchedulerServer: top-level scheduler object.
+
+Counterpart of the reference's ``scheduler/src/scheduler_server/mod.rs``:
+owns the :class:`SchedulerState`, the scheduling policy, and the
+query-stage event loop (buffer 10,000, `:55-61`); ``init()`` starts the
+loop and the dead-executor reaper (`:131-137`, `:192-253`); ``submit_job``
+posts ``JobQueued`` (`:139-153`); ``update_task_status`` posts
+``TaskUpdating`` after rejecting dead executors (`:157-178`).
+
+The pull-mode fill path (``poll_work``) mutates state directly from the
+RPC thread exactly like the reference's handler
+(``scheduler_server/grpc.rs:56-175``): graphs are behind per-job locks, so
+this is safe, and job-level consequences are still posted as events.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config import TaskSchedulingPolicy
+from ..plan import logical as lp
+from ..proto import pb
+from ..serde.scheduler_types import ExecutorMetadata
+from .backend import StateBackend
+from .event_loop import EventLoop
+from .execution_stage import TaskInfo
+from .executor_manager import (
+    DEFAULT_EXECUTOR_TIMEOUT_S,
+    ExecutorReservation,
+)
+from .query_stage_scheduler import (
+    ExecutorLost,
+    JobQueued,
+    QueryStageScheduler,
+    ReservationOffering,
+    TaskUpdating,
+    post_job_events,
+)
+from .session_manager import SessionBuilder, default_session_builder
+from .state import SchedulerState
+from .task_manager import TaskLauncher, TaskManager
+
+log = logging.getLogger(__name__)
+
+EVENT_LOOP_BUFFER = 10_000
+
+
+class SchedulerServer:
+    def __init__(
+        self,
+        scheduler_id: str,
+        backend: StateBackend,
+        policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+        session_builder: SessionBuilder = default_session_builder,
+        launcher: Optional[TaskLauncher] = None,
+        work_dir: str = "/tmp/ballista-tpu",
+        liveness_window_s: float = 60.0,
+        executor_timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S,
+        reaper_interval_s: Optional[float] = None,
+    ):
+        self.scheduler_id = scheduler_id
+        self.policy = policy
+        self.state = SchedulerState(
+            backend,
+            scheduler_id,
+            policy,
+            session_builder,
+            launcher,
+            work_dir,
+            liveness_window_s,
+        )
+        self.event_loop = EventLoop(
+            "query_stage", EVENT_LOOP_BUFFER, QueryStageScheduler(self.state)
+        )
+        self.executor_timeout_s = executor_timeout_s
+        self.reaper_interval_s = (
+            reaper_interval_s if reaper_interval_s is not None else executor_timeout_s
+        )
+        self._reaper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def init(self) -> "SchedulerServer":
+        self.event_loop.start()
+        self._reaper = threading.Thread(
+            target=self._reaper_loop, name="executor-reaper", daemon=True
+        )
+        self._reaper.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.event_loop.stop()
+        self.state.executor_manager.close()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until the event loop has processed everything queued (test
+        aid, mirrors the reference's await_condition polling)."""
+        return self.event_loop.drain(timeout)
+
+    # ------------------------------------------------------------ job entry
+    def submit_job(self, job_id: str, session_id: str, plan: lp.LogicalPlan) -> None:
+        self.event_loop.get_sender().post(JobQueued(job_id, session_id, plan))
+
+    def update_task_status(
+        self, executor_id: str, statuses: List[TaskInfo]
+    ) -> None:
+        """Reject updates from executors already declared dead
+        (reference: scheduler_server/mod.rs:157-178)."""
+        if self.state.executor_manager.is_dead_executor(executor_id):
+            log.warning(
+                "dropping %d task status(es) from dead executor %s",
+                len(statuses),
+                executor_id,
+            )
+            return
+        try:
+            meta = self.state.executor_manager.get_executor_metadata(executor_id)
+        except Exception:
+            # unknown executor (e.g. scheduler restarted and lost state):
+            # drop rather than erroring the RPC, which would make the
+            # executor's status reporter retry the same batch forever
+            log.warning(
+                "dropping %d task status(es) from unknown executor %s",
+                len(statuses),
+                executor_id,
+            )
+            return
+        self.event_loop.get_sender().post(TaskUpdating(meta, statuses))
+
+    def offer_reservation(self, reservations: List[ExecutorReservation]) -> None:
+        self.event_loop.get_sender().post(ReservationOffering(reservations))
+
+    def executor_lost(self, executor_id: str, reason: str = "") -> None:
+        self.event_loop.get_sender().post(ExecutorLost(executor_id, reason))
+
+    # ------------------------------------------------------------ pull mode
+    def poll_work(
+        self,
+        metadata: ExecutorMetadata,
+        can_accept_task: bool,
+        statuses: List[TaskInfo],
+    ) -> Optional[pb.TaskDefinition]:
+        """Pull-mode heart of the scheduler (reference: grpc.rs:56-175):
+        save metadata + heartbeat, apply piggybacked statuses, then fill at
+        most one task into the polling executor's free slot."""
+        em = self.state.executor_manager
+        if em.is_dead_executor(metadata.id):
+            log.warning("rejecting poll from dead executor %s", metadata.id)
+            return None
+        self._save_poll_registration(metadata)
+
+        if statuses:
+            events, _ = self.state.update_task_statuses(metadata, statuses)
+            post_job_events(self.state, self.event_loop.get_sender(), events)
+
+        if not can_accept_task:
+            return None
+        reservation = ExecutorReservation(metadata.id)
+        tm: TaskManager = self.state.task_manager
+        assignments, _free, _pending = tm.fill_reservations([reservation])
+        if not assignments:
+            return None
+        _executor_id, task = assignments[0]
+        return tm.prepare_task_definition(task)
+
+    def _save_poll_registration(self, metadata: ExecutorMetadata) -> None:
+        from .executor_manager import ExecutorHeartbeat
+
+        em = self.state.executor_manager
+        try:
+            em.get_executor_metadata(metadata.id)
+        except Exception:
+            em.register_executor(metadata)
+            return
+        em.save_heartbeat(ExecutorHeartbeat(metadata.id, time.time(), "active"))
+
+    # -------------------------------------------------------------- reaper
+    def _reaper_loop(self) -> None:
+        """Periodically expire executors whose heartbeats timed out
+        (reference: scheduler_server/mod.rs:192-253 expire_dead_executors)."""
+        while not self._stop.wait(self.reaper_interval_s):
+            try:
+                self._expire_dead_executors()
+            except Exception:  # noqa: BLE001 - reaper must never die
+                log.exception("dead-executor reaper iteration failed")
+
+    def _expire_dead_executors(self) -> None:
+        expired = self.state.executor_manager.get_expired_executors(
+            self.executor_timeout_s
+        )
+        for hb in expired:
+            age = time.time() - hb.timestamp
+            log.warning(
+                "executor %s heartbeat is %.0fs old (timeout %.0fs); removing",
+                hb.executor_id,
+                age,
+                self.executor_timeout_s,
+            )
+            self._try_stop_executor(hb.executor_id, "heartbeat timed out")
+            self.executor_lost(hb.executor_id, "heartbeat timed out")
+
+    def _try_stop_executor(self, executor_id: str, reason: str) -> None:
+        """Best-effort StopExecutor{force} RPC (reference: `:227-244`)."""
+        try:
+            meta = self.state.executor_manager.get_executor_metadata(executor_id)
+        except Exception:
+            return
+        if not meta.grpc_port:
+            return
+        try:
+            from ..proto.rpc import ExecutorGrpcStub, make_channel
+
+            stub = ExecutorGrpcStub(make_channel(meta.host, meta.grpc_port))
+            stub.StopExecutor(
+                pb.StopExecutorParams(
+                    executor_id=executor_id, reason=reason, force=True
+                ),
+                timeout=5,
+            )
+        except Exception as e:  # noqa: BLE001 - executor may simply be gone
+            log.debug("StopExecutor(%s) failed: %s", executor_id, e)
+
+    # --------------------------------------------------------------- misc
+    def cancel_job(self, job_id: str) -> None:
+        """Fail the job and tell executors to abort its running tasks
+        (reference: grpc.rs CancelJob → task_manager.rs:225-303)."""
+        running = self.state.task_manager.cancel_job(job_id)
+        from ..proto.rpc import ExecutorGrpcStub, make_channel
+
+        for meta, pids in running:
+            if not meta.grpc_port:
+                continue
+            try:
+                stub = ExecutorGrpcStub(make_channel(meta.host, meta.grpc_port))
+                stub.CancelTasks(
+                    pb.CancelTasksParams(
+                        partition_ids=[p.to_proto() for p in pids]
+                    ),
+                    timeout=5,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.warning("CancelTasks on %s failed: %s", meta.id, e)

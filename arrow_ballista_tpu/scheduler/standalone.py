@@ -1,0 +1,59 @@
+"""In-process scheduler for standalone mode.
+
+Counterpart of the reference's ``scheduler/src/standalone.rs:33-60``: a
+scheduler on a random localhost port over an in-memory state backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Optional, Tuple
+
+import grpc
+
+from ..config import TaskSchedulingPolicy
+from ..proto.rpc import add_scheduler_servicer, make_server
+from .backend import MemoryBackend, StateBackend
+from .grpc_service import SchedulerGrpcService
+from .server import SchedulerServer
+
+log = logging.getLogger(__name__)
+
+
+class StandaloneScheduler:
+    def __init__(self, server: SchedulerServer, grpc_server: grpc.Server, port: int):
+        self.server = server
+        self.grpc_server = grpc_server
+        self.port = port
+        self.host = "127.0.0.1"
+
+    def shutdown(self) -> None:
+        self.grpc_server.stop(grace=1)
+        self.server.stop()
+
+
+def new_standalone_scheduler(
+    policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+    backend: Optional[StateBackend] = None,
+    liveness_window_s: float = 60.0,
+    executor_timeout_s: float = 180.0,
+) -> StandaloneScheduler:
+    backend = backend or MemoryBackend()
+    scheduler_id = f"localhost:{uuid.uuid4().hex[:6]}"
+    server = SchedulerServer(
+        scheduler_id,
+        backend,
+        policy,
+        liveness_window_s=liveness_window_s,
+        executor_timeout_s=executor_timeout_s,
+    ).init()
+    grpc_server = make_server()
+    add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))
+    port = grpc_server.add_insecure_port("127.0.0.1:0")
+    grpc_server.start()
+    # the scheduler id doubles as the curator address executors report to
+    server.scheduler_id = f"127.0.0.1:{port}"
+    server.state.task_manager.scheduler_id = server.scheduler_id
+    log.info("standalone scheduler up at 127.0.0.1:%d (%s)", port, policy.value)
+    return StandaloneScheduler(server, grpc_server, port)

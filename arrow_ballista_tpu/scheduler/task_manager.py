@@ -232,7 +232,19 @@ class TaskManager:
             td.has_output_partitioning = True
         td.session_id = task.session_id
         td.curator_scheduler_id = self.scheduler_id
+        # ship the session settings so the executor's TaskContext + TPU
+        # acceleration pass see the client's config (reference: grpc.rs
+        # poll_work/launch builds TaskDefinition.props from session props)
+        for k, v in self._session_settings(task.session_id).items():
+            td.props[k] = v
         return td
+
+    def _session_settings(self, session_id: str) -> Dict[str, str]:
+        raw = self.backend.get(Keyspace.Sessions, session_id)
+        if raw is None:
+            return {}
+        msg = pb.SessionSettings.FromString(raw)
+        return {kv.key: kv.value for kv in msg.configs}
 
     def launch_tasks(
         self, executor: ExecutorMetadata, tasks: List[Task]

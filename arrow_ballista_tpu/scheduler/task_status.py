@@ -1,0 +1,109 @@
+"""TaskStatus proto ⇄ TaskInfo conversions, shared by scheduler + executor.
+
+Counterpart of the reference's ``executor/src/lib.rs as_task_status`` (the
+executor-side Result → protobuf mapping) and the scheduler-side decode in
+``scheduler/src/state/task_manager.rs:132-170``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..proto import pb
+from ..serde.scheduler_types import PartitionId, ShuffleWritePartition
+from .execution_stage import TaskInfo
+
+
+def task_info_to_proto(info: TaskInfo) -> pb.TaskStatus:
+    msg = pb.TaskStatus()
+    msg.task_id.CopyFrom(info.partition_id.to_proto())
+    if info.state == "running":
+        msg.running.executor_id = info.executor_id
+    elif info.state == "failed":
+        msg.failed.error = info.error or "task failed"
+    elif info.state == "completed":
+        msg.completed.executor_id = info.executor_id
+        for p in info.partitions:
+            msg.completed.partitions.append(p.to_proto())
+    else:
+        raise ValueError(f"unknown task state {info.state!r}")
+    for op_name, values in info.metrics:
+        m = msg.metrics.add()
+        m.operator_name = op_name
+        for k, v in values.items():
+            m.values[k] = int(v)
+    return msg
+
+
+def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
+    pid = PartitionId.from_proto(msg.task_id)
+    which = msg.WhichOneof("status")
+    metrics = [(m.operator_name, dict(m.values)) for m in msg.metrics]
+    if which == "running":
+        return TaskInfo(pid, "running", msg.running.executor_id, metrics=metrics)
+    if which == "failed":
+        return TaskInfo(pid, "failed", error=msg.failed.error, metrics=metrics)
+    if which == "completed":
+        parts = [
+            ShuffleWritePartition.from_proto(p) for p in msg.completed.partitions
+        ]
+        return TaskInfo(
+            pid,
+            "completed",
+            msg.completed.executor_id,
+            partitions=parts,
+            metrics=metrics,
+        )
+    raise ValueError(f"TaskStatus with no status set for {pid}")
+
+
+def job_status_to_proto(status: dict) -> pb.JobStatus:
+    """Scheduler-side status snapshot → wire JobStatus
+    (reference: proto JobStatus oneof, ballista.proto)."""
+    msg = pb.JobStatus()
+    state = status.get("state")
+    if state == "queued":
+        msg.queued.SetInParent()
+    elif state == "running":
+        msg.running.SetInParent()
+    elif state == "failed":
+        msg.failed.error = status.get("error", "")
+    elif state == "completed":
+        for loc in status.get("locations", []):
+            msg.completed.partition_location.append(loc.to_proto())
+    else:
+        msg.queued.SetInParent()
+    return msg
+
+
+def job_status_from_proto(msg: pb.JobStatus) -> dict:
+    from ..serde.scheduler_types import PartitionLocation
+
+    which = msg.WhichOneof("status")
+    if which == "failed":
+        return {"state": "failed", "error": msg.failed.error}
+    if which == "completed":
+        return {
+            "state": "completed",
+            "locations": [
+                PartitionLocation.from_proto(p)
+                for p in msg.completed.partition_location
+            ],
+        }
+    return {"state": which or "queued"}
+
+
+def collect_plan_metrics(plan) -> List[tuple]:
+    """Walk the operator tree gathering (operator_name, metric values)
+    (reference: core/src/utils.rs:347-358 collect_plan_metrics)."""
+    out: List[tuple] = []
+
+    def walk(node):
+        values = node.metrics.to_dict()
+        if values:
+            out.append((type(node).__name__, values))
+        for child in node.children():
+            walk(child)
+
+    walk(plan)
+    return out

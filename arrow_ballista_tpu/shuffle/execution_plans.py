@@ -142,6 +142,7 @@ class ShuffleWriterExec(ExecutionPlan):
             sink: Optional[_IpcFileSink] = None
             with self.metrics.timer("write_time_ns"):
                 for batch in self.input.execute(input_partition, ctx):
+                    ctx.check_cancelled()
                     if sink is None:
                         sink = _IpcFileSink(path, batch.schema)
                     sink.write(batch)
@@ -169,6 +170,7 @@ class ShuffleWriterExec(ExecutionPlan):
         ]
         in_schema = self.input.schema
         for batch in self.input.execute(input_partition, ctx):
+            ctx.check_cancelled()
             with self.metrics.timer("repart_time_ns"):
                 idx = partition_indices(batch, exprs, n_out)
                 order = np.argsort(idx, kind="stable")
