@@ -174,6 +174,8 @@ class SessionContext:
     def sql(self, query: str) -> DataFrame:
         stmt = parse_sql(query)
         if isinstance(stmt, ast.Query):
+            if stmt.ctes:
+                return self._sql_with_ctes(stmt)
             builder = PlanBuilder(self.catalog)
             return DataFrame(self, builder.build_query(stmt))
         if isinstance(stmt, ast.CreateExternalTable):
@@ -200,6 +202,44 @@ class SessionContext:
             self.deregister_table(stmt.name)
             return self._values_df(pa.table({"result": pa.array(["ok"])}))
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _sql_with_ctes(self, stmt: ast.Query) -> DataFrame:
+        """Materialize each WITH-clause query ONCE and expose it as an
+        in-memory table to the main query (and to later CTEs).
+
+        Eager single evaluation (rather than inline expansion at every
+        reference) both avoids recomputation and guarantees bit-identical
+        results across references — q15's ``total_revenue = (select
+        max(total_revenue) from revenue0)`` float equality depends on it.
+        """
+        import dataclasses
+
+        # (name, previously-registered provider or None) so a CTE that
+        # shadows a real table restores it afterwards
+        registered: list[tuple[str, Optional[TableProvider]]] = []
+        try:
+            for name, sub in stmt.ctes:
+                shadowed = self.catalog.tables.get(name.lower())
+                sub_df = self.sql_query_ast(sub)
+                tbl = sub_df.collect()
+                self.catalog.register(
+                    name,
+                    MemoryTable.from_table(tbl, self.config.shuffle_partitions),
+                )
+                registered.append((name, shadowed))
+            main = dataclasses.replace(stmt, ctes=[])
+            builder = PlanBuilder(self.catalog)
+            return DataFrame(self, builder.build_query(main))
+        finally:
+            for name, shadowed in registered:
+                self.catalog.deregister(name)
+                if shadowed is not None:
+                    self.catalog.register(name, shadowed)
+
+    def sql_query_ast(self, q: ast.Query) -> DataFrame:
+        if q.ctes:
+            return self._sql_with_ctes(q)
+        return DataFrame(self, PlanBuilder(self.catalog).build_query(q))
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> DataFrame:
         if stmt.name.lower() in self.catalog.tables and stmt.if_not_exists:

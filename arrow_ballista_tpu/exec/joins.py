@@ -56,8 +56,6 @@ class HashJoinExec(ExecutionPlan):
         self.filter = filter
         self._collect_left_cache: Optional[pa.Table] = None
         self._lock = threading.Lock()
-        if filter is not None and join_type in ("left", "right", "full"):
-            raise NotImplementedYet("residual join filter on outer joins")
 
     @property
     def schema(self) -> pa.Schema:
@@ -164,6 +162,46 @@ class HashJoinExec(ExecutionPlan):
                 all_idx = np.arange(left.num_rows, dtype=np.int64)
                 take = np.setdiff1d(all_idx, matched, assume_unique=False)
             return left.take(pa.array(take)).combine_chunks().cast(schema)
+
+        if jt in ("left", "right", "full") and self.filter is not None:
+            # Residual filter on an outer join (e.g. TPC-H q13's ON-clause
+            # `not like` predicate): the filter applies to *matched* pairs
+            # only — rows of the preserved side whose every match fails the
+            # filter still appear once, null-padded.  Reference semantics:
+            # DataFusion JoinFilter on HashJoinExec (ballista.proto:265-278).
+            pairs = lkeys.join(rkeys, keys=keys, join_type="inner")
+            inner_schema = pa.schema(list(self.left.schema) + list(self.right.schema))
+            joined = _gather_pair(left, right, pairs, inner_schema)
+            mask = pc.fill_null(self.filter.evaluate(_as_batch(joined)), False)
+            pairs = pairs.filter(mask)
+            li = np.asarray(pairs.column("__li"), dtype=np.int64)
+            ri = np.asarray(pairs.column("__ri"), dtype=np.int64)
+            li_parts, ri_parts = [li], [ri]
+            li_mask_parts = [np.zeros(len(li), dtype=bool)]
+            ri_mask_parts = [np.zeros(len(ri), dtype=bool)]
+            if jt in ("left", "full"):
+                lonely = np.setdiff1d(np.arange(left.num_rows, dtype=np.int64), li)
+                li_parts.append(lonely)
+                ri_parts.append(np.zeros(len(lonely), dtype=np.int64))
+                li_mask_parts.append(np.zeros(len(lonely), dtype=bool))
+                ri_mask_parts.append(np.ones(len(lonely), dtype=bool))
+            if jt in ("right", "full"):
+                lonely = np.setdiff1d(np.arange(right.num_rows, dtype=np.int64), ri)
+                li_parts.append(np.zeros(len(lonely), dtype=np.int64))
+                ri_parts.append(lonely)
+                li_mask_parts.append(np.ones(len(lonely), dtype=bool))
+                ri_mask_parts.append(np.zeros(len(lonely), dtype=bool))
+            padded = pa.table(
+                {
+                    "__li": pa.array(
+                        np.concatenate(li_parts), mask=np.concatenate(li_mask_parts)
+                    ),
+                    "__ri": pa.array(
+                        np.concatenate(ri_parts), mask=np.concatenate(ri_mask_parts)
+                    ),
+                }
+            )
+            return _gather_pair(left, right, padded, schema)
 
         pairs = lkeys.join(rkeys, keys=keys, join_type=_ACERO_TYPE[jt])
         out = _gather_pair(left, right, pairs, schema)

@@ -93,8 +93,14 @@ class PhysicalPlanner:
         def rewrite_expr(e: lex.Expr) -> lex.Expr:
             def fn(node: lex.Expr) -> lex.Expr:
                 if isinstance(node, lex.ScalarSubqueryExpr):
+                    # the embedded plan never went through the session's
+                    # optimizer pass (it lives inside an expression), so
+                    # fold/simplify here — date arithmetic etc. must be
+                    # constant-folded before physical lowering
+                    from ..plan.optimizer import optimize as _optimize
+
                     sub_phys = PhysicalPlanner(self.config).create_physical_plan(
-                        node.plan
+                        _optimize(node.plan)
                     )
                     tbl = collect(sub_phys, TaskContext(config=self.config))
                     if tbl.num_rows != 1:

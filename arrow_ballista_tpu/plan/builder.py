@@ -73,9 +73,22 @@ def _conjoin(exprs: list[ex.Expr]) -> Optional[ex.Expr]:
 class PlanBuilder:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
+        self._sq_counter = 0  # fresh-name counter for decorrelated subqueries
+        self._ctes: dict[str, ast.Query] = {}
 
     # ------------------------------------------------------------- queries
     def build_query(self, q: ast.Query) -> lp.LogicalPlan:
+        if q.ctes:
+            saved = dict(self._ctes)
+            try:
+                for name, sub in q.ctes:
+                    self._ctes[name.lower()] = sub
+                return self._build_query_body(q)
+            finally:
+                self._ctes = saved
+        return self._build_query_body(q)
+
+    def _build_query_body(self, q: ast.Query) -> lp.LogicalPlan:
         # FROM
         if q.from_:
             plan = self._plan_table_ref(q.from_[0])
@@ -84,21 +97,63 @@ class PlanBuilder:
         else:
             plan = lp.EmptyRelation(produce_one_row=True)
 
-        # WHERE — peel IN/EXISTS-subquery conjuncts into semi/anti joins
+        # WHERE — peel IN/EXISTS-subquery conjuncts into semi/anti joins.
+        # Plain conjuncts are filtered FIRST so the optimizer's
+        # Filter(CrossJoin) → hash-join rewrite still sees the cross-join
+        # tree; subquery joins are planted on top of the filtered plan.
         if q.where is not None:
             plain: list[ex.Expr] = []
+            sub_conjs: list[ast.SqlExpr] = []
+            scalar_conjs: list[ast.Binary] = []
             for conj in _split_conjuncts(q.where):
-                if isinstance(conj, ast.InSubquery):
-                    plan = self._plan_in_subquery(plan, conj)
-                elif isinstance(conj, ast.Exists):
-                    raise NotImplementedYet(
-                        "correlated EXISTS subqueries (TPC-H q4/q21/q22) not yet supported"
+                # normalize NOT EXISTS(...) / NOT (x IN (sub)) shapes
+                if (
+                    isinstance(conj, ast.Unary)
+                    and conj.op == "NOT"
+                    and isinstance(conj.operand, (ast.InSubquery, ast.Exists))
+                ):
+                    inner_c = conj.operand
+                    conj = (
+                        ast.Exists(inner_c.query, not inner_c.negated)
+                        if isinstance(inner_c, ast.Exists)
+                        else ast.InSubquery(
+                            inner_c.operand, inner_c.query, not inner_c.negated
+                        )
                     )
-                else:
+                if isinstance(conj, (ast.InSubquery, ast.Exists)):
+                    sub_conjs.append(conj)
+                    continue
+                try:
                     plain.append(self._expr(conj, plan.schema))
+                except PlanError:
+                    # a comparison against a *correlated* scalar subquery
+                    # fails normal building (outer refs don't resolve);
+                    # decorrelate it below instead
+                    if isinstance(conj, ast.Binary) and (
+                        isinstance(conj.left, ast.ScalarSubquery)
+                        or isinstance(conj.right, ast.ScalarSubquery)
+                    ):
+                        scalar_conjs.append(conj)
+                    else:
+                        raise
             pred = _conjoin(plain)
             if pred is not None:
                 plan = lp.Filter(pred, plan)
+            for conj in scalar_conjs:
+                outer_fields = list(plan.schema)
+                plan, cmp_expr = self._decorrelate_scalar(plan, conj)
+                plan = lp.Filter(cmp_expr, plan)
+                # project the helper key/value columns back out; alias to the
+                # FULL (possibly qualified) field name so later qualified
+                # references still resolve
+                plan = lp.Projection(
+                    [ex.Alias(ex.col(f.name), f.name) for f in outer_fields], plan
+                )
+            for conj in sub_conjs:
+                if isinstance(conj, ast.InSubquery):
+                    plan = self._plan_in_subquery(plan, conj)
+                else:
+                    plan = self._plan_exists(plan, conj)
 
         in_schema = plan.schema
 
@@ -241,6 +296,12 @@ class PlanBuilder:
 
     # ----------------------------------------------------------- table refs
     def _plan_table_ref(self, ref: ast.TableRef) -> lp.LogicalPlan:
+        # Inline-expansion fallback for CTEs reaching the builder directly
+        # (context._sql_with_ctes materializes top-level CTEs once instead;
+        # this path serves nested WITH and direct build_query callers)
+        if isinstance(ref, ast.NamedTable) and ref.name.lower() in self._ctes:
+            sub = self.build_query(self._ctes[ref.name.lower()])
+            return lp.SubqueryAlias(sub, ref.alias or ref.name)
         if isinstance(ref, ast.NamedTable):
             provider = self.catalog.get(ref.name)
             scan = lp.TableScan(ref.name, provider)
@@ -307,6 +368,160 @@ class PlanBuilder:
         right_key = ex.col(right_field)
         jt = "anti" if conj.negated else "semi"
         return lp.Join(plan, sub, [(left_key, right_key)], jt, None)
+
+    # ------------------------------------------------------- decorrelation
+    def _sub_from(self, sub_q: ast.Query) -> lp.LogicalPlan:
+        if not sub_q.from_:
+            raise SqlError("subquery requires a FROM clause")
+        sub_plan = self._plan_table_ref(sub_q.from_[0])
+        for ref in sub_q.from_[1:]:
+            sub_plan = lp.CrossJoin(sub_plan, self._plan_table_ref(ref))
+        return sub_plan
+
+    def _classify_correlated(
+        self,
+        where: Optional[ast.SqlExpr],
+        inner_schema: pa.Schema,
+        outer_schema: pa.Schema,
+    ) -> tuple[list[ast.SqlExpr], list[tuple[ex.Column, ex.Column]], list[ast.SqlExpr]]:
+        """Split a subquery WHERE into (local conjuncts, correlated equality
+        pairs as (outer_col, inner_col), residual correlated conjuncts).
+
+        SQL scoping rule: a name binds to the innermost (subquery) scope
+        first and only falls back to the outer scope if unresolved — hence
+        the try-inner-first classification.  Counterpart of DataFusion's
+        decorrelation rules the reference relies on upstream.
+        """
+        local: list[ast.SqlExpr] = []
+        pairs: list[tuple[ex.Column, ex.Column]] = []
+        residual: list[ast.SqlExpr] = []
+        if where is None:
+            return local, pairs, residual
+        for c in _split_conjuncts(where):
+            try:
+                self._expr(c, inner_schema)
+                local.append(c)
+                continue
+            except (PlanError, SqlError):
+                pass
+            pair = None
+            if isinstance(c, ast.Binary) and c.op == "=":
+                for a, b in ((c.left, c.right), (c.right, c.left)):
+                    try:
+                        ie = self._expr(a, inner_schema)
+                        oe = self._expr(b, outer_schema)
+                    except (PlanError, SqlError):
+                        continue
+                    if isinstance(ie, ex.Column) and isinstance(oe, ex.Column):
+                        pair = (oe, ie)
+                        break
+            if pair is not None:
+                pairs.append(pair)
+            else:
+                residual.append(c)
+        return local, pairs, residual
+
+    def _plan_exists(self, plan: lp.LogicalPlan, conj: ast.Exists) -> lp.LogicalPlan:
+        """Correlated [NOT] EXISTS → semi/anti hash join (TPC-H q4/q21/q22).
+
+        Correlated equalities become join keys; other correlated conjuncts
+        (e.g. q21's ``l2.l_suppkey <> l1.l_suppkey``) become the join's
+        residual filter, evaluated over the combined outer+inner row.
+        """
+        sub_q = conj.query
+        sub_plan = self._sub_from(sub_q)
+        inner_schema = sub_plan.schema
+        outer_schema = plan.schema
+        local, pairs, residual = self._classify_correlated(
+            sub_q.where, inner_schema, outer_schema
+        )
+        if not pairs:
+            raise NotImplementedYet(
+                "EXISTS subquery without a correlated equality predicate"
+            )
+        local_pred = _conjoin([self._expr(c, inner_schema) for c in local])
+        if local_pred is not None:
+            sub_plan = lp.Filter(local_pred, sub_plan)
+        joint = pa.schema(list(outer_schema) + list(inner_schema))
+        res_pred = _conjoin([self._expr(c, joint) for c in residual])
+        jt = "anti" if conj.negated else "semi"
+        return lp.Join(plan, sub_plan, pairs, jt, res_pred)
+
+    def _decorrelate_scalar(
+        self, plan: lp.LogicalPlan, conj: ast.Binary
+    ) -> tuple[lp.LogicalPlan, ex.Expr]:
+        """Rewrite ``expr CMP (correlated scalar aggregate subquery)`` into a
+        group-by-correlation-keys aggregate joined back to the outer plan
+        (TPC-H q2/q17/q20).  Returns (joined plan, comparison filter expr).
+
+        Empty groups: the spec scalar subquery yields NULL there and the
+        comparison is then not-true — the inner join drops those rows, which
+        is equivalent for a WHERE conjunct.
+        """
+        left_is_sub = isinstance(conj.left, ast.ScalarSubquery)
+        sub_ast = conj.left if left_is_sub else conj.right
+        other_ast = conj.right if left_is_sub else conj.left
+        assert isinstance(sub_ast, ast.ScalarSubquery)
+        sub_q = sub_ast.query
+        if sub_q.group_by or len(sub_q.select) != 1:
+            raise NotImplementedYet(
+                "correlated scalar subquery must be a single ungrouped aggregate"
+            )
+        sub_plan = self._sub_from(sub_q)
+        inner_schema = sub_plan.schema
+        outer_schema = plan.schema
+        local, pairs, residual = self._classify_correlated(
+            sub_q.where, inner_schema, outer_schema
+        )
+        if residual:
+            raise NotImplementedYet(
+                "non-equality correlated predicate in scalar subquery"
+            )
+        if not pairs:
+            raise PlanError("scalar subquery is not correlated; cannot decorrelate")
+        local_pred = _conjoin([self._expr(c, inner_schema) for c in local])
+        if local_pred is not None:
+            sub_plan = lp.Filter(local_pred, sub_plan)
+
+        val = self._expr(sub_q.select[0].expr, inner_schema)
+        aggs = list(ex.find_aggregates(val))
+        if not aggs:
+            raise NotImplementedYet("correlated scalar subquery without aggregate")
+        group_exprs: list[ex.Expr] = [inner for _, inner in pairs]
+        agg_plan = lp.Aggregate(group_exprs, aggs, sub_plan)
+        agg_schema = agg_plan.schema
+        rewrite: dict[str, str] = {}
+        for j, a in enumerate(aggs):
+            rewrite[str(a)] = agg_schema.field(len(group_exprs) + j).name
+
+        def _rw(node: ex.Expr) -> ex.Expr:
+            key = str(node)
+            if key in rewrite and not isinstance(node, ex.Column):
+                return ex.col(rewrite[key])
+            return node
+
+        n = self._sq_counter
+        self._sq_counter += 1
+        proj_exprs: list[ex.Expr] = [
+            ex.Alias(ex.col(agg_schema.field(i).name), f"__sq{n}_k{i}")
+            for i in range(len(pairs))
+        ]
+        proj_exprs.append(ex.Alias(ex.transform(val, _rw), f"__sq{n}_v"))
+        proj = lp.Projection(proj_exprs, agg_plan)
+
+        on = [
+            (outer, ex.col(f"__sq{n}_k{i}"))
+            for i, (outer, _) in enumerate(pairs)
+        ]
+        joined = lp.Join(plan, proj, on, "inner", None)
+        other = self._expr(other_ast, outer_schema)
+        v = ex.col(f"__sq{n}_v")
+        cmp_expr = (
+            ex.BinaryExpr(v, conj.op, other)
+            if left_is_sub
+            else ex.BinaryExpr(other, conj.op, v)
+        )
+        return joined, cmp_expr
 
     # ---------------------------------------------------------- expressions
     def _expr(

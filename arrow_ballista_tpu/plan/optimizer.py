@@ -27,6 +27,7 @@ from . import logical as lp
 
 def optimize(plan: lp.LogicalPlan) -> lp.LogicalPlan:
     plan = simplify_expressions(plan)
+    plan = factor_or_common(plan)
     plan = rewrite_cross_joins(plan)
     plan = push_down_predicates(plan)
     plan = push_down_projection(plan)
@@ -179,6 +180,61 @@ def rewrite_cross_joins(plan: lp.LogicalPlan) -> lp.LogicalPlan:
             joined = lp.CrossJoin(joined, cand)
         pred = _conjoin(residual)
         return lp.Filter(pred, joined) if pred is not None else joined
+
+    return lp.transform_up(plan, fn)
+
+
+def _split_disjuncts(e: ex.Expr) -> list[ex.Expr]:
+    if isinstance(e, ex.BinaryExpr) and e.op == "OR":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def factor_or_common(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """``(A and B) or (A and C)`` → ``A and (B or C)``.
+
+    TPC-H q19's predicate repeats ``p_partkey = l_partkey`` inside every OR
+    branch; factoring it out lets rewrite_cross_joins turn the cartesian
+    product into a hash join (DataFusion does this as part of its filter
+    simplification)."""
+
+    def fix_pred(pred: ex.Expr) -> ex.Expr:
+        branches = _split_disjuncts(pred)
+        if len(branches) < 2:
+            return pred
+        per_branch = [_split_expr_conjuncts(b) for b in branches]
+        common_keys = set(str(c) for c in per_branch[0])
+        for cs in per_branch[1:]:
+            common_keys &= {str(c) for c in cs}
+        if not common_keys:
+            return pred
+        common: list[ex.Expr] = []
+        seen: set[str] = set()
+        for c in per_branch[0]:
+            if str(c) in common_keys and str(c) not in seen:
+                common.append(c)
+                seen.add(str(c))
+        rests: list[ex.Expr] = []
+        for cs in per_branch:
+            rest = [c for c in cs if str(c) not in common_keys]
+            if not rest:
+                # a branch that is exactly the common part: the OR is
+                # implied true once common holds — drop the disjunction
+                return _conjoin(common)  # type: ignore[return-value]
+            rests.append(_conjoin(rest))  # type: ignore[arg-type]
+        ored = rests[0]
+        for r in rests[1:]:
+            ored = ex.BinaryExpr(ored, "OR", r)
+        return _conjoin(common + [ored])  # type: ignore[return-value]
+
+    def fn(p: lp.LogicalPlan) -> lp.LogicalPlan:
+        if isinstance(p, lp.Filter):
+            new_pred = _conjoin(
+                [fix_pred(c) for c in _split_expr_conjuncts(p.predicate)]
+            )
+            if new_pred is not None and str(new_pred) != str(p.predicate):
+                return lp.Filter(new_pred, p.input)
+        return p
 
     return lp.transform_up(plan, fn)
 

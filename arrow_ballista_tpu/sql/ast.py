@@ -196,6 +196,7 @@ class OrderItem:
 
 @dataclass
 class Query:
+    ctes: list[tuple[str, "Query"]] = field(default_factory=list)  # WITH name AS (...)
     select: list[SelectItem] = field(default_factory=list)
     distinct: bool = False
     from_: list[TableRef] = field(default_factory=list)  # comma-separated refs

@@ -79,7 +79,7 @@ class Parser:
         return stmt
 
     def _statement(self) -> ast.Statement:
-        if self.at_kw("SELECT") or self.peek().type is TokType.LPAREN:
+        if self.at_kw("SELECT", "WITH") or self.peek().type is TokType.LPAREN:
             return self.parse_query()
         if self.at_kw("CREATE"):
             return self._create_external_table()
@@ -172,8 +172,22 @@ class Parser:
             q = self.parse_query()
             self.expect(TokType.RPAREN)
             return q
+        ctes: list[tuple[str, ast.Query]] = []
+        if self.eat_kw("WITH"):
+            while True:
+                name = self._identifier()
+                self.expect_kw("AS")
+                self.expect(TokType.LPAREN)
+                sub = self.parse_query()
+                self.expect(TokType.RPAREN)
+                ctes.append((name, sub))
+                if self.peek().type is TokType.COMMA:
+                    self.next()
+                else:
+                    break
         self.expect_kw("SELECT")
         q = ast.Query()
+        q.ctes = ctes
         q.distinct = self.eat_kw("DISTINCT")
         self.eat_kw("ALL")
         q.select = self._select_list()

@@ -38,6 +38,24 @@ NATIONS = [
     ("UNITED STATES", 1),
 ]
 REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+# TPC-H spec P_NAME words (dbgen's colors list, subset) — q9 filters
+# '%green%' and q20 'forest%', so part names must draw from these
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+    "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+    "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+    "hot", "indian", "ivory", "khaki", "lace", "lavender", "lawn", "lemon",
+    "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+    "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange",
+    "orchid", "pale", "papaya", "peach", "peru", "pink", "plum", "powder",
+    "puff", "purple", "red", "rose", "rosy", "royal", "saddle", "salmon",
+    "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow", "spring",
+    "steel", "tan", "thistle", "tomato", "turquoise", "violet", "wheat", "white",
+    "yellow",
+]
+
 PART_TYPES_1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
 PART_TYPES_2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
 PART_TYPES_3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
@@ -111,6 +129,15 @@ def _comments(rng: np.random.Generator, n: int) -> np.ndarray:
     )
 
 
+def _part_names(rng: np.random.Generator, n: int) -> np.ndarray:
+    # dbgen: P_NAME is 5 distinct color words; 2 suffice for the LIKE
+    # predicates ('forest%' prefix, '%green%' containment) to hit
+    w = np.array(P_NAME_WORDS)
+    return np.char.add(
+        np.char.add(rng.choice(w, n), " "), rng.choice(w, n)
+    )
+
+
 def gen_orders(sf: float, seed: int = 43) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = int(1_500_000 * sf)
@@ -173,7 +200,7 @@ def gen_part(sf: float, seed: int = 45) -> pa.Table:
     return pa.table(
         {
             "p_partkey": pa.array(key, pa.int64()),
-            "p_name": pa.array(_comments(rng, n), pa.string()),
+            "p_name": pa.array(_part_names(rng, n), pa.string()),
             "p_mfgr": pa.array(
                 np.char.add("Manufacturer#", rng.integers(1, 6, n).astype(str)),
                 pa.string(),

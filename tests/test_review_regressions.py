@@ -184,3 +184,37 @@ def test_all_to_all_reports_overflow():
     _, recv_valid, n_dropped = fn(v_d, d_d, ok_d)
     delivered = int(np.asarray(recv_valid).sum())
     assert int(n_dropped) == n - delivered > 0
+
+
+def test_cte_shadowing_restores_table():
+    """A CTE that shadows a registered table must not destroy it
+    (code-review finding: _sql_with_ctes deregistered unconditionally)."""
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"a": [1, 2, 3]}))
+    r = ctx.sql("with t as (select a from t where a > 1) select * from t").collect()
+    assert r.num_rows == 2
+    assert ctx.sql("select * from t").collect().num_rows == 3
+
+
+def test_decorrelation_preserves_qualifiers():
+    """Post-decorrelation re-projection must keep table qualifiers so later
+    qualified references resolve (code-review finding)."""
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t1", pa.table({"k": [1, 1, 2], "x": [5.0, 9.0, 7.0]}))
+    ctx.register_arrow_table("t2", pa.table({"k": [1, 2], "y": [5.0, 7.0]}))
+    r = ctx.sql(
+        """
+        select a.k, a.x from t1 a, t1 b
+        where a.x = (select min(y) from t2 where t2.k = a.k) and a.k = b.k
+        order by a.k
+        """
+    ).collect()
+    assert r.to_pydict() == {"k": [1, 1, 2], "x": [5.0, 5.0, 7.0]}
