@@ -193,6 +193,15 @@ class EmptyExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         if self.produce_one_row:
+            if len(self._schema) == 0:
+                # zero columns can't carry num_rows=1 in Arrow; emit a
+                # placeholder column so `SELECT <literals>` (no FROM)
+                # projects exactly one row
+                yield pa.RecordBatch.from_arrays(
+                    [pa.nulls(1, pa.null())],
+                    schema=pa.schema([pa.field("__row", pa.null())]),
+                )
+                return
             arrays = [pa.nulls(1, f.type) for f in self._schema]
             yield pa.RecordBatch.from_arrays(arrays, schema=self._schema)
 

@@ -118,7 +118,9 @@ class ExecutorServer:
         scheduler_port: int,
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
         on_shutdown: Optional[Callable[[str], None]] = None,
+        bind_host: str = "0.0.0.0",
     ):
+        self.bind_host = bind_host
         self.executor = executor
         self.scheduler = SchedulerGrpcStub(
             make_channel(scheduler_host, scheduler_port)
@@ -142,8 +144,10 @@ class ExecutorServer:
         # 1. gRPC server first so the scheduler can push immediately
         self._grpc_server = make_server()
         add_executor_servicer(self._grpc_server, ExecutorGrpcService(self))
+        # bind locally on all interfaces; metadata.host is the ADVERTISE
+        # address (may be a DNS name that is not a local interface)
         bound = self._grpc_server.add_insecure_port(
-            f"{self.executor.metadata.host or '0.0.0.0'}:{self.grpc_port}"
+            f"{self.bind_host}:{self.grpc_port}"
         )
         if self.grpc_port == 0:
             self.grpc_port = bound

@@ -436,5 +436,16 @@ def _push_proj(plan: lp.LogicalPlan, required: Optional[set[str]]) -> lp.Logical
             for c in ex.find_columns(f):
                 unq.add(c.cname)
         cols = [f.name for f in plan.provider.schema if f.name in unq]
+        if not cols and len(plan.provider.schema) > 0:
+            # a column-free scan would lose the row count (batches with no
+            # arrays have num_rows 0) — count(*)-only queries need one
+            # column kept; pick the narrowest
+            def width(f: "object") -> int:
+                try:
+                    return f.type.bit_width
+                except Exception:
+                    return 1 << 16  # strings/nested sort last
+            narrowest = min(plan.provider.schema, key=width)
+            cols = [narrowest.name]
         return lp.TableScan(plan.table_name, plan.provider, cols, plan.filters)
     return plan

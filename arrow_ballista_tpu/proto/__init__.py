@@ -18,27 +18,31 @@ _GEN = os.path.join(_HERE, "gen")
 _PB2 = os.path.join(_GEN, "ballista_pb2.py")
 
 
-def _maybe_regen() -> None:
-    if not os.path.exists(_PROTO):
+def _maybe_regen(proto: str, pb2: str) -> None:
+    if not os.path.exists(proto):
         return
-    if os.path.exists(_PB2) and os.path.getmtime(_PB2) >= os.path.getmtime(_PROTO):
+    if os.path.exists(pb2) and os.path.getmtime(pb2) >= os.path.getmtime(proto):
         return
     try:
         subprocess.run(
-            ["protoc", f"--python_out={_GEN}", f"-I{_HERE}", _PROTO],
+            ["protoc", f"--python_out={_GEN}", f"-I{_HERE}", proto],
             check=True,
             capture_output=True,
         )
     except (OSError, subprocess.CalledProcessError):
-        if not os.path.exists(_PB2):
+        if not os.path.exists(pb2):
             raise
 
 
-_maybe_regen()
+_maybe_regen(_PROTO, _PB2)
+_maybe_regen(
+    os.path.join(_HERE, "keda.proto"), os.path.join(_GEN, "keda_pb2.py")
+)
 
 if _GEN not in sys.path:
     sys.path.insert(0, _GEN)
 
 import ballista_pb2 as pb  # noqa: E402
+import keda_pb2 as keda_pb  # noqa: E402
 
-__all__ = ["pb"]
+__all__ = ["pb", "keda_pb"]

@@ -1,0 +1,183 @@
+"""Scheduler process binary: ``python -m arrow_ballista_tpu.scheduler``.
+
+Counterpart of the reference's ``scheduler/src/main.rs:70-243`` +
+``scheduler_config_spec.toml:23-102``.  Config precedence mirrors
+configure_me: defaults < ``--config-file`` (TOML) < ``BALLISTA_SCHEDULER_*``
+env vars < CLI flags.  One gRPC server carries both the SchedulerGrpc and
+the KEDA ExternalScaler services (the reference muxes them on one hyper
+server); REST serves on its own port (grpcio owns its socket, so
+Accept-header muxing isn't possible — documented divergence), and the
+FlightSQL front-end is opt-in like the reference's ``flight-sql`` feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+import uuid
+
+
+CONFIG_KEYS = {
+    # key: (type, default, help)
+    "bind_host": (str, "0.0.0.0", "local address to bind"),
+    "external_host": (str, "", "address advertised to executors as curator"),
+    "bind_port": (int, 50050, "scheduler gRPC port"),
+    "rest_port": (int, -1, "REST API port (-1 = bind_port+1, 0 = disabled)"),
+    "flight_sql_port": (int, 0, "FlightSQL port (0 = disabled)"),
+    "scheduler_policy": (str, "pull-staged", "pull-staged | push-staged"),
+    "config_backend": (str, "memory", "memory | sqlite | etcd"),
+    "db_path": (str, "", "sqlite db path (config_backend=sqlite)"),
+    "etcd_urls": (str, "localhost:2379", "etcd endpoints (config_backend=etcd)"),
+    "namespace": (str, "ballista", "state key namespace"),
+    "work_dir": (str, "/tmp/ballista-tpu", "scratch dir for plans"),
+    "executor_timeout_seconds": (int, 180, "expire executors after this"),
+    "log_level_setting": (str, "INFO", "log filter"),
+    "log_dir": (str, "", "write logs to a file here instead of stdout"),
+    "log_file_name_prefix": (str, "scheduler", "log file prefix"),
+}
+
+
+def load_config(argv=None) -> dict:
+    cfg = {k: v[1] for k, v in CONFIG_KEYS.items()}
+
+    ap = argparse.ArgumentParser("ballista-tpu scheduler")
+    ap.add_argument("--config-file", default=None, help="TOML config file")
+    for k, (typ, default, hlp) in CONFIG_KEYS.items():
+        ap.add_argument(f"--{k.replace('_', '-')}", type=typ, default=None, help=hlp)
+    args = ap.parse_args(argv)
+
+    if args.config_file:
+        import tomllib
+
+        with open(args.config_file, "rb") as f:
+            for k, v in tomllib.load(f).items():
+                k = k.replace("-", "_")
+                if k in cfg:
+                    cfg[k] = CONFIG_KEYS[k][0](v)
+    for k in CONFIG_KEYS:
+        env = os.environ.get(f"BALLISTA_SCHEDULER_{k.upper()}")
+        if env is not None:
+            cfg[k] = CONFIG_KEYS[k][0](env)
+    for k in CONFIG_KEYS:
+        v = getattr(args, k, None)
+        if v is not None:
+            cfg[k] = v
+    return cfg
+
+
+def init_logging(cfg: dict, prefix_key: str = "log_file_name_prefix") -> None:
+    """Mirror of both binaries' tracing init (scheduler main.rs:173-194)."""
+    level = getattr(logging, cfg["log_level_setting"].upper(), logging.INFO)
+    handlers = None
+    if cfg["log_dir"]:
+        os.makedirs(cfg["log_dir"], exist_ok=True)
+        stamp = time.strftime("%Y-%m-%d")
+        path = os.path.join(cfg["log_dir"], f"{cfg[prefix_key]}.{stamp}.log")
+        handlers = [logging.FileHandler(path)]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(threadName)s %(name)s: %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+
+
+def make_backend(cfg: dict):
+    from .backend import EtcdBackend, MemoryBackend, SqliteBackend
+
+    kind = cfg["config_backend"].lower()
+    if kind == "memory":
+        return MemoryBackend()
+    if kind == "sqlite":
+        path = cfg["db_path"] or os.path.join(cfg["work_dir"], "scheduler.db")
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        return SqliteBackend(path)
+    if kind == "etcd":
+        return EtcdBackend(cfg["etcd_urls"], cfg["namespace"])
+    raise SystemExit(f"unknown config backend {kind!r}")
+
+
+def main(argv=None) -> None:
+    cfg = load_config(argv)
+    init_logging(cfg)
+    log = logging.getLogger("ballista.scheduler")
+
+    from ..config import TaskSchedulingPolicy
+    from ..proto.rpc import add_scheduler_servicer, make_server
+    from .api import ApiServerHandle
+    from .external_scaler import ExternalScalerService, add_external_scaler_servicer
+    from .grpc_service import SchedulerGrpcService
+    from .server import SchedulerServer
+
+    policy = (
+        TaskSchedulingPolicy.PUSH_STAGED
+        if cfg["scheduler_policy"] == "push-staged"
+        else TaskSchedulingPolicy.PULL_STAGED
+    )
+    backend = make_backend(cfg)
+    scheduler_id = f"{cfg['bind_host']}:{cfg['bind_port']}:{uuid.uuid4().hex[:6]}"
+    server = SchedulerServer(
+        scheduler_id,
+        backend,
+        policy,
+        work_dir=cfg["work_dir"],
+        executor_timeout_s=cfg["executor_timeout_seconds"],
+    ).init()
+    # the curator address executors dial back: must be reachable, never
+    # the 0.0.0.0 wildcard
+    external = cfg["external_host"] or cfg["bind_host"]
+    if external == "0.0.0.0":
+        external = "127.0.0.1"
+    server.scheduler_id = f"{external}:{cfg['bind_port']}"
+    server.state.task_manager.scheduler_id = server.scheduler_id
+
+    grpc_server = make_server()
+    add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))
+    add_external_scaler_servicer(grpc_server, ExternalScalerService(server))
+    bound = grpc_server.add_insecure_port(f"{cfg['bind_host']}:{cfg['bind_port']}")
+    if bound == 0:
+        raise SystemExit(f"cannot bind {cfg['bind_host']}:{cfg['bind_port']}")
+    grpc_server.start()
+    log.info("scheduler gRPC (+KEDA scaler) on %s:%d, policy=%s, backend=%s",
+             cfg["bind_host"], bound, policy.value, cfg["config_backend"])
+
+    rest_port = cfg["rest_port"] if cfg["rest_port"] >= 0 else bound + 1
+    api = None
+    if rest_port:
+        api = ApiServerHandle(server, cfg["bind_host"], rest_port).start()
+        log.info("REST API on %s:%d (/api/state)", cfg["bind_host"], api.port)
+
+    fsql = None
+    if cfg["flight_sql_port"]:
+        from .flight_sql import FlightSqlHandle
+
+        fsql = FlightSqlHandle(server, cfg["bind_host"], cfg["flight_sql_port"]).start()
+        log.info("FlightSQL on %s:%d", cfg["bind_host"], fsql.port)
+
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        log.info("shutting down")
+        if fsql:
+            fsql.stop()
+        if api:
+            api.stop()
+        grpc_server.stop(grace=2)
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Scheduler REST API.
+
+Counterpart of the reference's warp routes (``scheduler/src/api/handlers.rs:34-58``
+and ``scheduler/src/api/mod.rs``): ``GET /api/state`` returns the registered
+executors, scheduler uptime and version as JSON.  The reference multiplexes
+REST and gRPC on one port via Accept-header dispatch
+(``scheduler/src/main.rs:103-150``); grpcio owns its listening socket
+outright, so here REST serves on its own port (``scheduler_port + 1`` by
+convention in the binary).
+
+Extra endpoints beyond the reference: ``/api/jobs`` (job table) and
+``/api/metrics`` (slot accounting) — the scheduler UI needs both.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+BALLISTA_VERSION = "0.7.0-tpu"
+
+
+class SchedulerApiHandler(BaseHTTPRequestHandler):
+    server_version = "ballista-tpu-scheduler"
+    scheduler = None  # class attr injected by make_api_server
+    started_at = 0.0
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json(self, payload: dict, code: int = 200) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv = type(self).scheduler
+        if srv is None:
+            self._json({"error": "scheduler not attached"}, 500)
+            return
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/api/state":
+            em = srv.state.executor_manager
+            alive = em.get_alive_executors()
+            executors = []
+            for meta in em.executors():
+                executors.append(
+                    {
+                        "id": meta.id,
+                        "host": meta.host,
+                        "port": meta.flight_port,
+                        "grpc_port": meta.grpc_port,
+                        "last_seen": em.last_seen(meta.id),
+                        "alive": meta.id in alive,
+                    }
+                )
+            self._json(
+                {
+                    "executors": executors,
+                    "started": type(self).started_at,
+                    "uptime_seconds": int(time.time() - type(self).started_at),
+                    "version": BALLISTA_VERSION,
+                }
+            )
+            return
+        if path == "/api/jobs":
+            tm = srv.state.task_manager
+            self._json({"jobs": tm.list_jobs()})
+            return
+        if path == "/api/metrics":
+            em = srv.state.executor_manager
+            self._json(
+                {
+                    "available_slots": em.available_slots(),
+                    "alive_executors": len(em.get_alive_executors()),
+                    "active_jobs": len(srv.state.task_manager.active_job_ids()),
+                }
+            )
+            return
+        self._json({"error": f"no such route {path}"}, 404)
+
+
+def make_api_server(
+    scheduler, host: str = "0.0.0.0", port: int = 0
+) -> ThreadingHTTPServer:
+    """Build (but don't start) the REST server bound to ``host:port``."""
+    handler = type(
+        "BoundApiHandler",
+        (SchedulerApiHandler,),
+        {"scheduler": scheduler, "started_at": time.time()},
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+class ApiServerHandle:
+    """Background-thread REST server with clean shutdown."""
+
+    def __init__(self, scheduler, host: str = "0.0.0.0", port: int = 0):
+        self._httpd = make_api_server(scheduler, host, port)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ApiServerHandle":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="scheduler-rest", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,170 @@
+"""FlightSQL-style front-end on the scheduler.
+
+Counterpart of the reference's ``scheduler/src/flight_sql.rs:57-255``:
+``get_flight_info`` plans the SQL statement, enqueues a job, polls until the
+job completes (``check_job``, `:99-139`), then returns a ``FlightInfo``
+whose endpoints are FetchPartition tickets pointing *directly at the
+executors* that hold the result partitions (`:141-190`) — the client
+streams results over Flight without touching the scheduler again.  A
+prepared-statement cache maps handle → SQL (`:66`, uuid-keyed there).
+
+Protocol note: the reference speaks the full Arrow FlightSQL message
+envelope (CommandStatementQuery wrapped in protobuf Any).  pyarrow exposes
+generic Flight but not the FlightSQL message library, so this service
+accepts the SQL statement directly as the flight descriptor command bytes
+(UTF-8).  ADBC/JDBC drivers won't connect, but any pyarrow Flight client
+can run SQL with two calls:
+
+    info = client.get_flight_info(FlightDescriptor.for_command(b"select 1"))
+    for ep in info.endpoints:
+        table = flight.connect(ep.locations[0]).do_get(ep.ticket).read_all()
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from ..proto import pb
+from ..serde.scheduler_types import PartitionLocation
+
+log = logging.getLogger(__name__)
+
+JOB_POLL_INTERVAL_S = 0.1
+JOB_TIMEOUT_S = 300.0
+
+
+class FlightSqlService(flight.FlightServerBase):
+    def __init__(self, scheduler, host: str = "0.0.0.0", port: int = 0):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self.scheduler = scheduler
+        # ONE server-side session for all FlightSQL statements (the
+        # reference's service owns a single SessionContext), so CREATE
+        # EXTERNAL TABLE persists for subsequent queries
+        self.session_ctx = scheduler.state.session_manager.create_session({})
+        # handle → SQL text (reference: statements cache flight_sql.rs:66)
+        self._prepared: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- statements
+    def _submit_sql(self, sql: str) -> str:
+        """Plan + enqueue; returns job id (reference: flight_sql.rs:239-255).
+
+        DDL (CREATE EXTERNAL TABLE / SET / SHOW) executes eagerly in the
+        session; its result relation is then submitted like any query so
+        the client still gets a normal FlightInfo back."""
+        plan = self.session_ctx.sql(sql).logical_plan()
+        job_id = self.scheduler.state.task_manager.generate_job_id()
+        self.scheduler.submit_job(job_id, self.session_ctx.session_id, plan)
+        return job_id
+
+    def _check_job(self, job_id: str) -> list[PartitionLocation]:
+        """Poll until terminal (reference: check_job flight_sql.rs:99-139)."""
+        deadline = time.time() + JOB_TIMEOUT_S
+        tm = self.scheduler.state.task_manager
+        while True:
+            status = tm.get_job_status(job_id)
+            if status is not None:
+                if status["state"] == "completed":
+                    return list(status.get("locations", []))
+                if status["state"] == "failed":
+                    raise flight.FlightServerError(
+                        f"job {job_id} failed: {status.get('error', 'unknown')}"
+                    )
+            if time.time() > deadline:
+                raise flight.FlightServerError(f"job {job_id} timed out")
+            time.sleep(JOB_POLL_INTERVAL_S)
+
+    # ------------------------------------------------------------- flight
+    def get_flight_info(self, context, descriptor: flight.FlightDescriptor):
+        if descriptor.command:
+            sql = descriptor.command.decode("utf-8", "replace")
+            with self._lock:
+                # a prepared-statement handle round-trips as the command too
+                sql = self._prepared.get(sql, sql)
+        else:
+            raise flight.FlightServerError("descriptor must carry a SQL command")
+        job_id = self._submit_sql(sql)
+        locations = self._check_job(job_id)
+
+        endpoints = []
+        schema: Optional[pa.Schema] = None
+        total_rows = 0
+        total_bytes = 0
+        for loc in locations:
+            ticket = flight.Ticket(
+                pb.FetchPartitionTicket(
+                    job_id=loc.partition_id.job_id,
+                    stage_id=loc.partition_id.stage_id,
+                    partition_id=loc.partition_id.partition_id,
+                    path=loc.path,
+                ).SerializeToString()
+            )
+            ep_loc = flight.Location.for_grpc_tcp(
+                loc.executor_meta.host, loc.executor_meta.flight_port
+            )
+            endpoints.append(flight.FlightEndpoint(ticket, [ep_loc]))
+            total_rows += loc.partition_stats.num_rows
+            total_bytes += loc.partition_stats.num_bytes
+            if schema is None and loc.path:
+                try:
+                    with pa.OSFile(loc.path, "rb") as f:
+                        schema = pa.ipc.open_file(f).schema
+                except Exception:
+                    pass
+        if schema is None:
+            schema = pa.schema([])
+        return flight.FlightInfo(
+            schema, descriptor, endpoints, total_rows, total_bytes
+        )
+
+    def do_action(self, context, action: flight.Action):
+        """Prepared-statement lifecycle (reference: flight_sql.rs prepared
+        handling): CreatePreparedStatement / ClosePreparedStatement."""
+        if action.type == "CreatePreparedStatement":
+            sql = action.body.to_pybytes().decode("utf-8", "replace")
+            handle = uuid.uuid4().hex
+            with self._lock:
+                self._prepared[handle] = sql
+            yield flight.Result(handle.encode())
+        elif action.type == "ClosePreparedStatement":
+            handle = action.body.to_pybytes().decode("utf-8", "replace")
+            with self._lock:
+                self._prepared.pop(handle, None)
+            yield flight.Result(b"ok")
+        else:
+            raise flight.FlightServerError(f"unknown action {action.type!r}")
+
+    def list_actions(self, context):
+        return [
+            ("CreatePreparedStatement", "register a SQL text, returns a handle"),
+            ("ClosePreparedStatement", "drop a prepared handle"),
+        ]
+
+
+class FlightSqlHandle:
+    """Background FlightSQL server with clean shutdown."""
+
+    def __init__(self, scheduler, host: str = "0.0.0.0", port: int = 0):
+        self._service = FlightSqlService(scheduler, host, port)
+        self.port = self._service.port
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FlightSqlHandle":
+        self._thread = threading.Thread(
+            target=self._service.serve, name="scheduler-flightsql", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._service.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -50,6 +50,10 @@ def new_standalone_scheduler(
     ).init()
     grpc_server = make_server()
     add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))
+    # the KEDA scaler rides the same gRPC server, like the reference's mux
+    from .external_scaler import ExternalScalerService, add_external_scaler_servicer
+
+    add_external_scaler_servicer(grpc_server, ExternalScalerService(server))
     port = grpc_server.add_insecure_port("127.0.0.1:0")
     grpc_server.start()
     # the scheduler id doubles as the curator address executors report to

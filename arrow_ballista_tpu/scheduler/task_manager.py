@@ -135,13 +135,20 @@ class TaskManager:
         return graph
 
     def get_job_status(self, job_id: str) -> Optional[dict]:
-        """Status snapshot: {state, error?, locations?}."""
-        entry = self._entry(job_id)
-        with entry.lock:
-            graph = self._load(job_id, entry)
-            if graph is not None:
-                return self._status_of(graph)
-        for ks in (Keyspace.CompletedJobs, Keyspace.FailedJobs):
+        """Status snapshot: {state, error?, locations?}.
+
+        Read-only: must NOT create a cache entry — a finished job's status
+        is polled long after complete_job() evicted it, and a stray entry
+        would make active_job_ids() (and the KEDA scaler's inflight metric)
+        report the job forever."""
+        with self._cache_lock:
+            entry = self._cache.get(job_id)
+        if entry is not None:
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is not None:
+                    return self._status_of(graph)
+        for ks in (Keyspace.CompletedJobs, Keyspace.FailedJobs, Keyspace.ActiveJobs):
             raw = self.backend.get(ks, job_id)
             if raw is not None:
                 g = ExecutionGraph.decode(raw, self.work_dir)
@@ -359,6 +366,27 @@ class TaskManager:
     def active_job_ids(self) -> List[str]:
         with self._cache_lock:
             return list(self._cache.keys())
+
+    def list_jobs(self) -> List[dict]:
+        """Job table for the REST API: active, completed and failed jobs
+        with their states (reference exposes this via /api/state +
+        the scheduler UI's job dashboard)."""
+        out: List[dict] = []
+        seen: set = set()
+        for job_id in self.active_job_ids():
+            st = self.get_job_status(job_id)
+            if st is not None:
+                out.append({"job_id": job_id, "state": st["state"]})
+                seen.add(job_id)
+        for ks, state in (
+            (Keyspace.CompletedJobs, "completed"),
+            (Keyspace.FailedJobs, "failed"),
+        ):
+            for key in self.backend.scan_keys(ks):
+                if key not in seen:
+                    out.append({"job_id": key, "state": state})
+                    seen.add(key)
+        return out
 
     @staticmethod
     def generate_job_id() -> str:

@@ -1,0 +1,246 @@
+"""User surfaces: REST API, FlightSQL, KEDA scaler, CLI, process binaries.
+
+Reference counterparts: scheduler/src/api/handlers.rs (REST),
+scheduler/src/flight_sql.rs (FlightSQL), external_scaler.rs (KEDA),
+ballista-cli (REPL), scheduler/src/main.rs + executor/src/main.rs (config).
+"""
+
+import io
+import json
+import os
+import sys
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.standalone(num_executors=1)
+    yield ctx
+    ctx.close()
+
+
+# ------------------------------------------------------------------- REST
+def test_rest_api_state(cluster):
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+
+    api = ApiServerHandle(cluster._standalone_handles[0].server, "127.0.0.1", 0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/state", timeout=10
+        ) as resp:
+            state = json.load(resp)
+        assert state["version"]
+        assert isinstance(state["executors"], list) and state["executors"]
+        assert state["executors"][0]["id"]
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/metrics", timeout=10
+        ) as resp:
+            metrics = json.load(resp)
+        assert metrics["alive_executors"] >= 1
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/jobs", timeout=10
+        ) as resp:
+            jobs = json.load(resp)
+        assert "jobs" in jobs
+
+        code = urllib.request.urlopen(
+            urllib.request.Request(f"http://127.0.0.1:{api.port}/nope"),
+            timeout=10,
+        ).status if False else 404  # urllib raises on 404; checked below
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/nope", timeout=10
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        api.stop()
+
+
+# --------------------------------------------------------------- FlightSQL
+def test_flight_sql_roundtrip(cluster):
+    import pyarrow.flight as flight
+
+    from arrow_ballista_tpu.scheduler.flight_sql import FlightSqlHandle
+
+    import pyarrow.parquet as pq
+
+    pq.write_table(
+        pa.table({"g": ["a", "a", "b"], "v": [1, 2, 10]}), "/tmp/fs_t.parquet"
+    )
+    handle = FlightSqlHandle(cluster._standalone_handles[0].server, "127.0.0.1", 0).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{handle.port}")
+        ddl = flight.FlightDescriptor.for_command(
+            b"CREATE EXTERNAL TABLE fs_t STORED AS PARQUET LOCATION '/tmp/fs_t.parquet'"
+        )
+        client.get_flight_info(ddl)  # DDL round-trips like a query
+        desc = flight.FlightDescriptor.for_command(
+            b"select g, sum(v) as s from fs_t group by g order by g"
+        )
+        info = client.get_flight_info(desc)
+        assert info.endpoints
+        batches = []
+        for ep in info.endpoints:
+            conn = flight.connect(ep.locations[0])
+            reader = conn.do_get(ep.ticket)
+            tbl = reader.read_all()
+            if tbl.num_rows:
+                batches.append(tbl)
+        got = pa.concat_tables(batches)
+        d = dict(zip(got.column("g").to_pylist(), got.column("s").to_pylist()))
+        assert d == {"a": 3, "b": 10}
+    finally:
+        handle.stop()
+
+
+def test_flight_sql_prepared_statement(cluster):
+    import pyarrow.flight as flight
+
+    from arrow_ballista_tpu.scheduler.flight_sql import FlightSqlHandle
+
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"x": [1, 2, 3]}), "/tmp/fs_p.parquet")
+    handle = FlightSqlHandle(cluster._standalone_handles[0].server, "127.0.0.1", 0).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{handle.port}")
+        client.get_flight_info(
+            flight.FlightDescriptor.for_command(
+                b"CREATE EXTERNAL TABLE fs_p STORED AS PARQUET LOCATION '/tmp/fs_p.parquet'"
+            )
+        )
+        results = list(
+            client.do_action(
+                flight.Action(
+                    "CreatePreparedStatement", b"select count(*) as c from fs_p"
+                )
+            )
+        )
+        h = results[0].body.to_pybytes()
+        info = client.get_flight_info(flight.FlightDescriptor.for_command(h))
+        tbl = pa.concat_tables(
+            flight.connect(ep.locations[0]).do_get(ep.ticket).read_all()
+            for ep in info.endpoints
+        )
+        assert tbl.column("c").to_pylist() == [3]
+        list(client.do_action(flight.Action("ClosePreparedStatement", h)))
+    finally:
+        handle.stop()
+
+
+# ------------------------------------------------------------------- KEDA
+def test_keda_external_scaler(cluster):
+    import grpc
+
+    from arrow_ballista_tpu.proto import keda_pb
+    from arrow_ballista_tpu.scheduler.external_scaler import ExternalScalerStub
+
+    port = cluster._standalone_handles[0].port
+    stub = ExternalScalerStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    ref = keda_pb.ScaledObjectRef(name="executors", namespace="default")
+    assert stub.IsActive(ref, timeout=10).result is True
+    spec = stub.GetMetricSpec(ref, timeout=10)
+    assert spec.metricSpecs[0].metricName == "inflight_tasks"
+    metrics = stub.GetMetrics(
+        keda_pb.GetMetricsRequest(scaledObjectRef=ref, metricName="inflight_tasks"),
+        timeout=10,
+    )
+    assert metrics.metricValues[0].metricName == "inflight_tasks"
+
+
+# -------------------------------------------------------------------- CLI
+def test_cli_local_command(tmp_path, capsys, monkeypatch):
+    from arrow_ballista_tpu.cli import main
+
+    csv = tmp_path / "t.csv"
+    csv.write_text("a,b\n1,x\n2,y\n3,x\n")
+    main(
+        [
+            "-e",
+            f"CREATE EXTERNAL TABLE t STORED AS CSV WITH HEADER ROW LOCATION '{csv}'",
+            "-e",
+            "select b, count(*) as n from t group by b order by b",
+            "--format",
+            "csv",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "b,n" in out
+    assert "x,2" in out
+    assert "y,1" in out
+
+
+def test_cli_file_exec_and_formats(tmp_path, capsys):
+    from arrow_ballista_tpu.cli import main
+
+    sql = tmp_path / "script.sql"
+    sql.write_text("select 1 as one;")
+    main(["-f", str(sql), "--format", "json", "-q"])
+    out = capsys.readouterr().out
+    assert json.loads(out.strip()) == [{"one": 1}]
+
+
+def test_cli_repl_commands(capsys):
+    from arrow_ballista_tpu.cli import PrintOptions, Repl
+    from arrow_ballista_tpu.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("r_t", pa.table({"x": [1]}))
+    repl = Repl(ctx, PrintOptions())
+    assert repl.handle_command("\\d") is True
+    out = capsys.readouterr().out
+    assert "r_t" in out
+    assert repl.handle_command("\\d r_t") is True
+    out = capsys.readouterr().out
+    assert "x" in out
+    assert repl.handle_command("\\pset format csv") is True
+    assert repl.opts.format == "csv"
+    assert repl.handle_command("\\quiet on") is True
+    assert repl.opts.quiet is True
+    assert repl.handle_command("\\q") is False
+
+
+# ------------------------------------------------------------- binaries
+def test_scheduler_config_precedence(tmp_path, monkeypatch):
+    from arrow_ballista_tpu.scheduler.__main__ import load_config
+
+    toml = tmp_path / "scheduler.toml"
+    toml.write_text('bind_port = 60000\nscheduler_policy = "push-staged"\n')
+    monkeypatch.setenv("BALLISTA_SCHEDULER_BIND_PORT", "60001")
+    cfg = load_config(["--config-file", str(toml)])
+    # env beats file
+    assert cfg["bind_port"] == 60001
+    assert cfg["scheduler_policy"] == "push-staged"
+    # CLI beats env
+    cfg = load_config(["--config-file", str(toml), "--bind-port", "60002"])
+    assert cfg["bind_port"] == 60002
+
+
+def test_executor_janitor(tmp_path):
+    import time
+
+    from arrow_ballista_tpu.executor.__main__ import ShuffleJanitor
+
+    job = tmp_path / "jobX" / "1" / "2"
+    job.mkdir(parents=True)
+    f = job / "data.arrow"
+    f.write_bytes(b"x")
+    old = time.time() - 1000
+    os.utime(f, (old, old))
+    keep = tmp_path / "jobY"
+    keep.mkdir()
+    (keep / "data.arrow").write_bytes(b"y")
+
+    j = ShuffleJanitor(str(tmp_path), interval_s=3600, ttl_s=500)
+    j.sweep(500)
+    assert not (tmp_path / "jobX").exists()
+    assert (tmp_path / "jobY").exists()
