@@ -123,10 +123,19 @@ class DataFrame:
 
 class SessionContext:
     def __init__(self, config: Optional[BallistaConfig] = None):
+        from .udf import UdfRegistry, global_registry, load_udf_plugins
+
         self.config = config or BallistaConfig()
         self.catalog = Catalog()
         self.session_id = _gen_id()
         self.variables: dict[str, str] = {}
+        # session UDFs shadow the process-global registry (plugins)
+        self.udfs = UdfRegistry(parent=global_registry())
+        from .config import PLUGIN_DIR
+
+        plugin_dir = self.config.settings.get(PLUGIN_DIR, "")
+        if plugin_dir:
+            load_udf_plugins(plugin_dir)
 
     # -- registration ----------------------------------------------------
     def register_table(self, name: str, provider: TableProvider) -> None:
@@ -156,6 +165,22 @@ class SessionContext:
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
 
+    # -- user-defined functions ------------------------------------------
+    def register_udf(self, udf) -> None:
+        """Register a ScalarUDF for this session AND process-wide, so
+        in-proc executors (standalone mode) can resolve it at evaluation
+        time — the distributed analogue is the executor's plugin dir."""
+        from .udf import global_registry
+
+        self.udfs.register_scalar(udf)
+        global_registry().register_scalar(udf)
+
+    def register_udaf(self, udaf) -> None:
+        from .udf import global_registry
+
+        self.udfs.register_aggregate(udaf)
+        global_registry().register_aggregate(udaf)
+
     def read_parquet(self, path: str) -> DataFrame:
         name = f"__anon_parquet_{_gen_id()[:6]}"
         self.register_parquet(name, path)
@@ -176,7 +201,7 @@ class SessionContext:
         if isinstance(stmt, ast.Query):
             if stmt.ctes:
                 return self._sql_with_ctes(stmt)
-            builder = PlanBuilder(self.catalog)
+            builder = PlanBuilder(self.catalog, self.udfs)
             return DataFrame(self, builder.build_query(stmt))
         if isinstance(stmt, ast.CreateExternalTable):
             return self._create_external_table(stmt)
@@ -190,7 +215,7 @@ class SessionContext:
                 self.config = BallistaConfig.from_dict(settings)
             return self._values_df(pa.table({"result": pa.array(["ok"])}))
         if isinstance(stmt, ast.Explain):
-            builder = PlanBuilder(self.catalog)
+            builder = PlanBuilder(self.catalog, self.udfs)
             df = DataFrame(self, builder.build_query(stmt.query))
             text = df.explain()
             return self._values_df(
@@ -228,7 +253,7 @@ class SessionContext:
                 )
                 registered.append((name, shadowed))
             main = dataclasses.replace(stmt, ctes=[])
-            builder = PlanBuilder(self.catalog)
+            builder = PlanBuilder(self.catalog, self.udfs)
             return DataFrame(self, builder.build_query(main))
         finally:
             for name, shadowed in registered:
@@ -239,7 +264,7 @@ class SessionContext:
     def sql_query_ast(self, q: ast.Query) -> DataFrame:
         if q.ctes:
             return self._sql_with_ctes(q)
-        return DataFrame(self, PlanBuilder(self.catalog).build_query(q))
+        return DataFrame(self, PlanBuilder(self.catalog, self.udfs).build_query(q))
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> DataFrame:
         if stmt.name.lower() in self.catalog.tables and stmt.if_not_exists:

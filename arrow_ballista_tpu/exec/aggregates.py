@@ -175,6 +175,14 @@ class HashAggregateExec(ExecutionPlan):
                     )
                 agg_requests.append((src, "count_distinct"))
                 out_names.append(a.name)
+            elif a.func.startswith("udaf:"):
+                if partial:
+                    raise ExecutionError(
+                        "UDAFs must run single-stage after key repartition"
+                    )
+                # collect each group's values; the UDF folds them below
+                agg_requests.append((src, "list"))
+                out_names.append(a.name)
             else:
                 raise ExecutionError(f"unsupported aggregate {a.func}")
 
@@ -184,9 +192,14 @@ class HashAggregateExec(ExecutionPlan):
         fields = list(self._schema)
         for i in range(len(self.group_exprs)):
             out_cols.append(result.column(f"__g{i}"))
+        udaf_iter = iter(
+            [a for a in self.aggs if a.func.startswith("udaf:")]
+        )
         for req, f in zip(agg_requests, fields[len(self.group_exprs):]):
             src, func = req[0], req[1]
             col = result.column(f"{src}_{func}")
+            if func == "list":
+                col = _apply_udaf(next(udaf_iter), col, f.type)
             if not col.type.equals(f.type):
                 col = pc.cast(col, f.type, safe=False)
             out_cols.append(col)
@@ -216,6 +229,10 @@ class HashAggregateExec(ExecutionPlan):
                 cols.append(
                     pa.array([pc.count_distinct(src).as_py()], pa.int64())
                 )
+            elif a.func.startswith("udaf:"):
+                t = self._field_for(a.name).type
+                v = _resolve_udaf(a.func).fn(src.combine_chunks())
+                cols.append(pa.array([v], type=t))
             else:
                 raise ExecutionError(f"unsupported aggregate {a.func}")
         return pa.Table.from_arrays(cols, schema=self._schema)
@@ -315,6 +332,29 @@ class HashAggregateExec(ExecutionPlan):
                 col = pc.cast(col, f.type, safe=False)
             out_cols.append(col)
         return pa.Table.from_arrays(out_cols, schema=self._schema)
+
+
+def _resolve_udaf(func: str):
+    from ..udf import global_registry
+
+    name = func[5:]  # strip "udaf:"
+    u = global_registry().aggregate(name)
+    if u is None:
+        raise ExecutionError(
+            f"aggregate UDF {name!r} is not registered on this executor; "
+            f"load it via ballista.plugin_dir"
+        )
+    return u
+
+
+def _apply_udaf(spec: AggSpec, lists_col, out_type: pa.DataType) -> pa.ChunkedArray:
+    """Fold each group's collected value-list through the UDAF callable."""
+    u = _resolve_udaf(spec.func)
+    values = [
+        u.fn(lst.values if lst.is_valid else pa.array([], type=u.input_type))
+        for lst in lists_col.combine_chunks()
+    ]
+    return pa.chunked_array([pa.array(values, type=out_type)])
 
 
 def _as_array(v, n: int) -> pa.Array:

@@ -267,6 +267,50 @@ class Cast(PhysicalExpr):
 
 
 @dataclass(frozen=True)
+class ScalarUdf(PhysicalExpr):
+    """User scalar function resolved BY NAME from the process-global UDF
+    registry at evaluation time — executors never receive code, only the
+    name (reference: plugin-loaded ScalarUDF referenced from TaskContext).
+    """
+
+    fname: str
+    args: tuple[PhysicalExpr, ...]
+    out_type: pa.DataType = field(default_factory=pa.float64)
+
+    def evaluate(self, batch: pa.RecordBatch) -> pa.Array:
+        from ..udf import global_registry
+
+        u = global_registry().scalar(self.fname)
+        if u is None:
+            raise ExecutionError(
+                f"scalar UDF {self.fname!r} is not registered on this "
+                f"executor; load it via ballista.plugin_dir"
+            )
+        args = [_as_array_len(x.evaluate(batch), batch.num_rows) for x in self.args]
+        out = u.fn(*args)
+        if not isinstance(out, (pa.Array, pa.ChunkedArray)):
+            out = pa.array(out, type=self.out_type)
+        if isinstance(out, pa.ChunkedArray):
+            out = out.combine_chunks()
+        if not out.type.equals(self.out_type):
+            out = pc.cast(out, self.out_type, safe=False)
+        return out
+
+    def __str__(self) -> str:
+        return f"{self.fname}({', '.join(str(a) for a in self.args)})"
+
+
+def _as_array_len(v, n: int) -> pa.Array:
+    if isinstance(v, pa.ChunkedArray):
+        return v.combine_chunks()
+    if isinstance(v, pa.Array):
+        return v
+    if isinstance(v, pa.Scalar):
+        return pa.array([v.as_py()] * n, type=v.type)
+    return pa.array([v] * n)
+
+
+@dataclass(frozen=True)
 class ScalarFn(PhysicalExpr):
     fname: str
     args: tuple[PhysicalExpr, ...]
@@ -443,6 +487,12 @@ def create_physical_expr(e: lex.Expr, schema: pa.Schema) -> PhysicalExpr:
             e.fname,
             tuple(create_physical_expr(a, schema) for a in e.args),
             e.data_type(schema),
+        )
+    if isinstance(e, lex.ScalarUDFExpr):
+        return ScalarUdf(
+            e.fname,
+            tuple(create_physical_expr(a, schema) for a in e.args),
+            e.return_type,
         )
     if isinstance(e, lex.AggregateExpr):
         raise PlanError(f"aggregate {e} cannot be lowered as a scalar physical expr")

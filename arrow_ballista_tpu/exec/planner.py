@@ -211,7 +211,14 @@ class PhysicalPlanner:
         for j, a in enumerate(plan.agg_exprs):
             inner = a.expr if isinstance(a, lex.Alias) else a
             assert isinstance(inner, lex.AggregateExpr), f"not an aggregate: {a}"
-            if inner.func == "count_distinct" or inner.distinct:
+            if (
+                inner.func == "count_distinct"
+                or inner.distinct
+                or inner.func.startswith("udaf:")
+            ):
+                # UDAFs have no partial/merge decomposition — run single
+                # stage with each group wholly in one partition, the same
+                # strategy as distinct aggregates
                 has_distinct = True
             arg = (
                 create_physical_expr(inner.arg, in_schema)

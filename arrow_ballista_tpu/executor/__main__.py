@@ -30,6 +30,7 @@ CONFIG_KEYS = {
     "work_dir": (str, "", "shuffle data dir (default: tmp)"),
     "concurrent_tasks": (int, 4, "task slots"),
     "task_scheduling_policy": (str, "pull-staged", "pull-staged | push-staged"),
+    "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
     "job_data_ttl_seconds": (int, 604800, "delete job dirs older than this"),
     "log_level_setting": (str, "INFO", "log filter"),
@@ -136,6 +137,15 @@ def main(argv=None) -> None:
 
     work_dir = cfg["work_dir"] or tempfile.mkdtemp(prefix="ballista-executor-")
     os.makedirs(work_dir, exist_ok=True)
+
+    # populate the process-global UDF registry BEFORE any task arrives —
+    # plans reference UDFs by name only (reference: executors load .so
+    # plugins from plugin_dir at startup)
+    if cfg["plugin_dir"]:
+        from ..udf import load_udf_plugins
+
+        n = load_udf_plugins(cfg["plugin_dir"])
+        log.info("loaded %d UDF plugin(s) from %s", n, cfg["plugin_dir"])
     external = cfg["external_host"] or cfg["bind_host"]
     if external == "0.0.0.0":
         external = "127.0.0.1"

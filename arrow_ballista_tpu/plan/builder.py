@@ -71,8 +71,9 @@ def _conjoin(exprs: list[ex.Expr]) -> Optional[ex.Expr]:
 
 
 class PlanBuilder:
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, udfs=None):
         self.catalog = catalog
+        self.udfs = udfs  # Optional[UdfRegistry]
         self._sq_counter = 0  # fresh-name counter for decorrelated subqueries
         self._ctes: dict[str, ast.Query] = {}
 
@@ -274,6 +275,11 @@ class PlanBuilder:
             resolved: list[ex.SortExpr] = []
             for s in order_exprs:
                 try:
+                    # data_type alone is not enough: exprs with a fixed
+                    # return type (UDFs) succeed without resolving their
+                    # argument columns
+                    for c in ex.find_columns(s.expr):
+                        c.resolve_index(proj_schema)
                     s.expr.data_type(proj_schema)
                     resolved.append(s)
                 except PlanError:
@@ -644,6 +650,33 @@ class PlanBuilder:
                 return ex.ScalarFunction(
                     fname, tuple(self._expr(a, schema, alias_map) for a in e.args)
                 )
+            # user-defined functions, resolved from the session registry
+            if self.udfs is not None:
+                u = self.udfs.scalar(fname)
+                if u is not None:
+                    if len(e.args) != len(u.input_types):
+                        raise SqlError(
+                            f"UDF {fname} takes {len(u.input_types)} "
+                            f"argument(s), got {len(e.args)}"
+                        )
+                    return ex.ScalarUDFExpr(
+                        u.name,
+                        tuple(self._expr(a, schema, alias_map) for a in e.args),
+                        u.return_type,
+                    )
+                ua = self.udfs.aggregate(fname)
+                if ua is not None:
+                    if len(e.args) != 1:
+                        raise SqlError(f"UDAF {fname} takes one argument")
+                    if e.distinct:
+                        raise NotImplementedYet(
+                            f"DISTINCT is not supported for UDAF {fname}"
+                        )
+                    return ex.AggregateExpr(
+                        f"udaf:{ua.name}",
+                        self._expr(e.args[0], schema, alias_map),
+                        False,
+                    )
             raise SqlError(f"unknown function {fname!r}")
         if isinstance(e, ast.ScalarSubquery):
             sub = self.build_query(e.query)

@@ -485,12 +485,45 @@ class ScalarFunction(Expr):
 
 
 @dataclass(frozen=True, eq=False)
+class ScalarUDFExpr(Expr):
+    """A user scalar function call, resolved by NAME from the UDF registry
+    (reference: ScalarUDF shipped as UdfNode, code loaded via plugin)."""
+
+    fname: str
+    args: tuple = ()
+    return_type: pa.DataType = field(default_factory=pa.float64)
+
+    def data_type(self, schema: pa.Schema) -> pa.DataType:
+        return self.return_type
+
+    def nullable(self, schema: pa.Schema) -> bool:
+        return True
+
+    def children(self) -> list["Expr"]:
+        return list(self.args)
+
+    @property
+    def name(self) -> str:
+        return self.fname
+
+    def __str__(self) -> str:
+        return f"{self.fname}({', '.join(str(a) for a in self.args)})"
+
+
+@dataclass(frozen=True, eq=False)
 class AggregateExpr(Expr):
-    func: str  # sum | avg | min | max | count | count_distinct
+    func: str  # sum | avg | min | max | count | count_distinct | udaf:<name>
     arg: Optional[Expr]  # None for COUNT(*)
     distinct: bool = False
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
+        if self.func.startswith("udaf:"):
+            from ..udf import global_registry
+
+            u = global_registry().aggregate(self.func[5:])
+            if u is None:
+                raise PlanError(f"UDAF {self.func[5:]!r} not registered")
+            return u.return_type
         if self.func.startswith("count"):
             return pa.int64()
         if self.func == "avg":

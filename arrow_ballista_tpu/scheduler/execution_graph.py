@@ -186,6 +186,11 @@ class ExecutionGraph:
                 sid = info.partition_id.stage_id
                 completed = stage.to_completed()
                 self.stages[sid] = completed
+                from .display import print_stage_metrics
+
+                print_stage_metrics(
+                    self.job_id, sid, completed.plan, completed.stage_metrics
+                )
                 for link in completed.output_links:
                     consumer = self.stages.get(link)
                     if isinstance(consumer, UnresolvedStage):

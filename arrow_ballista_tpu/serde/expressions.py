@@ -93,6 +93,12 @@ def physical_expr_to_proto(e: pex.PhysicalExpr) -> pb.ExprNode:
             n.scalar_fn.args.add().CopyFrom(physical_expr_to_proto(a))
         n.scalar_fn.out_type = dtype_to_bytes(e.out_type)
         return n
+    if isinstance(e, pex.ScalarUdf):
+        n.udf.name = e.fname
+        for a in e.args:
+            n.udf.args.add().CopyFrom(physical_expr_to_proto(a))
+        n.udf.out_type = dtype_to_bytes(e.out_type)
+        return n
     raise PlanError(f"cannot serialize physical expr {type(e).__name__}")
 
 
@@ -146,6 +152,12 @@ def physical_expr_from_proto(n: pb.ExprNode) -> pex.PhysicalExpr:
             n.scalar_fn.fname,
             tuple(physical_expr_from_proto(a) for a in n.scalar_fn.args),
             dtype_from_bytes(n.scalar_fn.out_type),
+        )
+    if kind == "udf":
+        return pex.ScalarUdf(
+            n.udf.name,
+            tuple(physical_expr_from_proto(a) for a in n.udf.args),
+            dtype_from_bytes(n.udf.out_type),
         )
     raise PlanError(f"cannot deserialize physical expr node {kind!r}")
 
@@ -228,6 +240,12 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
         n.scalar_fn.fname = e.fname
         for a in e.args:
             n.scalar_fn.args.add().CopyFrom(logical_expr_to_proto(a))
+        return n
+    if isinstance(e, lex.ScalarUDFExpr):
+        n.udf.name = e.fname
+        for a in e.args:
+            n.udf.args.add().CopyFrom(logical_expr_to_proto(a))
+        n.udf.out_type = dtype_to_bytes(e.return_type)
         return n
     if isinstance(e, lex.AggregateExpr):
         n.aggregate.func = e.func
@@ -319,6 +337,12 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
         return lex.ScalarFunction(
             n.scalar_fn.fname,
             tuple(logical_expr_from_proto(a) for a in n.scalar_fn.args),
+        )
+    if kind == "udf":
+        return lex.ScalarUDFExpr(
+            n.udf.name,
+            tuple(logical_expr_from_proto(a) for a in n.udf.args),
+            dtype_from_bytes(n.udf.out_type),
         )
     if kind == "aggregate":
         arg = (

@@ -1,0 +1,198 @@
+"""UDF/UDAF registry + plugin loading, GraphViz diagrams, metrics display.
+
+Reference counterparts: core/src/plugin (UDF plugin system), python
+bindings udf.rs/udaf.rs, core/src/utils.rs:109-224 (produce_diagram),
+scheduler/src/display.rs (print_stage_metrics).
+"""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from arrow_ballista_tpu import SessionContext
+from arrow_ballista_tpu.udf import AggregateUDF, ScalarUDF, UdfRegistry, load_udf_plugins
+
+
+@pytest.fixture
+def ctx():
+    c = SessionContext()
+    c.register_arrow_table(
+        "t", pa.table({"g": ["a", "a", "b", "b"], "x": [1.0, 2.0, 3.0, 4.0]}),
+        partitions=2,
+    )
+    return c
+
+
+def test_scalar_udf_sql(ctx):
+    ctx.register_udf(
+        ScalarUDF(
+            "double_it", lambda a: pc.multiply(a, 2.0), (pa.float64(),), pa.float64()
+        )
+    )
+    out = ctx.sql("select double_it(x) as d from t order by d").collect()
+    assert out.column("d").to_pylist() == [2.0, 4.0, 6.0, 8.0]
+
+
+def test_scalar_udf_in_predicate_and_projection(ctx):
+    ctx.register_udf(
+        ScalarUDF("plus1", lambda a: pc.add(a, 1.0), (pa.float64(),), pa.float64())
+    )
+    out = ctx.sql(
+        "select g, plus1(x) as y from t where plus1(x) > 3.0 order by y"
+    ).collect()
+    assert out.column("y").to_pylist() == [4.0, 5.0]
+
+
+def test_udaf_grouped(ctx):
+    # geometric-mean-ish: product of values per group
+    def product(values: pa.Array) -> float:
+        out = 1.0
+        for v in values:
+            if v.is_valid:
+                out *= v.as_py()
+        return out
+
+    ctx.register_udaf(AggregateUDF("prod", product, pa.float64(), pa.float64()))
+    out = ctx.sql("select g, prod(x) as p from t group by g order by g").collect()
+    assert out.column("p").to_pylist() == [2.0, 12.0]
+
+
+def test_udaf_global(ctx):
+    ctx.register_udaf(
+        AggregateUDF(
+            "second_largest",
+            lambda v: sorted(v.to_pylist())[-2] if len(v) >= 2 else None,
+            pa.float64(),
+            pa.float64(),
+        )
+    )
+    out = ctx.sql("select second_largest(x) as s from t").collect()
+    assert out.column("s").to_pylist() == [3.0]
+
+
+def test_unknown_function_still_errors(ctx):
+    from arrow_ballista_tpu.errors import SqlError
+
+    with pytest.raises(SqlError, match="unknown function"):
+        ctx.sql("select nope(x) from t").collect()
+
+
+def test_udf_serde_roundtrip(ctx):
+    """UDF exprs ship by NAME through the wire protocol (UdfNode)."""
+    from arrow_ballista_tpu.serde.expressions import (
+        logical_expr_from_proto,
+        logical_expr_to_proto,
+        physical_expr_from_proto,
+        physical_expr_to_proto,
+    )
+    from arrow_ballista_tpu.exec import expressions as pex
+    from arrow_ballista_tpu.plan import expressions as lex
+
+    e = lex.ScalarUDFExpr("myfn", (lex.col("x"),), pa.float64())
+    rt = logical_expr_from_proto(logical_expr_to_proto(e))
+    assert isinstance(rt, lex.ScalarUDFExpr)
+    assert rt.fname == "myfn" and rt.return_type == pa.float64()
+
+    p = pex.ScalarUdf("myfn", (pex.Col(0, "x"),), pa.float64())
+    prt = physical_expr_from_proto(physical_expr_to_proto(p))
+    assert isinstance(prt, pex.ScalarUdf)
+    assert prt.fname == "myfn"
+
+
+def test_udf_distributed_standalone():
+    """UDF resolution on the executor side via the process-global registry
+    (standalone shares the process; distributed uses plugin_dir)."""
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.standalone(num_executors=1)
+    try:
+        ctx.register_table(
+            "u_t", MemoryTable.from_table(pa.table({"x": [1.0, 2.0]}))
+        )
+        from arrow_ballista_tpu.udf import global_registry
+
+        global_registry().register_scalar(
+            ScalarUDF("triple", lambda a: pc.multiply(a, 3.0), (pa.float64(),), pa.float64())
+        )
+        # remote planning happens client-side; give the client session the udf
+        ctx._session.register_udf(
+            ScalarUDF("triple", lambda a: pc.multiply(a, 3.0), (pa.float64(),), pa.float64())
+        )
+        out = ctx.sql("select triple(x) as y from u_t order by y").collect()
+        assert out.column("y").to_pylist() == [3.0, 6.0]
+    finally:
+        ctx.close()
+
+
+def test_plugin_dir_loading(tmp_path):
+    plugin = tmp_path / "my_udfs.py"
+    plugin.write_text(
+        "import pyarrow as pa\n"
+        "import pyarrow.compute as pc\n"
+        "from arrow_ballista_tpu.udf import ScalarUDF\n"
+        "def register_udfs(registry):\n"
+        "    registry.register_scalar(ScalarUDF(\n"
+        "        'halve', lambda a: pc.divide(a, 2.0), (pa.float64(),), pa.float64()))\n"
+    )
+    reg = UdfRegistry()
+    n = load_udf_plugins(str(tmp_path), reg)
+    assert n == 1
+    assert reg.scalar("halve") is not None
+    # via session config, into the global registry
+    from arrow_ballista_tpu import BallistaConfig
+
+    c = SessionContext(BallistaConfig({"ballista.plugin_dir": str(tmp_path)}))
+    c.register_arrow_table("p_t", pa.table({"x": [4.0]}))
+    out = c.sql("select halve(x) as h from p_t").collect()
+    assert out.column("h").to_pylist() == [2.0]
+
+
+# ----------------------------------------------------------------- diagrams
+def test_plan_diagram(ctx):
+    from arrow_ballista_tpu.utils.diagram import produce_plan_diagram
+
+    df = ctx.sql("select g, sum(x) as s from t group by g")
+    dot = produce_plan_diagram(df.physical_plan(), "q")
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert "HashAggregateExec" in dot or "Aggregate" in dot
+    assert "->" in dot
+
+
+def test_execution_graph_diagram():
+    from arrow_ballista_tpu.scheduler.planner import DistributedPlanner
+    from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+    from arrow_ballista_tpu.utils.diagram import produce_diagram
+    from arrow_ballista_tpu import BallistaConfig
+
+    ctx = SessionContext(BallistaConfig({"ballista.shuffle.partitions": "2"}))
+    ctx.register_arrow_table(
+        "d_t", pa.table({"g": ["a", "b"], "x": [1.0, 2.0]}), partitions=2
+    )
+    plan = ctx.sql("select g, sum(x) from d_t group by g").physical_plan()
+    graph = ExecutionGraph(
+        "sched1", "job1", "sess", plan, "/tmp/ballista-diagram-test"
+    )
+    dot = produce_diagram(graph)
+    assert "subgraph cluster_" in dot
+    assert "Stage 1" in dot
+    assert "style=dashed" in dot  # shuffle edge between stages
+
+
+# ------------------------------------------------------------------ display
+def test_stage_metrics_display():
+    from arrow_ballista_tpu.scheduler.display import (
+        DisplayableBallistaExecutionPlan,
+        _fmt_metrics,
+    )
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("m_t", pa.table({"x": [1.0]}))
+    plan = ctx.sql("select x from m_t").physical_plan()
+    name = str(plan)
+    text = DisplayableBallistaExecutionPlan(
+        plan, {name: {"output_rows": 5, "scan_time_ns": 2_000_000}}
+    ).indent()
+    assert "output_rows=5" in text
+    assert "scan_time=2.000ms" in text
+    assert _fmt_metrics({"a_ns": 1_500_000, "rows": 2}) == "a=1.500ms, rows=2"
