@@ -17,7 +17,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..errors import ExecutionError
-from .expressions import PhysicalExpr
+from .expressions import PhysicalExpr, _as_array_len
 from .operators import ExecutionPlan, Partitioning, TaskContext
 
 PARTIAL = "partial"
@@ -112,12 +112,12 @@ class HashAggregateExec(ExecutionPlan):
         cols: dict[str, pa.ChunkedArray] = {}
         for i, (e, name) in enumerate(self.group_exprs):
             cols[f"__g{i}"] = pa.chunked_array(
-                [_as_array(e.evaluate(b), b.num_rows) for b in batches]
+                [_as_array_len(e.evaluate(b), b.num_rows) for b in batches]
             )
         for j, a in enumerate(self.aggs):
             if a.arg is not None:
                 cols[f"__a{j}"] = pa.chunked_array(
-                    [_as_array(a.arg.evaluate(b), b.num_rows) for b in batches]
+                    [_as_array_len(a.arg.evaluate(b), b.num_rows) for b in batches]
                 )
         if not cols:  # count(*) with no groups
             return pa.table({"__dummy": pa.array([0] * sum(b.num_rows for b in batches))})
@@ -355,16 +355,6 @@ def _apply_udaf(spec: AggSpec, lists_col, out_type: pa.DataType) -> pa.ChunkedAr
         for lst in lists_col.combine_chunks()
     ]
     return pa.chunked_array([pa.array(values, type=out_type)])
-
-
-def _as_array(v, n: int) -> pa.Array:
-    if isinstance(v, pa.ChunkedArray):
-        return v.combine_chunks()
-    if isinstance(v, pa.Scalar):
-        return pa.array([v.as_py()] * n, v.type)
-    if isinstance(v, pa.Array):
-        return v
-    return pa.array([v] * n)
 
 
 def _scalar_col(s: pa.Scalar, t: pa.DataType) -> pa.Array:

@@ -676,6 +676,7 @@ class PlanBuilder:
                         f"udaf:{ua.name}",
                         self._expr(e.args[0], schema, alias_map),
                         False,
+                        udaf_type=ua.return_type,
                     )
             raise SqlError(f"unknown function {fname!r}")
         if isinstance(e, ast.ScalarSubquery):

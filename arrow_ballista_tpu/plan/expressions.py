@@ -515,9 +515,14 @@ class AggregateExpr(Expr):
     func: str  # sum | avg | min | max | count | count_distinct | udaf:<name>
     arg: Optional[Expr]  # None for COUNT(*)
     distinct: bool = False
+    # UDAF return type, captured at build time and shipped over the wire so
+    # a scheduler that has not registered the UDAF can still plan the job
+    udaf_type: Optional[pa.DataType] = None
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         if self.func.startswith("udaf:"):
+            if self.udaf_type is not None:
+                return self.udaf_type
             from ..udf import global_registry
 
             u = global_registry().aggregate(self.func[5:])
@@ -633,9 +638,16 @@ def transform(e: Expr, fn) -> Expr:
         e2 = CastExpr(transform(e.expr, fn), e.to_type)
     elif isinstance(e, ScalarFunction):
         e2 = ScalarFunction(e.fname, tuple(transform(a, fn) for a in e.args))
+    elif isinstance(e, ScalarUDFExpr):
+        e2 = ScalarUDFExpr(
+            e.fname, tuple(transform(a, fn) for a in e.args), e.return_type
+        )
     elif isinstance(e, AggregateExpr):
         e2 = AggregateExpr(
-            e.func, transform(e.arg, fn) if e.arg is not None else None, e.distinct
+            e.func,
+            transform(e.arg, fn) if e.arg is not None else None,
+            e.distinct,
+            udaf_type=e.udaf_type,
         )
     elif isinstance(e, SortExpr):
         e2 = SortExpr(transform(e.expr, fn), e.asc, e.nulls_first)

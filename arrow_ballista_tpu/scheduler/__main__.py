@@ -34,6 +34,7 @@ CONFIG_KEYS = {
     "etcd_urls": (str, "localhost:2379", "etcd endpoints (config_backend=etcd)"),
     "namespace": (str, "ballista", "state key namespace"),
     "work_dir": (str, "/tmp/ballista-tpu", "scratch dir for plans"),
+    "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "executor_timeout_seconds": (int, 180, "expire executors after this"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
@@ -113,6 +114,12 @@ def main(argv=None) -> None:
     from .external_scaler import ExternalScalerService, add_external_scaler_servicer
     from .grpc_service import SchedulerGrpcService
     from .server import SchedulerServer
+
+    if cfg["plugin_dir"]:
+        from ..udf import load_udf_plugins
+
+        n = load_udf_plugins(cfg["plugin_dir"])
+        log.info("loaded %d UDF plugin(s) from %s", n, cfg["plugin_dir"])
 
     policy = (
         TaskSchedulingPolicy.PUSH_STAGED

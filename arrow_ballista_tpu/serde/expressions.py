@@ -253,6 +253,12 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
             n.aggregate.arg.CopyFrom(logical_expr_to_proto(e.arg))
             n.aggregate.has_arg = True
         n.aggregate.distinct = e.distinct
+        if e.func.startswith("udaf:"):
+            # ship the return type: the scheduler may not have the UDAF
+            t = e.udaf_type
+            if t is None:
+                t = e.data_type(pa.schema([]))
+            n.aggregate.udaf_out_type = dtype_to_bytes(t)
         return n
     if isinstance(e, lex.SortExpr):
         n.sort.expr.CopyFrom(logical_expr_to_proto(e.expr))
@@ -348,7 +354,14 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
         arg = (
             logical_expr_from_proto(n.aggregate.arg) if n.aggregate.has_arg else None
         )
-        return lex.AggregateExpr(n.aggregate.func, arg, n.aggregate.distinct)
+        udaf_type = (
+            dtype_from_bytes(n.aggregate.udaf_out_type)
+            if n.aggregate.udaf_out_type
+            else None
+        )
+        return lex.AggregateExpr(
+            n.aggregate.func, arg, n.aggregate.distinct, udaf_type=udaf_type
+        )
     if kind == "sort":
         nf: Optional[bool] = (
             None if n.sort.nulls_first == 0 else n.sort.nulls_first == 1

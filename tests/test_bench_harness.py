@@ -1,0 +1,73 @@
+"""Benchmark harness (counterpart of benchmarks/src/bin/tpch.rs + nyctaxi.rs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+ENV = dict(os.environ, PYTHONPATH=os.path.abspath(REPO), JAX_PLATFORMS="cpu")
+
+
+def run_mod(args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", *args],
+        capture_output=True, text=True, env=ENV, timeout=timeout, cwd="/tmp",
+    )
+
+
+@pytest.fixture(scope="module")
+def datadir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tpch-bench")
+    r = run_mod(["benchmarks.tpch", "data", "--path", str(path), "--sf", "0.002",
+                 "--partitions", "1"])
+    assert r.returncode == 0, r.stderr
+    return path
+
+
+def test_benchmark_json_summary(datadir):
+    r = run_mod([
+        "benchmarks.tpch", "benchmark", "local", "--path", str(datadir),
+        "--query", "6", "--iterations", "1",
+    ])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["engine"] == "local"
+    assert "q6" in summary["queries"]
+    assert summary["queries"]["q6"]["rows"] == 1
+    assert summary["queries"]["q6"]["min_ms"] > 0
+
+
+def test_convert_tbl(tmp_path):
+    tbl_dir = tmp_path / "tbl"
+    tbl_dir.mkdir()
+    (tbl_dir / "region.tbl").write_text(
+        "0|AFRICA|lar deposits|\n1|AMERICA|hs use ironic|\n"
+    )
+    out = tmp_path / "out"
+    r = run_mod([
+        "benchmarks.tpch", "convert", "--input", str(tbl_dir),
+        "--output", str(out), "--format", "parquet", "--table", "region",
+    ])
+    assert r.returncode == 0, r.stderr
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(out / "region" / "part-0.parquet")
+    assert t.schema.names == ["r_regionkey", "r_name", "r_comment"]
+    assert t.column("r_name").to_pylist() == ["AFRICA", "AMERICA"]
+
+
+def test_nyctaxi(tmp_path):
+    data = tmp_path / "taxi.parquet"
+    r = run_mod(["benchmarks.nyctaxi", "data", "--path", str(data), "--rows", "5000"])
+    assert r.returncode == 0, r.stderr
+    r = run_mod([
+        "benchmarks.nyctaxi", "benchmark", "local", "--path", str(data),
+        "--iterations", "1",
+    ])
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["benchmark"] == "nyctaxi"
+    assert out["groups"] == 6
