@@ -28,6 +28,7 @@ TPU_ENABLE = "ballista.tpu.enable"
 TPU_SEGMENT_CAPACITY = "ballista.tpu.segment_capacity"
 TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
 TPU_DTYPE = "ballista.tpu.dtype"
+TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
 
 
@@ -103,6 +104,14 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "1048576",
         ),
         ConfigEntry(TPU_DTYPE, "accumulation dtype on device", str, "float64"),
+        ConfigEntry(
+            TPU_MIN_ROWS,
+            "partitions with fewer input rows than this run the CPU operator "
+            "path instead of launching a device kernel (kernel-launch and "
+            "compile latency dominate below it); 0 disables the fallback",
+            int,
+            "16384",
+        ),
         ConfigEntry(
             TPU_CACHE_COLUMNS,
             "pin prepared scan inputs (columns, masks, group ids) in device "
@@ -182,6 +191,10 @@ class BallistaConfig:
     @property
     def tpu_cache_columns(self) -> bool:
         return self._get(TPU_CACHE_COLUMNS)
+
+    @property
+    def tpu_min_rows(self) -> int:
+        return self._get(TPU_MIN_ROWS)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -118,6 +118,9 @@ class ShuffleJanitor(threading.Thread):
 
 
 def main(argv=None) -> None:
+    from ..utils import apply_jax_platform_env
+
+    apply_jax_platform_env()
     cfg = load_config(argv)
     from ..scheduler.__main__ import init_logging
 

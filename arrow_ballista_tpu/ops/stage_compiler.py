@@ -38,6 +38,41 @@ class _CapacityExceeded(Exception):
     pass
 
 
+class _SmallInput(Exception):
+    """Control flow: the source peek found fewer rows than tpu.min_rows;
+    carries the already-buffered batches so the CPU path needn't re-scan."""
+
+    def __init__(self, batches: list):
+        super().__init__(f"{sum(b.num_rows for b in batches)} rows")
+        self.batches = batches
+
+
+class _BufferedExec(ExecutionPlan):
+    """In-memory stand-in for a stage source whose batches were already
+    pulled by the small-input peek."""
+
+    def __init__(self, template: ExecutionPlan, batches: list):
+        super().__init__()
+        self._template = template
+        self._batches = batches
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._template.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return self._template.output_partitioning()
+
+    def children(self) -> list[ExecutionPlan]:
+        return []
+
+    def with_new_children(self, children):
+        return self
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        yield from self._batches
+
+
 # Compiled-kernel cache: plans are rebuilt per query, but the fused kernel
 # is a pure function of the stage's structural signature — reuse the jitted
 # callable (and with it XLA's compilation cache) across plan instances.
@@ -216,12 +251,29 @@ class TpuStageExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         try:
             yield from self._execute_device(partition, ctx)
+            return
+        except _SmallInput as si:
+            # partition under tpu.min_rows: run the CPU operator path over
+            # the batches the peek already pulled (no source re-scan), and
+            # OUTSIDE this try so real CPU errors propagate instead of
+            # being mistaken for device failures
+            self.metrics.add("cpu_fallback", 1)
+            cpu_plan = self.original.with_new_children(
+                [
+                    _replace_leaf(
+                        self.original.input,
+                        self.fused.source,
+                        _BufferedExec(self.fused.source, si.batches),
+                    )
+                ]
+            )
         except (_CapacityExceeded, ExecutionError):
             # group cardinality exceeded the device segment table, or a
             # column type slipped past plan-time lowering checks — re-run
             # this partition on the CPU operator path
             self.metrics.add("tpu_fallback", 1)
-            yield from self.original.execute(partition, ctx)
+            cpu_plan = self.original
+        yield from cpu_plan.execute(partition, ctx)
 
     def _cache_key(self, ctx: TaskContext):
         """(provider, signature) when the stage source is a cacheable scan."""
@@ -272,6 +324,27 @@ class TpuStageExec(ExecutionPlan):
                 )
                 return
 
+        src = fused.source.execute(partition, ctx)
+        min_rows = self.config.tpu_min_rows
+        if min_rows > 0:
+            # peek: kernel-launch/compile latency dominates tiny inputs, so
+            # partitions under the threshold run the CPU operator path
+            # (signalled to execute() with the buffered batches)
+            import itertools
+
+            buffered: list[pa.RecordBatch] = []
+            total = 0
+            exhausted = True
+            for b in src:
+                buffered.append(b)
+                total += b.num_rows
+                if total >= min_rows:
+                    exhausted = False
+                    break
+            if exhausted and total < min_rows:
+                raise _SmallInput(buffered)
+            src = itertools.chain(buffered, src)
+
         key_encoders = [DictEncoder() for _ in fused.group_exprs]
         tuple_gids: dict[tuple, int] = {}
         gid_tuples: list[tuple] = []
@@ -280,7 +353,7 @@ class TpuStageExec(ExecutionPlan):
         acc = None
         n_rows_in = 0
         with self.metrics.timer("tpu_stage_time_ns"):
-            for batch in fused.source.execute(partition, ctx):
+            for batch in src:
                 if batch.num_rows == 0:
                     continue
                 n = batch.num_rows

@@ -104,6 +104,9 @@ def make_backend(cfg: dict):
 
 
 def main(argv=None) -> None:
+    from ..utils import apply_jax_platform_env
+
+    apply_jax_platform_env()
     cfg = load_config(argv)
     init_logging(cfg)
     log = logging.getLogger("ballista.scheduler")

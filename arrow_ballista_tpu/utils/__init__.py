@@ -1,0 +1,21 @@
+"""Shared utilities."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative.
+
+    jax honors the env var itself, but platform *plugins* registered via
+    entry points can pin a different backend regardless; the config API
+    always wins, so process entry points (scheduler/executor binaries,
+    benchmark harnesses) call this before any jax compute to guarantee
+    ``JAX_PLATFORMS=cpu`` really means cpu.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

@@ -116,6 +116,9 @@ def _make_context(args):
             {
                 "ballista.shuffle.partitions": str(args.partitions),
                 "ballista.batch.size": str(args.batch_size),
+                # session settings ship with every query, so the executors
+                # honor --tpu in cluster mode too
+                "ballista.tpu.enable": "true" if args.tpu else "false",
             }
         )
         return BallistaContext.remote(args.host, args.port, cfg)
@@ -239,13 +242,20 @@ def cmd_loadtest(args) -> None:
     latencies: list[float] = []
     lock = threading.Lock()
 
+    # distribute num_queries over workers exactly (remainder to the first)
+    per_worker = [
+        args.num_queries // args.concurrency
+        + (1 if i < args.num_queries % args.concurrency else 0)
+        for i in range(args.concurrency)
+    ]
+
     def worker(wid: int) -> None:
         ctx = _make_context(args)
         _register_tables(ctx, args.path)
         import random
 
         rng = random.Random(wid)
-        for _ in range(args.num_queries // args.concurrency):
+        for _ in range(per_worker[wid]):
             qn = rng.choice(queries)
             t0 = time.perf_counter()
             try:
@@ -285,6 +295,9 @@ def cmd_loadtest(args) -> None:
 
 
 def main(argv=None) -> None:
+    from arrow_ballista_tpu.utils import apply_jax_platform_env
+
+    apply_jax_platform_env()
     ap = argparse.ArgumentParser("tpch", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 

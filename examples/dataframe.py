@@ -1,0 +1,28 @@
+"""Remote DataFrame example (counterpart of examples/src/bin/dataframe.rs).
+
+Requires a running cluster (see examples/sql.py header).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from arrow_ballista_tpu import col, lit
+from arrow_ballista_tpu.client.context import BallistaContext
+
+
+def main() -> None:
+    ctx = BallistaContext.remote("localhost", 50050)
+
+    testdata = os.path.join(os.path.dirname(__file__), "testdata")
+    df = (
+        ctx.read_parquet(os.path.join(testdata, "alltypes_plain.parquet"))
+        .select("id", "bool_col", "timestamp_col")
+        .filter(col("id") > lit(1))
+    )
+    print(df.collect().to_pandas())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Remote SQL example (counterpart of the reference's examples/src/bin/sql.rs:17-52).
+
+Run a scheduler + executor first:
+    python -m arrow_ballista_tpu.scheduler --bind-port 50050
+    python -m arrow_ballista_tpu.executor --scheduler-port 50050 --bind-port 0
+Then:
+    python examples/sql.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from arrow_ballista_tpu import BallistaConfig
+from arrow_ballista_tpu.client.context import BallistaContext
+
+
+def main() -> None:
+    config = BallistaConfig({"ballista.shuffle.partitions": "4"})
+    ctx = BallistaContext.remote("localhost", 50050, config)
+
+    # register a table from CSV test data then run an aggregate query
+    testdata = os.path.join(os.path.dirname(__file__), "testdata")
+    ctx.register_csv("test", os.path.join(testdata, "aggregate_test_100.csv"))
+
+    df = ctx.sql(
+        "SELECT c1, MIN(c12), MAX(c12) FROM test WHERE c11 > 0.1 AND c11 < 0.9 GROUP BY c1"
+    )
+    print(df.collect().to_pandas())
+
+
+if __name__ == "__main__":
+    main()

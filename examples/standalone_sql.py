@@ -1,0 +1,40 @@
+"""Standalone (single-process cluster) SQL example — counterpart of the
+reference's examples/src/bin/standalone-sql.rs: scheduler + executor spin up
+in-process on random ports, no external services needed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import pyarrow as pa
+
+from arrow_ballista_tpu import BallistaConfig
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.client.context import BallistaContext
+
+
+def main() -> None:
+    config = BallistaConfig({"ballista.shuffle.partitions": "2"})
+    with BallistaContext.standalone(config, num_executors=1) as ctx:
+        ctx.register_table(
+            "sales",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "region": ["east", "east", "west", "west", "north"],
+                        "amount": [10.0, 20.0, 5.0, 30.0, 7.5],
+                    }
+                ),
+                partitions=2,
+            ),
+        )
+        df = ctx.sql(
+            "SELECT region, SUM(amount) AS total FROM sales GROUP BY region ORDER BY total DESC"
+        )
+        print(df.collect().to_pandas())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""UDF/UDAF example: register python functions and use them from SQL.
+
+Counterpart of the reference's python UDF surface (python/src/udf.rs,
+udaf.rs) and the plugin system (core/src/plugin).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from arrow_ballista_tpu import SessionContext
+from arrow_ballista_tpu.udf import AggregateUDF, ScalarUDF
+
+
+def main() -> None:
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "trades",
+        pa.table(
+            {
+                "symbol": ["A", "A", "B", "B", "B"],
+                "price": [10.0, 11.0, 100.0, 98.0, 104.0],
+            }
+        ),
+    )
+
+    # vectorized scalar UDF: works on whole Arrow arrays
+    ctx.register_udf(
+        ScalarUDF(
+            "with_fee",
+            lambda p: pc.multiply(p, 1.0025),
+            (pa.float64(),),
+            pa.float64(),
+        )
+    )
+
+    # aggregate UDF: folds each group's values to one scalar
+    def price_range(values: pa.Array) -> float:
+        vals = [v for v in values.to_pylist() if v is not None]
+        return max(vals) - min(vals) if vals else None
+
+    ctx.register_udaf(
+        AggregateUDF("price_range", price_range, pa.float64(), pa.float64())
+    )
+
+    df = ctx.sql(
+        """
+        SELECT symbol, price_range(with_fee(price)) AS spread
+        FROM trades GROUP BY symbol ORDER BY symbol
+        """
+    )
+    print(df.collect().to_pandas())
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,9 @@ from arrow_ballista_tpu import BallistaConfig, SessionContext
 
 
 def _ctx(**settings):
+    # these regressions exercise the device kernel on tiny fixtures — keep
+    # the small-input CPU fallback out of the way
+    settings.setdefault("ballista.tpu.min_rows", "0")
     cfg = BallistaConfig({k: str(v) for k, v in settings.items()})
     return SessionContext(cfg)
 

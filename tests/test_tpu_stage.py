@@ -11,7 +11,12 @@ from arrow_ballista_tpu import BallistaConfig, SessionContext
 
 
 def _ctx(tpu: bool, **extra) -> SessionContext:
-    settings = {"ballista.tpu.enable": "true" if tpu else "false"}
+    # min_rows=0: these tests exist to exercise the device kernel on small
+    # fixtures, so the small-input CPU fallback must stay out of the way
+    settings = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.tpu.min_rows": "0",
+    }
     settings.update({k: str(v) for k, v in extra.items()})
     return SessionContext(BallistaConfig(settings))
 
