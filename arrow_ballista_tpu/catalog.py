@@ -77,6 +77,35 @@ class ParquetTable(TableProvider):
         return {"kind": "parquet", "path": self.path}
 
 
+class AvroTable(TableProvider):
+    """Avro object-container files (reference: register_avro / read_avro,
+    client/src/context.rs:212-311); decoded by the built-in pure-python
+    reader (avro.py) — no external avro library required."""
+
+    def __init__(self, path: str):
+        from .avro import AvroFile
+
+        self.path = path
+        self.files = _expand_path(path, ".avro")
+        self._readers = [AvroFile(f) for f in self.files]
+        self._schema = self._readers[0].schema
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self.files)
+
+    def scan_partition(
+        self, partition: int, projection: Optional[list[str]], batch_size: int = 8192
+    ) -> Iterator[pa.RecordBatch]:
+        yield from self._readers[partition].read_batches(projection, batch_size)
+
+    def describe(self) -> dict:
+        return {"kind": "avro", "path": self.path}
+
+
 class CsvTable(TableProvider):
     def __init__(
         self,
@@ -192,6 +221,8 @@ def provider_from_description(d: dict) -> TableProvider:
     kind = d["kind"]
     if kind == "parquet":
         return ParquetTable(d["path"])
+    if kind == "avro":
+        return AvroTable(d["path"])
     if kind == "csv":
         schema = None
         if "schema" in d:

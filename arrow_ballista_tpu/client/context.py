@@ -128,6 +128,9 @@ class BallistaContext:
     def register_csv(self, name: str, path: str, **kw) -> None:
         self._session.register_csv(name, path, **kw)
 
+    def register_avro(self, name: str, path: str) -> None:
+        self._session.register_avro(name, path)
+
     def register_table(self, name: str, provider) -> None:
         self._session.register_table(name, provider)
 
@@ -136,6 +139,9 @@ class BallistaContext:
 
     def read_csv(self, path: str, **kw) -> BallistaDataFrame:
         return self._wrap(self._session.read_csv(path, **kw))
+
+    def read_avro(self, path: str) -> BallistaDataFrame:
+        return self._wrap(self._session.read_avro(path))
 
     def table(self, name: str) -> BallistaDataFrame:
         return self._wrap(self._session.table(name))

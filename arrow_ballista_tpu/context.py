@@ -154,6 +154,16 @@ class SessionContext:
     ) -> None:
         self.catalog.register(name, CsvTable(path, schema, has_header, delimiter))
 
+    def register_avro(self, name: str, path: str) -> None:
+        from .catalog import AvroTable
+
+        self.catalog.register(name, AvroTable(path))
+
+    def read_avro(self, path: str) -> DataFrame:
+        name = f"__anon_avro_{_gen_id()[:6]}"
+        self.register_avro(name, path)
+        return self.table(name)
+
     def register_record_batches(
         self, name: str, partitions: list[list[pa.RecordBatch]]
     ) -> None:
@@ -282,6 +292,8 @@ class SessionContext:
                 stmt.name,
                 CsvTable(stmt.location, schema, stmt.has_header, stmt.delimiter),
             )
+        elif ft == "AVRO":
+            self.register_avro(stmt.name, stmt.location)
         else:
             raise SqlError(f"unsupported file type {stmt.file_type}")
         return self._values_df(pa.table({"result": pa.array(["ok"])}))

@@ -22,6 +22,63 @@ from typing import Optional
 
 BALLISTA_VERSION = "0.7.0-tpu"
 
+# Minimal cluster dashboard (stand-in for the reference's React scheduler
+# UI, ballista/ui/scheduler/): polls /api/state + /api/jobs + /api/metrics.
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>Ballista-TPU Scheduler</title>
+<style>
+ body { font-family: ui-monospace, Menlo, monospace; margin: 2rem; color: #222; }
+ h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.4rem; }
+ table { border-collapse: collapse; margin-top: .4rem; }
+ th, td { border: 1px solid #bbb; padding: .25rem .6rem; font-size: .85rem; text-align: left; }
+ th { background: #f0f0f0; }
+ .ok { color: #0a7d2c; } .dead { color: #b00020; }
+ #meta { color: #666; font-size: .8rem; }
+</style></head><body>
+<h1>Ballista-TPU Scheduler</h1>
+<div id="meta">loading…</div>
+<h2>Executors</h2><table id="executors"><thead><tr>
+ <th>id</th><th>host</th><th>flight</th><th>grpc</th><th>alive</th><th>last seen</th>
+</tr></thead><tbody></tbody></table>
+<h2>Jobs</h2><table id="jobs"><thead><tr>
+ <th>job</th><th>state</th></tr></thead><tbody></tbody></table>
+<script>
+async function refresh() {
+  try {
+    const [state, jobs, metrics] = await Promise.all([
+      fetch('/api/state').then(r => r.json()),
+      fetch('/api/jobs').then(r => r.json()),
+      fetch('/api/metrics').then(r => r.json()),
+    ]);
+    document.getElementById('meta').textContent =
+      `version ${state.version} · uptime ${state.uptime_seconds}s · ` +
+      `${metrics.alive_executors} executor(s) · ${metrics.available_slots} slot(s) · ` +
+      `${metrics.active_jobs} active job(s)`;
+    const etb = document.querySelector('#executors tbody');
+    etb.innerHTML = '';
+    for (const e of state.executors) {
+      const age = e.last_seen ? Math.round(Date.now()/1000 - e.last_seen) + 's ago' : '—';
+      etb.insertAdjacentHTML('beforeend',
+        `<tr><td>${e.id}</td><td>${e.host}</td><td>${e.port}</td>` +
+        `<td>${e.grpc_port || '—'}</td>` +
+        `<td class="${e.alive ? 'ok' : 'dead'}">${e.alive ? 'alive' : 'dead'}</td>` +
+        `<td>${age}</td></tr>`);
+    }
+    const jtb = document.querySelector('#jobs tbody');
+    jtb.innerHTML = '';
+    for (const j of jobs.jobs) {
+      jtb.insertAdjacentHTML('beforeend',
+        `<tr><td>${j.job_id}</td><td>${j.state}</td></tr>`);
+    }
+  } catch (err) {
+    document.getElementById('meta').textContent = 'scheduler unreachable: ' + err;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script></body></html>
+"""
+
 
 class SchedulerApiHandler(BaseHTTPRequestHandler):
     server_version = "ballista-tpu-scheduler"
@@ -82,6 +139,14 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
                     "active_jobs": len(srv.state.task_manager.active_job_ids()),
                 }
             )
+            return
+        if path in ("", "/", "/ui"):
+            body = DASHBOARD_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         self._json({"error": f"no such route {path}"}, 404)
 

@@ -1,0 +1,201 @@
+"""Avro reader/provider, scheduler UI dashboard, executor-loss recovery.
+
+Reference counterparts: register_avro/read_avro (client/src/context.rs),
+ballista/ui/scheduler (React dashboard), executor expiry + stage rollback
+(scheduler_server/mod.rs:192-253, execution_graph.rs:499-622).
+"""
+
+import datetime
+import time
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import SessionContext
+from arrow_ballista_tpu.avro import AvroFile, write_avro
+
+
+@pytest.fixture
+def avro_path(tmp_path):
+    tbl = pa.table(
+        {
+            "id": pa.array([1, 2, 3, 4], pa.int64()),
+            "name": pa.array(["a", "b", None, "d"], pa.string()),
+            "score": pa.array([1.5, 2.5, 3.5, None], pa.float64()),
+            "flag": pa.array([True, False, True, False], pa.bool_()),
+            "day": pa.array(
+                [datetime.date(2024, 1, i + 1) for i in range(4)], pa.date32()
+            ),
+        }
+    )
+    path = str(tmp_path / "data.avro")
+    write_avro(path, tbl)
+    return path, tbl
+
+
+def test_avro_roundtrip(avro_path):
+    path, tbl = avro_path
+    f = AvroFile(path)
+    got = pa.Table.from_batches(list(f.read_batches()), schema=f.schema)
+    assert got.num_rows == tbl.num_rows
+    for name in tbl.schema.names:
+        assert got.column(name).to_pylist() == tbl.column(name).to_pylist(), name
+
+
+def test_avro_projection_and_batches(avro_path):
+    path, tbl = avro_path
+    f = AvroFile(path)
+    batches = list(f.read_batches(projection=["score", "id"], batch_size=3))
+    assert [b.num_rows for b in batches] == [3, 1]
+    assert batches[0].schema.names == ["score", "id"]
+
+
+def test_avro_sql(avro_path):
+    path, _ = avro_path
+    ctx = SessionContext()
+    ctx.register_avro("t", path)
+    out = ctx.sql("select count(*) as n, sum(id) as s from t where flag").collect()
+    assert out.to_pydict() == {"n": [2], "s": [4]}
+    # DDL route
+    ctx.sql(f"CREATE EXTERNAL TABLE t2 STORED AS AVRO LOCATION '{path}'")
+    assert ctx.sql("select count(*) as n from t2").collect().to_pydict() == {"n": [4]}
+    # read_avro dataframe route
+    assert ctx.read_avro(path).count() == 4
+
+
+def test_avro_distributed(avro_path):
+    """Avro provider ships through plan serde to executors."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    path, _ = avro_path
+    ctx = BallistaContext.standalone(num_executors=1)
+    try:
+        ctx.register_avro("t", path)
+        out = ctx.sql("select sum(id) as s from t").collect()
+        assert out.column("s").to_pylist() == [10]
+    finally:
+        ctx.close()
+
+
+def test_avro_deflate_codec(tmp_path):
+    """Deflate-compressed blocks decode (zlib raw)."""
+    import json
+    import struct
+    import zlib
+
+    # hand-build a deflate avro file with two long rows
+    def zigzag(n):
+        u = (n << 1) ^ (n >> 63)
+        out = bytearray()
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            if u:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    schema = {"type": "record", "name": "r", "fields": [{"name": "x", "type": "long"}]}
+    body = zigzag(7) + zigzag(-3)
+    compressed = zlib.compress(body)[2:-4]  # raw deflate
+    sync = b"S" * 16
+    path = tmp_path / "d.avro"
+    with open(path, "wb") as f:
+        f.write(b"Obj\x01")
+        meta = {"avro.schema": json.dumps(schema).encode(), "avro.codec": b"deflate"}
+        f.write(zigzag(len(meta)))
+        for k, v in meta.items():
+            f.write(zigzag(len(k)) + k.encode())
+            f.write(zigzag(len(v)) + v)
+        f.write(zigzag(0))
+        f.write(sync)
+        f.write(zigzag(2))
+        f.write(zigzag(len(compressed)))
+        f.write(compressed)
+        f.write(sync)
+    f2 = AvroFile(str(path))
+    got = pa.Table.from_batches(list(f2.read_batches()), schema=f2.schema)
+    assert got.column("x").to_pylist() == [7, -3]
+
+
+# ------------------------------------------------------------------- UI
+def test_dashboard_served():
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+
+    ctx = BallistaContext.standalone(num_executors=1)
+    api = ApiServerHandle(
+        ctx._standalone_handles[0].server, "127.0.0.1", 0
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/", timeout=10
+        ) as resp:
+            html = resp.read().decode()
+        assert "Ballista-TPU Scheduler" in html
+        assert "/api/state" in html  # dashboard polls the JSON API
+    finally:
+        api.stop()
+        ctx.close()
+
+
+# -------------------------------------------------------- loss recovery
+def test_executor_loss_cluster_recovers():
+    """Kill an executor abruptly (no ExecutorStopped); the reaper expires
+    it via missed heartbeats and later queries run on the survivor
+    (reference: expire_dead_executors + liveness window)."""
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import TaskSchedulingPolicy
+    from arrow_ballista_tpu.executor.standalone import new_standalone_executor
+    from arrow_ballista_tpu.scheduler.standalone import new_standalone_scheduler
+
+    scheduler = new_standalone_scheduler(
+        liveness_window_s=1.0, executor_timeout_s=2.0
+    )
+    e1 = new_standalone_executor(
+        scheduler.host, scheduler.port, heartbeat_interval_s=0.3
+    )
+    e2 = new_standalone_executor(
+        scheduler.host, scheduler.port, heartbeat_interval_s=0.3
+    )
+    ctx = BallistaContext.remote(scheduler.host, scheduler.port)
+    try:
+        ctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table({"g": ["a", "b"] * 50, "x": [1.0] * 100}), 2
+            ),
+        )
+        out = ctx.sql("select g, sum(x) as s from t group by g order by g").collect()
+        assert out.column("s").to_pylist() == [50.0, 50.0]
+
+        # hard-kill e1: stop its heartbeater + poll loop without notifying
+        if e1.poll_loop is not None:
+            e1.poll_loop.stop()
+        e1.flight.shutdown()
+
+        # wait for the reaper to expire it (timeout 2s + sweep interval)
+        deadline = time.time() + 20
+        em = scheduler.server.state.executor_manager
+        while time.time() < deadline:
+            if e1.id not in em.get_alive_executors():
+                break
+            time.sleep(0.2)
+        assert e1.id not in em.get_alive_executors()
+
+        # new queries must still complete on the survivor
+        out2 = ctx.sql("select sum(x) as s from t").collect()
+        assert out2.column("s").to_pylist() == [100.0]
+    finally:
+        ctx.close()
+        e2.shutdown()
+        try:
+            e1.shutdown()
+        except Exception:
+            pass
+        scheduler.shutdown()
