@@ -1,9 +1,11 @@
 """TPU compute plane: fused relational kernels over JAX/XLA.
 
-f64 is enabled globally: TPC-H aggregates sum ~1e10-magnitude values over
-millions of rows, beyond f32 precision; XLA emulates f64 on TPU at a cost
-the (tiny) aggregate FLOP count absorbs easily — the stage bottleneck is
-host→HBM transfer, not VPU math.
+Dtype policy (``kernels.precision_mode``): the CPU platform runs f64/i64
+kernels ("x64" — exact vs pyarrow oracles); TPU runs native f32/i32
+("x32") with double-float compensated sums, since v5e has no f64/i64 ALUs.
+``jax_enable_x64`` is enabled globally so the x64 mode can exist at all;
+x32-mode kernels pin every dtype explicitly and never materialize a 64-bit
+device array, so the flag is harmless on TPU.
 """
 
 import jax

@@ -202,12 +202,14 @@ class TpuStageExec(ExecutionPlan):
         self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
         self._leaf_names = list(self.leaves.keys())
         self._flat_names = K.flat_arg_names(self._leaf_names)
+        self._mode = K.precision_mode()
         sig = (
             tuple(str(f) for f in fused.filters),
             tuple((s.func, str(a.arg)) for s, a in zip(specs, fused.aggs)),
             self.capacity,
             tuple(self._flat_names),
             str(fused.source.schema),
+            self._mode,
         )
         cached = _KERNEL_CACHE.get(sig)
         if cached is None:
@@ -296,7 +298,7 @@ class TpuStageExec(ExecutionPlan):
             ]
             + [str(g) for g, _ in self.fused.group_exprs]
             + [f"proj={node.projection}", f"cols={source_cols}"]
-            + [str(ctx.batch_size), f"cap={self.capacity}"]
+            + [str(ctx.batch_size), f"cap={self.capacity}", self._mode]
         )
         return node.provider, sig
 
@@ -463,9 +465,19 @@ class TpuStageExec(ExecutionPlan):
                 cols.append(pa.array(host[i][keep], pa.int64()))
                 i += 1
                 continue
-            v = host[i][keep]
-            n_arr = host[i + 1][keep]
-            i += 2
+            if spec.func in ("sum", "avg") and self._mode == "x32":
+                # double-float state: hi + lo recombine in f64 on host,
+                # recovering ~48-bit precision from f32 device math
+                v = (
+                    host[i][keep].astype(np.float64)
+                    + host[i + 1][keep].astype(np.float64)
+                )
+                n_arr = host[i + 2][keep]
+                i += 3
+            else:
+                v = host[i][keep]
+                n_arr = host[i + 1][keep]
+                i += 2
             if spec.func == "avg":
                 if partial:
                     cols.append(pa.array(v, pa.float64()))

@@ -48,25 +48,26 @@ def make_distributed_agg_step(
     """
     from jax import shard_map
 
+    from ..ops import kernels as K
+
+    mode = K.precision_mode()
+
     def reduce_states(states):
+        # per-field collective chosen by the kernel's state layout
+        # (state_fields): psum for additive fields — including the x32
+        # double-float lo term, whose psum error is second-order — and
+        # pmin/pmax for extrema
         out = []
         i = 0
         for spec in specs:
-            if spec.func in ("count", "count_star"):
-                out.append(jax.lax.psum(states[i], DATA_AXIS))
+            for role in K.state_fields(spec, mode):
+                if role == "min":
+                    out.append(jax.lax.pmin(states[i], DATA_AXIS))
+                elif role == "max":
+                    out.append(jax.lax.pmax(states[i], DATA_AXIS))
+                else:
+                    out.append(jax.lax.psum(states[i], DATA_AXIS))
                 i += 1
-            elif spec.func in ("sum", "avg"):
-                out.append(jax.lax.psum(states[i], DATA_AXIS))
-                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
-                i += 2
-            elif spec.func == "min":
-                out.append(jax.lax.pmin(states[i], DATA_AXIS))
-                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
-                i += 2
-            elif spec.func == "max":
-                out.append(jax.lax.pmax(states[i], DATA_AXIS))
-                out.append(jax.lax.psum(states[i + 1], DATA_AXIS))
-                i += 2
         out.append(jax.lax.psum(states[-1], DATA_AXIS))  # presence
         return tuple(out)
 
@@ -125,7 +126,7 @@ def ici_all_to_all_repartition(mesh: Mesh, capacity: int):
         )[:n_dev]
         offsets = jnp.cumsum(counts) - counts  # start of each dest run
         safe_dest = jnp.minimum(dest_s, n_dev - 1)
-        idx_within = jnp.arange(rows) - offsets[safe_dest]
+        idx_within = jnp.arange(rows, dtype=jnp.int32) - offsets[safe_dest]
         ok = (
             (dest_s < n_dev) & (idx_within >= 0) & (idx_within < capacity)
         )

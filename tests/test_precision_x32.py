@@ -1,0 +1,189 @@
+"""x32 (f32/i32) kernel mode: the TPU-native dtype path.
+
+TPU v5e has no f64/i64 ALUs, so on-chip kernels run f32/i32 with
+double-float compensated sums (kernels._segment_sum_df32).  These tests
+force x32 mode on the CPU platform — f32 semantics are identical — and
+require TPC-H results to match the exact CPU-operator oracle at 1e-6,
+the VERDICT.md round-1 acceptance bar for killing the global-x64 design.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.ops import kernels as K
+
+
+@pytest.fixture(autouse=True)
+def _x32_mode():
+    K.set_precision("x32")
+    yield
+    K.set_precision(None)
+
+
+def _ctx(tpu: bool) -> SessionContext:
+    return SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true" if tpu else "false",
+                "ballista.tpu.min_rows": "0",
+            }
+        )
+    )
+
+
+def _register_tpch(ctx, sf=0.01):
+    from benchmarks.tpch.datagen import register_all
+
+    register_all(ctx, sf=sf, partitions=2)
+
+
+def _assert_close(a: pa.Table, b: pa.Table, rel=1e-6):
+    assert a.schema.names == b.schema.names
+    assert a.num_rows == b.num_rows
+    for name in a.schema.names:
+        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=rel), name
+            elif isinstance(x, int) and isinstance(y, int):
+                # integer sums accumulate in f32 double-float: exact to
+                # ~48 bits, far beyond any TPC-H magnitude
+                assert x == y, name
+            else:
+                assert x == y, name
+
+
+def _both(sql: str):
+    c_cpu, c_tpu = _ctx(False), _ctx(True)
+    _register_tpch(c_cpu)
+    _register_tpch(c_tpu)
+    return c_cpu.sql(sql).collect(), c_tpu.sql(sql).collect()
+
+
+def test_q1_x32_matches_oracle_at_1e6():
+    from benchmarks.tpch.queries import QUERIES
+
+    cpu, tpu = _both(QUERIES[1])
+    _assert_close(cpu, tpu, rel=1e-6)
+
+
+def test_q6_x32_matches_oracle_at_1e6():
+    from benchmarks.tpch.queries import QUERIES
+
+    cpu, tpu = _both(QUERIES[6])
+    _assert_close(cpu, tpu, rel=1e-6)
+
+
+def test_x32_plan_still_accelerates():
+    from benchmarks.tpch.queries import QUERIES
+
+    ctx = _ctx(True)
+    _register_tpch(ctx)
+    assert "TpuStageExec" in ctx.sql(QUERIES[1]).explain()
+
+
+def test_df32_segment_sum_beats_naive_f32():
+    """The compensated sum must track the f64 oracle where plain f32
+    accumulation drifts: 4M adversarially-spread positive values."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    n = 1 << 22
+    v = (rng.uniform(0.001, 105000.0, n)).astype(np.float64)
+    seg = np.zeros(n, dtype=np.int32)
+    oracle = v.sum()  # numpy pairwise f64
+
+    hi, lo = jax.jit(
+        lambda x, s: K._segment_sum_df32(x, s, 4)
+    )(v.astype(np.float32), seg)
+    df = float(np.asarray(hi, np.float64)[0] + np.asarray(lo, np.float64)[0])
+    naive = float(np.cumsum(v.astype(np.float32), dtype=np.float32)[-1])
+
+    assert abs(df - oracle) / oracle < 1e-6
+    # per-row f32 quantization alone costs ~eps; sequential accumulation
+    # must be measurably worse than the compensated path
+    assert abs(df - oracle) <= abs(naive - oracle)
+
+
+def test_x32_mesh_agg_non_pow2_shards():
+    """Mesh shards are n/n_dev rows — NOT pow2-bucketed.  The df32 sum must
+    pad internally (review regression: reshape/tree crashed on 1000-row
+    shards in x32 mode)."""
+    import jax
+
+    from arrow_ballista_tpu.parallel import mesh as M
+
+    mesh = M.make_mesh(8)
+    n = 8 * 1000
+    rng = np.random.default_rng(3)
+    vals = rng.uniform(0.0, 100.0, n)
+    seg = rng.integers(0, 5, n).astype(np.int32)
+
+    flat_names = ["v", "v__valid"]
+
+    def closure(env):
+        return env["v"], env["v__valid"]
+
+    specs = [K.KernelAggSpec("sum", True), K.KernelAggSpec("count_star", False)]
+    kernel = K.make_partial_agg_kernel(
+        None, [closure, None], specs, 8, flat_names
+    )
+    step = M.make_distributed_agg_step(kernel, specs, mesh, 8)
+    args = M.shard_batch(
+        mesh,
+        [
+            seg,
+            np.ones(n, bool),
+            vals.astype(np.float32),
+            np.ones(n, bool),
+        ],
+    )
+    out = step(*args)
+    hi, lo = np.asarray(out[0], np.float64), np.asarray(out[1], np.float64)
+    got = (hi + lo)[:5]
+    want = np.array([vals[seg == g].sum() for g in range(5)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    counts = np.asarray(out[2])[:5]
+    assert counts.tolist() == [int((seg == g).sum()) for g in range(5)]
+
+
+def test_int64_overflow_guard_falls_back():
+    """int64 columns beyond i32 range must not silently wrap: the bridge
+    raises and the stage re-runs on the CPU path with exact results."""
+    big = 5_000_000_000
+    t = pa.table(
+        {
+            "k": pa.array([1, 1, 2, 2], pa.int64()),
+            "v": pa.array([big, big + 1, big + 2, big + 3], pa.int64()),
+        }
+    )
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    ctx = _ctx(True)
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    out = ctx.sql("SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k").collect()
+    assert out.column("s").to_pylist() == [2 * big + 1, 2 * big + 5]
+
+
+def test_timestamp_not_lowered_in_x32():
+    """ns-epoch timestamps overflow i32; plan must keep them on CPU."""
+    import datetime
+
+    t = pa.table(
+        {
+            "ts": pa.array(
+                [datetime.datetime(2020, 1, 1), datetime.datetime(2021, 1, 1)],
+                pa.timestamp("us"),
+            ),
+            "v": pa.array([1.0, 2.0]),
+        }
+    )
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    ctx = _ctx(True)
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    out = ctx.sql(
+        "SELECT SUM(v) AS s FROM t WHERE ts >= TIMESTAMP '2020-06-01 00:00:00'"
+    ).collect()
+    assert out.column("s").to_pylist() == [2.0]
