@@ -63,6 +63,7 @@ class BallistaContext:
         self._session = SessionContext(self.config)
         self._session.ballista_context = self
         self._standalone_handles = _standalone_handles
+        self._job_ids: set[str] = set()
 
         # mint a server-side session id (reference: context.rs:103-119)
         result = self.stub.ExecuteQuery(
@@ -84,6 +85,7 @@ class BallistaContext:
         num_executors: int = 1,
         concurrent_tasks: int = 4,
         policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
+        work_dir: Optional[str] = None,
     ) -> "BallistaContext":
         """In-proc cluster: scheduler + executors over real gRPC/Flight on
         random localhost ports (reference: context.rs:140-210)."""
@@ -97,6 +99,7 @@ class BallistaContext:
                 scheduler.port,
                 concurrent_tasks=concurrent_tasks,
                 policy=policy,
+                work_dir=work_dir,
             )
             for _ in range(num_executors)
         ]
@@ -108,6 +111,14 @@ class BallistaContext:
         )
 
     def close(self) -> None:
+        # release this client's memory-plane shuffle partitions (the
+        # counterpart of the executor janitor's work-dir sweep for jobs
+        # that ran with ballista.shuffle.to_memory / mesh gang stages)
+        from ..shuffle import memory_store
+
+        for job_id in self._job_ids:
+            memory_store.delete_job(job_id)
+        self._job_ids.clear()
         if self._standalone_handles is not None:
             scheduler, executors = self._standalone_handles
             for e in executors:
@@ -181,6 +192,7 @@ class BallistaContext:
 
     def _collect_distributed(self, plan) -> pa.Table:
         job_id = self.execute_logical_plan(plan)
+        self._job_ids.add(job_id)
         status = self.wait_for_job(job_id)
         return self.fetch_job_output(status)
 

@@ -30,6 +30,9 @@ TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
 TPU_DTYPE = "ballista.tpu.dtype"
 TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
+MESH_ENABLE = "ballista.mesh.enable"
+MESH_DEVICES = "ballista.mesh.devices"
+SHUFFLE_TO_MEMORY = "ballista.shuffle.to_memory"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -119,6 +122,26 @@ _ENTRIES: dict[str, ConfigEntry] = {
             _parse_bool,
             "true",
         ),
+        ConfigEntry(
+            MESH_ENABLE,
+            "run eligible stages as single gang tasks over the device mesh, "
+            "replacing the shuffle hop with ICI collectives",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            MESH_DEVICES,
+            "mesh width for gang stages (0 = all visible devices)",
+            int,
+            "0",
+        ),
+        ConfigEntry(
+            SHUFFLE_TO_MEMORY,
+            "hold shuffle partitions in executor memory (served via Flight) "
+            "instead of Arrow IPC files on disk",
+            _parse_bool,
+            "false",
+        ),
     ]
 }
 
@@ -195,6 +218,18 @@ class BallistaConfig:
     @property
     def tpu_min_rows(self) -> int:
         return self._get(TPU_MIN_ROWS)
+
+    @property
+    def mesh_enable(self) -> bool:
+        return self._get(MESH_ENABLE)
+
+    @property
+    def mesh_devices(self) -> int:
+        return self._get(MESH_DEVICES)
+
+    @property
+    def shuffle_to_memory(self) -> bool:
+        return self._get(SHUFFLE_TO_MEMORY)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -330,8 +330,9 @@ class SessionContext:
     def create_physical_plan(self, logical: lp.LogicalPlan) -> ExecutionPlan:
         phys = PhysicalPlanner(self.config).create_physical_plan(logical)
         from .ops.stage_compiler import maybe_accelerate
+        from .parallel.mesh_stage import maybe_mesh
 
-        return maybe_accelerate(phys, self.config)
+        return maybe_mesh(maybe_accelerate(phys, self.config), self.config)
 
     def execute(self, plan: ExecutionPlan) -> pa.Table:
         return collect(plan, self.task_context())

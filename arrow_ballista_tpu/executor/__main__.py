@@ -87,6 +87,9 @@ class ShuffleJanitor(threading.Thread):
             self.sweep(0)
 
     def sweep(self, ttl_s: float) -> None:
+        from ..shuffle import memory_store
+
+        memory_store.sweep(ttl_s)
         now = time.time()
         try:
             entries = os.listdir(self.work_dir)

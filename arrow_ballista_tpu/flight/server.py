@@ -31,6 +31,16 @@ class ShuffleFlightService(flight.FlightServerBase):
             msg.ParseFromString(ticket.ticket)
         except Exception as e:
             raise flight.FlightServerError(f"invalid ticket: {e}")
+        from ..shuffle import memory_store
+
+        if msg.path.startswith(memory_store.SCHEME):
+            hit = memory_store.get(msg.path)
+            if hit is None:
+                raise flight.FlightServerError(
+                    f"no such memory partition {msg.path!r}"
+                )
+            schema, batches = hit
+            return flight.GeneratorStream(schema, iter(batches))
         path = os.path.abspath(msg.path)
         # only serve files inside the work dir (the ticket's path originates
         # from this executor's own shuffle-write stats, but never trust it)

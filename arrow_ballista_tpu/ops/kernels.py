@@ -687,16 +687,21 @@ def make_partial_agg_kernel(
 
 
 def combine_states(
-    specs: list[KernelAggSpec], acc: Optional[tuple], new: tuple
+    specs: list[KernelAggSpec],
+    acc: Optional[tuple],
+    new: tuple,
+    mode: Optional[str] = None,
 ) -> tuple:
     """Merge per-batch kernel outputs (device-side, cheap elementwise).
 
     In x32 mode sum/avg states are double-float (hi, lo) pairs merged with
     an error-free 2Sum so cross-batch accumulation keeps ~f64 precision.
+    ``mode`` must be the mode the kernel was BUILT under (the owning
+    TpuStageExec pins it); the global is only a fallback.
     """
     if acc is None:
         return new
-    mode = precision_mode()
+    mode = mode or precision_mode()
     out = []
     i = 0
     for spec in specs:

@@ -218,9 +218,12 @@ class TpuStageExec(ExecutionPlan):
             kernel = K.make_partial_agg_kernel(
                 filter_closure, arg_closures, specs, self.capacity, self._flat_names
             )
-            cached = jax.jit(kernel)
+            cached = (kernel, jax.jit(kernel))
             _KERNEL_CACHE[sig] = cached
-        self._jit_kernel = cached
+        # raw kernel kept for mesh gang execution: shard_map needs the
+        # untraced function to wrap with the cross-chip reduction
+        self._raw_kernel, self._jit_kernel = cached
+        self._sig = sig
 
     @property
     def schema(self) -> pa.Schema:
@@ -319,7 +322,7 @@ class TpuStageExec(ExecutionPlan):
                     with self.metrics.timer("device_time_ns"):
                         for seg, valid, args in entries:
                             out = self._jit_kernel(seg, valid, *args)
-                            acc = K.combine_states(self.specs, acc, out)
+                            acc = K.combine_states(self.specs, acc, out, self._mode)
                 self.metrics.add("cache_hits", 1)
                 yield from self._materialize(
                     acc, key_encoders, gid_tuples, n_rows_in, ctx, partition
@@ -385,7 +388,7 @@ class TpuStageExec(ExecutionPlan):
                         args = [jax.device_put(a) for a in args]
                         entries.append((seg, valid, args))
                     out = self._jit_kernel(seg, valid, *args)
-                    acc = K.combine_states(self.specs, acc, out)
+                    acc = K.combine_states(self.specs, acc, out, self._mode)
 
         if ck is not None and acc is not None:
             device_cache.put(

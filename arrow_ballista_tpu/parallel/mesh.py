@@ -35,6 +35,7 @@ def make_distributed_agg_step(
     specs,
     mesh: Mesh,
     capacity: int,
+    mode: Optional[str] = None,
 ):
     """Wrap a fused partial-agg kernel so it runs sharded over the mesh.
 
@@ -50,7 +51,9 @@ def make_distributed_agg_step(
 
     from ..ops import kernels as K
 
-    mode = K.precision_mode()
+    # the mode must match the one the kernel was BUILT under (pinned by
+    # the owning TpuStageExec); the global is only a fallback
+    mode = mode or K.precision_mode()
 
     def reduce_states(states):
         # per-field collective chosen by the kernel's state layout

@@ -1,0 +1,215 @@
+"""Mesh gang stages: whole-stage SPMD execution over the device mesh.
+
+This is the engine integration of :mod:`.mesh` (VERDICT.md round-1 item 3):
+the reference routes EVERY cross-stage exchange through the disk+Flight
+shuffle (``shuffle_writer.rs:142-292`` → ``flight_service.rs:80-118``); on
+a TPU host, partitions of a mesh-resident stage are SHARDS, and the
+partial-aggregate exchange collapses into ``psum``/``pmin``/``pmax`` over
+ICI inside one jit-compiled ``shard_map`` program.
+
+Mechanically: the distributed planner wraps an eligible stage subtree
+(filter→project→partial-aggregate, the same shapes ``maybe_accelerate``
+fuses) in a :class:`MeshGangExec` whose output partitioning is 1 — so the
+scheduler naturally creates ONE task for the stage, and the executor that
+receives it runs every input partition as a shard of a single mesh
+program.  Nothing else in the graph/task machinery changes: recovery,
+retries and stats see an ordinary one-task stage.  The reduced
+[capacity]-sized states are the only thing that leaves the device.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..exec.operators import ExecutionPlan, Partitioning, TaskContext
+
+# jitted shard_map step per (kernel signature, n_devices): reused across
+# plan instances exactly like stage_compiler._KERNEL_CACHE
+_MESH_STEP_CACHE: dict = {}
+
+
+def gang_eligible(plan: ExecutionPlan) -> bool:
+    """Structural check (no kernel build, no device touch — safe on the
+    scheduler): does this stage subtree fuse into a partial-aggregate
+    kernel whose states reduce with mesh collectives?"""
+    from ..exec.aggregates import PARTIAL, HashAggregateExec
+    from ..ops.stage_compiler import _flatten
+
+    if not isinstance(plan, HashAggregateExec) or plan.mode != PARTIAL:
+        return False
+    if any(
+        a.func == "count_distinct" or a.func.startswith("udaf:")
+        for a in plan.aggs
+    ):
+        return False
+    return _flatten(plan) is not None
+
+
+class MeshGangExec(ExecutionPlan):
+    """Runs a whole stage as one shard_map program over the mesh.
+
+    Output partitioning is always 1: the scheduler sees a one-task stage.
+    Execution accelerates the subtree (``maybe_accelerate``) and, when it
+    fused, shards ALL input partitions over the mesh's data axis, reduces
+    the per-device states over ICI and materializes the combined partial
+    result.  Any fusion/capacity failure falls back to executing the input
+    partitions sequentially inside the same task — still correct, just
+    without the collective.
+    """
+
+    def __init__(self, input: ExecutionPlan, n_devices: int = 0):
+        super().__init__()
+        self.input = input
+        self.n_devices = n_devices
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self.input.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return MeshGangExec(children[0], self.n_devices)
+
+    def __str__(self) -> str:
+        n = self.n_devices or "auto"
+        return f"MeshGangExec: devices={n}"
+
+    # ------------------------------------------------------------ execute
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        assert partition == 0, "gang stages are single-task"
+        from ..ops.stage_compiler import TpuStageExec, maybe_accelerate
+
+        from ..errors import ExecutionError
+        from ..ops.stage_compiler import _CapacityExceeded
+
+        inner = self.input
+        if not isinstance(inner, TpuStageExec):
+            inner = maybe_accelerate(inner, ctx.config)
+        if isinstance(inner, TpuStageExec) and ctx.config.tpu_enable:
+            try:
+                # fully materialized before yielding: a capacity fallback
+                # must never follow already-emitted rows with a re-run
+                batches = list(self._execute_mesh(inner, ctx))
+                yield from batches
+                return
+            except (_CapacityExceeded, ExecutionError):
+                # group capacity overflow or a type that slipped past
+                # plan-time lowering: re-run sequentially (Cancelled and
+                # real bugs propagate — they are not fusion failures)
+                self.metrics.add("mesh_fallback", 1)
+        yield from self._execute_sequential(inner, ctx)
+
+    def _execute_sequential(
+        self, inner: ExecutionPlan, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        for p in range(self.input.output_partitioning().n):
+            yield from inner.execute(p, ctx)
+
+    def _execute_mesh(self, tpu, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        """All input partitions → one sharded fused kernel + ICI reduce."""
+        import jax
+
+        from ..ops import kernels as K
+        from ..ops.bridge import DictEncoder
+        from . import mesh as M
+
+        fused = tpu.fused
+        n_dev = self.n_devices or ctx.config.mesh_devices or len(jax.devices())
+        n_dev = max(1, min(n_dev, len(jax.devices())))
+
+        key_encoders = [DictEncoder() for _ in fused.group_exprs]
+        tuple_gids: dict = {}
+        gid_tuples: list = []
+        segs: list[np.ndarray] = []
+        leaf_arrays: dict[str, list[np.ndarray]] = {
+            nm: [] for nm in tpu._flat_names
+        }
+        n_rows = 0
+        n_parts = fused.source.output_partitioning().n
+        with self.metrics.timer("mesh_stage_time_ns"):
+            for p in range(n_parts):
+                for batch in fused.source.execute(p, ctx):
+                    ctx.check_cancelled()
+                    if batch.num_rows == 0:
+                        continue
+                    n = batch.num_rows
+                    if fused.group_exprs:
+                        with self.metrics.timer("key_encode_time_ns"):
+                            seg = tpu._encode_groups(
+                                batch, key_encoders, tuple_gids, gid_tuples
+                            )
+                    else:
+                        seg = np.zeros(n, dtype=np.int32)
+                    segs.append(seg)
+                    with self.metrics.timer("bridge_time_ns"):
+                        env = K.build_env(batch, tpu.leaves, n)
+                    for nm in tpu._flat_names:
+                        leaf_arrays[nm].append(env[nm])
+                    n_rows += n
+
+            if n_rows == 0:
+                yield from tpu._materialize(
+                    None, key_encoders, gid_tuples, 0, ctx, 0
+                )
+                return
+
+            seg = np.concatenate(segs)
+            valid = np.ones(n_rows, dtype=bool)
+            args = [
+                np.concatenate(leaf_arrays[nm]) for nm in tpu._flat_names
+            ]
+
+            step_key = (tpu._sig, n_dev)
+            step = _MESH_STEP_CACHE.get(step_key)
+            if step is None:
+                mesh = M.make_mesh(n_dev)
+                step = M.make_distributed_agg_step(
+                    tpu._raw_kernel, tpu.specs, mesh, tpu.capacity, tpu._mode
+                )
+                _MESH_STEP_CACHE[step_key] = step
+            with self.metrics.timer("device_time_ns"):
+                mesh = M.make_mesh(n_dev)
+                sharded = M.shard_batch(mesh, [seg, valid] + args)
+                out = step(*sharded)
+                out = [o.block_until_ready() for o in out]
+        self.metrics.add("mesh_rows_in", n_rows)
+        self.metrics.add("mesh_devices", n_dev)
+        yield from tpu._materialize(
+            tuple(out), key_encoders, gid_tuples, n_rows, ctx, 0
+        )
+
+
+def maybe_mesh(plan: ExecutionPlan, config) -> ExecutionPlan:
+    """Physical-optimizer rule for the LOCAL engine (SessionContext): run
+    an accelerated partial-aggregate under Repartition/Coalesce as one
+    mesh gang so the local path exercises the same collectives as the
+    distributed gang stages."""
+    from ..exec.operators import CoalescePartitionsExec, RepartitionExec
+    from ..ops.stage_compiler import TpuStageExec
+
+    if not (config.mesh_enable and config.tpu_enable):
+        return plan
+    kids = plan.children()
+    if kids:
+        plan = plan.with_new_children([maybe_mesh(c, config) for c in kids])
+    if isinstance(plan, (RepartitionExec, CoalescePartitionsExec)):
+        child = plan.children()[0]
+        if (
+            isinstance(child, TpuStageExec)
+            and child.fused.mode == "partial"
+            and child.fused.source.output_partitioning().n > 1
+        ):
+            return plan.with_new_children(
+                [MeshGangExec(child, config.mesh_devices)]
+            )
+    return plan

@@ -65,6 +65,7 @@ class ExecutionGraph:
         session_id: str,
         plan: ExecutionPlan,
         work_dir: str = "/tmp/ballista-tpu",
+        config=None,
     ):
         self.scheduler_id = scheduler_id
         self.job_id = job_id
@@ -74,7 +75,7 @@ class ExecutionGraph:
         self.stages: Dict[int, Stage] = {}
         self.output_locations: List[PartitionLocation] = []
 
-        planner = DistributedPlanner(work_dir)
+        planner = DistributedPlanner(work_dir, config)
         stage_plans = planner.plan_query_stages(job_id, plan)
         self._final_stage_id = stage_plans[-1].stage_id
         self.output_partitions = stage_plans[-1].output_partitioning().n

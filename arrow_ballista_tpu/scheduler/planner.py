@@ -32,9 +32,29 @@ from ..shuffle import ShuffleReaderExec, ShuffleWriterExec, UnresolvedShuffleExe
 
 
 class DistributedPlanner:
-    def __init__(self, work_dir: str = "/tmp/ballista-tpu"):
+    def __init__(self, work_dir: str = "/tmp/ballista-tpu", config=None):
+        from ..config import BallistaConfig
+
         self.work_dir = work_dir
+        self.config = config or BallistaConfig()
         self._next_stage_id = 0
+
+    def _maybe_gang(self, plan: ExecutionPlan) -> ExecutionPlan:
+        """TPU-native stage form: when the stage subtree fuses into a
+        partial aggregate, run the WHOLE stage as one mesh gang task —
+        its cross-partition exchange happens via ICI collectives inside
+        the task, and only [capacity]-sized reduced states reach the
+        shuffle (replacing the per-partition disk+Flight hop the
+        reference always takes, shuffle_writer.rs:142-292)."""
+        from ..parallel.mesh_stage import MeshGangExec, gang_eligible
+
+        if not (self.config.mesh_enable and self.config.tpu_enable):
+            return plan
+        if plan.output_partitioning().n <= 1:
+            return plan  # single partition: nothing to gang
+        if gang_eligible(plan):
+            return MeshGangExec(plan, self.config.mesh_devices)
+        return plan
 
     def _new_stage_id(self) -> int:
         self._next_stage_id += 1
@@ -59,7 +79,9 @@ class DistributedPlanner:
             children.append(child_plan)
 
         if isinstance(plan, CoalescePartitionsExec):
-            writer = self._create_shuffle_writer(job_id, children[0], None)
+            writer = self._create_shuffle_writer(
+                job_id, self._maybe_gang(children[0]), None
+            )
             stages.append(writer)
             placeholder = UnresolvedShuffleExec(
                 writer.stage_id,
@@ -73,7 +95,9 @@ class DistributedPlanner:
         if isinstance(plan, RepartitionExec):
             part = plan.partitioning
             if part.kind == "hash":
-                writer = self._create_shuffle_writer(job_id, children[0], part)
+                writer = self._create_shuffle_writer(
+                    job_id, self._maybe_gang(children[0]), part
+                )
                 stages.append(writer)
                 placeholder = UnresolvedShuffleExec(
                     writer.stage_id,

@@ -124,8 +124,13 @@ class TaskManager:
         session_id: str,
         plan: ExecutionPlan,
     ) -> ExecutionGraph:
+        from ..config import BallistaConfig
+
+        # the session's config steers distributed planning (mesh gang
+        # stages, shuffle data plane) exactly as it steers acceleration
+        config = BallistaConfig(self._session_settings(session_id))
         graph = ExecutionGraph(
-            self.scheduler_id, job_id, session_id, plan, self.work_dir
+            self.scheduler_id, job_id, session_id, plan, self.work_dir, config
         )
         graph.revive()
         entry = self._entry(job_id)

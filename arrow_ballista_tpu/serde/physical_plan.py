@@ -175,6 +175,12 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.unresolved_shuffle.input_partition_count = plan.input_partition_count
         n.unresolved_shuffle.output_partition_count = plan.output_partition_count
         return n
+    from ..parallel.mesh_stage import MeshGangExec
+
+    if isinstance(plan, MeshGangExec):
+        n.mesh_gang.input.CopyFrom(physical_plan_to_proto(plan.input))
+        n.mesh_gang.n_devices = plan.n_devices
+        return n
     raise PlanError(f"cannot serialize physical plan {type(plan).__name__}")
 
 
@@ -292,4 +298,8 @@ def physical_plan_from_proto(
             n.unresolved_shuffle.input_partition_count,
             n.unresolved_shuffle.output_partition_count,
         )
+    if kind == "mesh_gang":
+        from ..parallel.mesh_stage import MeshGangExec
+
+        return MeshGangExec(rec(n.mesh_gang.input), n.mesh_gang.n_devices)
     raise PlanError(f"cannot deserialize physical plan node {kind!r}")

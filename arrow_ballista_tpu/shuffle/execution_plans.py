@@ -87,6 +87,39 @@ class _IpcFileSink:
         return os.path.getsize(self.path)
 
 
+class _MemSink:
+    """Memory-store sink with the same stats interface as _IpcFileSink.
+
+    TPU-first data plane: gang-stage outputs (and, with
+    ``ballista.shuffle.to_memory``, every shuffle partition) stay in
+    executor RAM and stream out of the Flight service without disk I/O.
+    """
+
+    def __init__(
+        self, job_id: str, stage_id: int, out_part: int, in_part: int,
+        schema: pa.Schema,
+    ):
+        from . import memory_store
+
+        self.path = memory_store.make_path(job_id, stage_id, out_part, in_part)
+        self._key = (job_id, stage_id, out_part, in_part)
+        self._schema = schema
+        self._batches: list[pa.RecordBatch] = []
+        self.num_rows = 0
+        self.num_batches = 0
+
+    def write(self, batch: pa.RecordBatch) -> None:
+        self._batches.append(batch)
+        self.num_rows += batch.num_rows
+        self.num_batches += 1
+
+    def close(self) -> int:
+        from . import memory_store
+
+        path = memory_store.put(*self._key, self._schema, self._batches)
+        return memory_store.put_size(path)
+
+
 class ShuffleWriterExec(ExecutionPlan):
     def __init__(
         self,
@@ -127,6 +160,24 @@ class ShuffleWriterExec(ExecutionPlan):
             self.shuffle_output_partitioning,
         )
 
+    def _use_memory(self, ctx: TaskContext) -> bool:
+        """Memory data plane: explicit config, or a mesh gang stage whose
+        (tiny, collective-reduced) output never belongs on disk."""
+        from ..parallel.mesh_stage import MeshGangExec
+
+        return ctx.config.shuffle_to_memory or isinstance(
+            self.input, MeshGangExec
+        )
+
+    def _sink(
+        self, to_mem: bool, stage_dir: str, out_part: int, in_part: int,
+        schema: pa.Schema, single_file: bool,
+    ):
+        if to_mem:
+            return _MemSink(self.job_id, self.stage_id, out_part, in_part, schema)
+        name = "data.arrow" if single_file else f"data-{in_part}.arrow"
+        return _IpcFileSink(os.path.join(stage_dir, str(out_part), name), schema)
+
     # ------------------------------------------------------------- core
     def execute_shuffle_write(
         self, input_partition: int, ctx: TaskContext
@@ -135,24 +186,30 @@ class ShuffleWriterExec(ExecutionPlan):
         output (reference: shuffle_writer.rs:142-292)."""
         stage_dir = os.path.join(self.work_dir, self.job_id, str(self.stage_id))
         part = self.shuffle_output_partitioning
+        to_mem = self._use_memory(ctx)
 
         if part is None:
-            # no repartition: single output file for this input partition
-            path = os.path.join(stage_dir, str(input_partition), "data.arrow")
-            sink: Optional[_IpcFileSink] = None
+            # no repartition: single output sink for this input partition
+            sink = None
             with self.metrics.timer("write_time_ns"):
                 for batch in self.input.execute(input_partition, ctx):
                     ctx.check_cancelled()
                     if sink is None:
-                        sink = _IpcFileSink(path, batch.schema)
+                        sink = self._sink(
+                            to_mem, stage_dir, input_partition,
+                            input_partition, batch.schema, True,
+                        )
                     sink.write(batch)
                 if sink is None:
-                    sink = _IpcFileSink(path, self.input.schema)
+                    sink = self._sink(
+                        to_mem, stage_dir, input_partition, input_partition,
+                        self.input.schema, True,
+                    )
                 nbytes = sink.close()
             self.metrics.add("output_rows", sink.num_rows)
             return [
                 ShuffleWritePartition(
-                    input_partition, path, sink.num_batches, sink.num_rows, nbytes
+                    input_partition, sink.path, sink.num_batches, sink.num_rows, nbytes
                 )
             ]
 
@@ -163,11 +220,7 @@ class ShuffleWriterExec(ExecutionPlan):
 
         n_out = part.n
         exprs = list(part.exprs)
-        sinks: list[Optional[_IpcFileSink]] = [None] * n_out
-        paths = [
-            os.path.join(stage_dir, str(p), f"data-{input_partition}.arrow")
-            for p in range(n_out)
-        ]
+        sinks: list = [None] * n_out
         in_schema = self.input.schema
         for batch in self.input.execute(input_partition, ctx):
             ctx.check_cancelled()
@@ -183,15 +236,20 @@ class ShuffleWriterExec(ExecutionPlan):
                     if hi <= lo:
                         continue
                     if sinks[p] is None:
-                        sinks[p] = _IpcFileSink(paths[p], batch.schema)
+                        sinks[p] = self._sink(
+                            to_mem, stage_dir, p, input_partition,
+                            batch.schema, False,
+                        )
                     sinks[p].write(shuffled.slice(lo, hi - lo))
         out = []
         with self.metrics.timer("write_time_ns"):
             for p in range(n_out):
                 s = sinks[p]
                 if s is None:
-                    # write an empty file so readers need no existence probe
-                    s = _IpcFileSink(paths[p], in_schema)
+                    # empty sink so readers need no existence probe
+                    s = self._sink(
+                        to_mem, stage_dir, p, input_partition, in_schema, False
+                    )
                 nbytes = s.close()
                 self.metrics.add("output_rows", s.num_rows)
                 out.append(
@@ -253,8 +311,16 @@ class ShuffleReaderExec(ExecutionPlan):
                 yield b
 
     def _fetch(self, loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+        from . import memory_store
+
+        if loc.path and loc.path.startswith(memory_store.SCHEME):
+            # memory data plane: same-process fast path, Flight otherwise
+            hit = memory_store.get(loc.path)
+            if hit is not None:
+                yield from hit[1]
+                return
         # local fast path: the file is on this machine's filesystem
-        if loc.path and os.path.exists(loc.path):
+        elif loc.path and os.path.exists(loc.path):
             with pa.OSFile(loc.path, "rb") as f:
                 reader = pa.ipc.open_file(f)
                 for i in range(reader.num_record_batches):

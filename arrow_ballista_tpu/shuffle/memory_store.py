@@ -1,0 +1,129 @@
+"""In-process memory shuffle store: the TPU-first shuffle data plane.
+
+The reference persists every shuffle partition as an Arrow IPC file and
+serves it over Flight (``shuffle_writer.rs:142-292`` →
+``flight_service.rs:80-118``).  On a TPU host the data either stays on the
+mesh (gang stages exchange via ICI collectives) or — for stage outputs
+that must cross a process/host boundary — can be held in RAM and streamed
+straight out of the executor's Flight service without touching disk.
+
+Paths use the scheme ``mem://<job>/<stage>/<out_partition>/<in_partition>``
+so PartitionLocation / ShuffleWritePartition stats, the scheduler graph,
+and fault recovery are completely unchanged: a lost executor loses its
+memory partitions exactly like its local files, and ``reset_stages`` rolls
+the producing stage back the same way.
+
+Lifetime mirrors the shuffle janitor's job-directory GC: ``delete_job`` is
+called wherever job work-dirs are removed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+SCHEME = "mem://"
+
+_lock = threading.Lock()
+# values are compact Arrow IPC stream buffers, NOT RecordBatch lists: a
+# stored batch slice would pin its parent batch's entire allocation (and
+# overstate stats); serializing compacts to exactly the partition's bytes,
+# and readers reopen the buffer zero-copy
+_store: Dict[Tuple[str, int, int, int], pa.Buffer] = {}
+_job_touched: Dict[str, float] = {}  # job_id -> last put() wall time
+
+
+def make_path(job_id: str, stage_id: int, out_part: int, in_part: int) -> str:
+    return f"{SCHEME}{job_id}/{stage_id}/{out_part}/{in_part}"
+
+
+def parse_path(path: str) -> Optional[Tuple[str, int, int, int]]:
+    if not path.startswith(SCHEME):
+        return None
+    parts = path[len(SCHEME):].split("/")
+    if len(parts) != 4:
+        return None
+    return parts[0], int(parts[1]), int(parts[2]), int(parts[3])
+
+
+def put(
+    job_id: str,
+    stage_id: int,
+    out_part: int,
+    in_part: int,
+    schema: pa.Schema,
+    batches: List[pa.RecordBatch],
+) -> str:
+    import time
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        for b in batches:
+            writer.write_batch(b)
+    buf = sink.getvalue()
+
+    key = (job_id, stage_id, out_part, in_part)
+    with _lock:
+        _store[key] = buf
+        _job_touched[job_id] = time.time()
+    return make_path(*key)
+
+
+def put_size(path: str) -> int:
+    key = parse_path(path)
+    with _lock:
+        buf = _store.get(key) if key else None
+    return buf.size if buf is not None else 0
+
+
+def get(path: str) -> Optional[Tuple[pa.Schema, List[pa.RecordBatch]]]:
+    key = parse_path(path)
+    if key is None:
+        return None
+    with _lock:
+        buf = _store.get(key)
+    if buf is None:
+        return None
+    with pa.ipc.open_stream(buf) as reader:
+        batches = list(reader)
+        return reader.schema, batches
+
+
+def delete_job(job_id: str) -> int:
+    with _lock:
+        keys = [k for k in _store if k[0] == job_id]
+        for k in keys:
+            del _store[k]
+        _job_touched.pop(job_id, None)
+    return len(keys)
+
+
+def sweep(ttl_s: float) -> List[str]:
+    """Drop jobs idle longer than ttl_s (the janitor's memory analogue of
+    the work-dir sweep)."""
+    import time
+
+    now = time.time()
+    with _lock:
+        stale = [j for j, t in _job_touched.items() if now - t > ttl_s]
+    for j in stale:
+        delete_job(j)
+    return stale
+
+
+def job_ids() -> List[str]:
+    with _lock:
+        return sorted({k[0] for k in _store})
+
+
+def stored_bytes() -> int:
+    with _lock:
+        return sum(buf.size for buf in _store.values())
+
+
+def clear() -> None:
+    with _lock:
+        _store.clear()
+        _job_touched.clear()

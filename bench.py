@@ -40,14 +40,15 @@ def _emit() -> None:
 
 
 def _collect_stage_metrics(plan) -> dict:
-    """Walk the executed physical plan and sum TpuStageExec metric timers."""
+    """Walk the executed physical plan and sum device-stage metric timers."""
     from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+    from arrow_ballista_tpu.parallel.mesh_stage import MeshGangExec
 
     agg: dict = {}
     stack = [plan]
     while stack:
         node = stack.pop()
-        if isinstance(node, TpuStageExec):
+        if isinstance(node, (TpuStageExec, MeshGangExec)):
             for k, v in node.metrics.values.items():
                 agg[k] = agg.get(k, 0) + v
         stack.extend(node.children())
@@ -124,8 +125,9 @@ def main() -> None:
         except Exception:
             return None
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        probed = "cpu"  # explicit dev/test override: don't probe hardware
+    explicit_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if explicit_cpu:
+        probed = "cpu"  # intentional dev/test platform: no probe, no error
     else:
         probed = _probe_device(180)
         if probed in (None, "timeout"):
@@ -135,7 +137,8 @@ def main() -> None:
     import jax
 
     if probed in (None, "timeout", "cpu"):
-        RESULT["error"] = "device init unavailable (probe=%s)" % probed
+        if not explicit_cpu:
+            RESULT["error"] = "device init unavailable (probe=%s)" % probed
         jax.config.update("jax_platforms", "cpu")
     platform = jax.default_backend()
 

@@ -74,8 +74,13 @@ def physical(ctx, sql):
 
 
 def make_graph(sql, partitions=2, job_id="job1"):
+    # ctx disables TPU acceleration, so pass its config through: these
+    # tests model the reference's per-partition task mechanics (a mesh
+    # gang stage would collapse the map stage to one task)
     ctx = make_ctx(partitions)
-    return ExecutionGraph("sched-1", job_id, ctx.session_id, physical(ctx, sql))
+    return ExecutionGraph(
+        "sched-1", job_id, ctx.session_id, physical(ctx, sql), config=ctx.config
+    )
 
 
 def complete_task(graph, task, executor):

@@ -1,0 +1,211 @@
+"""Mesh execution wired into the ENGINE (VERDICT.md round-1 item 3).
+
+The round-1 gap: parallel/mesh.py was only reachable from tests and the
+graft entry.  These tests prove the collectives now run inside the real
+query path — SessionContext locally, BallistaContext through the
+scheduler/executor — replacing the ShuffleWriter→Flight→ShuffleReader hop
+for eligible stages, with zero shuffle files when the memory data plane
+is on.
+"""
+
+import glob
+import os
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.parallel.mesh_stage import MeshGangExec
+
+
+def _cfg(**extra):
+    settings = {
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.partitions": "2",
+    }
+    settings.update({k: str(v) for k, v in extra.items()})
+    return BallistaConfig(settings)
+
+
+def _register(ctx):
+    from benchmarks.tpch.datagen import register_all
+
+    register_all(ctx, sf=0.01, partitions=4)
+
+
+# ------------------------------------------------------------ local engine
+def test_local_plan_contains_mesh_gang():
+    from benchmarks.tpch.queries import QUERIES
+
+    ctx = SessionContext(_cfg())
+    _register(ctx)
+    assert "MeshGangExec" in ctx.sql(QUERIES[1]).explain()
+
+
+def test_local_q1_mesh_uses_collectives_and_matches():
+    from benchmarks.tpch.queries import QUERIES
+
+    ctx_mesh = SessionContext(_cfg())
+    ctx_off = SessionContext(
+        _cfg(**{"ballista.mesh.enable": "false", "ballista.tpu.enable": "false"})
+    )
+    _register(ctx_mesh)
+    _register(ctx_off)
+
+    df = ctx_mesh.sql(QUERIES[1])
+    plan = df.physical_plan()
+    got = ctx_mesh.execute(plan)
+    want = ctx_off.sql(QUERIES[1]).collect()
+
+    # the mesh program actually ran (not the sequential fallback)
+    gangs = _find(plan, MeshGangExec)
+    assert gangs, "no MeshGangExec in executed plan"
+    m = gangs[0].metrics.to_dict()
+    assert m.get("mesh_devices") == 8, m
+    assert m.get("mesh_rows_in", 0) > 0, m
+    assert "mesh_fallback" not in m, m
+
+    assert got.num_rows == want.num_rows
+    for name in want.schema.names:
+        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
+            if isinstance(x, float):
+                assert y == pytest.approx(x, rel=1e-9), name
+            else:
+                assert x == y, name
+
+
+def _find(plan, cls):
+    out = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, cls):
+            out.append(n)
+        stack.extend(n.children())
+    return out
+
+
+# ------------------------------------------------------- distributed plan
+def test_distributed_planner_gangs_partial_agg_stage():
+    from arrow_ballista_tpu.scheduler.planner import DistributedPlanner
+
+    ctx = SessionContext(_cfg(**{"ballista.tpu.enable": "true"}))
+    _register(ctx)
+    from benchmarks.tpch.queries import QUERIES
+
+    # unaccelerated physical plan, as the scheduler sees it
+    from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+
+    phys = PhysicalPlanner(ctx.config).create_physical_plan(
+        ctx.sql(QUERIES[1]).optimized_plan()
+    )
+    stages = DistributedPlanner("/tmp/unused", ctx.config).plan_query_stages(
+        "jobx", phys
+    )
+    gang_stages = [
+        s for s in stages if isinstance(s.input, MeshGangExec)
+    ]
+    assert gang_stages, "partial-agg stage was not gang-wrapped"
+    for s in gang_stages:
+        assert s.output_partitioning().n == 1  # one task for the scheduler
+
+
+def test_mesh_gang_serde_roundtrip():
+    from arrow_ballista_tpu.serde import BallistaCodec
+
+    ctx = SessionContext(_cfg())
+    _register(ctx)
+    from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import DistributedPlanner
+    from benchmarks.tpch.queries import QUERIES
+
+    phys = PhysicalPlanner(ctx.config).create_physical_plan(
+        ctx.sql(QUERIES[6]).optimized_plan()
+    )
+    stages = DistributedPlanner("/tmp/unused", ctx.config).plan_query_stages(
+        "joby", phys
+    )
+    gang = next(s for s in stages if isinstance(s.input, MeshGangExec))
+    blob = BallistaCodec.encode_physical(gang)
+    back = BallistaCodec.decode_physical(blob, "/tmp/unused")
+    assert isinstance(back.input, MeshGangExec)
+    assert back.input.n_devices == gang.input.n_devices
+    assert str(back.input.input.schema) == str(gang.input.input.schema)
+
+
+# ------------------------------------------------- distributed end-to-end
+def test_distributed_q1_zero_shuffle_files_matches_flight_path(tmp_path):
+    """THE round-2 acceptance test: q1 through BallistaContext with mesh
+    gang + memory data plane writes NO shuffle files and matches the
+    disk+Flight answer."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.shuffle import memory_store
+    from benchmarks.tpch.datagen import gen_lineitem
+    from benchmarks.tpch.queries import QUERIES
+
+    import pyarrow.parquet as pq
+
+    li = gen_lineitem(0.01)
+    pq.write_table(li, str(tmp_path / "lineitem.parquet"))
+
+    def run(mesh: bool, work_dir: str):
+        cfg = _cfg(
+            **{
+                "ballista.mesh.enable": str(mesh).lower(),
+                "ballista.shuffle.to_memory": str(mesh).lower(),
+                "ballista.tpu.enable": str(mesh).lower(),
+            }
+        )
+        bctx = BallistaContext.standalone(config=cfg, work_dir=work_dir)
+        try:
+            bctx.register_parquet("lineitem", str(tmp_path / "lineitem.parquet"))
+            out = bctx.sql(QUERIES[1]).collect()
+            return out, memory_store.job_ids()
+        finally:
+            bctx.close()
+
+    flight_dir = str(tmp_path / "wd_flight")
+    mesh_dir = str(tmp_path / "wd_mesh")
+    want, _ = run(False, flight_dir)
+    memory_store.clear()
+    got, mem_jobs = run(True, mesh_dir)
+
+    # the flight path wrote shuffle files; the mesh path wrote NONE
+    assert glob.glob(os.path.join(flight_dir, "**", "*.arrow"), recursive=True)
+    assert not glob.glob(os.path.join(mesh_dir, "**", "*.arrow"), recursive=True)
+    # its exchanges went through the memory plane, and close() released them
+    assert mem_jobs
+    assert not memory_store.job_ids()
+
+    assert got.num_rows == want.num_rows
+    got = got.sort_by(
+        [(got.column_names[0], "ascending"), (got.column_names[1], "ascending")]
+    )
+    want = want.sort_by(
+        [(want.column_names[0], "ascending"), (want.column_names[1], "ascending")]
+    )
+    for name in want.column_names:
+        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
+            if isinstance(x, float):
+                assert y == pytest.approx(x, rel=1e-9), name
+            else:
+                assert x == y, name
+
+
+def test_memory_partitions_served_over_flight(tmp_path):
+    """Cross-executor reads of memory partitions go through DoGet."""
+    from arrow_ballista_tpu.flight.client import BallistaClient
+    from arrow_ballista_tpu.flight.server import FlightServerHandle
+    from arrow_ballista_tpu.shuffle import memory_store
+
+    batch = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+    path = memory_store.put("jobf", 1, 0, 0, batch.schema, [batch])
+
+    handle = FlightServerHandle(str(tmp_path), "127.0.0.1", 0).start()
+    try:
+        client = BallistaClient.get("127.0.0.1", handle.port)
+        got = list(client.fetch_partition("jobf", 1, 0, path))
+        assert sum(b.num_rows for b in got) == 3
+    finally:
+        handle.shutdown()
+        memory_store.delete_job("jobf")
