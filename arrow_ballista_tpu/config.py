@@ -26,6 +26,7 @@ PLUGIN_DIR = "ballista.plugin_dir"
 # TPU-native additions
 TPU_ENABLE = "ballista.tpu.enable"
 TPU_SEGMENT_CAPACITY = "ballista.tpu.segment_capacity"
+TPU_MAX_CAPACITY = "ballista.tpu.max_capacity"
 TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
 TPU_DTYPE = "ballista.tpu.dtype"
 TPU_MIN_ROWS = "ballista.tpu.min_rows"
@@ -96,9 +97,17 @@ _ENTRIES: dict[str, ConfigEntry] = {
         ),
         ConfigEntry(
             TPU_SEGMENT_CAPACITY,
-            "fixed group-table capacity for on-device hash aggregation",
+            "initial group-table capacity for on-device hash aggregation "
+            "(grows 4x, with state padding, up to tpu.max_capacity)",
             int,
             "4096",
+        ),
+        ConfigEntry(
+            TPU_MAX_CAPACITY,
+            "group-table ceiling; cardinality beyond it falls back to the "
+            "CPU operator path",
+            int,
+            str(1 << 21),
         ),
         ConfigEntry(
             TPU_BATCH_ROWS,
@@ -206,6 +215,10 @@ class BallistaConfig:
     @property
     def tpu_segment_capacity(self) -> int:
         return self._get(TPU_SEGMENT_CAPACITY)
+
+    @property
+    def tpu_max_capacity(self) -> int:
+        return self._get(TPU_MAX_CAPACITY)
 
     @property
     def tpu_batch_rows(self) -> int:

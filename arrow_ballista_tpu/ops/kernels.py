@@ -686,6 +686,35 @@ def make_partial_agg_kernel(
     return fn
 
 
+def pad_states(
+    specs: list[KernelAggSpec],
+    acc: Optional[tuple],
+    new_cap: int,
+    mode: str,
+):
+    """Grow accumulated [old_cap] states to [new_cap] (adaptive segment
+    capacity): additive fields pad with 0, extrema with their identity.
+    Existing group ids stay valid — the host encoder assigns them
+    monotonically."""
+    if acc is None:
+        return None
+    out = []
+    i = 0
+    old_cap = acc[0].shape[0]
+    grow = new_cap - old_cap
+    for spec in specs:
+        for role in state_fields(spec, mode):
+            ident = (
+                jnp.inf if role == "min" else -jnp.inf if role == "max" else 0
+            )
+            out.append(
+                jnp.pad(acc[i], (0, grow), constant_values=ident)
+            )
+            i += 1
+    out.append(jnp.pad(acc[-1], (0, grow)))  # presence
+    return tuple(out)
+
+
 def combine_states(
     specs: list[KernelAggSpec],
     acc: Optional[tuple],

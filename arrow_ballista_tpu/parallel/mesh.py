@@ -92,6 +92,213 @@ def make_distributed_agg_step(
 
 
 # ------------------------------------------------- on-device repartition
+def ici_batch_exchange(mesh: Mesh, n_cols: int, capacity: int):
+    """Multi-column hash-repartition exchange over ICI.
+
+    Generalizes :func:`ici_all_to_all_repartition` (single f64 column) to a
+    typed multi-column payload (VERDICT.md round-1 item 4): the routing —
+    stable sort by destination, per-destination staging slots, overflow
+    accounting — is computed ONCE from (dest, valid), then every column
+    scatters into its own [n_dev, capacity] staging buffer and rides its
+    own ``all_to_all``.  Columns may be any device dtype (f32/f64, i32,
+    bool, dictionary codes); validity masks travel as ordinary bool
+    columns.
+
+    Returns ``fn(dest i32[rows], valid bool[rows], *cols) →
+    (*recv_cols [n_dev*capacity], recv_valid bool[n_dev*capacity],
+    n_dropped i32)``.  ``n_dropped`` is the global count of valid rows that
+    overflowed a (source, destination) bucket — callers MUST re-run with a
+    larger capacity (or fall back to the Flight shuffle) when non-zero.
+    """
+    from jax import shard_map
+
+    n_dev = mesh.devices.size
+
+    def local_exchange(dest, valid, *cols):
+        rows = dest.shape[0]
+        dest_m = jnp.where(valid, dest, n_dev)
+        order = jnp.argsort(dest_m, stable=True)
+        dest_s = dest_m[order]
+        counts = jax.ops.segment_sum(
+            jnp.ones(rows, jnp.int32), dest_s, num_segments=n_dev + 1
+        )[:n_dev]
+        offsets = jnp.cumsum(counts) - counts
+        safe_dest = jnp.minimum(dest_s, n_dev - 1)
+        idx_within = jnp.arange(rows, dtype=jnp.int32) - offsets[safe_dest]
+        ok = (dest_s < n_dev) & (idx_within >= 0) & (idx_within < capacity)
+        overflow = (dest_s < n_dev) & (idx_within >= capacity)
+        n_dropped = jax.lax.psum(
+            jnp.sum(overflow.astype(jnp.int32)), DATA_AXIS
+        )
+        slot = jnp.where(ok, idx_within, capacity)
+
+        def route(c, fill_ok=False):
+            cs = (ok if fill_ok else c[order])
+            stage = jnp.zeros((n_dev, capacity + 1), cs.dtype)
+            stage = stage.at[safe_dest, slot].set(cs, mode="drop")
+            stage = stage[:, :capacity]
+            return jax.lax.all_to_all(
+                stage, DATA_AXIS, split_axis=0, concat_axis=0, tiled=False
+            ).reshape(-1)
+
+        recv_cols = tuple(route(c) for c in cols)
+        recv_valid = route(None, fill_ok=True)
+        return recv_cols + (recv_valid, n_dropped)
+
+    fn = shard_map(
+        local_exchange,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS),) * (2 + n_cols),
+        out_specs=(P(DATA_AXIS),) * (n_cols + 1) + (P(),),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class BatchExchanger:
+    """Schema-aware host bridge around :func:`ici_batch_exchange`.
+
+    Turns RecordBatches into device columns (value + validity per field;
+    strings as shared dictionary codes; i64 as exact lo/hi i32 pairs when
+    the device dtype mode is x32), runs the on-mesh exchange, and
+    reassembles per-destination RecordBatches.
+    """
+
+    def __init__(self, mesh: Mesh, schema, capacity: int):
+        import pyarrow as pa
+
+        from ..ops import kernels as K
+        from ..ops.bridge import DictEncoder
+
+        self.mesh = mesh
+        self.schema = schema
+        self.capacity = capacity
+        self._x32 = K.precision_mode() == "x32"
+        # per-field device layout: "num" (one array), "dict" (codes),
+        # "i64pair" (lo/hi split — exchange-exact without device i64)
+        self.layout: list[tuple] = []
+        self.encoders: dict[int, DictEncoder] = {}
+        for i, f in enumerate(schema):
+            t = f.type
+            if pa.types.is_string(t) or pa.types.is_large_string(t):
+                self.encoders[i] = DictEncoder()
+                self.layout.append(("dict", i))
+            elif self._x32 and (
+                pa.types.is_int64(t)
+                or pa.types.is_uint64(t)
+                or pa.types.is_date64(t)
+                or pa.types.is_timestamp(t)
+            ):
+                self.layout.append(("i64pair", i))
+            else:
+                self.layout.append(("num", i))
+        self.n_cols = sum(
+            2 if kind == "i64pair" else 1 for kind, _ in self.layout
+        ) + len(self.layout)  # +1 validity per field
+        self._fn = ici_batch_exchange(mesh, self.n_cols, capacity)
+
+    # ------------------------------------------------------------- host →
+    def to_columns(self, batch) -> list[np.ndarray]:
+        """Flatten one RecordBatch into the exchange's column list."""
+        import pyarrow.compute as pc
+
+        from ..ops.bridge import arrow_to_numpy
+
+        cols: list[np.ndarray] = []
+        for kind, i in self.layout:
+            arr = batch.column(i)
+            if kind == "dict":
+                codes = self.encoders[i].encode(arr)
+                validity = (
+                    np.asarray(pc.is_valid(arr))
+                    if arr.null_count
+                    else np.ones(len(arr), bool)
+                )
+                cols.append(codes)
+            else:
+                values, validity = arrow_to_numpy(
+                    arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+                )
+                if validity is None:
+                    validity = np.ones(len(values), bool)
+                if kind == "i64pair":
+                    v = values.astype(np.int64)
+                    cols.append((v & 0xFFFFFFFF).astype(np.uint32).view(np.int32))
+                    cols.append((v >> 32).astype(np.int32))
+                else:
+                    if self._x32 and values.dtype == np.float64:
+                        values = values.astype(np.float32)
+                    cols.append(values)
+            cols.append(validity)
+        return cols
+
+    # ------------------------------------------------------------ exchange
+    def exchange(self, dest: np.ndarray, valid: np.ndarray, cols):
+        """Run the sharded exchange; returns (recv_cols, recv_valid,
+        n_dropped) as host arrays."""
+        sharded = shard_batch(self.mesh, [dest, valid] + list(cols))
+        out = self._fn(*sharded)
+        host = [np.asarray(o) for o in out[:-1]]
+        return host[:-1], host[-1], int(np.asarray(out[-1]))
+
+    # ------------------------------------------------------------- → host
+    def to_batches(self, recv_cols, recv_valid) -> list:
+        """Reassemble one RecordBatch per destination device."""
+        import pyarrow as pa
+
+        n_dev = self.mesh.devices.size
+        per_dev = len(recv_valid) // n_dev
+        out = []
+        for d in range(n_dev):
+            sl = slice(d * per_dev, (d + 1) * per_dev)
+            mask = recv_valid[sl]
+            arrays = []
+            ci = 0
+            for kind, i in self.layout:
+                f = self.schema.field(i)
+                if kind == "i64pair":
+                    lo = recv_cols[ci][sl][mask].view(np.uint32).astype(np.int64)
+                    hi = recv_cols[ci + 1][sl][mask].astype(np.int64)
+                    values = (hi << 32) | lo
+                    ci += 2
+                else:
+                    values = recv_cols[ci][sl][mask]
+                    ci += 1
+                validity = recv_cols[ci][sl][mask]
+                ci += 1
+                if kind == "dict":
+                    rev = self.encoders[i].reverse
+                    pyvals = [
+                        rev[c] if ok else None
+                        for c, ok in zip(values.tolist(), validity.tolist())
+                    ]
+                    arrays.append(pa.array(pyvals, f.type))
+                else:
+                    arrays.append(
+                        pa.array(
+                            _cast_back(values, f.type),
+                            f.type,
+                            mask=~validity,
+                        )
+                    )
+            out.append(pa.RecordBatch.from_arrays(arrays, schema=self.schema))
+        return out
+
+
+def _cast_back(values: np.ndarray, t) -> np.ndarray:
+    import pyarrow as pa
+
+    if pa.types.is_date32(t):
+        return values.astype("datetime64[D]")
+    if pa.types.is_date64(t):
+        return values.astype("int64").view("datetime64[ms]")
+    if pa.types.is_timestamp(t):
+        return values.astype("int64").view(f"datetime64[{t.unit}]")
+    if pa.types.is_floating(t) and values.dtype == np.float32:
+        return values.astype(np.float64)
+    return values
+
+
 def ici_all_to_all_repartition(mesh: Mesh, capacity: int):
     """Build a sharded hash-repartition exchange over ICI.
 

@@ -169,12 +169,22 @@ class MeshGangExec(ExecutionPlan):
                 np.concatenate(leaf_arrays[nm]) for nm in tpu._flat_names
             ]
 
-            step_key = (tpu._sig, n_dev)
+            # same 4x capacity bucketing as the sequential device path —
+            # segment ids beyond the table would be dropped silently
+            cap = tpu.capacity
+            while cap < len(gid_tuples):
+                cap *= 4
+            cap = min(cap, tpu.max_capacity)
+            if cap > tpu.capacity:
+                self.metrics.add("capacity_growths", 1)
+
+            step_key = (tpu._sig, n_dev, cap)
             step = _MESH_STEP_CACHE.get(step_key)
             if step is None:
                 mesh = M.make_mesh(n_dev)
+                raw_kernel, _ = tpu._kernel_for(cap)
                 step = M.make_distributed_agg_step(
-                    tpu._raw_kernel, tpu.specs, mesh, tpu.capacity, tpu._mode
+                    raw_kernel, tpu.specs, mesh, cap, tpu._mode
                 )
                 _MESH_STEP_CACHE[step_key] = step
             with self.metrics.timer("device_time_ns"):

@@ -1,0 +1,183 @@
+"""h2o.ai db-benchmark harness: ``python -m benchmarks.h2o groupby --n 1e8``.
+
+Counterpart of the reference's ``benchmarks/db-benchmark/groupby-datafusion.py``
+(BASELINE.md config #5): generates the G1 dataset (n rows, k groups) and
+runs the standard groupby questions this engine's aggregate set covers
+(sums, means, min/max, counts — the median/sd/corr/window questions need
+aggregates outside the reference parity set and are reported as skipped),
+emitting one JSON line per question plus a summary line in the
+db-benchmark timings shape.
+
+The high-cardinality questions (id3, id6: ~n/k distinct groups) are
+exactly the shapes that stress the adaptive segment-capacity growth of
+the fused TPU aggregate path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+import pyarrow as pa
+
+QUESTIONS = [
+    ("q1", "sum v1 by id1",
+     "select id1, sum(v1) as v1 from x group by id1"),
+    ("q2", "sum v1 by id1:id2",
+     "select id1, id2, sum(v1) as v1 from x group by id1, id2"),
+    ("q3", "sum v1 mean v3 by id3",
+     "select id3, sum(v1) as v1, avg(v3) as v3 from x group by id3"),
+    ("q4", "mean v1:v3 by id4",
+     "select id4, avg(v1) as v1, avg(v2) as v2, avg(v3) as v3 "
+     "from x group by id4"),
+    ("q5", "sum v1:v3 by id6",
+     "select id6, sum(v1) as v1, sum(v2) as v2, sum(v3) as v3 "
+     "from x group by id6"),
+    ("q7", "max v1 - min v2 by id3",
+     "select id3, max(v1) - min(v2) as range_v1_v2 from x group by id3"),
+    ("q10", "sum v3 count by id1:id6",
+     "select id1, id2, id3, id4, id5, id6, sum(v3) as v3, count(*) as cnt "
+     "from x group by id1, id2, id3, id4, id5, id6"),
+]
+
+SKIPPED = [
+    ("q6", "median v3 sd v3 by id4 id5", "median/stddev not implemented"),
+    ("q8", "largest two v3 by id6", "window functions not implemented"),
+    ("q9", "regression v1 v2 by id2 id4", "corr not implemented"),
+]
+
+
+def gen_groupby(n: int, k: int, nas: int = 0, seed: int = 42) -> pa.Table:
+    """G1 dataset: n rows, k low-card groups, n/k high-card groups."""
+    rng = np.random.default_rng(seed)
+    hi = max(1, n // k)
+    id1 = rng.integers(1, k + 1, n)
+    id2 = rng.integers(1, k + 1, n)
+    id3 = rng.integers(1, hi + 1, n)
+
+    def idstr(vals, width):
+        # vectorized 'id%0*d' formatting via char arithmetic
+        return np.char.add(
+            "id", np.char.zfill(vals.astype(str), width)
+        )
+
+    tbl = pa.table(
+        {
+            "id1": pa.array(idstr(id1, 3).tolist(), pa.string()),
+            "id2": pa.array(idstr(id2, 3).tolist(), pa.string()),
+            "id3": pa.array(idstr(id3, 10).tolist(), pa.string()),
+            "id4": pa.array(rng.integers(1, k + 1, n), pa.int32()),
+            "id5": pa.array(rng.integers(1, k + 1, n), pa.int32()),
+            "id6": pa.array(rng.integers(1, hi + 1, n), pa.int32()),
+            "v1": pa.array(rng.integers(1, 6, n), pa.int32()),
+            "v2": pa.array(rng.integers(1, 16, n), pa.int32()),
+            "v3": pa.array(np.round(rng.uniform(0, 100, n), 6)),
+        }
+    )
+    return tbl
+
+
+def run_groupby(
+    n: int, k: int, partitions: int, tpu: bool, iters: int, out=sys.stdout
+) -> dict:
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    t0 = time.perf_counter()
+    data = gen_groupby(n, k)
+    gen_s = time.perf_counter() - t0
+
+    ctx = SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true" if tpu else "false",
+                "ballista.batch.size": str(1 << 21),
+                "ballista.shuffle.partitions": str(partitions),
+            }
+        )
+    )
+    ctx.register_table("x", MemoryTable.from_table(data, partitions))
+
+    results = []
+    for qid, desc, sql in QUESTIONS:
+        times = []
+        rows = 0
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out_tbl = ctx.sql(sql).collect()
+            times.append(time.perf_counter() - t0)
+            rows = out_tbl.num_rows
+        rec = {
+            "task": "groupby",
+            "question": f"{qid}: {desc}",
+            "data": f"G1_{n:.0e}_{k}_0_0".replace("+0", ""),
+            "time_sec": round(min(times), 4),
+            "out_rows": rows,
+            "engine": "tpu" if tpu else "cpu",
+        }
+        results.append(rec)
+        print(json.dumps(rec), file=out, flush=True)
+    for qid, desc, why in SKIPPED:
+        print(
+            json.dumps(
+                {"task": "groupby", "question": f"{qid}: {desc}", "skipped": why}
+            ),
+            file=out,
+            flush=True,
+        )
+    summary = {
+        "task": "groupby",
+        "rows": n,
+        "k": k,
+        "engine": "tpu" if tpu else "cpu",
+        "gen_sec": round(gen_s, 2),
+        "total_sec": round(sum(r["time_sec"] for r in results), 4),
+        "questions": len(results),
+    }
+    print(json.dumps(summary), file=out, flush=True)
+    return summary
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="benchmarks.h2o")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("groupby", help="G1 groupby benchmark")
+    g.add_argument("--n", type=float, default=1e7, help="rows (e.g. 1e8)")
+    g.add_argument("--k", type=int, default=100, help="group cardinality")
+    g.add_argument("--partitions", type=int, default=2)
+    g.add_argument("--iters", type=int, default=2)
+    g.add_argument(
+        "--engine", choices=["tpu", "cpu", "both"], default="both"
+    )
+    g.add_argument(
+        "--jax-platform",
+        default="",
+        help="force a jax platform (e.g. 'cpu') before backend init — the "
+        "config API override works where the JAX_PLATFORMS env var is "
+        "pinned by the session",
+    )
+    args = p.parse_args()
+
+    if getattr(args, "jax_platform", ""):
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
+
+    if args.cmd == "groupby":
+        engines = ["cpu", "tpu"] if args.engine == "both" else [args.engine]
+        for eng in engines:
+            run_groupby(
+                int(args.n), args.k, args.partitions, eng == "tpu", args.iters
+            )
+
+
+if __name__ == "__main__":
+    main()

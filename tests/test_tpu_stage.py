@@ -220,15 +220,115 @@ def test_empty_partition_global_agg_not_duplicated():
     assert tpu.column("c").to_pylist() == [3]
 
 
-def test_four_group_keys_stay_on_cpu():
-    ctx = _ctx(True)
+def test_four_plus_group_keys_on_device():
+    # the re-densifying key fold supports any GROUP BY width (round-1
+    # capped at 3 keys via the 21-bit fold)
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    n = 4000
     tbl = pa.table(
         {
-            "a": ["x", "y"], "b": ["p", "q"], "c": ["m", "n"], "d": ["u", "v"],
-            "v": pa.array([1.0, 2.0], pa.float64()),
+            "a": pa.array(np.array(["x", "y", "z"], object)[rng.integers(0, 3, n)].tolist()),
+            "b": pa.array(rng.integers(0, 4, n), pa.int64()),
+            "c": pa.array(np.array(["m", "n"], object)[rng.integers(0, 2, n)].tolist()),
+            "d": pa.array(rng.integers(0, 5, n), pa.int64()),
+            "e": pa.array(rng.integers(0, 3, n), pa.int64()),
+            "v": pa.array(rng.uniform(0, 100, n), pa.float64()),
         }
     )
-    ctx.register_arrow_table("t", tbl)
-    df = ctx.sql("select a, b, c, d, sum(v) as s from t group by a, b, c, d")
-    assert "TpuStageExec" not in df.explain()
-    assert df.collect().num_rows == 2
+
+    def reg(ctx):
+        ctx.register_arrow_table("t", tbl, partitions=2)
+
+    sql = (
+        "select a, b, c, d, e, sum(v) as s, count(*) as n from t "
+        "group by a, b, c, d, e order by a, b, c, d, e"
+    )
+    ctx = _ctx(True)
+    reg(ctx)
+    assert "TpuStageExec" in ctx.sql(sql).explain()
+    cpu, tpu = _both(sql, reg)
+    _assert_tables_equal(cpu, tpu)
+
+
+def test_capacity_grows_without_fallback():
+    """Cardinality beyond the initial segment capacity grows the table in
+    4x buckets on device rather than falling back to CPU."""
+    import numpy as np
+
+    n = 5000
+    tbl = pa.table(
+        {
+            "g": pa.array(np.arange(n) % 3000, pa.int64()),
+            "v": pa.array(np.ones(n), pa.float64()),
+        }
+    )
+    ctx = _ctx(True, **{"ballista.tpu.segment_capacity": 256})
+    ctx.register_arrow_table("t", tbl, partitions=2)
+    df = ctx.sql("select g, sum(v) as s from t group by g order by g")
+    plan = df.physical_plan()
+    out = ctx.execute(plan)
+    assert out.num_rows == 3000
+    m = _stage_metrics(plan)
+    assert m.get("capacity_growths", 0) >= 1, m
+    assert "tpu_fallback" not in m, m
+
+
+def test_max_capacity_falls_back_to_cpu():
+    import numpy as np
+
+    n = 3000
+    tbl = pa.table(
+        {
+            "g": pa.array(np.arange(n), pa.int64()),  # all distinct
+            "v": pa.array(np.ones(n), pa.float64()),
+        }
+    )
+    ctx = _ctx(
+        True,
+        **{
+            "ballista.tpu.segment_capacity": 64,
+            "ballista.tpu.max_capacity": 1024,
+        },
+    )
+    ctx.register_arrow_table("t", tbl, partitions=1)
+    df = ctx.sql("select g, sum(v) as s from t group by g order by g")
+    plan = df.physical_plan()
+    out = ctx.execute(plan)
+    assert out.num_rows == n  # correct via CPU fallback
+    assert _stage_metrics(plan).get("tpu_fallback", 0) >= 1
+
+
+def test_q3_aggregate_accelerates_no_fallback(tpch_ctx):
+    """q3 (3 keys incl. a date, join feeding the aggregate) must run its
+    partial aggregate on device with zero fallbacks."""
+    from benchmarks.tpch.queries import QUERIES
+
+    ctx = _ctx(True)
+    _register_tpch(ctx)
+    df = ctx.sql(QUERIES[3])
+    plan = df.physical_plan()
+    assert "TpuStageExec" in plan.display() or "MeshGangExec" in plan.display()
+    got = ctx.execute(plan)
+    m = _stage_metrics(plan)
+    assert "tpu_fallback" not in m, m
+    assert "mesh_fallback" not in m, m
+
+    want = tpch_ctx.sql(QUERIES[3]).collect()
+    _assert_tables_equal(want, got, rel=1e-9)
+
+
+def _stage_metrics(plan) -> dict:
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+    from arrow_ballista_tpu.parallel.mesh_stage import MeshGangExec
+
+    agg: dict = {}
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (TpuStageExec, MeshGangExec)):
+            for k, v in node.metrics.to_dict().items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(node.children())
+    return agg
