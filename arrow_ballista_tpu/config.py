@@ -33,6 +33,7 @@ TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
 MESH_ENABLE = "ballista.mesh.enable"
 MESH_DEVICES = "ballista.mesh.devices"
+MESH_EXCHANGE_MAX_ROWS = "ballista.mesh.exchange_max_rows"
 SHUFFLE_TO_MEMORY = "ballista.shuffle.to_memory"
 
 
@@ -100,7 +101,9 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "initial group-table capacity for on-device hash aggregation "
             "(grows 4x, with state padding, up to tpu.max_capacity)",
             int,
-            "4096",
+            # matmul-path FLOPs scale with capacity (rows x cap x cols):
+            # start small, let 4x growth track real cardinality
+            "1024",
         ),
         ConfigEntry(
             TPU_MAX_CAPACITY,
@@ -143,6 +146,14 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "mesh width for gang stages (0 = all visible devices)",
             int,
             "0",
+        ),
+        ConfigEntry(
+            MESH_EXCHANGE_MAX_ROWS,
+            "row ceiling for the ICI repartition exchange (it buffers the "
+            "stage input in host memory); beyond it the writer falls back "
+            "to the streaming hash-split path",
+            int,
+            str(1 << 26),
         ),
         ConfigEntry(
             SHUFFLE_TO_MEMORY,
@@ -239,6 +250,10 @@ class BallistaConfig:
     @property
     def mesh_devices(self) -> int:
         return self._get(MESH_DEVICES)
+
+    @property
+    def mesh_exchange_max_rows(self) -> int:
+        return self._get(MESH_EXCHANGE_MAX_ROWS)
 
     @property
     def shuffle_to_memory(self) -> bool:

@@ -188,6 +188,11 @@ class BatchExchanger:
                 or pa.types.is_uint64(t)
                 or pa.types.is_date64(t)
                 or pa.types.is_timestamp(t)
+                # f64 bitcasts through the pair path too: the exchange is
+                # pure data movement, so values must survive EXACTLY even
+                # though the device has no f64 (narrowing to f32 would
+                # silently corrupt pass-through repartition payloads)
+                or pa.types.is_float64(t)
             ):
                 self.layout.append(("i64pair", i))
             else:
@@ -222,7 +227,11 @@ class BatchExchanger:
                 if validity is None:
                     validity = np.ones(len(values), bool)
                 if kind == "i64pair":
-                    v = values.astype(np.int64)
+                    v = (
+                        values.view(np.int64)  # f64: exact bitcast
+                        if values.dtype == np.float64
+                        else values.astype(np.int64)
+                    )
                     cols.append((v & 0xFFFFFFFF).astype(np.uint32).view(np.int32))
                     cols.append((v >> 32).astype(np.int32))
                 else:
@@ -294,6 +303,8 @@ def _cast_back(values: np.ndarray, t) -> np.ndarray:
         return values.astype("int64").view("datetime64[ms]")
     if pa.types.is_timestamp(t):
         return values.astype("int64").view(f"datetime64[{t.unit}]")
+    if pa.types.is_float64(t) and values.dtype == np.int64:
+        return values.view(np.float64)  # inverse of the exact pair bitcast
     if pa.types.is_floating(t) and values.dtype == np.float32:
         return values.astype(np.float64)
     return values

@@ -178,7 +178,7 @@ class MeshGangExec(ExecutionPlan):
             if cap > tpu.capacity:
                 self.metrics.add("capacity_growths", 1)
 
-            step_key = (tpu._sig, n_dev, cap)
+            step_key = (tpu._sig, n_dev, cap) + K.algo_cache_token()
             step = _MESH_STEP_CACHE.get(step_key)
             if step is None:
                 mesh = M.make_mesh(n_dev)
@@ -191,12 +191,218 @@ class MeshGangExec(ExecutionPlan):
                 mesh = M.make_mesh(n_dev)
                 sharded = M.shard_batch(mesh, [seg, valid] + args)
                 out = step(*sharded)
-                out = [o.block_until_ready() for o in out]
+                # packed fetch = the only reliable sync on the tunnel TPU
+                # (block_until_ready is a no-op there); one roundtrip
+                host_states = tpu._fetch_states(tuple(out))
         self.metrics.add("mesh_rows_in", n_rows)
         self.metrics.add("mesh_devices", n_dev)
         yield from tpu._materialize(
-            tuple(out), key_encoders, gid_tuples, n_rows, ctx, 0
+            host_states, key_encoders, gid_tuples, n_rows, ctx, 0
         )
+
+
+class MeshExchangeError(Exception):
+    """Exchange-specific failure (capacity ceiling, untransferable column):
+    the owning writer falls back to the classic hash-split.  Deliberately
+    NOT an ExecutionError so inner-plan execution errors propagate to the
+    normal stage-retry machinery instead of being silently re-run."""
+
+
+def exchange_supported(schema: pa.Schema) -> bool:
+    """Can every field of this schema cross the ICI batch exchange?
+    (numeric/bool/date/timestamp directly, strings as dictionary codes,
+    i64 as lo/hi pairs — mesh.BatchExchanger's layout rules)."""
+    from ..ops.bridge import _is_device_friendly
+
+    for f in schema:
+        t = f.type
+        if not (
+            pa.types.is_string(t)
+            or pa.types.is_large_string(t)
+            or _is_device_friendly(t)
+        ):
+            return False
+    return True
+
+
+class MeshRepartitionExec(ExecutionPlan):
+    """Gang-form hash repartition: the stage's shuffle IS an ICI collective.
+
+    The reference hash-splits every batch per input partition and writes
+    n_in x n_out shuffle files (``shuffle_writer.rs:201-285``); when the
+    stage's partitions are mesh-resident, this node runs ONE task that
+    shards every input partition over the mesh, routes rows to their
+    destination output partition with a single ``all_to_all``
+    (:class:`..parallel.mesh.BatchExchanger`), and hands the owning
+    :class:`ShuffleWriterExec` already-partitioned output batches — zero
+    hash-split files, one memory write per output partition.
+
+    ``output_partitioning()`` is 1 so the scheduler sees an ordinary
+    one-task stage (same trick as :class:`MeshGangExec`); recovery and
+    stats machinery are untouched.  Capacity follows the documented
+    n_dropped contract: computed exactly from the shard layout, doubled
+    and retried if the exchange still reports drops, ExecutionError (→
+    writer fallback) past the ceiling.
+    """
+
+    _CAP_CEILING = 1 << 24
+    # process-wide observability: completed exchanges / writer fallbacks
+    # (executor-side metrics are not reachable from cluster tests)
+    exchanges_completed = 0
+
+    def __init__(
+        self, input: ExecutionPlan, partitioning: Partitioning,
+        n_devices: int = 0,
+    ):
+        super().__init__()
+        assert partitioning.kind == "hash"
+        self.input = input
+        self.partitioning = partitioning
+        self.n_devices = n_devices
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self.input.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return MeshRepartitionExec(
+            children[0], self.partitioning, self.n_devices
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"MeshRepartitionExec: hash({self.partitioning.n}) "
+            f"devices={self.n_devices or 'auto'}"
+        )
+
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        # direct execution (no writer): repartition does not change row
+        # content, so pass every input partition through unchanged
+        for p in range(self.input.output_partitioning().n):
+            yield from self.input.execute(p, ctx)
+
+    # -------------------------------------------------------- exchanged
+    def execute_exchanged(
+        self, ctx: TaskContext
+    ) -> Iterator[tuple[int, pa.RecordBatch]]:
+        """Yield (output_partition, batch) pairs after the mesh exchange."""
+        import jax
+
+        from ..errors import ExecutionError
+        from ..shuffle.execution_plans import partition_indices
+        from . import mesh as M
+
+        n_out = self.partitioning.n
+        exprs = list(self.partitioning.exprs)
+        n_dev = self.n_devices or ctx.config.mesh_devices or len(jax.devices())
+        n_dev = max(1, min(n_dev, len(jax.devices())))
+
+        # the exchange buffers the stage input in host memory (~2x resident
+        # plus device staging): a row ceiling keeps huge shuffles on the
+        # streaming hash-split path instead of OOMing this task
+        max_rows = ctx.config.mesh_exchange_max_rows
+        with self.metrics.timer("mesh_stage_time_ns"):
+            batches: list[pa.RecordBatch] = []
+            dest_parts: list[np.ndarray] = []
+            rows_seen = 0
+            for p in range(self.input.output_partitioning().n):
+                for b in self.input.execute(p, ctx):
+                    ctx.check_cancelled()
+                    if b.num_rows == 0:
+                        continue
+                    rows_seen += b.num_rows
+                    if rows_seen > max_rows:
+                        raise MeshExchangeError(
+                            f"stage exceeds mesh.exchange_max_rows "
+                            f"({rows_seen} > {max_rows})"
+                        )
+                    with self.metrics.timer("repart_time_ns"):
+                        idx = partition_indices(b, exprs, n_out)
+                    batches.append(b)
+                    dest_parts.append(idx.astype(np.int32))
+            if not batches:
+                return
+
+            # destination column rides the exchange so one device can
+            # carry several output partitions (n_out != n_dev)
+            ext_schema = pa.schema(
+                list(self.input.schema) + [pa.field("__part", pa.int32())]
+            )
+            ext_batches = [
+                pa.RecordBatch.from_arrays(
+                    list(b.columns) + [pa.array(d)], schema=ext_schema
+                )
+                for b, d in zip(batches, dest_parts)
+            ]
+            dest_dev = np.concatenate(dest_parts) % n_dev
+            dest_dev = dest_dev.astype(np.int32)
+            total = len(dest_dev)
+            valid = np.ones(total, dtype=bool)
+
+            # exact per-(source shard, destination) bucket need from the
+            # known contiguous shard layout (shard_batch pads evenly)
+            per_shard = -(-total // n_dev)
+            shard_id = np.arange(total, dtype=np.int64) // per_shard
+            need = int(
+                np.bincount(
+                    shard_id * n_dev + dest_dev, minlength=n_dev * n_dev
+                ).max()
+            )
+            cap = 1 << max(need - 1, 0).bit_length()
+
+            mesh = M.make_mesh(n_dev)
+            try:
+                while True:
+                    ex = M.BatchExchanger(mesh, ext_schema, cap)
+                    cols_per_batch = [ex.to_columns(b) for b in ext_batches]
+                    cols = [
+                        np.concatenate(parts) for parts in zip(*cols_per_batch)
+                    ]
+                    with self.metrics.timer("device_time_ns"):
+                        recv_cols, recv_valid, n_dropped = ex.exchange(
+                            dest_dev, valid, cols
+                        )
+                    if n_dropped == 0:
+                        break
+                    cap *= 2  # grow-or-fallback contract (mesh.py docstring)
+                    if cap > self._CAP_CEILING:
+                        raise MeshExchangeError(
+                            "mesh exchange capacity ceiling exceeded"
+                        )
+                    self.metrics.add("capacity_growths", 1)
+            except ExecutionError as e:
+                # column didn't cross the bridge (dtype slipped past the
+                # plan-time check): an exchange failure, not a plan failure
+                raise MeshExchangeError(str(e)) from e
+
+            self.metrics.add("mesh_exchange_rows", total)
+            self.metrics.add("mesh_devices", n_dev)
+            MeshRepartitionExec.exchanges_completed += 1
+
+            part_col = len(ext_schema) - 1
+            for recv in ex.to_batches(recv_cols, recv_valid):
+                if recv.num_rows == 0:
+                    continue
+                parts = np.asarray(recv.column(part_col))
+                core = recv.select(range(part_col))
+                order = np.argsort(parts, kind="stable")
+                sorted_parts = parts[order]
+                shuffled = core.take(pa.array(order))
+                bounds = np.searchsorted(
+                    sorted_parts, np.arange(n_out + 1)
+                )
+                for out_p in range(n_out):
+                    lo, hi = int(bounds[out_p]), int(bounds[out_p + 1])
+                    if hi > lo:
+                        yield out_p, shuffled.slice(lo, hi - lo)
 
 
 def maybe_mesh(plan: ExecutionPlan, config) -> ExecutionPlan:

@@ -39,14 +39,24 @@ class DistributedPlanner:
         self.config = config or BallistaConfig()
         self._next_stage_id = 0
 
-    def _maybe_gang(self, plan: ExecutionPlan) -> ExecutionPlan:
-        """TPU-native stage form: when the stage subtree fuses into a
-        partial aggregate, run the WHOLE stage as one mesh gang task —
-        its cross-partition exchange happens via ICI collectives inside
-        the task, and only [capacity]-sized reduced states reach the
-        shuffle (replacing the per-partition disk+Flight hop the
-        reference always takes, shuffle_writer.rs:142-292)."""
-        from ..parallel.mesh_stage import MeshGangExec, gang_eligible
+    def _maybe_gang(self, plan: ExecutionPlan, part=None) -> ExecutionPlan:
+        """TPU-native stage forms (two shapes):
+
+        * the subtree fuses into a partial aggregate → MeshGangExec: the
+          cross-partition exchange is a psum over ICI and only
+          [capacity]-sized states reach the shuffle;
+        * the stage feeds a hash repartition (``part``) → MeshRepartition-
+          Exec: rows route to their output partition with one all_to_all
+          over ICI and the writer persists pre-partitioned batches —
+          replacing the per-partition hash-split + disk+Flight hop the
+          reference always takes (shuffle_writer.rs:142-292, :201-285).
+        """
+        from ..parallel.mesh_stage import (
+            MeshGangExec,
+            MeshRepartitionExec,
+            exchange_supported,
+            gang_eligible,
+        )
 
         if not (self.config.mesh_enable and self.config.tpu_enable):
             return plan
@@ -54,6 +64,13 @@ class DistributedPlanner:
             return plan  # single partition: nothing to gang
         if gang_eligible(plan):
             return MeshGangExec(plan, self.config.mesh_devices)
+        if (
+            part is not None
+            and part.kind == "hash"
+            and part.exprs
+            and exchange_supported(plan.schema)
+        ):
+            return MeshRepartitionExec(plan, part, self.config.mesh_devices)
         return plan
 
     def _new_stage_id(self) -> int:
@@ -96,7 +113,7 @@ class DistributedPlanner:
             part = plan.partitioning
             if part.kind == "hash":
                 writer = self._create_shuffle_writer(
-                    job_id, self._maybe_gang(children[0]), part
+                    job_id, self._maybe_gang(children[0], part), part
                 )
                 stages.append(writer)
                 placeholder = UnresolvedShuffleExec(

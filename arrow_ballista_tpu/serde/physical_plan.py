@@ -175,7 +175,15 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.unresolved_shuffle.input_partition_count = plan.input_partition_count
         n.unresolved_shuffle.output_partition_count = plan.output_partition_count
         return n
-    from ..parallel.mesh_stage import MeshGangExec
+    from ..parallel.mesh_stage import MeshGangExec, MeshRepartitionExec
+
+    if isinstance(plan, MeshRepartitionExec):
+        n.mesh_repartition.input.CopyFrom(physical_plan_to_proto(plan.input))
+        n.mesh_repartition.partitioning.CopyFrom(
+            partitioning_to_proto(plan.partitioning)
+        )
+        n.mesh_repartition.n_devices = plan.n_devices
+        return n
 
     if isinstance(plan, MeshGangExec):
         n.mesh_gang.input.CopyFrom(physical_plan_to_proto(plan.input))
@@ -302,4 +310,12 @@ def physical_plan_from_proto(
         from ..parallel.mesh_stage import MeshGangExec
 
         return MeshGangExec(rec(n.mesh_gang.input), n.mesh_gang.n_devices)
+    if kind == "mesh_repartition":
+        from ..parallel.mesh_stage import MeshRepartitionExec
+
+        return MeshRepartitionExec(
+            rec(n.mesh_repartition.input),
+            partitioning_from_proto(n.mesh_repartition.partitioning),
+            n.mesh_repartition.n_devices,
+        )
     raise PlanError(f"cannot deserialize physical plan node {kind!r}")

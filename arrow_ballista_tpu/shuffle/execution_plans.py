@@ -161,12 +161,12 @@ class ShuffleWriterExec(ExecutionPlan):
         )
 
     def _use_memory(self, ctx: TaskContext) -> bool:
-        """Memory data plane: explicit config, or a mesh gang stage whose
-        (tiny, collective-reduced) output never belongs on disk."""
-        from ..parallel.mesh_stage import MeshGangExec
+        """Memory data plane: explicit config, or a mesh stage (gang or
+        ICI-exchanged repartition) whose output never belongs on disk."""
+        from ..parallel.mesh_stage import MeshGangExec, MeshRepartitionExec
 
         return ctx.config.shuffle_to_memory or isinstance(
-            self.input, MeshGangExec
+            self.input, (MeshGangExec, MeshRepartitionExec)
         )
 
     def _sink(
@@ -216,46 +216,111 @@ class ShuffleWriterExec(ExecutionPlan):
         if part.kind != "hash":
             raise ExecutionError(f"unsupported shuffle partitioning {part.kind}")
 
+        from ..parallel.mesh_stage import MeshExchangeError, MeshRepartitionExec
+
+        if isinstance(self.input, MeshRepartitionExec):
+            # the stage body already routed rows to their destination over
+            # ICI: write each received output partition directly (one task,
+            # zero hash-split work here).  Only exchange-specific failures
+            # fall back; inner-plan errors propagate to stage retry.
+            try:
+                return self._exchanged_write(input_partition, ctx, stage_dir)
+            except MeshExchangeError:
+                self.metrics.add("mesh_exchange_fallback", 1)
+                return self._fallback_hash_write(ctx, stage_dir, part)
+
+        sinks: list = [None] * part.n
+        for batch in self.input.execute(input_partition, ctx):
+            ctx.check_cancelled()
+            self._hash_split_into_sinks(
+                batch, part, sinks, to_mem, stage_dir, input_partition
+            )
+        return self._close_sinks(
+            sinks, to_mem, stage_dir, input_partition, self.input.schema
+        )
+
+    def _hash_split_into_sinks(
+        self, batch, part: Partitioning, sinks: list, to_mem: bool,
+        stage_dir: str, in_part: int,
+    ) -> None:
+        """Hash-split one batch and append each run to its partition sink
+        (the reference hot loop, shuffle_writer.rs:201-285)."""
         import numpy as np
 
         n_out = part.n
-        exprs = list(part.exprs)
-        sinks: list = [None] * n_out
-        in_schema = self.input.schema
-        for batch in self.input.execute(input_partition, ctx):
-            ctx.check_cancelled()
-            with self.metrics.timer("repart_time_ns"):
-                idx = partition_indices(batch, exprs, n_out)
-                order = np.argsort(idx, kind="stable")
-                sorted_idx = idx[order]
-                shuffled = batch.take(pa.array(order))
-                bounds = np.searchsorted(sorted_idx, np.arange(n_out + 1))
-            with self.metrics.timer("write_time_ns"):
-                for p in range(n_out):
-                    lo, hi = int(bounds[p]), int(bounds[p + 1])
-                    if hi <= lo:
-                        continue
-                    if sinks[p] is None:
-                        sinks[p] = self._sink(
-                            to_mem, stage_dir, p, input_partition,
-                            batch.schema, False,
-                        )
-                    sinks[p].write(shuffled.slice(lo, hi - lo))
-        out = []
+        with self.metrics.timer("repart_time_ns"):
+            idx = partition_indices(batch, list(part.exprs), n_out)
+            order = np.argsort(idx, kind="stable")
+            sorted_idx = idx[order]
+            shuffled = batch.take(pa.array(order))
+            bounds = np.searchsorted(sorted_idx, np.arange(n_out + 1))
         with self.metrics.timer("write_time_ns"):
             for p in range(n_out):
+                lo, hi = int(bounds[p]), int(bounds[p + 1])
+                if hi <= lo:
+                    continue
+                if sinks[p] is None:
+                    sinks[p] = self._sink(
+                        to_mem, stage_dir, p, in_part, batch.schema, False
+                    )
+                sinks[p].write(shuffled.slice(lo, hi - lo))
+
+    def _close_sinks(
+        self, sinks: list, to_mem: bool, stage_dir: str, in_part: int,
+        in_schema: pa.Schema,
+    ) -> list[ShuffleWritePartition]:
+        """Close every partition sink (creating empty ones so readers need
+        no existence probe) and assemble the write stats."""
+        out = []
+        with self.metrics.timer("write_time_ns"):
+            for p in range(len(sinks)):
                 s = sinks[p]
                 if s is None:
-                    # empty sink so readers need no existence probe
                     s = self._sink(
-                        to_mem, stage_dir, p, input_partition, in_schema, False
+                        to_mem, stage_dir, p, in_part, in_schema, False
                     )
                 nbytes = s.close()
                 self.metrics.add("output_rows", s.num_rows)
                 out.append(
-                    ShuffleWritePartition(p, s.path, s.num_batches, s.num_rows, nbytes)
+                    ShuffleWritePartition(
+                        p, s.path, s.num_batches, s.num_rows, nbytes
+                    )
                 )
         return out
+
+    def _exchanged_write(
+        self, input_partition: int, ctx: TaskContext, stage_dir: str
+    ) -> list[ShuffleWritePartition]:
+        """Persist already-exchanged (out_partition, batch) pairs from a
+        MeshRepartitionExec stage body — the write half of the ICI shuffle."""
+        assert input_partition == 0, "mesh-exchanged stages are single-task"
+        to_mem = self._use_memory(ctx)
+        sinks: list = [None] * self.shuffle_output_partitioning.n
+        for out_p, batch in self.input.execute_exchanged(ctx):
+            ctx.check_cancelled()
+            with self.metrics.timer("write_time_ns"):
+                if sinks[out_p] is None:
+                    sinks[out_p] = self._sink(
+                        to_mem, stage_dir, out_p, 0, batch.schema, False
+                    )
+                sinks[out_p].write(batch)
+        return self._close_sinks(sinks, to_mem, stage_dir, 0, self.input.schema)
+
+    def _fallback_hash_write(
+        self, ctx: TaskContext, stage_dir: str, part: Partitioning
+    ) -> list[ShuffleWritePartition]:
+        """Exchange fallback: run the classic hash-split over EVERY inner
+        partition inside this one task (still correct, no collective)."""
+        to_mem = self._use_memory(ctx)
+        inner = self.input.children()[0]
+        sinks: list = [None] * part.n
+        for in_p in range(inner.output_partitioning().n):
+            for batch in inner.execute(in_p, ctx):
+                ctx.check_cancelled()
+                self._hash_split_into_sinks(
+                    batch, part, sinks, to_mem, stage_dir, 0
+                )
+        return self._close_sinks(sinks, to_mem, stage_dir, 0, inner.schema)
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         stats = self.execute_shuffle_write(partition, ctx)
