@@ -47,10 +47,15 @@ class _Reader:
         return out
 
     def read_long(self) -> int:
-        """Zigzag varint."""
+        """Zigzag varint (bounds-checked: a truncated or corrupt file must
+        raise AvroError, not IndexError / an unbounded shift loop)."""
         shift = 0
         accum = 0
         while True:
+            if self.pos >= len(self.data):
+                raise AvroError("truncated avro varint")
+            if shift > 63:
+                raise AvroError("avro varint exceeds 64 bits")
             b = self.data[self.pos]
             self.pos += 1
             accum |= (b & 0x7F) << shift

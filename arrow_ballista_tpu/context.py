@@ -137,6 +137,21 @@ class SessionContext:
         if plugin_dir:
             load_udf_plugins(plugin_dir)
 
+    def fork(self) -> "SessionContext":
+        """Statement-scoped view of this session: shares config/UDFs/
+        variables and SEES the same tables, but owns a private catalog
+        copy so CTE registration (``_sql_with_ctes`` mutates the catalog)
+        cannot race concurrent statements on a shared session — the
+        FlightSQL front-end runs every query on a fork."""
+        child = SessionContext.__new__(SessionContext)
+        child.config = self.config
+        child.catalog = Catalog()
+        child.catalog.tables = dict(self.catalog.tables)
+        child.session_id = self.session_id
+        child.variables = dict(self.variables)
+        child.udfs = self.udfs
+        return child
+
     # -- registration ----------------------------------------------------
     def register_table(self, name: str, provider: TableProvider) -> None:
         self.catalog.register(name, provider)

@@ -292,6 +292,13 @@ class ScalarUdf(PhysicalExpr):
             out = pa.array(out, type=self.out_type)
         if isinstance(out, pa.ChunkedArray):
             out = out.combine_chunks()
+        if len(out) != batch.num_rows:
+            # a UDF that mis-sizes its output would silently corrupt row
+            # alignment downstream (round-1 advisor finding)
+            raise ExecutionError(
+                f"scalar UDF {self.fname!r} returned {len(out)} rows for a "
+                f"{batch.num_rows}-row batch"
+            )
         if not out.type.equals(self.out_type):
             out = pc.cast(out, self.out_type, safe=False)
         return out

@@ -129,3 +129,46 @@ class DictEncoder:
     def to_arrow(self, dtype: pa.DataType) -> pa.Array:
         return pa.array(self.reverse, dtype)
 
+    def decode(self, codes: np.ndarray, t: pa.DataType) -> pa.Array:
+        """codes → original values (vectorized object fancy-index)."""
+        rev = np.asarray(self.reverse, dtype=object)
+        return pa.array(rev[codes].tolist(), t)
+
+
+class IdentityKeyEncoder:
+    """Group-key encoder for int/date32 columns: VALUE + 1 is the code
+    (code 0 is the NULL key, so nullable key columns stay on device).
+
+    Dictionary-hashing numeric keys costs a Python mapping loop per
+    distinct value (2.8s of q3 SF10's stage time in round 3's first cut);
+    identity codes cost one astype.  Negative values raise ExecutionError
+    — the stage executor turns that into a CPU fallback (rare: pre-1970
+    dates or negative keys as GROUP BY columns).
+    """
+
+    def encode(self, arr) -> np.ndarray:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        values, validity = arrow_to_numpy(arr)
+        v = values.astype(np.int64)
+        if len(v) and v.min() < 0:
+            raise ExecutionError("negative group key in identity key encoder")
+        codes = v + 1
+        if validity is not None:
+            codes = np.where(validity, codes, 0)
+        return codes
+
+    def decode(self, codes: np.ndarray, t: pa.DataType) -> pa.Array:
+        mask = codes == 0
+        vals = np.where(mask, 0, codes - 1)
+        if pa.types.is_date32(t):
+            return pa.array(vals.astype("datetime64[D]"), t, mask=mask)
+        return pa.array(vals, t, mask=mask)
+
+
+def make_key_encoder(t: pa.DataType):
+    """Identity for int/date32 group keys, dictionary otherwise."""
+    if pa.types.is_integer(t) or pa.types.is_date32(t):
+        return IdentityKeyEncoder()
+    return DictEncoder()
+

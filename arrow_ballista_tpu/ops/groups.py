@@ -1,0 +1,111 @@
+"""Vectorized multi-key group table for the fused aggregate path.
+
+Round-2 assigned dense group ids with a Python loop over every NEW key
+combination (``stage_compiler._encode_groups``) — ~3M loop iterations on
+q3 SF10, 6 of the stage's 7.8 seconds.  This table keeps everything in
+numpy:
+
+* per-key dictionary codes fold into ONE int64 via per-key bit radixes
+  (bits grow with the observed code range; the stored table re-combines
+  vectorized when a radix grows);
+* known combinations resolve through ``np.searchsorted`` on a sorted
+  (combined → gid) index — no Python per-row/per-group work;
+* new combinations batch-append: one ``np.unique`` over the misses only.
+
+Group ids are row indices of ``key_mat`` (assignment order), so device
+states stay valid as the table grows — matching the adaptive-capacity
+contract of the kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# combined keys live in int64: total radix bits must stay under 63
+_MAX_TOTAL_BITS = 62
+
+
+class RadixOverflow(Exception):
+    """Combined key space exceeds 62 bits — caller falls back."""
+
+
+class GroupTable:
+    def __init__(self, n_keys: int):
+        self.n_keys = n_keys
+        self.key_mat = np.empty((0, n_keys), dtype=np.int64)
+        self._bits = [1] * n_keys
+        self._sorted_combined = np.empty(0, dtype=np.int64)
+        self._sorted_gids = np.empty(0, dtype=np.int32)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.key_mat)
+
+    def codes_for(self, gids: np.ndarray, key: int) -> np.ndarray:
+        """Per-key dictionary codes for the given group ids (vectorized)."""
+        return self.key_mat[gids, key]
+
+    # ------------------------------------------------------------ internal
+    def _combine(self, code_cols: list[np.ndarray]) -> np.ndarray:
+        combined = code_cols[0].astype(np.int64)
+        for bits, c in zip(self._bits[1:], code_cols[1:]):
+            combined = (combined << bits) | c.astype(np.int64)
+        return combined
+
+    def _grow_radix(self, code_arrays: list[np.ndarray]) -> None:
+        changed = False
+        for k, c in enumerate(code_arrays):
+            if len(c) == 0:
+                continue
+            need = max(1, int(c.max()).bit_length())
+            if need > self._bits[k]:
+                self._bits[k] = need
+                changed = True
+        if sum(self._bits) > _MAX_TOTAL_BITS:
+            raise RadixOverflow(
+                f"combined group-key space needs {sum(self._bits)} bits"
+            )
+        if changed and self.n_groups:
+            combined = self._combine(
+                [self.key_mat[:, k] for k in range(self.n_keys)]
+            )
+            order = np.argsort(combined, kind="stable")
+            self._sorted_combined = combined[order]
+            self._sorted_gids = order.astype(np.int32)
+
+    # ------------------------------------------------------------- encode
+    def encode(self, code_arrays: list[np.ndarray]) -> np.ndarray:
+        """Dense stable group ids for one batch of per-key code columns."""
+        self._grow_radix(code_arrays)
+        combined = self._combine(code_arrays)
+        known = self._sorted_combined
+        if len(known):
+            pos = np.searchsorted(known, combined)
+            pos_c = np.minimum(pos, len(known) - 1)
+            found = known[pos_c] == combined
+            gids = np.where(found, self._sorted_gids[pos_c], -1).astype(
+                np.int32
+            )
+        else:
+            found = np.zeros(len(combined), dtype=bool)
+            gids = np.full(len(combined), -1, dtype=np.int32)
+
+        if not found.all():
+            miss_rows = np.nonzero(~found)[0]
+            uniq, first_idx, inverse = np.unique(
+                combined[miss_rows], return_index=True, return_inverse=True
+            )
+            base = self.n_groups
+            new_gids = base + np.arange(len(uniq), dtype=np.int32)
+            rep = miss_rows[first_idx]
+            new_mat = np.stack(
+                [c[rep].astype(np.int64) for c in code_arrays], axis=1
+            )
+            self.key_mat = np.concatenate([self.key_mat, new_mat])
+            all_combined = np.concatenate([self._sorted_combined, uniq])
+            all_gids = np.concatenate([self._sorted_gids, new_gids])
+            order = np.argsort(all_combined, kind="stable")
+            self._sorted_combined = all_combined[order]
+            self._sorted_gids = all_gids[order]
+            gids[miss_rows] = new_gids[inverse]
+        return gids

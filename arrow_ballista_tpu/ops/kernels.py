@@ -41,10 +41,18 @@ class NotLowerable(Exception):
 
 @dataclass
 class LeafSpec:
-    """One host-supplied input array of the fused kernel."""
+    """One host-supplied input array of the fused kernel.
+
+    Kinds: "column" (value + validity), "cpu_expr" (host-evaluated value +
+    validity), "column_validity" (validity ONLY — count(col) never needs
+    the values, so wide i64 key columns don't cross the bridge at all),
+    "column_pair" (i64 as an exact f32 (hi, lo) pair in x32 mode — hi/lo
+    and validity; 48-bit exact, so big-key sums survive the i32-less
+    device).
+    """
 
     name: str
-    kind: str  # "column" | "cpu_expr"
+    kind: str  # "column" | "cpu_expr" | "column_validity" | "column_pair"
     col_index: int = -1
     cpu_expr: Optional[pe.PhysicalExpr] = None
 
@@ -149,6 +157,32 @@ class JaxExprCompiler:
 
         def run(env: dict):
             return env[name], env[vname]
+
+        return run
+
+    def validity_only(self, e: pe.Col) -> JaxClosure:
+        """Leaf that ships ONLY the validity mask of a column (count(col):
+        the values are never read, so i32-unrepresentable columns still
+        count on device)."""
+        name = f"col_{e.index}__validonly"
+        self.leaves[name] = LeafSpec(name, "column_validity", col_index=e.index)
+        vname = f"{name}__valid"
+
+        def run(env: dict):
+            return None, env[vname]
+
+        return run
+
+    def pair_column(self, e: pe.Col) -> JaxClosure:
+        """i64 column as an exact f32 (hi, lo) pair (x32 mode): the value
+        half of the closure result is a (hi, lo) TUPLE consumed only by
+        pair-aware aggregate kernels (KernelAggSpec.pair)."""
+        name = f"col_{e.index}__pair"
+        self.leaves[name] = LeafSpec(name, "column_pair", col_index=e.index)
+        vname = f"{name}__valid"
+
+        def run(env: dict):
+            return (env[f"{name}__hi"], env[f"{name}__lo"]), env[vname]
 
         return run
 
@@ -470,21 +504,46 @@ def build_env(
     across batches — nulls appearing mid-stream must not trigger an XLA
     recompile.
     """
+    import pyarrow.compute as pc
+
     env: dict[str, np.ndarray] = {}
     for name, spec in leaves.items():
-        if spec.kind == "column":
-            arr = batch.column(spec.col_index)
-        else:
+        if spec.kind == "cpu_expr":
             arr = spec.cpu_expr.evaluate(batch)
             if isinstance(arr, pa.Scalar):
                 arr = pa.array([arr.as_py()] * batch.num_rows, arr.type)
-        values, validity = arrow_to_numpy(
-            arr if isinstance(arr, pa.Array) else arr.combine_chunks()
-        )
-        env[name] = _pad(coerce_host_values(values), n_padded)
+        else:
+            arr = batch.column(spec.col_index)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if spec.kind == "column_validity":
+            # count(col): ONLY the validity mask crosses — the values are
+            # never read, so any column type (strings, decimals, wide
+            # i64) counts on device
+            validity = (
+                np.asarray(pc.is_valid(arr))
+                if arr.null_count
+                else np.ones(len(arr), dtype=bool)
+            )
+            env[f"{name}__valid"] = _pad(validity, n_padded)
+            continue
+        values, validity = arrow_to_numpy(arr)
         if validity is None:
             validity = np.ones(len(values), dtype=bool)
         env[f"{name}__valid"] = _pad(validity, n_padded)
+        if spec.kind == "column_pair":
+            v = values.astype(np.float64)
+            if len(v) and np.abs(v).max() >= float(1 << 48):
+                raise ExecutionError(
+                    "int64 column exceeds 48-bit pair range in x32 mode"
+                )
+            hi = v.astype(np.float32)
+            env[f"{name}__hi"] = _pad(hi, n_padded)
+            env[f"{name}__lo"] = _pad(
+                (v - hi.astype(np.float64)).astype(np.float32), n_padded
+            )
+            continue
+        env[name] = _pad(coerce_host_values(values), n_padded)
     return env
 
 
@@ -509,12 +568,16 @@ def coerce_host_values(values: np.ndarray) -> np.ndarray:
     return values
 
 
-def flat_arg_names(leaf_names: list[str]) -> list[str]:
-    """Positional arg order of the fused kernel: value, validity per leaf."""
+def flat_arg_names(leaves: dict[str, LeafSpec]) -> list[str]:
+    """Positional arg order of the fused kernel, per leaf kind."""
     out = []
-    for n in leaf_names:
-        out.append(n)
-        out.append(f"{n}__valid")
+    for n, spec in leaves.items():
+        if spec.kind == "column_validity":
+            out.append(f"{n}__valid")
+        elif spec.kind == "column_pair":
+            out.extend([f"{n}__hi", f"{n}__lo", f"{n}__valid"])
+        else:
+            out.extend([n, f"{n}__valid"])
     return out
 
 
@@ -536,6 +599,9 @@ def bucket_rows(n: int, floor: int = 1024) -> int:
 class KernelAggSpec:
     func: str  # sum | count | avg | min | max | count_star
     has_arg: bool
+    # x32 only: the arg closure yields an exact f32 (hi, lo) pair for an
+    # i64 column; the kernel sums both halves and recombines error-free
+    pair: bool = False
 
 
 def state_fields(spec: KernelAggSpec, mode: str) -> tuple[str, ...]:
@@ -685,7 +751,7 @@ def _segment_sum_df32(v, seg_ids, capacity, block_cap: int = 4096):
     n = v.shape[0]
     if jax.default_backend() == "cpu":
         block = int(max(256, min(block_cap, n // 64)))
-    else:
+    elif capacity <= (1 << 16):
         # TPU scatter cost grows with block COUNT (each vmapped block is
         # its own serialized scatter), but compensation quality shrinks as
         # blocks grow: nb <= 64 bounds the vmap cost while worst-case
@@ -693,6 +759,11 @@ def _segment_sum_df32(v, seg_ids, capacity, block_cap: int = 4096):
         # path only runs at capacity > 8192, where typical rows/segment
         # per block are far smaller
         block = int(max(8192, -(-n // 64)))
+    else:
+        # very high cardinality: the [nb, capacity] partial buffer is the
+        # constraint (64 x 2M x 4B = 512MB per column) — nb <= 8 keeps it
+        # ~64MB; rows/segment are tiny here, so precision holds
+        block = int(max(1 << 16, -(-n // 8)))
     nb = -(-n // block)
     nb = 1 << (nb - 1).bit_length()  # pow2 block count for the pair tree
     n2 = nb * block
@@ -766,6 +837,20 @@ def make_partial_agg_kernel(
                 outs.append(n)
                 continue
             if spec.func in ("sum", "avg"):
+                if spec.pair:  # x32 i64 pair: sum halves, recombine exactly
+                    vhi, vlo = val
+                    z = jnp.zeros((), jnp.float32)
+                    a_hi, a_lo = _segment_sum_df32(
+                        jnp.where(m, vhi, z), seg_ids, capacity
+                    )
+                    b_hi, b_lo = _segment_sum_df32(
+                        jnp.where(m, vlo, z), seg_ids, capacity
+                    )
+                    s, e = _two_sum(a_hi, b_hi)
+                    outs.append(s)
+                    outs.append(a_lo + b_lo + e)
+                    outs.append(n)
+                    continue
                 v = jnp.where(m, val.astype(_F()), jnp.zeros((), _F()))
                 if mode == "x32":
                     hi, lo = _segment_sum_df32(v, seg_ids, capacity)
@@ -833,6 +918,14 @@ def make_partial_agg_kernel(
             nj = cnt_col(m, avalid)
             if spec.func == "count":
                 plan.append(("count", nj))
+            elif spec.func in ("sum", "avg") and spec.pair:
+                vhi, vlo = val
+                z = jnp.zeros((), jnp.float32)
+                sj1 = len(sum_cols)
+                sum_cols.append(jnp.where(m, vhi, z))
+                sj2 = len(sum_cols)
+                sum_cols.append(jnp.where(m, vlo, z))
+                plan.append(("sumpair", sj1, sj2, nj))
             elif spec.func in ("sum", "avg"):
                 sj = len(sum_cols)
                 sum_cols.append(
@@ -861,6 +954,11 @@ def make_partial_agg_kernel(
         for entry in plan:
             if entry[0] == "count":
                 outs.append(counts[:, entry[1]])
+            elif entry[0] == "sumpair":
+                s, e = _two_sum(hi[:, entry[1]], hi[:, entry[2]])
+                outs.append(s)
+                outs.append(lo[:, entry[1]] + lo[:, entry[2]] + e)
+                outs.append(counts[:, entry[3]])
             elif entry[0] == "sum":
                 outs.append(hi[:, entry[1]])
                 outs.append(lo[:, entry[1]])
@@ -913,9 +1011,12 @@ def state_is_int(spec: KernelAggSpec, mode: str) -> tuple[bool, ...]:
 
 # Packed-fetch plumbing: on the tunnel-attached TPU only FETCHES block
 # (block_until_ready is unreliable), and every fetch pays a ~35ms
-# roundtrip.  Packing the whole state tuple into ONE array (int fields
-# bitcast into the float dtype) makes materialization a single roundtrip
-# instead of one per state field.
+# roundtrip.  Packing the whole state tuple into ONE array makes
+# materialization a single roundtrip instead of one per state field.
+# The pack travels in the INTEGER domain (floats bitcast to i32/i64):
+# int→float bitcasts produce denormal bit patterns that the TPU flushes
+# to zero during multi-row relayout — measured: a [2, 1] stack of
+# bitcast counts came back all-zero — while integer copies are exact.
 _PACK_CACHE: dict = {}
 
 
@@ -932,9 +1033,9 @@ def pack_for_fetch(specs: list[KernelAggSpec], acc: tuple, mode: str):
             fdt = jnp.float64 if mode == "x64" else jnp.float32
             idt = jnp.int64 if mode == "x64" else jnp.int32
             rows = [
-                jax.lax.bitcast_convert_type(a.astype(idt), fdt)
+                a.astype(idt)
                 if is_int
-                else a.astype(fdt)
+                else jax.lax.bitcast_convert_type(a.astype(fdt), idt)
                 for a, is_int in zip(states, flags)
             ]
             return jnp.stack(rows, axis=0)
@@ -949,10 +1050,10 @@ def unpack_host(
 ) -> list[np.ndarray]:
     """Host-side inverse of :func:`pack_for_fetch` (numpy, no device)."""
     flags = [f for spec in specs for f in state_is_int(spec, mode)] + [True]
-    idt = np.int64 if mode == "x64" else np.int32
+    fdt = np.float64 if mode == "x64" else np.float32
     out = []
     for row, is_int in zip(packed, flags):
-        out.append(row.view(idt) if is_int else row)
+        out.append(row if is_int else row.view(fdt))
     return out
 
 

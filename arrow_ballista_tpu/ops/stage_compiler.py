@@ -47,14 +47,34 @@ class _SmallInput(Exception):
         self.batches = batches
 
 
+class _HighCardinality(Exception):
+    """Control flow: the first batch showed groups ~ rows — the C++ hash
+    aggregate beats transfer + device scatter for that shape, so the stage
+    hands back to the CPU path, replaying the consumed batch and chaining
+    the still-live source iterator (no re-scan)."""
+
+    def __init__(self, batches: list, tail):
+        super().__init__("high-cardinality aggregate")
+        self.batches = batches
+        self.tail = tail
+
+
+# High-cardinality CPU selection: below either bound the device path wins
+# (measured q1 SF10: 38x); above both, q3 SF10's 3M-group aggregate ran
+# 0.6x CPU — pyarrow's hash table is the right tool when groups ~ rows.
+_HIGHCARD_MIN_GROUPS = 1 << 16
+_HIGHCARD_RATIO = 0.05
+
+
 class _BufferedExec(ExecutionPlan):
     """In-memory stand-in for a stage source whose batches were already
-    pulled by the small-input peek."""
+    pulled by a peek (optionally chaining the still-live remainder)."""
 
-    def __init__(self, template: ExecutionPlan, batches: list):
+    def __init__(self, template: ExecutionPlan, batches: list, tail=None):
         super().__init__()
         self._template = template
         self._batches = batches
+        self._tail = tail
 
     @property
     def schema(self) -> pa.Schema:
@@ -71,6 +91,8 @@ class _BufferedExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         yield from self._batches
+        if self._tail is not None:
+            yield from self._tail
 
 
 # Compiled-kernel cache: plans are rebuilt per query, but the fused kernel
@@ -182,17 +204,65 @@ class TpuStageExec(ExecutionPlan):
             for f in fused.filters[1:]:
                 pred = pe.Binary(pred, "AND", f)
             filter_closure = compiler._lower_or_leaf(pred)
-        arg_closures: list[Optional[K.JaxClosure]] = []
-        specs: list[K.KernelAggSpec] = []
-        for a in fused.aggs:
-            if a.func == "count_distinct":
-                raise K.NotLowerable("count_distinct")
+        x32 = K.precision_mode() == "x32"
+        # two passes: count(col) resolves AFTER the other aggregates so it
+        # can reuse a column leaf's validity that is shipping anyway,
+        # instead of adding a duplicate mask leaf
+        pending: list = [None] * len(fused.aggs)
+        count_cols: list[tuple[int, pe.Col]] = []
+        for idx, a in enumerate(fused.aggs):
             if a.arg is None:
-                specs.append(K.KernelAggSpec("count_star", False))
-                arg_closures.append(None)
+                if a.func not in ("count", "count_star"):
+                    raise K.NotLowerable(a.func)
+                pending[idx] = (K.KernelAggSpec("count_star", False), None)
+                continue
+            if a.func not in ("count", "sum", "avg", "min", "max"):
+                # count_distinct, udaf:*, anything unknown: reject at PLAN
+                # time so no partition pays a failed device trace
+                raise K.NotLowerable(a.func)
+            if a.func == "count" and isinstance(a.arg, pe.Col):
+                count_cols.append((idx, a.arg))
+                continue
+            t = (
+                fused.source.schema.field(a.arg.index).type
+                if isinstance(a.arg, pe.Col)
+                else None
+            )
+            if (
+                x32
+                and a.func == "avg"
+                and t is not None
+                and (pa.types.is_int64(t) or pa.types.is_uint64(t))
+            ):
+                # avg(i64) rides as an f32 (hi, lo) pair: each VALUE is
+                # 48-bit exact, the float average is good to ~1e-7 — no
+                # i32 narrowing cliff.  sum(i64) keeps the CPU fallback
+                # past i32 range: its INT output must be bit-exact, and
+                # block-level f32 partials round at 2^24-scale totals.
+                pending[idx] = (
+                    K.KernelAggSpec(a.func, True, pair=True),
+                    compiler.pair_column(a.arg),
+                )
+                continue
+            pending[idx] = (
+                K.KernelAggSpec(a.func, True), compiler._lower(a.arg)
+            )
+        for idx, colarg in count_cols:
+            # count(col) needs only the validity mask — wide i64 / string
+            # columns never ship values (round-2 x32 cliff); reuse an
+            # existing leaf's validity when the column ships anyway
+            existing = None
+            for cand in (f"col_{colarg.index}", f"col_{colarg.index}__pair"):
+                if cand in compiler.leaves:
+                    existing = f"{cand}__valid"
+                    break
+            if existing is not None:
+                closure = (lambda vn: lambda env: (None, env[vn]))(existing)
             else:
-                specs.append(K.KernelAggSpec(a.func, True))
-                arg_closures.append(compiler._lower(a.arg))
+                closure = compiler.validity_only(colarg)
+            pending[idx] = (K.KernelAggSpec("count", True), closure)
+        specs = [s for s, _ in pending]
+        arg_closures: list[Optional[K.JaxClosure]] = [c for _, c in pending]
         self.leaves = compiler.leaves
         self.specs = specs
         self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
@@ -202,7 +272,7 @@ class TpuStageExec(ExecutionPlan):
         self._filter_closure = filter_closure
         self._arg_closures = arg_closures
         self._leaf_names = list(self.leaves.keys())
-        self._flat_names = K.flat_arg_names(self._leaf_names)
+        self._flat_names = K.flat_arg_names(self.leaves)
         self._mode = K.precision_mode()
         sig = (
             tuple(str(f) for f in fused.filters),
@@ -289,6 +359,19 @@ class TpuStageExec(ExecutionPlan):
                     )
                 ]
             )
+        except _HighCardinality as hc:
+            # groups ~ rows: hand the stage to the C++ hash aggregate,
+            # replaying the consumed batch + chaining the live source
+            self.metrics.add("highcard_fallback", 1)
+            cpu_plan = self.original.with_new_children(
+                [
+                    _replace_leaf(
+                        self.original.input,
+                        self.fused.source,
+                        _BufferedExec(self.fused.source, hc.batches, hc.tail),
+                    )
+                ]
+            )
         except (_CapacityExceeded, ExecutionError):
             # group cardinality exceeded the device segment table, or a
             # column type slipped past plan-time lowering checks — re-run
@@ -326,14 +409,13 @@ class TpuStageExec(ExecutionPlan):
         self, partition: int, ctx: TaskContext
     ) -> Iterator[pa.RecordBatch]:
         from . import device_cache
-        from .bridge import DictEncoder
 
         fused = self.fused
         ck = self._cache_key(ctx)
         if ck is not None:
             cached = device_cache.get(ck[0], partition, ck[1])
             if cached is not None:
-                entries, key_encoders, gid_tuples, n_rows_in, cap = cached
+                entries, key_encoders, group_table, n_rows_in, cap = cached
                 _, kernel = self._kernel_for(cap)
                 acc = None
                 with self.metrics.timer("tpu_stage_time_ns"):
@@ -344,7 +426,7 @@ class TpuStageExec(ExecutionPlan):
                         host_states = self._fetch_states(acc)
                 self.metrics.add("cache_hits", 1)
                 yield from self._materialize(
-                    host_states, key_encoders, gid_tuples, n_rows_in, ctx,
+                    host_states, key_encoders, group_table, n_rows_in, ctx,
                     partition,
                 )
                 return
@@ -370,9 +452,14 @@ class TpuStageExec(ExecutionPlan):
                 raise _SmallInput(buffered)
             src = itertools.chain(buffered, src)
 
-        key_encoders = [DictEncoder() for _ in fused.group_exprs]
-        tuple_gids: dict[tuple, int] = {}
-        gid_tuples: list[tuple] = []
+        from .bridge import make_key_encoder
+        from .groups import GroupTable
+
+        key_encoders = [
+            make_key_encoder(self._schema.field(i).type)
+            for i in range(len(fused.group_exprs))
+        ]
+        group_table = GroupTable(len(fused.group_exprs))
         entries = []
 
         acc = None
@@ -390,15 +477,20 @@ class TpuStageExec(ExecutionPlan):
                 if fused.group_exprs:
                     with self.metrics.timer("key_encode_time_ns"):
                         seg = self._encode_groups(
-                            batch, key_encoders, tuple_gids, gid_tuples
+                            batch, key_encoders, group_table
                         )
                     if acc is None and not entries:
+                        if (
+                            group_table.n_groups > _HIGHCARD_MIN_GROUPS
+                            and group_table.n_groups > _HIGHCARD_RATIO * n
+                        ):
+                            raise _HighCardinality([batch], src)
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path
                         # FLOPs scale with capacity, so a 6-group q1 must
                         # not pay for the 1024-slot default table
                         tight = 64
-                        while tight < 2 * max(1, len(gid_tuples)):
+                        while tight < 2 * max(1, group_table.n_groups):
                             tight *= 4
                         if tight < cap:
                             cap = min(tight, self.max_capacity)
@@ -407,8 +499,8 @@ class TpuStageExec(ExecutionPlan):
                     # buckets when the data's cardinality outruns it,
                     # padding accumulated states (VERDICT round-1: fixed
                     # 4096 caps fell back to CPU on q3/h2o shapes)
-                    if len(gid_tuples) > cap:
-                        while cap < len(gid_tuples):
+                    if group_table.n_groups > cap:
+                        while cap < group_table.n_groups:
                             cap *= 4
                         cap = min(cap, self.max_capacity)
                         acc = K.pad_states(self.specs, acc, cap, self._mode)
@@ -444,10 +536,10 @@ class TpuStageExec(ExecutionPlan):
         if ck is not None and acc is not None:
             device_cache.put(
                 ck[0], partition, ck[1],
-                (entries, key_encoders, gid_tuples, n_rows_in, cap),
+                (entries, key_encoders, group_table, n_rows_in, cap),
             )
         yield from self._materialize(
-            host_states, key_encoders, gid_tuples, n_rows_in, ctx, partition
+            host_states, key_encoders, group_table, n_rows_in, ctx, partition
         )
 
     def _fetch_states(self, acc) -> Optional[list]:
@@ -457,52 +549,39 @@ class TpuStageExec(ExecutionPlan):
         packed = K.pack_for_fetch(self.specs, acc, self._mode)
         return K.unpack_host(self.specs, np.asarray(packed), self._mode)
 
-    def _encode_groups(self, batch, key_encoders, tuple_gids, gid_tuples):
+    def _encode_groups(self, batch, key_encoders, group_table):
         """Vectorized multi-key → dense group id encoding, any key count.
 
-        Per-key global dictionary codes fold pairwise into one int64 —
-        re-densified with np.unique at each step so the 21-bit shift never
-        overflows regardless of how many GROUP BY keys there are (the
-        round-1 design unpacked bits and was capped at 3 keys).  Each
-        distinct combination's per-key codes are recovered from a
-        representative row, so only NEW combinations touch Python.
+        Per-key global dictionary codes fold into one int64 via growing
+        per-key radix bits; known combinations resolve with searchsorted
+        and only MISSES pay one np.unique (ops/groups.py — the round-2
+        design looped Python over every new combination: 6 of q3 SF10's
+        7.8 stage-seconds).
         """
+        from .groups import RadixOverflow
+
         code_arrays = [
             enc.encode(_eval_arr(g, batch))
             for (g, _), enc in zip(self.fused.group_exprs, key_encoders)
         ]
-        for enc in key_encoders:
-            if enc.size >= (1 << 21):
-                raise _CapacityExceeded()
-        combined = code_arrays[0].astype(np.int64)
-        for c in code_arrays[1:]:
-            _, dense = np.unique(combined, return_inverse=True)
-            combined = (dense.astype(np.int64) << 21) | c.astype(np.int64)
-        uniq, first_idx, inverse = np.unique(
-            combined, return_index=True, return_inverse=True
-        )
-        key_mat = np.stack([c[first_idx] for c in code_arrays], axis=1)
-        local_gids = np.empty(len(uniq), dtype=np.int32)
-        for j in range(len(uniq)):
-            t = tuple(key_mat[j].tolist())
-            gid = tuple_gids.get(t)
-            if gid is None:
-                gid = len(gid_tuples)
-                if gid >= self.max_capacity:
-                    raise _CapacityExceeded()
-                tuple_gids[t] = gid
-                gid_tuples.append(t)
-            local_gids[j] = gid
-        return local_gids[inverse].astype(np.int32)
+        try:
+            gids = group_table.encode(code_arrays)
+        except RadixOverflow:
+            raise _CapacityExceeded()
+        if group_table.n_groups > self.max_capacity:
+            raise _CapacityExceeded()
+        return gids
 
     # ------------------------------------------------------- materialize
     def _materialize(
-        self, host_states, key_encoders, gid_tuples, n_rows_in,
+        self, host_states, key_encoders, group_table, n_rows_in,
         ctx: TaskContext, partition: int,
     ) -> Iterator[pa.RecordBatch]:
         """Build the output batch from already-fetched numpy state arrays
         (``host_states`` comes from :meth:`_fetch_states`; device work and
-        the fetch are accounted to device_time_ns by then)."""
+        the fetch are accounted to device_time_ns by then).  Everything is
+        vectorized — per-group Python loops cost seconds at q3/h2o
+        cardinalities."""
         fused = self.fused
         schema = self._schema
 
@@ -513,15 +592,15 @@ class TpuStageExec(ExecutionPlan):
                 yield from self.original.execute(partition, ctx)
             return
 
-        n_groups = len(gid_tuples) if fused.group_exprs else 1
+        n_groups = group_table.n_groups if fused.group_exprs else 1
         host = [a[:n_groups] for a in host_states]
         presence = host[-1]
         keep = np.nonzero(presence > 0)[0] if fused.group_exprs else np.arange(1)
 
         cols: list[pa.Array] = []
         for i, ((_, _name), enc) in enumerate(zip(fused.group_exprs, key_encoders)):
-            vals = [enc.reverse[gid_tuples[g][i]] for g in keep]
-            cols.append(pa.array(vals, schema.field(len(cols)).type))
+            codes = group_table.codes_for(keep, i)
+            cols.append(enc.decode(codes, schema.field(len(cols)).type))
 
         partial = fused.mode == PARTIAL
         i = 0
@@ -540,32 +619,31 @@ class TpuStageExec(ExecutionPlan):
                 n_arr = host[i + 2][keep]
                 i += 3
             else:
-                v = host[i][keep]
+                v = host[i][keep].astype(np.float64)
                 n_arr = host[i + 1][keep]
                 i += 2
+            empty = n_arr == 0
             if spec.func == "avg":
                 if partial:
                     cols.append(pa.array(v, pa.float64()))
                     cols.append(pa.array(n_arr, pa.int64()))
                 else:
+                    denom = np.where(empty, 1, n_arr)
                     cols.append(
-                        pa.array(
-                            [
-                                None if c == 0 else float(x) / c
-                                for x, c in zip(v.tolist(), n_arr.tolist())
-                            ],
-                            pa.float64(),
-                        )
+                        pa.array(v / denom, pa.float64(), mask=empty)
                     )
                 continue
             field_t = schema.field(len(cols)).type
-            pyvals = [
-                None if c == 0 else x for x, c in zip(v.tolist(), n_arr.tolist())
-            ]
             if pa.types.is_integer(field_t):
                 # device accumulates in f64; exact for |sum| < 2^53
-                pyvals = [None if x is None else int(round(x)) for x in pyvals]
-            cols.append(pa.array(pyvals, field_t))
+                # (±inf extrema identities of empty groups are masked out,
+                # zeroed first so the int cast can't warn)
+                v_int = np.round(np.where(np.isfinite(v), v, 0.0))
+                cols.append(
+                    pa.array(v_int.astype(np.int64), field_t, mask=empty)
+                )
+            else:
+                cols.append(pa.array(v, field_t, mask=empty))
 
         out = pa.RecordBatch.from_arrays(cols, schema=schema)
         self.metrics.add("output_rows", out.num_rows)

@@ -164,7 +164,7 @@ class BatchExchanger:
     reassembles per-destination RecordBatches.
     """
 
-    def __init__(self, mesh: Mesh, schema, capacity: int):
+    def __init__(self, mesh: Mesh, schema, capacity: int, share_from=None):
         import pyarrow as pa
 
         from ..ops import kernels as K
@@ -173,6 +173,16 @@ class BatchExchanger:
         self.mesh = mesh
         self.schema = schema
         self.capacity = capacity
+        if share_from is not None:
+            # capacity retry: the layout/encoders (and any columns already
+            # produced by to_columns) are schema-properties, capacity only
+            # parameterizes the jitted exchange — share them
+            self._x32 = share_from._x32
+            self.layout = share_from.layout
+            self.encoders = share_from.encoders
+            self.n_cols = share_from.n_cols
+            self._fn = ici_batch_exchange(mesh, self.n_cols, capacity)
+            return
         self._x32 = K.precision_mode() == "x32"
         # per-field device layout: "num" (one array), "dict" (codes),
         # "i64pair" (lo/hi split — exchange-exact without device i64)
@@ -276,12 +286,14 @@ class BatchExchanger:
                 validity = recv_cols[ci][sl][mask]
                 ci += 1
                 if kind == "dict":
-                    rev = self.encoders[i].reverse
-                    pyvals = [
-                        rev[c] if ok else None
-                        for c, ok in zip(values.tolist(), validity.tolist())
-                    ]
-                    arrays.append(pa.array(pyvals, f.type))
+                    # vectorized decode: the repartition path pushes up to
+                    # mesh.exchange_max_rows rows through here
+                    rev = np.asarray(self.encoders[i].reverse, dtype=object)
+                    safe = np.where(validity, values, 0)
+                    vals = rev[safe] if len(rev) else np.full(len(safe), None)
+                    arrays.append(
+                        pa.array(vals.tolist(), f.type, mask=~validity)
+                    )
                 else:
                     arrays.append(
                         pa.array(
@@ -384,6 +396,55 @@ def ici_all_to_all_repartition(mesh: Mesh, capacity: int):
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def assemble_shards(
+    mesh: Mesh, per_dev_chunks: list, n_cols: int
+) -> list[jax.Array]:
+    """Device-resident chunks → global row-sharded arrays, no host concat.
+
+    ``per_dev_chunks[d]`` is a list of chunks already placed on device d,
+    each chunk a list of ``n_cols`` equal-length 1-D arrays (the streaming
+    upload path: partitions transfer as they are scanned).  Shards must
+    share one length, so each device concatenates ITS chunks and pads to
+    the longest device — on device, in shard-size pieces — then the padded
+    per-device arrays stitch into one sharded array per column via
+    ``make_array_from_single_device_arrays``.  Pad rows are zeros, which
+    the kernels' validity column (False-padded) masks out.
+    """
+    devices = list(mesh.devices.flatten())
+    assert len(per_dev_chunks) == len(devices)
+    lens = [
+        sum(int(ch[0].shape[0]) for ch in chunks) for chunks in per_dev_chunks
+    ]
+    L = max(max(lens), 1)
+    protos = [
+        next(ch[c] for chunks in per_dev_chunks for ch in chunks)
+        for c in range(n_cols)
+    ]
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out = []
+    for c in range(n_cols):
+        per_dev = []
+        for d, chunks in enumerate(per_dev_chunks):
+            pieces = [ch[c] for ch in chunks]
+            if not pieces:
+                a = jax.device_put(
+                    np.zeros(L, dtype=protos[c].dtype), devices[d]
+                )
+            else:
+                a = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+                pad = L - int(a.shape[0])
+                if pad:
+                    a = jnp.pad(a, (0, pad))
+                a = jax.device_put(a, devices[d])
+            per_dev.append(a)
+        out.append(
+            jax.make_array_from_single_device_arrays(
+                (L * len(devices),), sharding, per_dev
+            )
+        )
+    return out
 
 
 def shard_batch(

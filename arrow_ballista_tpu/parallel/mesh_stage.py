@@ -120,23 +120,37 @@ class MeshGangExec(ExecutionPlan):
         import jax
 
         from ..ops import kernels as K
-        from ..ops.bridge import DictEncoder
         from . import mesh as M
 
         fused = tpu.fused
         n_dev = self.n_devices or ctx.config.mesh_devices or len(jax.devices())
         n_dev = max(1, min(n_dev, len(jax.devices())))
 
-        key_encoders = [DictEncoder() for _ in fused.group_exprs]
-        tuple_gids: dict = {}
-        gid_tuples: list = []
-        segs: list[np.ndarray] = []
-        leaf_arrays: dict[str, list[np.ndarray]] = {
-            nm: [] for nm in tpu._flat_names
-        }
+        from ..ops.groups import GroupTable
+
+        from ..ops.bridge import make_key_encoder
+
+        key_encoders = [
+            make_key_encoder(tpu._schema.field(i).type)
+            for i in range(len(fused.group_exprs))
+        ]
+        group_table = GroupTable(len(fused.group_exprs))
         n_rows = 0
         n_parts = fused.source.output_partitioning().n
+        # Partitions ARE the shards: each partition's arrays transfer to
+        # its device (round-robin) as soon as the partition is scanned, so
+        # peak host memory is ONE partition and source I/O overlaps device
+        # transfer (round-2 weakness #6: the old path np.concatenate'd the
+        # whole stage input on host first).  Column order per device chunk:
+        # [seg, valid, *flat_names].
+        names = ["__seg", "__valid"] + list(tpu._flat_names)
+        n_dev_chunks: list[list[list]] = []  # [device][chunk][column]
         with self.metrics.timer("mesh_stage_time_ns"):
+            import jax as _jax
+
+            mesh = M.make_mesh(n_dev)
+            devices = list(mesh.devices.flatten())
+            n_dev_chunks = [[] for _ in devices]
             for p in range(n_parts):
                 for batch in fused.source.execute(p, ctx):
                     ctx.check_cancelled()
@@ -146,33 +160,50 @@ class MeshGangExec(ExecutionPlan):
                     if fused.group_exprs:
                         with self.metrics.timer("key_encode_time_ns"):
                             seg = tpu._encode_groups(
-                                batch, key_encoders, tuple_gids, gid_tuples
+                                batch, key_encoders, group_table
                             )
+                        if n_rows == 0:
+                            from ..ops.stage_compiler import (
+                                _HIGHCARD_MIN_GROUPS,
+                                _HIGHCARD_RATIO,
+                            )
+
+                            if (
+                                group_table.n_groups > _HIGHCARD_MIN_GROUPS
+                                and group_table.n_groups > _HIGHCARD_RATIO * n
+                            ):
+                                # groups ~ rows: the sequential fallback
+                                # will route each partition to the C++
+                                # hash aggregate
+                                from ..errors import ExecutionError
+
+                                raise ExecutionError(
+                                    "high-cardinality gang stage"
+                                )
                     else:
                         seg = np.zeros(n, dtype=np.int32)
-                    segs.append(seg)
                     with self.metrics.timer("bridge_time_ns"):
                         env = K.build_env(batch, tpu.leaves, n)
-                    for nm in tpu._flat_names:
-                        leaf_arrays[nm].append(env[nm])
+                        cols = [seg, np.ones(n, dtype=bool)] + [
+                            env[nm] for nm in tpu._flat_names
+                        ]
+                        dev = devices[p % n_dev]
+                        n_dev_chunks[p % n_dev].append(
+                            [_jax.device_put(c, dev) for c in cols]
+                        )
                     n_rows += n
+                    # host copies die with `env`/`cols` at next iteration
 
             if n_rows == 0:
                 yield from tpu._materialize(
-                    None, key_encoders, gid_tuples, 0, ctx, 0
+                    None, key_encoders, group_table, 0, ctx, 0
                 )
                 return
-
-            seg = np.concatenate(segs)
-            valid = np.ones(n_rows, dtype=bool)
-            args = [
-                np.concatenate(leaf_arrays[nm]) for nm in tpu._flat_names
-            ]
 
             # same 4x capacity bucketing as the sequential device path —
             # segment ids beyond the table would be dropped silently
             cap = tpu.capacity
-            while cap < len(gid_tuples):
+            while cap < group_table.n_groups:
                 cap *= 4
             cap = min(cap, tpu.max_capacity)
             if cap > tpu.capacity:
@@ -181,15 +212,13 @@ class MeshGangExec(ExecutionPlan):
             step_key = (tpu._sig, n_dev, cap) + K.algo_cache_token()
             step = _MESH_STEP_CACHE.get(step_key)
             if step is None:
-                mesh = M.make_mesh(n_dev)
                 raw_kernel, _ = tpu._kernel_for(cap)
                 step = M.make_distributed_agg_step(
                     raw_kernel, tpu.specs, mesh, cap, tpu._mode
                 )
                 _MESH_STEP_CACHE[step_key] = step
             with self.metrics.timer("device_time_ns"):
-                mesh = M.make_mesh(n_dev)
-                sharded = M.shard_batch(mesh, [seg, valid] + args)
+                sharded = M.assemble_shards(mesh, n_dev_chunks, len(names))
                 out = step(*sharded)
                 # packed fetch = the only reliable sync on the tunnel TPU
                 # (block_until_ready is a no-op there); one roundtrip
@@ -197,7 +226,7 @@ class MeshGangExec(ExecutionPlan):
         self.metrics.add("mesh_rows_in", n_rows)
         self.metrics.add("mesh_devices", n_dev)
         yield from tpu._materialize(
-            host_states, key_encoders, gid_tuples, n_rows, ctx, 0
+            host_states, key_encoders, group_table, n_rows, ctx, 0
         )
 
 
@@ -360,12 +389,21 @@ class MeshRepartitionExec(ExecutionPlan):
 
             mesh = M.make_mesh(n_dev)
             try:
+                base_ex = None
+                cols = None
                 while True:
-                    ex = M.BatchExchanger(mesh, ext_schema, cap)
-                    cols_per_batch = [ex.to_columns(b) for b in ext_batches]
-                    cols = [
-                        np.concatenate(parts) for parts in zip(*cols_per_batch)
-                    ]
+                    ex = M.BatchExchanger(
+                        mesh, ext_schema, cap, share_from=base_ex
+                    )
+                    if cols is None:  # encoding is capacity-independent
+                        base_ex = ex
+                        cols_per_batch = [
+                            ex.to_columns(b) for b in ext_batches
+                        ]
+                        cols = [
+                            np.concatenate(parts)
+                            for parts in zip(*cols_per_batch)
+                        ]
                     with self.metrics.timer("device_time_ns"):
                         recv_cols, recv_valid, n_dropped = ex.exchange(
                             dest_dev, valid, cols

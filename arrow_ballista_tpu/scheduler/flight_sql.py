@@ -51,6 +51,9 @@ class FlightSqlService(flight.FlightServerBase):
         self.session_ctx = scheduler.state.session_manager.create_session({})
         # handle → SQL text (reference: statements cache flight_sql.rs:66)
         self._prepared: Dict[str, str] = {}
+        # handle → positional parameter values bound via DoPut (reference:
+        # do_put CommandPreparedStatementQuery, flight_sql.rs:199-227)
+        self._params: Dict[str, list] = {}
         self._lock = threading.Lock()
 
     # ---------------------------------------------------------- statements
@@ -58,9 +61,23 @@ class FlightSqlService(flight.FlightServerBase):
         """Plan + enqueue; returns job id (reference: flight_sql.rs:239-255).
 
         DDL (CREATE EXTERNAL TABLE / SET / SHOW) executes eagerly in the
-        session; its result relation is then submitted like any query so
-        the client still gets a normal FlightInfo back."""
-        plan = self.session_ctx.sql(sql).logical_plan()
+        shared session under the lock so its effects persist; QUERIES plan
+        on a per-statement ``fork()`` of the session, so concurrent
+        statements can't race each other's CTE registrations in the shared
+        catalog (round-1 advisor finding: shared-session CTE race)."""
+        from ..sql import ast
+        from ..sql.parser import parse_sql
+
+        stmt = parse_sql(sql)
+        if isinstance(stmt, ast.Query):
+            # fork() copies the catalog dict, so it must not race the DDL
+            # branch's mutations — take the same lock for the (cheap) copy
+            with self._lock:
+                fork = self.session_ctx.fork()
+            plan = fork.sql(sql).logical_plan()
+        else:
+            with self._lock:
+                plan = self.session_ctx.sql(sql).logical_plan()
         job_id = self.scheduler.state.task_manager.generate_job_id()
         self.scheduler.submit_job(job_id, self.session_ctx.session_id, plan)
         return job_id
@@ -88,7 +105,11 @@ class FlightSqlService(flight.FlightServerBase):
             sql = descriptor.command.decode("utf-8", "replace")
             with self._lock:
                 # a prepared-statement handle round-trips as the command too
+                handle = sql
                 sql = self._prepared.get(sql, sql)
+                params = self._params.get(handle)
+            if params is not None:
+                sql = _bind_positional(sql, params)
         else:
             raise flight.FlightServerError("descriptor must carry a SQL command")
         job_id = self._submit_sql(sql)
@@ -125,6 +146,27 @@ class FlightSqlService(flight.FlightServerBase):
             schema, descriptor, endpoints, total_rows, total_bytes
         )
 
+    def do_put(self, context, descriptor, reader, writer):
+        """Bind positional parameters to a prepared statement (reference:
+        do_put CommandPreparedStatementQuery, flight_sql.rs:199-227): the
+        descriptor command is the prepared handle, the stream is a ONE-row
+        batch whose columns are the ``?`` values in order."""
+        handle = (descriptor.command or b"").decode("utf-8", "replace")
+        table = reader.read_all()
+        if table.num_rows != 1:
+            raise flight.FlightServerError(
+                f"parameter batch must have exactly 1 row, got {table.num_rows}"
+            )
+        values = [table.column(i)[0].as_py() for i in range(table.num_columns)]
+        with self._lock:
+            # validate + store under ONE acquisition: a concurrent Close
+            # between a check and a write would leak a permanent entry
+            if handle not in self._prepared:
+                raise flight.FlightServerError(
+                    f"unknown prepared handle {handle!r}"
+                )
+            self._params[handle] = values
+
     def do_action(self, context, action: flight.Action):
         """Prepared-statement lifecycle (reference: flight_sql.rs prepared
         handling): CreatePreparedStatement / ClosePreparedStatement."""
@@ -138,6 +180,7 @@ class FlightSqlService(flight.FlightServerBase):
             handle = action.body.to_pybytes().decode("utf-8", "replace")
             with self._lock:
                 self._prepared.pop(handle, None)
+                self._params.pop(handle, None)
             yield flight.Result(b"ok")
         else:
             raise flight.FlightServerError(f"unknown action {action.type!r}")
@@ -147,6 +190,82 @@ class FlightSqlService(flight.FlightServerBase):
             ("CreatePreparedStatement", "register a SQL text, returns a handle"),
             ("ClosePreparedStatement", "drop a prepared handle"),
         ]
+
+
+def _bind_positional(sql: str, values: list) -> str:
+    """Substitute ``?`` placeholders with SQL literals, positionally.
+
+    Skips string literals ('' escapes), double-quoted identifiers and
+    ``--`` line comments — a ``?`` inside any of those is content, not a
+    placeholder."""
+    out = []
+    it = iter(values)
+    state = None  # None | "str" | "ident" | "comment"
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if state == "str":
+            out.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    out.append("'")
+                    i += 1
+                else:
+                    state = None
+        elif state == "ident":
+            out.append(ch)
+            if ch == '"':
+                state = None
+        elif state == "comment":
+            out.append(ch)
+            if ch == "\n":
+                state = None
+        elif ch == "'":
+            state = "str"
+            out.append(ch)
+        elif ch == '"':
+            state = "ident"
+            out.append(ch)
+        elif ch == "-" and i + 1 < len(sql) and sql[i + 1] == "-":
+            state = "comment"
+            out.append(ch)
+        elif ch == "?":
+            try:
+                v = next(it)
+            except StopIteration:
+                raise flight.FlightServerError(
+                    "more ? placeholders than bound parameters"
+                )
+            out.append(_sql_literal(v))
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _sql_literal(v) -> str:
+    import datetime
+    import decimal
+
+    if v is None:
+        return "NULL"
+    if isinstance(v, decimal.Decimal):
+        return str(v)  # numeric literal, not a quoted string
+    if isinstance(v, (bytes, bytearray)):
+        raise flight.FlightServerError(
+            "binary parameters are not supported in SQL text binding"
+        )
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+        return "NULL"  # nan/inf have no SQL literal form
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, datetime.datetime):  # before date: datetime IS a date
+        return f"timestamp '{v.isoformat(sep=' ')}'"
+    if isinstance(v, datetime.date):
+        return f"date '{v.isoformat()}'"
+    return "'" + str(v).replace("'", "''") + "'"
 
 
 class FlightSqlHandle:

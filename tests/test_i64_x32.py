@@ -1,0 +1,231 @@
+"""x32 i64 cliff (VERDICT round-2 weakness #8 / next-round item 4).
+
+Round 2 narrowed i64 host columns to i32 and fell back to CPU per
+partition whenever a value exceeded 2^31 — exactly the orderkey/custkey
+scale of TPC-H SF100.  Round 3: count(col) ships only the validity mask,
+and i64 sum/avg args ride as exact f32 (hi, lo) pairs (48-bit exact).
+These tests run the x32 device path on columns far beyond i32 range and
+require tpu_fallback == 0 with EXACT integer answers.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops import kernels as K
+from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+
+@pytest.fixture(autouse=True)
+def _x32():
+    K.set_precision("x32")
+    yield
+    K.set_precision(None)
+
+
+def _ctx():
+    return SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true",
+                "ballista.tpu.min_rows": "0",
+                "ballista.mesh.enable": "false",
+            }
+        )
+    )
+
+
+def _metrics(plan):
+    agg = {}
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TpuStageExec):
+            for k, v in n.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(n.children())
+    return agg
+
+
+def _run(sql: str, table: pa.Table):
+    ctx = _ctx()
+    ctx.register_table("t", MemoryTable.from_table(table, 2))
+    plan = ctx.sql(sql).physical_plan()
+    out = ctx.execute(plan)
+    return out, _metrics(plan)
+
+
+def _big_table(n=5000, seed=11):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 5, n).astype(np.int64)
+    big = (rng.integers(0, 1 << 40, n) + (1 << 33)).astype(np.int64)
+    vals = rng.uniform(1.0, 100.0, n)
+    mask = rng.random(n) < 0.1
+    big_nullable = pa.array(
+        [None if m else int(v) for v, m in zip(big, mask)], pa.int64()
+    )
+    return (
+        pa.table(
+            {
+                "k": pa.array(keys),
+                "big": pa.array(big),
+                "bign": big_nullable,
+                "v": pa.array(vals),
+            }
+        ),
+        keys,
+        big,
+        big_nullable,
+    )
+
+
+def test_count_wide_i64_stays_on_device():
+    t, keys, big, bign = _big_table()
+    out, m = _run(
+        "select k, count(bign), count(*) from t group by k order by k", t
+    )
+    assert m.get("tpu_fallback", 0) == 0, m
+    assert "device_time_ns" in m, m
+    nulls = np.array([v is None for v in bign.to_pylist()])
+    for row in out.to_pylist():
+        k = row["k"]
+        assert row["count(bign)"] == int(((keys == k) & ~nulls).sum())
+        assert row["count(Star)" if "count(Star)" in row else "count(*)"] == int(
+            (keys == k).sum()
+        )
+
+
+def test_avg_wide_i64_on_device_sum_exact_via_fallback():
+    t, keys, big, _ = _big_table()
+    # avg(i64): float output — pair path keeps it on device at ~1e-7
+    out2, m2 = _run("select k, avg(big) from t group by k order by k", t)
+    assert m2.get("tpu_fallback", 0) == 0, m2
+    assert "device_time_ns" in m2, m2
+    for row in out2.to_pylist():
+        sel = big[keys == row["k"]]
+        assert row["avg(big)"] == pytest.approx(sel.sum() / len(sel), rel=1e-7)
+
+    # sum(i64) past i32 range: INT output must be bit-exact, so the
+    # engine deliberately falls back to CPU for the partition — correct
+    # answer over fast answer
+    out, m = _run("select k, sum(big) from t group by k order by k", t)
+    for row in out.to_pylist():
+        want = int(big[keys == row["k"]].sum())
+        assert row["sum(big)"] == want  # EXACT integer equality
+
+
+def test_q3_with_big_orderkeys_no_fallback():
+    """THE acceptance check: q3-shaped aggregate over orderkeys > 2^31
+    keeps the device path (tpu_fallback == 0) and matches the oracle."""
+    from benchmarks.tpch.datagen import gen_customer, gen_lineitem, gen_orders
+    from benchmarks.tpch.queries import QUERIES
+
+    def bump(t, cols):
+        arrays = {}
+        for f in t.schema:
+            c = t.column(f.name)
+            if f.name in cols:
+                c = pa.chunked_array(
+                    [
+                        pa.array(
+                            np.asarray(ch).astype(np.int64) + (1 << 33),
+                            pa.int64(),
+                        )
+                        for ch in c.chunks
+                    ]
+                )
+            arrays[f.name] = c
+        return pa.table(arrays)
+
+    li = bump(gen_lineitem(0.01), {"l_orderkey"})
+    od = bump(gen_orders(0.01), {"o_orderkey"})
+    cu = gen_customer(0.01)
+
+    ctx = _ctx()
+    ctx.register_table("lineitem", MemoryTable.from_table(li, 2))
+    ctx.register_table("orders", MemoryTable.from_table(od, 2))
+    ctx.register_table("customer", MemoryTable.from_table(cu, 2))
+    plan = ctx.sql(QUERIES[3]).physical_plan()
+    got = ctx.execute(plan)
+    m = _metrics(plan)
+    assert m.get("tpu_fallback", 0) == 0, m
+    assert m.get("cpu_fallback", 0) == 0, m
+    assert "device_time_ns" in m, m
+
+    off = SessionContext(BallistaConfig({"ballista.tpu.enable": "false"}))
+    off.register_table("lineitem", MemoryTable.from_table(li, 2))
+    off.register_table("orders", MemoryTable.from_table(od, 2))
+    off.register_table("customer", MemoryTable.from_table(cu, 2))
+    want = off.sql(QUERIES[3]).collect()
+    assert got.num_rows == want.num_rows
+    for name in want.column_names:
+        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
+            if isinstance(x, float):
+                assert y == pytest.approx(x, rel=1e-6), name
+            else:
+                assert x == y, name
+
+
+def test_udaf_rejected_at_plan_time():
+    """udaf:* aggregates must keep the CPU plan (no TpuStageExec, so no
+    per-partition failed device trace — round-2 advisor finding)."""
+    from arrow_ballista_tpu.udf import AggregateUDF
+
+    t = pa.table({"k": pa.array([1, 2, 1], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+    ctx = _ctx()
+
+    def my_last(values: pa.Array):
+        vals = [v.as_py() for v in values if v.is_valid]
+        return vals[-1] if vals else None
+
+    ctx.register_udaf(
+        AggregateUDF("my_last", my_last, pa.float64(), pa.float64())
+    )
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    plan = ctx.sql("select k, my_last(v) from t group by k").physical_plan()
+    found = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        found.append(type(n).__name__)
+        stack.extend(n.children())
+    assert "TpuStageExec" not in found, found
+
+
+def test_high_cardinality_routes_to_cpu_hash_agg():
+    """Groups ~ rows: the stage must hand off to the C++ hash aggregate
+    (highcard_fallback) without re-scanning the source, and still be
+    correct.  Measured basis: q3 SF10's 3M-group aggregate ran 0.6x CPU
+    through the device path."""
+    rng = np.random.default_rng(5)
+    n = 300_000
+    keys = rng.integers(0, 150_000, n).astype(np.int64)  # ~50% distinct
+    t = pa.table({"k": pa.array(keys), "v": pa.array(np.ones(n))})
+    out, m = _run("select k, sum(v) from t group by k order by k limit 5", t)
+    assert m.get("highcard_fallback", 0) >= 1, m
+    assert "device_time_ns" not in m, m  # never touched the device
+    assert out.num_rows == 5
+    import collections
+
+    counts = collections.Counter(keys.tolist())
+    for row in out.to_pylist():
+        assert row["sum(v)"] == counts[row["k"]]
+
+
+def test_null_group_keys_stay_on_device():
+    """Nullable int group keys must keep the device path (identity codes
+    reserve 0 for NULL — review finding: a mid-stream null used to force
+    a full CPU re-scan)."""
+    t = pa.table(
+        {
+            "k": pa.array([1, None, 2, None, 1], pa.int64()),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        }
+    )
+    out, m = _run("select k, sum(v) from t group by k order by k", t)
+    assert m.get("tpu_fallback", 0) == 0, m
+    d = {r["k"]: r["sum(v)"] for r in out.to_pylist()}
+    assert d == {1: 6.0, 2: 3.0, None: 6.0}

@@ -221,3 +221,127 @@ def test_decorrelation_preserves_qualifiers():
         """
     ).collect()
     assert r.to_pydict() == {"k": [1, 1, 2], "x": [5.0, 5.0, 7.0]}
+
+
+def test_avro_truncated_varint_raises_avro_error():
+    """avro.read_long must raise AvroError on truncated/corrupt input, not
+    IndexError or spin on an unbounded shift (round-1 advisor finding)."""
+    import pytest
+
+    from arrow_ballista_tpu.avro import AvroError, _Reader
+
+    r = _Reader(b"\x80\x80")  # continuation bits with no terminator
+    with pytest.raises(AvroError):
+        r.read_long()
+
+    r2 = _Reader(b"\x80" * 12 + b"\x01")  # > 64-bit varint
+    with pytest.raises(AvroError):
+        r2.read_long()
+
+
+def test_scalar_udf_wrong_output_length_raises():
+    """A UDF returning the wrong row count must fail loudly, not corrupt
+    row alignment (round-1 advisor finding)."""
+    import pyarrow as pa
+    import pytest
+
+    from arrow_ballista_tpu import SessionContext
+    from arrow_ballista_tpu.errors import ExecutionError
+    from arrow_ballista_tpu.udf import ScalarUDF
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"x": [1.0, 2.0, 3.0]}))
+    ctx.register_udf(
+        ScalarUDF(
+            "bad_len",
+            lambda a: pa.array([1.0]),  # always one row
+            (pa.float64(),),
+            pa.float64(),
+        )
+    )
+    with pytest.raises(ExecutionError, match="returned 1 rows"):
+        ctx.sql("select bad_len(x) from t").collect()
+
+
+def test_session_fork_isolates_cte_registration():
+    """fork() gives a statement-scoped catalog view: CTEs registered while
+    planning on a fork never touch the parent session (the FlightSQL
+    shared-session race, round-1 advisor finding)."""
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import SessionContext
+
+    parent = SessionContext()
+    parent.register_arrow_table("base", pa.table({"a": [1, 2, 3]}))
+
+    f1 = parent.fork()
+    f2 = parent.fork()
+    # both forks plan WITH-queries that shadow the same name concurrently
+    r1 = f1.sql("with c as (select a from base where a > 1) select * from c")
+    r2 = f2.sql("with c as (select a from base where a > 2) select * from c")
+    assert r1.collect().num_rows == 2
+    assert r2.collect().num_rows == 1
+    # the parent catalog never saw a 'c' table
+    assert "c" not in parent.catalog.tables
+    # and forks see parent tables without copying data
+    assert f1.sql("select * from base").collect().num_rows == 3
+
+
+def test_flight_sql_concurrent_cte_statements(tmp_path):
+    """End-to-end: concurrent FlightSQL statements with colliding CTE
+    names all return correct answers (each plans on a session fork)."""
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.flight as flight
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu import BallistaConfig
+    from arrow_ballista_tpu.scheduler.flight_sql import FlightSqlHandle
+
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"a": list(range(100))}), str(tmp_path / "t.parquet"))
+    bctx = BallistaContext.standalone(
+        config=BallistaConfig({"ballista.shuffle.partitions": "1"}),
+        work_dir=str(tmp_path / "wd"),
+    )
+    try:
+        handle = FlightSqlHandle(
+            bctx._standalone_handles[0].server, "127.0.0.1", 0
+        ).start()
+        client = flight.connect(f"grpc://127.0.0.1:{handle.port}")
+        # DDL once through FlightSQL so the table persists in the session
+        info = client.get_flight_info(
+            flight.FlightDescriptor.for_command(
+                b"create external table t stored as parquet location '%s'"
+                % str(tmp_path / "t.parquet").encode()
+            )
+        )
+        results = {}
+        errors = []
+
+        def run(thresh):
+            try:
+                sql = (
+                    f"with c as (select a from t where a >= {thresh}) "
+                    "select count(*) as n from c"
+                ).encode()
+                info = client.get_flight_info(
+                    flight.FlightDescriptor.for_command(sql)
+                )
+                for ep in info.endpoints:
+                    tbl = flight.connect(ep.locations[0]).do_get(ep.ticket).read_all()
+                    results[thresh] = tbl.column("n")[0].as_py()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(k,)) for k in (10, 40, 90)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert results == {10: 90, 40: 60, 90: 10}
+    finally:
+        bctx.close()

@@ -244,3 +244,58 @@ def test_executor_janitor(tmp_path):
     j.sweep(500)
     assert not (tmp_path / "jobX").exists()
     assert (tmp_path / "jobY").exists()
+
+
+def test_flight_sql_prepared_with_doput_params(cluster):
+    """Prepared-statement parameter binding: CreatePreparedStatement →
+    DoPut a 1-row parameter batch → execute by handle (reference:
+    flight_sql.rs:199-227 do_put prepared-statement flow)."""
+    import pyarrow.flight as flight
+    import pyarrow.parquet as pq
+
+    from arrow_ballista_tpu.scheduler.flight_sql import FlightSqlHandle
+
+    pq.write_table(
+        pa.table({"g": ["a", "a", "b", "b"], "v": [1, 2, 10, 20]}),
+        "/tmp/fs_p.parquet",
+    )
+    handle = FlightSqlHandle(
+        cluster._standalone_handles[0].server, "127.0.0.1", 0
+    ).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{handle.port}")
+        client.get_flight_info(
+            flight.FlightDescriptor.for_command(
+                b"CREATE EXTERNAL TABLE fs_p STORED AS PARQUET LOCATION '/tmp/fs_p.parquet'"
+            )
+        )
+        res = list(
+            client.do_action(
+                flight.Action(
+                    "CreatePreparedStatement",
+                    b"select g, sum(v) as s from fs_p where g = ? and v >= ? group by g",
+                )
+            )
+        )
+        ph = res[0].body.to_pybytes().decode()
+
+        params = pa.record_batch(
+            {"p0": pa.array(["b"]), "p1": pa.array([15])}
+        )
+        desc = flight.FlightDescriptor.for_command(ph.encode())
+        writer, _ = client.do_put(desc, params.schema)
+        writer.write_batch(params)
+        writer.close()
+
+        info = client.get_flight_info(desc)
+        rows = []
+        for ep in info.endpoints:
+            tbl = flight.connect(ep.locations[0]).do_get(ep.ticket).read_all()
+            rows.extend(
+                zip(tbl.column("g").to_pylist(), tbl.column("s").to_pylist())
+            )
+        assert rows == [("b", 20)]
+
+        list(client.do_action(flight.Action("ClosePreparedStatement", ph.encode())))
+    finally:
+        handle.stop()
