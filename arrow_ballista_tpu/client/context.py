@@ -86,6 +86,7 @@ class BallistaContext:
         concurrent_tasks: int = 4,
         policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
         work_dir: Optional[str] = None,
+        heartbeat_interval_s: float = 5.0,
     ) -> "BallistaContext":
         """In-proc cluster: scheduler + executors over real gRPC/Flight on
         random localhost ports (reference: context.rs:140-210)."""
@@ -100,6 +101,7 @@ class BallistaContext:
                 concurrent_tasks=concurrent_tasks,
                 policy=policy,
                 work_dir=work_dir,
+                heartbeat_interval_s=heartbeat_interval_s,
             )
             for _ in range(num_executors)
         ]

@@ -221,8 +221,11 @@ class SessionContext:
         return DataFrame(self, lp.TableScan(name.lower(), provider))
 
     # -- SQL -------------------------------------------------------------
-    def sql(self, query: str) -> DataFrame:
-        stmt = parse_sql(query)
+    def sql(self, query: str, stmt: Optional[ast.Statement] = None) -> DataFrame:
+        """Run a SQL statement.  ``stmt`` lets a caller that already parsed
+        the text (FlightSQL's Query/DDL dispatch) skip the second parse."""
+        if stmt is None:
+            stmt = parse_sql(query)
         if isinstance(stmt, ast.Query):
             if stmt.ctes:
                 return self._sql_with_ctes(stmt)

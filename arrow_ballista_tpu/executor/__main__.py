@@ -33,6 +33,7 @@ CONFIG_KEYS = {
     "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
     "job_data_ttl_seconds": (int, 604800, "delete job dirs older than this"),
+    "heartbeat_sidecar": (int, 1, "process-isolated liveness backstop (0=off)"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "executor", "log file prefix"),
@@ -197,6 +198,15 @@ def main(argv=None) -> None:
     stub = SchedulerGrpcStub(
         make_channel(cfg["scheduler_host"], cfg["scheduler_port"])
     )
+    sidecar = None
+    if cfg["heartbeat_sidecar"]:
+        # liveness survives anything the main process's GIL is doing (the
+        # TPU-side answer to the reference's DedicatedExecutor isolation)
+        from .isolation import HeartbeatSidecar
+
+        sidecar = HeartbeatSidecar(
+            executor.id, cfg["scheduler_host"], cfg["scheduler_port"]
+        ).start()
     server = None
     loop = None
     if policy == TaskSchedulingPolicy.PUSH_STAGED:
@@ -224,6 +234,8 @@ def main(argv=None) -> None:
             )
         except Exception:
             pass
+        if sidecar is not None:
+            sidecar.stop()
         if loop is not None:
             loop.stop()
         if server is not None:

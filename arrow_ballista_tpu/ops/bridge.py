@@ -129,9 +129,17 @@ class DictEncoder:
     def to_arrow(self, dtype: pa.DataType) -> pa.Array:
         return pa.array(self.reverse, dtype)
 
-    def decode(self, codes: np.ndarray, t: pa.DataType) -> pa.Array:
-        """codes → original values (vectorized object fancy-index)."""
+    def decode(
+        self, codes: np.ndarray, t: pa.DataType,
+        mask: Optional[np.ndarray] = None,
+    ) -> pa.Array:
+        """codes → original values (vectorized object fancy-index);
+        ``mask`` marks null rows (their codes may be garbage)."""
         rev = np.asarray(self.reverse, dtype=object)
+        if mask is not None:
+            safe = np.where(mask, 0, codes)
+            vals = rev[safe] if len(rev) else np.full(len(safe), None)
+            return pa.array(vals.tolist(), t, mask=mask)
         return pa.array(rev[codes].tolist(), t)
 
 

@@ -288,11 +288,8 @@ class BatchExchanger:
                 if kind == "dict":
                     # vectorized decode: the repartition path pushes up to
                     # mesh.exchange_max_rows rows through here
-                    rev = np.asarray(self.encoders[i].reverse, dtype=object)
-                    safe = np.where(validity, values, 0)
-                    vals = rev[safe] if len(rev) else np.full(len(safe), None)
                     arrays.append(
-                        pa.array(vals.tolist(), f.type, mask=~validity)
+                        self.encoders[i].decode(values, f.type, mask=~validity)
                     )
                 else:
                     arrays.append(

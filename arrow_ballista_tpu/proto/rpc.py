@@ -34,6 +34,22 @@ _EXECUTOR_METHODS = {
     "CancelTasks": (pb.CancelTasksParams, pb.CancelTasksResult),
 }
 
+_KV_METHODS = {
+    "Get": (pb.KvGetParams, pb.KvGetResult),
+    "GetFromPrefix": (pb.KvScanParams, pb.KvScanResult),
+    "Scan": (pb.KvScanParams, pb.KvScanResult),
+    "Put": (pb.KvPutParams, pb.KvPutResult),
+    "PutTxn": (pb.KvTxnParams, pb.KvTxnResult),
+    "Mv": (pb.KvMvParams, pb.KvMvResult),
+    "Delete": (pb.KvDeleteParams, pb.KvDeleteResult),
+    "Lock": (pb.KvLockParams, pb.KvLockResult),
+    "Unlock": (pb.KvUnlockParams, pb.KvUnlockResult),
+}
+# server-streaming: handled separately from the unary table
+_KV_STREAM_METHODS = {
+    "Watch": (pb.KvWatchParams, pb.KvWatchEvent),
+}
+
 # Tuned channel options (reference: core/src/utils.rs:318-345 keepalive /
 # nodelay / 20s connect timeout).
 GRPC_OPTIONS = [
@@ -95,6 +111,50 @@ def add_scheduler_servicer(server: grpc.Server, servicer) -> None:
 def add_executor_servicer(server: grpc.Server, servicer) -> None:
     server.add_generic_rpc_handlers(
         (_generic_handler("ExecutorGrpc", _EXECUTOR_METHODS, servicer),)
+    )
+
+
+class KvStoreGrpcStub(_Stub):
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(channel, "KvStoreGrpc", _KV_METHODS)
+        for name, (req_t, resp_t) in _KV_STREAM_METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_stream(
+                    f"/ballista_tpu.KvStoreGrpc/{name}",
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                ),
+            )
+
+
+def add_kvstore_servicer(server: grpc.Server, servicer) -> None:
+    handlers = {}
+    for name, (req_t, resp_t) in _KV_METHODS.items():
+        fn = getattr(servicer, name, None)
+        if fn is None:
+            continue
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    for name, (req_t, resp_t) in _KV_STREAM_METHODS.items():
+        fn = getattr(servicer, name, None)
+        if fn is None:
+            continue
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
+            fn,
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "ballista_tpu.KvStoreGrpc", handlers
+            ),
+        )
     )
 
 

@@ -27,6 +27,10 @@ class Keyspace(str, Enum):
     Slots = "slots"
     Sessions = "sessions"
     Heartbeats = "heartbeats"
+    # scheduler liveness lives APART from executor heartbeats: the
+    # executor-manager watches Heartbeats with an empty prefix and decodes
+    # every event as ExecutorHeartbeat protobuf
+    Schedulers = "schedulers"
 
 
 class WatchEvent:
@@ -282,17 +286,25 @@ class SqliteBackend(_WatchMixin, _LockMixin, StateBackend):
             self._conn.close()
 
 
-class EtcdBackend(StateBackend):  # pragma: no cover - requires etcd3 client
-    """Remote HA backend slot. The reference supports etcd
-    (``backend/etcd.rs``); this image has no etcd client library, so the
-    class documents the integration point and fails fast if selected."""
+def EtcdBackend(endpoints: str, namespace: str = "ballista"):
+    """Remote HA backend (the reference's etcd slot, ``backend/etcd.rs``).
 
-    def __init__(self, endpoints: str, namespace: str = "ballista"):
-        raise NotImplementedError(
-            "etcd backend requires the python 'etcd3' client, which is not "
-            "available in this environment; use SqliteBackend (durable) or "
-            "MemoryBackend (in-proc) instead"
-        )
+    This image has no etcd3 client, so the same semantics — shared remote
+    store, transactional puts, lease locks with TTL expiry, prefix watches
+    — are served by this repo's own KvStoreGrpc service
+    (:mod:`.kvstore`): run ``python -m arrow_ballista_tpu.scheduler.kvstore``
+    (optionally over sqlite for durability) and point every scheduler's
+    ``--state-backend etcd --etcd-urls host:port`` at it.
+    """
+    from .kvstore import RemoteBackend
+
+    # comma lists accepted for etcd-flag compatibility; the store is a
+    # single service, so extra endpoints are failover spares (unused yet)
+    first = endpoints.split(",")[0].strip()
+    host, _, port = first.partition(":")
+    return RemoteBackend(
+        host or "127.0.0.1", int(port or 50060), namespace=namespace
+    )
 
 
 def create_backend(kind: str, path: Optional[str] = None) -> StateBackend:

@@ -149,6 +149,11 @@ class ExecutorManager:
                 if hb.status == "dead":
                     self._dead.add(hb.executor_id)
 
+    def heartbeats(self) -> List["ExecutorHeartbeat"]:
+        """Snapshot of the in-memory heartbeat map (observability/tests)."""
+        with self._hb_lock:
+            return list(self._heartbeats.values())
+
     def get_alive_executors(self, now: Optional[float] = None) -> Set[str]:
         now = time.time() if now is None else now
         cutoff = now - self.liveness_window_s

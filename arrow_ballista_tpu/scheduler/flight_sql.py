@@ -74,10 +74,10 @@ class FlightSqlService(flight.FlightServerBase):
             # branch's mutations — take the same lock for the (cheap) copy
             with self._lock:
                 fork = self.session_ctx.fork()
-            plan = fork.sql(sql).logical_plan()
+            plan = fork.sql(sql, stmt=stmt).logical_plan()
         else:
             with self._lock:
-                plan = self.session_ctx.sql(sql).logical_plan()
+                plan = self.session_ctx.sql(sql, stmt=stmt).logical_plan()
         job_id = self.scheduler.state.task_manager.generate_job_id()
         self.scheduler.submit_job(job_id, self.session_ctx.session_id, plan)
         return job_id
@@ -195,12 +195,13 @@ class FlightSqlService(flight.FlightServerBase):
 def _bind_positional(sql: str, values: list) -> str:
     """Substitute ``?`` placeholders with SQL literals, positionally.
 
-    Skips string literals ('' escapes), double-quoted identifiers and
-    ``--`` line comments — a ``?`` inside any of those is content, not a
+    Skips string literals ('' escapes), double-quoted identifiers, ``--``
+    line comments and ``/* */`` block comments (all legal in this
+    dialect's lexer) — a ``?`` inside any of those is content, not a
     placeholder."""
     out = []
     it = iter(values)
-    state = None  # None | "str" | "ident" | "comment"
+    state = None  # None | "str" | "ident" | "comment" | "block"
     i = 0
     while i < len(sql):
         ch = sql[i]
@@ -220,6 +221,12 @@ def _bind_positional(sql: str, values: list) -> str:
             out.append(ch)
             if ch == "\n":
                 state = None
+        elif state == "block":
+            out.append(ch)
+            if ch == "*" and i + 1 < len(sql) and sql[i + 1] == "/":
+                out.append("/")
+                i += 1
+                state = None
         elif ch == "'":
             state = "str"
             out.append(ch)
@@ -228,6 +235,9 @@ def _bind_positional(sql: str, values: list) -> str:
             out.append(ch)
         elif ch == "-" and i + 1 < len(sql) and sql[i + 1] == "-":
             state = "comment"
+            out.append(ch)
+        elif ch == "/" and i + 1 < len(sql) and sql[i + 1] == "*":
+            state = "block"
             out.append(ch)
         elif ch == "?":
             try:

@@ -1,0 +1,347 @@
+"""Remote etcd-semantics state store: the HA substrate.
+
+Counterpart of the reference's etcd backend
+(``scheduler/src/state/backend/etcd.rs:37-345``): several schedulers share
+ONE external store so any of them can take over a peer's jobs.  The python
+etcd3 client isn't in this image, so the same semantics ride this repo's
+own gRPC service (``KvStoreGrpc`` in ballista.proto):
+
+* transactional multi-put (etcd Txn ↔ ``PutTxn`` over the local backend's
+  ``put_txn``);
+* distributed locks as LEASES with TTL auto-expiry (etcd lock + keep-alive
+  ↔ ``Lock``/``Unlock`` with ``ttl_s``; a crashed holder's lease simply
+  expires, `etcd.rs:333-345`);
+* prefix watches as server streams (etcd watch ↔ ``Watch``).
+
+``KvStoreServer`` wraps any local :class:`StateBackend` (sqlite for
+durability); ``RemoteBackend`` implements the ``StateBackend`` ABC over
+the stub so the whole scheduler state layer runs unchanged against the
+shared store.  ``python -m arrow_ballista_tpu.scheduler.kvstore`` runs a
+standalone store.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from ..proto import pb
+from ..proto.rpc import (
+    GRPC_OPTIONS,
+    KvStoreGrpcStub,
+    add_kvstore_servicer,
+    make_channel,
+    make_server,
+)
+from .backend import Keyspace, StateBackend, WatchEvent, Watcher
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LOCK_TTL_S = 30.0
+DEFAULT_LOCK_WAIT_S = 20.0
+
+
+# ------------------------------------------------------------------ server
+class _Lease:
+    __slots__ = ("owner", "expires")
+
+    def __init__(self, owner: str, expires: float):
+        self.owner = owner
+        self.expires = expires
+
+
+class KvStoreService:
+    """gRPC servicer over a local StateBackend + lease table."""
+
+    def __init__(self, backend: StateBackend):
+        self.backend = backend
+        self._leases: Dict[Tuple[str, str], _Lease] = {}
+        self._lease_guard = threading.Lock()
+
+    # ---- kv ----
+    def Get(self, req: pb.KvGetParams, ctx) -> pb.KvGetResult:
+        v = self.backend.get(Keyspace(req.keyspace), req.key)
+        return pb.KvGetResult(found=v is not None, value=v or b"")
+
+    def GetFromPrefix(self, req: pb.KvScanParams, ctx) -> pb.KvScanResult:
+        pairs = self.backend.get_from_prefix(Keyspace(req.keyspace), req.prefix)
+        return pb.KvScanResult(
+            pairs=[pb.KvPair(key=k, value=v) for k, v in pairs]
+        )
+
+    def Scan(self, req: pb.KvScanParams, ctx) -> pb.KvScanResult:
+        pairs = self.backend.scan(Keyspace(req.keyspace))
+        if req.prefix:
+            pairs = [(k, v) for k, v in pairs if k.startswith(req.prefix)]
+        return pb.KvScanResult(
+            pairs=[pb.KvPair(key=k, value=v) for k, v in pairs]
+        )
+
+    def Put(self, req: pb.KvPutParams, ctx) -> pb.KvPutResult:
+        self.backend.put(Keyspace(req.keyspace), req.key, req.value)
+        return pb.KvPutResult()
+
+    def PutTxn(self, req: pb.KvTxnParams, ctx) -> pb.KvTxnResult:
+        self.backend.put_txn(
+            [(Keyspace(op.keyspace), op.key, op.value) for op in req.ops]
+        )
+        return pb.KvTxnResult()
+
+    def Mv(self, req: pb.KvMvParams, ctx) -> pb.KvMvResult:
+        self.backend.mv(
+            Keyspace(req.from_keyspace), Keyspace(req.to_keyspace), req.key
+        )
+        return pb.KvMvResult()
+
+    def Delete(self, req: pb.KvDeleteParams, ctx) -> pb.KvDeleteResult:
+        self.backend.delete(Keyspace(req.keyspace), req.key)
+        return pb.KvDeleteResult()
+
+    # ---- leases ----
+    def Lock(self, req: pb.KvLockParams, ctx) -> pb.KvLockResult:
+        ttl = req.ttl_s or DEFAULT_LOCK_TTL_S
+        wait = req.wait_s or DEFAULT_LOCK_WAIT_S
+        key = (req.keyspace, req.key)
+        deadline = time.monotonic() + wait
+        while True:
+            now = time.monotonic()
+            with self._lease_guard:
+                lease = self._leases.get(key)
+                if lease is None or lease.expires <= now or lease.owner == req.owner:
+                    self._leases[key] = _Lease(req.owner, now + ttl)
+                    return pb.KvLockResult(acquired=True)
+            if now >= deadline:
+                return pb.KvLockResult(acquired=False)
+            time.sleep(0.01)
+
+    def Unlock(self, req: pb.KvUnlockParams, ctx) -> pb.KvUnlockResult:
+        key = (req.keyspace, req.key)
+        with self._lease_guard:
+            lease = self._leases.get(key)
+            if lease is not None and lease.owner == req.owner:
+                del self._leases[key]
+        return pb.KvUnlockResult()
+
+    # ---- watch ----
+    def Watch(self, req: pb.KvWatchParams, ctx):
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        unsub = self.backend.watch(
+            Keyspace(req.keyspace), req.prefix, q.put
+        )
+        try:
+            while ctx.is_active():
+                try:
+                    ev = q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                yield pb.KvWatchEvent(
+                    kind=ev.kind, key=ev.key, value=ev.value or b""
+                )
+        finally:
+            unsub()
+
+
+class KvStoreHandle:
+    """Background KV store server with clean shutdown."""
+
+    def __init__(self, backend: StateBackend, host: str = "127.0.0.1", port: int = 0):
+        self.service = KvStoreService(backend)
+        self.server = make_server()
+        add_kvstore_servicer(self.server, self.service)
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    def start(self) -> "KvStoreHandle":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.stop(grace=1.0)
+
+
+# ------------------------------------------------------------------ client
+class _RemoteLock:
+    """Context-manager lock over the store's lease API (etcd lock shape:
+    acquire with TTL, release explicitly, expire on crash)."""
+
+    def __init__(self, stub, keyspace: str, key: str, owner: str):
+        self._stub = stub
+        self._keyspace = keyspace
+        self._key = key
+        self._owner = owner
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        res = self._stub.Lock(
+            pb.KvLockParams(
+                keyspace=self._keyspace,
+                key=self._key,
+                owner=self._owner,
+                wait_s=timeout or 0.0,
+            )
+        )
+        return res.acquired
+
+    def release(self) -> None:
+        self._stub.Unlock(
+            pb.KvUnlockParams(
+                keyspace=self._keyspace, key=self._key, owner=self._owner
+            )
+        )
+
+    def __enter__(self):
+        if not self.acquire():
+            raise TimeoutError(
+                f"kv lock {self._keyspace}/{self._key} not acquired"
+            )
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class RemoteBackend(StateBackend):
+    """StateBackend over a shared KvStoreGrpc endpoint (the etcd slot).
+
+    ``namespace`` prefixes every key (etcd's ``/ballista/{namespace}/``
+    layout, `etcd.rs:49-60`): independent clusters can share one store
+    without seeing each other's state.
+    """
+
+    def __init__(
+        self, host: str, port: int, owner: str = "", namespace: str = ""
+    ):
+        import uuid
+
+        self._channel = make_channel(host, port)
+        self._stub = KvStoreGrpcStub(self._channel)
+        self._owner = owner or uuid.uuid4().hex[:12]
+        self._ns = f"{namespace}/" if namespace else ""
+        self._watch_threads: List[threading.Thread] = []
+        self._closed = threading.Event()
+
+    def _k(self, key: str) -> str:
+        return self._ns + key
+
+    def _strip(self, key: str) -> str:
+        return key[len(self._ns):] if self._ns else key
+
+    def get(self, keyspace: Keyspace, key: str) -> Optional[bytes]:
+        r = self._stub.Get(
+            pb.KvGetParams(keyspace=keyspace.value, key=self._k(key))
+        )
+        return r.value if r.found else None
+
+    def get_from_prefix(self, keyspace, prefix):
+        r = self._stub.GetFromPrefix(
+            pb.KvScanParams(keyspace=keyspace.value, prefix=self._k(prefix))
+        )
+        return [(self._strip(p.key), p.value) for p in r.pairs]
+
+    def scan(self, keyspace):
+        if self._ns:
+            return self.get_from_prefix(keyspace, "")
+        r = self._stub.Scan(pb.KvScanParams(keyspace=keyspace.value))
+        return [(p.key, p.value) for p in r.pairs]
+
+    def put(self, keyspace, key, value):
+        self._stub.Put(
+            pb.KvPutParams(
+                keyspace=keyspace.value, key=self._k(key), value=value
+            )
+        )
+
+    def put_txn(self, ops):
+        self._stub.PutTxn(
+            pb.KvTxnParams(
+                ops=[
+                    pb.KvTxnOp(keyspace=ks.value, key=self._k(k), value=v)
+                    for ks, k, v in ops
+                ]
+            )
+        )
+
+    def mv(self, from_keyspace, to_keyspace, key):
+        self._stub.Mv(
+            pb.KvMvParams(
+                from_keyspace=from_keyspace.value,
+                to_keyspace=to_keyspace.value,
+                key=self._k(key),
+            )
+        )
+
+    def delete(self, keyspace, key):
+        self._stub.Delete(
+            pb.KvDeleteParams(keyspace=keyspace.value, key=self._k(key))
+        )
+
+    def lock(self, keyspace: Keyspace, key: str):
+        return _RemoteLock(
+            self._stub, keyspace.value, self._k(key),
+            f"{self._owner}:{threading.get_ident()}",
+        )
+
+    def watch(self, keyspace: Keyspace, prefix: str, watcher: Watcher) -> Callable:
+        stop = threading.Event()
+        ns_prefix = self._k(prefix)
+
+        def run():
+            while not stop.is_set() and not self._closed.is_set():
+                try:
+                    stream = self._stub.Watch(
+                        pb.KvWatchParams(
+                            keyspace=keyspace.value, prefix=ns_prefix
+                        )
+                    )
+                    for ev in stream:
+                        if stop.is_set():
+                            break
+                        watcher(
+                            WatchEvent(
+                                ev.kind, self._strip(ev.key), ev.value or None
+                            )
+                        )
+                except Exception:  # noqa: BLE001 - incl. closed-channel ValueError
+                    if stop.is_set() or self._closed.is_set():
+                        return
+                    time.sleep(0.5)  # store restarting: retry the stream
+
+        t = threading.Thread(target=run, name=f"kv-watch-{prefix}", daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return stop.set
+
+    def close(self) -> None:
+        self._closed.set()
+        self._channel.close()
+
+
+def main() -> None:  # pragma: no cover - thin binary wrapper
+    import argparse
+
+    from .backend import MemoryBackend, SqliteBackend
+
+    p = argparse.ArgumentParser(prog="arrow_ballista_tpu.scheduler.kvstore")
+    p.add_argument("--bind-host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=50060)
+    p.add_argument("--db", default="", help="sqlite path (default: memory)")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    backend = SqliteBackend(args.db) if args.db else MemoryBackend()
+    handle = KvStoreHandle(backend, args.bind_host, args.port).start()
+    log.info("kv store serving on %s:%d", args.bind_host, handle.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        handle.stop()
+
+
+if __name__ == "__main__":
+    main()

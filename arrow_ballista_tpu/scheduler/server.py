@@ -24,7 +24,7 @@ from ..config import TaskSchedulingPolicy
 from ..plan import logical as lp
 from ..proto import pb
 from ..serde.scheduler_types import ExecutorMetadata
-from .backend import StateBackend
+from .backend import Keyspace, StateBackend
 from .event_loop import EventLoop
 from .execution_stage import TaskInfo
 from .executor_manager import (
@@ -85,6 +85,12 @@ class SchedulerServer:
     # ------------------------------------------------------------ lifecycle
     def init(self) -> "SchedulerServer":
         self.event_loop.start()
+        # restart-resume: re-arm every persisted active job before serving
+        # (Running stages were stored Resolved, so their tasks re-dispatch
+        # through the normal offer/poll path)
+        recovered = self.state.task_manager.recover_active_jobs()
+        if recovered:
+            log.info("recovered %d active job(s): %s", len(recovered), recovered)
         self._reaper = threading.Thread(
             target=self._reaper_loop, name="executor-reaper", daemon=True
         )
@@ -181,12 +187,75 @@ class SchedulerServer:
     # -------------------------------------------------------------- reaper
     def _reaper_loop(self) -> None:
         """Periodically expire executors whose heartbeats timed out
-        (reference: scheduler_server/mod.rs:192-253 expire_dead_executors)."""
+        (reference: scheduler_server/mod.rs:192-253 expire_dead_executors),
+        publish this scheduler's own liveness, and adopt jobs curated by
+        dead peer schedulers (HA failover over a shared backend)."""
         while not self._stop.wait(self.reaper_interval_s):
             try:
                 self._expire_dead_executors()
             except Exception:  # noqa: BLE001 - reaper must never die
                 log.exception("dead-executor reaper iteration failed")
+            try:
+                self.heartbeat_self()
+                self.take_over_dead_schedulers()
+            except Exception:  # noqa: BLE001
+                log.exception("scheduler-liveness sweep failed")
+
+    # --------------------------------------------------------- HA failover
+    SCHEDULER_HB_PREFIX = "scheduler:"
+    # a peer is dead only after missing several sweeps: the publish period
+    # IS the sweep period, so the threshold must be a clear multiple of it
+    # (executors use the same shape: 60s beats, 180s expiry)
+    SCHEDULER_DEAD_SWEEPS = 3.0
+
+    def heartbeat_self(self) -> None:
+        """Publish this scheduler's liveness into the shared backend (the
+        peer-visible analogue of executor heartbeats; its own keyspace so
+        the executor-heartbeat watch never sees it)."""
+        self.state.backend.put(
+            Keyspace.Schedulers,
+            f"{self.SCHEDULER_HB_PREFIX}{self.scheduler_id}",
+            str(time.time()).encode(),
+        )
+
+    def take_over_dead_schedulers(
+        self, timeout_s: Optional[float] = None
+    ) -> List[str]:
+        """Adopt active jobs curated by peers whose heartbeat expired.
+        With a shared etcd-style backend this is the multi-scheduler HA
+        story: any survivor resumes a dead curator's jobs (reference:
+        curator ids in ``execution_graph.rs:99-101`` +
+        ``backend/etcd.rs`` shared state)."""
+        timeout = (
+            timeout_s
+            if timeout_s is not None
+            else self.SCHEDULER_DEAD_SWEEPS * self.reaper_interval_s
+        )
+        now = time.time()
+        adopted: List[str] = []
+        for key, raw in self.state.backend.get_from_prefix(
+            Keyspace.Schedulers, self.SCHEDULER_HB_PREFIX
+        ):
+            peer = key[len(self.SCHEDULER_HB_PREFIX):]
+            if peer == self.scheduler_id:
+                continue
+            try:
+                ts = float(raw.decode())
+            except ValueError:
+                continue
+            if now - ts <= timeout:
+                continue
+            jobs = self.state.task_manager.take_over_jobs(peer)
+            # one survivor wins the takeover lock; clearing the heartbeat
+            # makes the adoption idempotent across sweeps
+            self.state.backend.delete(Keyspace.Schedulers, key)
+            if jobs:
+                log.warning(
+                    "adopted %d job(s) from dead scheduler %s: %s",
+                    len(jobs), peer, jobs,
+                )
+                adopted.extend(jobs)
+        return adopted
 
     def _expire_dead_executors(self) -> None:
         expired = self.state.executor_manager.get_expired_executors(

@@ -117,6 +117,49 @@ class TaskManager:
     def _persist(self, graph: ExecutionGraph) -> None:
         self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
 
+    # ------------------------------------------------------------ recovery
+    def recover_active_jobs(self) -> List[str]:
+        """Resume every ActiveJobs graph from the backend (scheduler
+        restart).  Graphs persist Running stages as Resolved
+        (execution_graph.py module rule, mirroring the reference's
+        ``execution_graph.rs:867-920``), so revive() re-marks their tasks
+        dispatchable and the normal offer/poll path re-executes exactly
+        the in-flight work — completed stages keep their locations.
+        Returns the recovered job ids."""
+        out: List[str] = []
+        for job_id in self.backend.scan_keys(Keyspace.ActiveJobs):
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                graph.revive()
+                self._persist(graph)
+                out.append(job_id)
+        return out
+
+    def take_over_jobs(self, dead_scheduler_id: str) -> List[str]:
+        """HA failover: adopt every active job CURATED by a dead peer
+        scheduler (reference: jobs carry a curator scheduler id,
+        ``execution_graph.rs:99-101``; with a shared etcd-style backend any
+        surviving scheduler can resume them).  Returns adopted job ids."""
+        out: List[str] = []
+        with self.backend.lock(Keyspace.ActiveJobs, f"takeover:{dead_scheduler_id}"):
+            for job_id in self.backend.scan_keys(Keyspace.ActiveJobs):
+                entry = self._entry(job_id)
+                with entry.lock:
+                    entry.graph = None  # peer may have persisted newer state
+                    graph = self._load(job_id, entry)
+                    if graph is None or graph.status in (COMPLETED, FAILED):
+                        continue
+                    if graph.scheduler_id != dead_scheduler_id:
+                        continue
+                    graph.scheduler_id = self.scheduler_id
+                    graph.revive()
+                    self._persist(graph)
+                    out.append(job_id)
+        return out
+
     # -------------------------------------------------------------- submit
     def submit_job(
         self,

@@ -420,3 +420,153 @@ def test_session_manager_persistence_and_rebuild():
     rebuilt = sm2.get_session(sid)
     assert rebuilt is not None
     assert rebuilt.config.shuffle_partitions == 7
+
+
+# ----------------------------------------------------- restart / resume
+def test_scheduler_restart_resumes_job_over_sqlite(tmp_path):
+    """Kill the scheduler mid-job; a NEW scheduler over the same sqlite
+    file resumes and completes it (VERDICT round-1 item 7 / round-2 item
+    6).  Running stages persist as Resolved (execution_graph.py module
+    rule, reference execution_graph.rs:867-920), so in-flight tasks
+    re-dispatch; stages completed before the crash keep their locations
+    and never re-run."""
+    db = str(tmp_path / "sched.db")
+
+    # --- scheduler A: submit, complete SOME tasks, then die
+    f1 = Fixture(TaskSchedulingPolicy.PULL_STAGED, backend=SqliteBackend(db))
+    try:
+        f1.state.executor_manager.register_executor(EXEC1)
+        ctx = f1.make_session()
+        job_id = f1.submit(ctx, "select g, sum(v) as s from t group by g")
+
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        # complete stage 1 ENTIRELY (both partitions): stage-level progress
+        # is the unit of preservation — a half-done Running stage persists
+        # as Resolved and re-dispatches whole, exactly like the reference
+        done_before = 0
+        for _ in range(2):
+            assignments, _, _ = f1.state.task_manager.fill_reservations(
+                [ExecutorReservation(EXEC1.id)]
+            )
+            assert assignments, "no task to run before the crash"
+            _, task = assignments[0]
+            part = task.output_partitioning
+            partitions = [
+                ShuffleWritePartition(p, f"/fake/{task.partition}/{p}", 1, 5, 50)
+                for p in range(part.n)
+            ] if part is not None else [
+                ShuffleWritePartition(
+                    task.partition.partition_id, f"/fake/{task.partition}", 1, 5, 50
+                )
+            ]
+            f1.sender.post(
+                TaskUpdating(
+                    EXEC1, [TaskInfo(task.partition, "completed", EXEC1.id,
+                                     partitions=partitions)]
+                )
+            )
+            assert f1.loop.drain(5.0)
+            done_before += 1
+        status = f1.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "running"
+    finally:
+        f1.stop()  # the "crash": event loop gone, cache gone
+
+    # --- scheduler B: fresh process-equivalent over the same sqlite file
+    f2 = Fixture(TaskSchedulingPolicy.PULL_STAGED, backend=SqliteBackend(db))
+    try:
+        recovered = f2.state.task_manager.recover_active_jobs()
+        assert job_id in recovered, recovered
+        f2.state.executor_manager.register_executor(EXEC1)
+
+        # the resumed job must still be visible and running
+        status = f2.state.task_manager.get_job_status(job_id)
+        assert status is not None and status["state"] == "running"
+
+        # drive to completion; count how many tasks B had to run
+        ran_after = 0
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        for _ in range(50):
+            assignments, _, pending = f2.state.task_manager.fill_reservations(
+                [ExecutorReservation(EXEC1.id)]
+            )
+            if not assignments:
+                if pending == 0:
+                    break
+                continue
+            _, task = assignments[0]
+            ran_after += 1
+            part = task.output_partitioning
+            partitions = [
+                ShuffleWritePartition(p, f"/fake2/{task.partition}/{p}", 1, 5, 50)
+                for p in range(part.n)
+            ] if part is not None else [
+                ShuffleWritePartition(
+                    task.partition.partition_id, f"/fake2/{task.partition}", 1, 5, 50
+                )
+            ]
+            f2.sender.post(
+                TaskUpdating(
+                    EXEC1, [TaskInfo(task.partition, "completed", EXEC1.id,
+                                     partitions=partitions)]
+                )
+            )
+            assert f2.loop.drain(5.0)
+
+        status = f2.state.task_manager.get_job_status(job_id)
+        assert status["state"] == "completed", status
+        assert status["locations"]
+        assert ran_after >= 1
+        assert f2.backend.get(Keyspace.CompletedJobs, job_id) is not None
+    finally:
+        f2.stop()
+
+    # --- baseline: the same job uninterrupted, to prove the pre-crash
+    # task was genuinely preserved (B ran exactly one task fewer)
+    f3 = Fixture(TaskSchedulingPolicy.PULL_STAGED)
+    try:
+        f3.state.executor_manager.register_executor(EXEC1)
+        ctx3 = f3.make_session()
+        job3 = f3.submit(ctx3, "select g, sum(v) as s from t group by g",
+                         job_id="job-base")
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        baseline = 0
+        for _ in range(50):
+            assignments, _, pending = f3.state.task_manager.fill_reservations(
+                [ExecutorReservation(EXEC1.id)]
+            )
+            if not assignments:
+                if pending == 0:
+                    break
+                continue
+            _, task = assignments[0]
+            baseline += 1
+            part = task.output_partitioning
+            partitions = [
+                ShuffleWritePartition(p, f"/fb/{task.partition}/{p}", 1, 5, 50)
+                for p in range(part.n)
+            ] if part is not None else [
+                ShuffleWritePartition(
+                    task.partition.partition_id, f"/fb/{task.partition}", 1, 5, 50
+                )
+            ]
+            f3.sender.post(
+                TaskUpdating(
+                    EXEC1, [TaskInfo(task.partition, "completed", EXEC1.id,
+                                     partitions=partitions)]
+                )
+            )
+            assert f3.loop.drain(5.0)
+        assert f3.state.task_manager.get_job_status(job3)["state"] == "completed"
+        assert ran_after == baseline - done_before, (ran_after, baseline)
+    finally:
+        f3.stop()
