@@ -508,6 +508,8 @@ def build_env(
 
     env: dict[str, np.ndarray] = {}
     for name, spec in leaves.items():
+        if spec.kind == "join_col":
+            continue  # gathered on device by the join wrapper
         if spec.kind == "cpu_expr":
             arr = spec.cpu_expr.evaluate(batch)
             if isinstance(arr, pa.Scalar):
@@ -581,6 +583,51 @@ def flat_arg_names(leaves: dict[str, LeafSpec]) -> list[str]:
     return out
 
 
+def make_join_kernel(
+    inner_fn, flat_names: list[str], join_slots: dict[str, int], n_build: int
+):
+    """Wrap a fused aggregate kernel with an on-device PK-FK probe join.
+
+    ``join_slots`` maps flat arg NAMES that come from the build side to
+    their index in the build-column arrays.  The wrapped signature is::
+
+        fn(seg, valid, *probe_args, pkey, pkey_valid,
+           bkeys, *bvals, *bvalids)
+
+    where ``probe_args`` are the per-batch arrays for NON-join flat names
+    (in order), ``pkey`` is this batch's probe join key, and the build
+    arrays are [m]-sized, SORTED by key (unique keys).  The join itself is
+    a searchsorted + gather; non-matching probe rows fold into the global
+    row mask (inner join), so shapes stay static and the joined relation
+    is never materialized.
+    """
+    n_probe = sum(1 for n in flat_names if n not in join_slots)
+
+    def fn(seg_ids, valid, *args):
+        probe_args = args[:n_probe]
+        pkey, pkey_valid, bkeys = args[n_probe:n_probe + 3]
+        bvals = args[n_probe + 3:n_probe + 3 + n_build]
+        bvalids = args[n_probe + 3 + n_build:]
+        m = bkeys.shape[0]
+        idx = jnp.clip(
+            jnp.searchsorted(bkeys, pkey), 0, max(m - 1, 0)
+        ).astype(jnp.int32)
+        match = jnp.logical_and(bkeys[idx] == pkey, pkey_valid)
+        full = []
+        it = iter(probe_args)
+        for name in flat_names:
+            j = join_slots.get(name)
+            if j is None:
+                full.append(next(it))
+            elif name.endswith("__valid"):
+                full.append(jnp.logical_and(bvalids[j][idx], match))
+            else:
+                full.append(bvals[j][idx])
+        return inner_fn(seg_ids, jnp.logical_and(valid, match), *full)
+
+    return fn
+
+
 def _pad(x: np.ndarray, n: int) -> np.ndarray:
     if len(x) == n:
         return x
@@ -602,6 +649,10 @@ class KernelAggSpec:
     # x32 only: the arg closure yields an exact f32 (hi, lo) pair for an
     # i64 column; the kernel sums both halves and recombines error-free
     pair: bool = False
+    # min/max over integer/date args stay in INTEGER dtype end-to-end —
+    # casting to f32 rounds above 2^24, and a min/max that comes back
+    # sub-ulp wrong breaks decorrelated equality predicates (q2)
+    int_minmax: bool = False
 
 
 def state_fields(spec: KernelAggSpec, mode: str) -> tuple[str, ...]:
@@ -862,17 +913,15 @@ def make_partial_agg_kernel(
                     )
                 outs.append(n)
                 continue
-            if spec.func == "min":
-                v = jnp.where(m, val.astype(_F()), jnp.asarray(jnp.inf, _F()))
-                outs.append(
-                    jax.ops.segment_min(v, seg_ids, num_segments=capacity)
+            if spec.func in ("min", "max"):
+                v, ident = _minmax_operand(spec, val)
+                red = (
+                    jax.ops.segment_min
+                    if spec.func == "min"
+                    else jax.ops.segment_max
                 )
-                outs.append(n)
-                continue
-            if spec.func == "max":
-                v = jnp.where(m, val.astype(_F()), jnp.asarray(-jnp.inf, _F()))
                 outs.append(
-                    jax.ops.segment_max(v, seg_ids, num_segments=capacity)
+                    red(jnp.where(m, v, ident), seg_ids, num_segments=capacity)
                 )
                 outs.append(n)
                 continue
@@ -933,15 +982,16 @@ def make_partial_agg_kernel(
                 )
                 plan.append(("sum", sj, nj))
             elif spec.func in ("min", "max"):
-                ident = jnp.inf if spec.func == "min" else -jnp.inf
-                v = jnp.where(m, val.astype(jnp.float32), jnp.asarray(ident, jnp.float32))
+                v, ident = _minmax_operand(spec, val)
                 red = (
                     jax.ops.segment_min
                     if spec.func == "min"
                     else jax.ops.segment_max
                 )
                 plan.append(("minmax", len(minmax), nj))
-                minmax.append(red(v, seg_ids, num_segments=capacity))
+                minmax.append(
+                    red(jnp.where(m, v, ident), seg_ids, num_segments=capacity)
+                )
             else:
                 raise ExecutionError(f"kernel agg {spec.func}")
         presence_j = cnt_col(maskf)
@@ -971,6 +1021,41 @@ def make_partial_agg_kernel(
     return fn
 
 
+def _minmax_operand(spec: KernelAggSpec, val):
+    """(operand, identity) for a min/max reduction, dtype-preserving for
+    the integer path (exactness) and float for the rest."""
+    if spec.int_minmax:
+        v = val.astype(_I())
+        info = jnp.iinfo(_I())
+        ident = jnp.asarray(
+            info.max if spec.func == "min" else info.min, _I()
+        )
+        return v, ident
+    v = val.astype(_F())
+    ident = jnp.asarray(
+        jnp.inf if spec.func == "min" else -jnp.inf, _F()
+    )
+    return v, ident
+
+
+def _pad_ident(role: str, dtype):
+    """Growth-padding identity per state field, dtype-aware (integer
+    min/max states must not pad with float inf)."""
+    if role == "min":
+        return (
+            jnp.iinfo(dtype).max
+            if jnp.issubdtype(dtype, jnp.integer)
+            else jnp.inf
+        )
+    if role == "max":
+        return (
+            jnp.iinfo(dtype).min
+            if jnp.issubdtype(dtype, jnp.integer)
+            else -jnp.inf
+        )
+    return 0
+
+
 def pad_states(
     specs: list[KernelAggSpec],
     acc: Optional[tuple],
@@ -989,9 +1074,7 @@ def pad_states(
     grow = new_cap - old_cap
     for spec in specs:
         for role in state_fields(spec, mode):
-            ident = (
-                jnp.inf if role == "min" else -jnp.inf if role == "max" else 0
-            )
+            ident = _pad_ident(role, acc[i].dtype)
             out.append(
                 jnp.pad(acc[i], (0, grow), constant_values=ident)
             )
@@ -1006,7 +1089,7 @@ def state_is_int(spec: KernelAggSpec, mode: str) -> tuple[bool, ...]:
         return (True,)
     if spec.func in ("sum", "avg"):
         return (False, False, True) if mode == "x32" else (False, True)
-    return (False, True)  # min/max: (value, n)
+    return (spec.int_minmax, True)  # min/max: (value, n)
 
 
 # Packed-fetch plumbing: on the tunnel-attached TPU only FETCHES block

@@ -38,6 +38,12 @@ class _CapacityExceeded(Exception):
     pass
 
 
+class _JoinIneligible(Exception):
+    """The device join cannot run for THIS data (non-unique or
+    i32-unrepresentable build keys): re-run with the join on CPU and only
+    the aggregate on device (the pre-fold round-2 shape)."""
+
+
 class _SmallInput(Exception):
     """Control flow: the source peek found fewer rows than tpu.min_rows;
     carries the already-buffered batches so the CPU path needn't re-scan."""
@@ -137,6 +143,27 @@ def _subst(e: pe.PhysicalExpr, mapping: list[pe.PhysicalExpr]) -> pe.PhysicalExp
 
 
 @dataclasses.dataclass
+class DeviceJoinSpec:
+    """A PK-FK join folded INTO the fused device stage (SURVEY §7 hard
+    part: hash join on device).
+
+    Scope: inner single-key equi-join with UNIQUE build keys (every TPC-H
+    join).  The build side (smaller input) collects once on host, sorts by
+    key and ships [m]-sized arrays; each probe batch joins ON DEVICE with
+    a searchsorted + gather — static shapes, no dynamic output: the match
+    mask simply folds into the stage's row mask, so the joined rows feed
+    the fused aggregate without EVER materializing the join.
+    """
+
+    build: ExecutionPlan  # collected on host, must have unique keys
+    probe_key: pe.PhysicalExpr  # over the probe (source) schema
+    build_key_index: int  # plain column of the build schema
+    build_cols: list[int]  # build columns the stage reads, virtual order
+    # (group-only build columns resolve on HOST at materialize time; only
+    # the ones the kernel reads ship to the device — see _join_slots)
+
+
+@dataclasses.dataclass
 class _FusedStage:
     """The flattened eligible subtree, rewritten onto the source schema."""
 
@@ -145,9 +172,12 @@ class _FusedStage:
     group_exprs: list[tuple[pe.PhysicalExpr, str]]
     aggs: list[AggSpec]
     mode: str
+    join: Optional[DeviceJoinSpec] = None
 
 
-def _flatten(agg: HashAggregateExec) -> Optional[_FusedStage]:
+def _flatten(
+    agg: HashAggregateExec, fold_join: bool = True
+) -> Optional[_FusedStage]:
     chain: list[ExecutionPlan] = []
     node = agg.input
     while isinstance(node, (FilterExec, ProjectionExec, RenameSchemaExec)):
@@ -175,7 +205,137 @@ def _flatten(agg: HashAggregateExec) -> Optional[_FusedStage]:
         ]
     except ExecutionError:
         return None
-    return _FusedStage(source, filters, group_exprs, aggs, agg.mode)
+    fused = _FusedStage(source, filters, group_exprs, aggs, agg.mode)
+    if fold_join:
+        return _maybe_fold_join(fused) or fused
+    return fused
+
+
+def _cols_used(e: pe.PhysicalExpr, out: set) -> None:
+    if isinstance(e, pe.Col):
+        out.add(e.index)
+    for name in ("left", "right", "expr", "else_expr"):
+        sub = getattr(e, name, None)
+        if sub is not None:
+            _cols_used(sub, out)
+    for name in ("args",):
+        for sub in getattr(e, name, ()) or ():
+            _cols_used(sub, out)
+    if isinstance(e, pe.Case):
+        for w, t in e.whens:
+            _cols_used(w, out)
+            _cols_used(t, out)
+
+
+def _shift_cols(e: pe.PhysicalExpr, remap: dict) -> pe.PhysicalExpr:
+    """Rewrite column indexes through ``remap`` (join schema → probe +
+    virtual build columns)."""
+    mapping = [None] * (max(remap) + 1 if remap else 0)
+    for i, j in remap.items():
+        mapping[i] = pe.Col(j, f"c{j}")
+    return _subst(e, mapping)
+
+
+def _maybe_fold_join(fused: _FusedStage) -> Optional[_FusedStage]:
+    """Fold an eligible HashJoinExec source into a DeviceJoinSpec."""
+    from ..exec.joins import HashJoinExec
+
+    join = fused.source
+    if not isinstance(join, HashJoinExec):
+        return None
+    if (
+        join.join_type != "inner"
+        or len(join.on) != 1
+        or join.filter is not None
+    ):
+        return None
+    lkey, rkey = join.on[0]
+    if not isinstance(lkey, pe.Col):
+        return None  # build key must be a plain column (sortable table)
+    probe = join.right
+    left_n = len(join.left.schema)
+    probe_n = len(probe.schema)
+
+    def _int_key(t) -> bool:
+        return pa.types.is_integer(t) or pa.types.is_date32(t)
+
+    # float keys would truncate through the int64 key path and match rows
+    # SQL equality never joins: integer/date keys only
+    if not _int_key(join.left.schema.field(lkey.index).type):
+        return None
+    try:
+        if not _int_key(K._infer_pa_type(rkey, probe.schema)):
+            return None
+    except Exception:
+        return None
+
+    # which join-schema columns does the stage actually read?
+    used: set = set()
+    for f in fused.filters:
+        _cols_used(f, used)
+    for g, _ in fused.group_exprs:
+        _cols_used(g, used)
+    for a in fused.aggs:
+        if a.arg is not None:
+            _cols_used(a.arg, used)
+
+    build_cols: list[int] = []
+    remap: dict = {}
+    for i in sorted(used):
+        if i >= left_n:
+            remap[i] = i - left_n  # probe side, shifted onto probe schema
+        else:
+            if i not in build_cols:
+                build_cols.append(i)
+            remap[i] = probe_n + build_cols.index(i)
+
+    # group keys on the build side must be PLAIN build columns AND the
+    # probe join key must itself be a group key, so materialize can
+    # resolve them (unique build keys => functional dependency)
+    probe_key = rkey
+    group_has_build = False
+    key_in_groups = False
+    for g, _name in fused.group_exprs:
+        gused: set = set()
+        _cols_used(g, gused)
+        if any(i < left_n for i in gused):
+            if not (isinstance(g, pe.Col) and g.index < left_n):
+                return None
+            group_has_build = True
+        elif (
+            isinstance(g, pe.Col)
+            and g.index >= left_n
+            and isinstance(probe_key, pe.Col)
+            and g.index - left_n == probe_key.index
+        ):
+            key_in_groups = True
+    if group_has_build and not key_in_groups:
+        return None
+
+    try:
+        filters = [_shift_cols(f, remap) for f in fused.filters]
+        group_exprs = [
+            (_shift_cols(g, remap), name) for g, name in fused.group_exprs
+        ]
+        aggs = [
+            dataclasses.replace(
+                a, arg=_shift_cols(a.arg, remap) if a.arg is not None else None
+            )
+            for a in fused.aggs
+        ]
+    except ExecutionError:
+        return None
+
+    return _FusedStage(
+        probe,
+        filters,
+        group_exprs,
+        aggs,
+        fused.mode,
+        join=DeviceJoinSpec(
+            join.left, probe_key, lkey.index, build_cols
+        ),
+    )
 
 
 class TpuStageExec(ExecutionPlan):
@@ -197,7 +357,19 @@ class TpuStageExec(ExecutionPlan):
         self.config = config
         self._schema = original.schema
 
-        compiler = K.JaxExprCompiler(fused.source.schema)
+        # device-join stages compile over a VIRTUAL schema: the probe
+        # schema plus one appended field per referenced build column
+        probe_schema = fused.source.schema
+        if fused.join is not None:
+            virtual = list(probe_schema) + [
+                fused.join.build.schema.field(i) for i in fused.join.build_cols
+            ]
+            compile_schema = pa.schema(virtual)
+        else:
+            compile_schema = probe_schema
+        self._probe_ncols = len(probe_schema)
+
+        compiler = K.JaxExprCompiler(compile_schema)
         filter_closure = None
         if fused.filters:
             pred = fused.filters[0]
@@ -224,10 +396,32 @@ class TpuStageExec(ExecutionPlan):
                 count_cols.append((idx, a.arg))
                 continue
             t = (
-                fused.source.schema.field(a.arg.index).type
+                compile_schema.field(a.arg.index).type
                 if isinstance(a.arg, pe.Col)
                 else None
             )
+            if a.func in ("min", "max"):
+                if t is None:
+                    try:
+                        t = K._infer_pa_type(a.arg, compile_schema)
+                    except Exception:
+                        t = None
+                int_mm = t is not None and (
+                    pa.types.is_integer(t) or pa.types.is_date32(t)
+                )
+                if x32 and not int_mm and not (
+                    t is not None and pa.types.is_float32(t)
+                ):
+                    # f64 min/max would come back f32-rounded: a sub-ulp
+                    # wrong extremum breaks decorrelated equality (q2's
+                    # ps_supplycost = (select min(...))) — CPU keeps it
+                    # exact; ints/dates stay on device in INT dtype
+                    raise K.NotLowerable("x32 min/max over f64")
+                pending[idx] = (
+                    K.KernelAggSpec(a.func, True, int_minmax=int_mm),
+                    compiler._lower(a.arg),
+                )
+                continue
             if (
                 x32
                 and a.func == "avg"
@@ -271,9 +465,51 @@ class TpuStageExec(ExecutionPlan):
         )
         self._filter_closure = filter_closure
         self._arg_closures = arg_closures
+
+        # device-join plumbing: leaves over virtual (build-side) columns
+        # are gathered ON DEVICE by the join wrapper, never read from the
+        # probe batch; pair/validity-only kinds and host-evaluated exprs
+        # cannot reference the build side
+        self._join_slots: dict[str, int] = {}
+        if fused.join is not None:
+            for name, spec in self.leaves.items():
+                if spec.kind == "cpu_expr":
+                    used: set = set()
+                    _cols_used(spec.cpu_expr, used)
+                    if any(i >= self._probe_ncols for i in used):
+                        raise K.NotLowerable("host expr over build side")
+                    continue
+                if spec.col_index >= self._probe_ncols:
+                    if spec.kind != "column":
+                        raise K.NotLowerable(f"join leaf kind {spec.kind}")
+                    spec.kind = "join_col"
+                    j = spec.col_index - self._probe_ncols
+                    self._join_slots[name] = j
+                    self._join_slots[f"{name}__valid"] = j
+        # only the build columns the KERNEL reads ship to the device
+        # (group-only build columns resolve on host at materialize)
+        self._device_build_cols: list[int] = []
+        if fused.join is not None and self._join_slots:
+            device_js = sorted(set(self._join_slots.values()))
+            dense = {j: k for k, j in enumerate(device_js)}
+            self._join_slots = {
+                n: dense[j] for n, j in self._join_slots.items()
+            }
+            self._device_build_cols = [
+                fused.join.build_cols[j] for j in device_js
+            ]
+
         self._leaf_names = list(self.leaves.keys())
         self._flat_names = K.flat_arg_names(self.leaves)
         self._mode = K.precision_mode()
+        join_sig = ()
+        if fused.join is not None:
+            join_sig = (
+                str(fused.join.probe_key),
+                fused.join.build_key_index,
+                tuple(fused.join.build_cols),
+                str(fused.join.build.schema),
+            )
         sig = (
             tuple(str(f) for f in fused.filters),
             tuple((s.func, str(a.arg)) for s, a in zip(specs, fused.aggs)),
@@ -281,8 +517,46 @@ class TpuStageExec(ExecutionPlan):
             tuple(self._flat_names),
             str(fused.source.schema),
             self._mode,
+            join_sig,
         )
         self._sig = sig
+
+        # group plan: which GROUP BY positions encode on host vs resolve
+        # from the build table at materialize (functionally dependent on
+        # the probe join key — unique build keys)
+        self._group_plan: list[tuple[str, int]] = []
+        slot = 0
+        for g, _n in fused.group_exprs:
+            if (
+                fused.join is not None
+                and isinstance(g, pe.Col)
+                and g.index >= self._probe_ncols
+            ):
+                self._group_plan.append(("build", g.index - self._probe_ncols))
+            else:
+                self._group_plan.append(("enc", slot))
+                slot += 1
+        self._n_encoded_groups = slot
+        self._jk_slot = self._jk_pos = None
+        if fused.join is not None:
+            pk = fused.join.probe_key
+            for pos, (g, _n) in enumerate(fused.group_exprs):
+                if (
+                    self._group_plan[pos][0] == "enc"
+                    and isinstance(g, pe.Col)
+                    and isinstance(pk, pe.Col)
+                    and g.index == pk.index
+                ):
+                    self._jk_slot = self._group_plan[pos][1]
+                    self._jk_pos = pos
+                    break
+            if any(k == "build" for k, _ in self._group_plan) and (
+                self._jk_slot is None
+            ):
+                raise K.NotLowerable("build group keys without probe key")
+        self._build_state = None  # lazily prepared per instance
+        self._build_lock = __import__("threading").Lock()
+
         # raw kernel kept for mesh gang execution: shard_map needs the
         # untraced function to wrap with the cross-chip reduction
         self._raw_kernel, self._jit_kernel = self._kernel_for(self.capacity)
@@ -301,13 +575,22 @@ class TpuStageExec(ExecutionPlan):
         if cached is None:
             import jax
 
-            kernel = K.make_partial_agg_kernel(
+            inner = K.make_partial_agg_kernel(
                 self._filter_closure,
                 self._arg_closures,
                 self.specs,
                 capacity,
                 self._flat_names,
             )
+            if self.fused.join is not None:
+                kernel = K.make_join_kernel(
+                    inner,
+                    self._flat_names,
+                    self._join_slots,
+                    len(self._device_build_cols),
+                )
+            else:
+                kernel = inner
             cached = (kernel, jax.jit(kernel))
             _KERNEL_CACHE[key] = cached
         return cached
@@ -326,10 +609,18 @@ class TpuStageExec(ExecutionPlan):
         new_original = self.original.with_new_children(
             [_replace_leaf(self.original.input, self.fused.source, children[0])]
         )
-        fused = _flatten(new_original)
-        if fused is None:
-            return new_original
-        return TpuStageExec(new_original, fused, self.config)
+        # same fold-then-retry ladder as maybe_accelerate: a shape that
+        # lowers only with the join on CPU must not lose acceleration here
+        for fold in (True, False):
+            fused = _flatten(new_original, fold_join=fold)
+            if fused is None:
+                return new_original
+            try:
+                return TpuStageExec(new_original, fused, self.config)
+            except K.NotLowerable:
+                if fused.join is None:
+                    return new_original
+        return new_original
 
     def __str__(self) -> str:
         return (
@@ -343,6 +634,12 @@ class TpuStageExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         try:
             yield from self._execute_device(partition, ctx)
+            return
+        except _JoinIneligible:
+            # non-unique or unrepresentable build keys: run the join on
+            # CPU and keep ONLY the aggregate on device (round-2 shape)
+            self.metrics.add("join_fallback", 1)
+            yield from self._nojoin_stage().execute(partition, ctx)
             return
         except _SmallInput as si:
             # partition under tpu.min_rows: run the CPU operator path over
@@ -411,7 +708,19 @@ class TpuStageExec(ExecutionPlan):
         from . import device_cache
 
         fused = self.fused
-        ck = self._cache_key(ctx)
+        build = None
+        if fused.join is not None:
+            build = self._prepare_build(ctx)
+            if build[0] == "empty":
+                # inner join against an empty build side: no rows at all
+                yield from self._materialize(
+                    None, [], None, 0, ctx, partition
+                )
+                return
+        # the device column cache keys on scan inputs; join stages add
+        # build-side state, so they skip it (probe sources are usually
+        # joins/filters anyway)
+        ck = self._cache_key(ctx) if fused.join is None else None
         if ck is not None:
             cached = device_cache.get(ck[0], partition, ck[1])
             if cached is not None:
@@ -455,11 +764,14 @@ class TpuStageExec(ExecutionPlan):
         from .bridge import make_key_encoder
         from .groups import GroupTable
 
+        # encoders exist only for host-ENCODED group positions (build-side
+        # group keys resolve from the build table at materialize)
         key_encoders = [
-            make_key_encoder(self._schema.field(i).type)
-            for i in range(len(fused.group_exprs))
+            make_key_encoder(self._schema.field(pos).type)
+            for pos, (kind, _s) in enumerate(self._group_plan)
+            if kind == "enc"
         ]
-        group_table = GroupTable(len(fused.group_exprs))
+        group_table = GroupTable(max(self._n_encoded_groups, 1))
         entries = []
 
         acc = None
@@ -481,9 +793,13 @@ class TpuStageExec(ExecutionPlan):
                         )
                     if acc is None and not entries:
                         if (
-                            group_table.n_groups > _HIGHCARD_MIN_GROUPS
+                            fused.join is None
+                            and group_table.n_groups > _HIGHCARD_MIN_GROUPS
                             and group_table.n_groups > _HIGHCARD_RATIO * n
                         ):
+                            # with a device join fused, the CPU
+                            # alternative pays the join too — stay on
+                            # device even at high cardinality
                             raise _HighCardinality([batch], src)
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path
@@ -514,7 +830,32 @@ class TpuStageExec(ExecutionPlan):
 
                 with self.metrics.timer("bridge_time_ns"):
                     env = K.build_env(batch, self.leaves, n_pad)
-                    args = [env[nm] for nm in self._flat_names]
+                    args = [
+                        env[nm]
+                        for nm in self._flat_names
+                        if nm not in self._join_slots
+                    ]
+                    if fused.join is not None:
+                        pk = _eval_arr(fused.join.probe_key, batch)
+                        from .bridge import arrow_to_numpy
+
+                        pkv, pk_valid = arrow_to_numpy(pk)
+                        pkv = pkv.astype(np.int64)
+                        if pk_valid is None:
+                            pk_valid = np.ones(n, dtype=bool)
+                        if self._mode == "x32":
+                            # probe keys outside i32 cannot match the
+                            # (range-checked) build keys: mask, don't fail
+                            in_range = (pkv >= -(1 << 31)) & (pkv < 1 << 31)
+                            if not in_range.all():
+                                pk_valid = pk_valid & in_range
+                                pkv = np.where(in_range, pkv, 0)
+                            pkv = pkv.astype(np.int32)
+                        args += [
+                            K._pad(pkv, n_pad),
+                            K._pad(pk_valid, n_pad),
+                            build[1],  # bkeys (device)
+                        ] + build[2] + build[3]  # bvals, bvalids
                 with self.metrics.timer("device_time_ns"):
                     if ck is not None:
                         import jax
@@ -542,6 +883,83 @@ class TpuStageExec(ExecutionPlan):
             host_states, key_encoders, group_table, n_rows_in, ctx, partition
         )
 
+    # ------------------------------------------------------- device join
+    def _nojoin_stage(self) -> "TpuStageExec":
+        """Sibling stage with the join UNFOLDED (join on CPU, aggregate on
+        device) for data the device join cannot handle."""
+        with self._build_lock:
+            cached = getattr(self, "_nojoin", None)
+            if cached is None:
+                fused = _flatten(self.original, fold_join=False)
+                cached = TpuStageExec(self.original, fused, self.config)
+                cached.metrics = self.metrics  # one bag for observability
+                self._nojoin = cached
+            return cached
+
+    def _prepare_build(self, ctx: TaskContext):
+        """Collect + sort the build side once: device arrays for the
+        kernel's searchsorted/gather, host copies for group resolution.
+        Raises ExecutionError (→ CPU fallback) on non-unique keys or
+        un-shippable key/column ranges."""
+        from .bridge import arrow_to_numpy
+
+        with self._build_lock:
+            if self._build_state is not None:
+                return self._build_state
+            import jax
+
+            spec = self.fused.join
+            batches = []
+            for p in range(spec.build.output_partitioning().n):
+                for b in spec.build.execute(p, ctx):
+                    ctx.check_cancelled()
+                    if b.num_rows:
+                        batches.append(b)
+            if batches:
+                table = pa.Table.from_batches(batches, schema=spec.build.schema)
+            else:
+                table = spec.build.schema.empty_table()
+            key_col = table.column(spec.build_key_index)
+            kv, kvalid = arrow_to_numpy(
+                key_col.combine_chunks()
+                if isinstance(key_col, pa.ChunkedArray)
+                else key_col
+            )
+            kv = kv.astype(np.int64)
+            if kvalid is not None:
+                table = table.filter(pa.array(kvalid))
+                kv = kv[kvalid]  # null build keys never match an inner join
+            order = np.argsort(kv, kind="stable")
+            kv_sorted = kv[order]
+            if len(kv_sorted) > 1 and bool(
+                np.any(kv_sorted[1:] == kv_sorted[:-1])
+            ):
+                raise _JoinIneligible("device join requires unique build keys")
+            table = table.take(pa.array(order))
+
+            if len(kv_sorted) == 0:
+                self._build_state = ("empty",)
+                return self._build_state
+
+            try:
+                bkeys_dev = jax.device_put(K.coerce_host_values(kv_sorted))
+                bvals, bvalids = [], []
+                for ci in self._device_build_cols:
+                    col = table.column(ci).combine_chunks()
+                    vals, validity = arrow_to_numpy(col)
+                    bvals.append(jax.device_put(K.coerce_host_values(vals)))
+                    if validity is None:
+                        validity = np.ones(len(vals), dtype=bool)
+                    bvalids.append(jax.device_put(validity))
+            except ExecutionError as e:
+                # un-shippable key/column ranges or types: join on CPU,
+                # aggregate on device (not a full-CPU fallback)
+                raise _JoinIneligible(str(e)) from e
+            self._build_state = (
+                "ok", bkeys_dev, bvals, bvalids, kv_sorted, table
+            )
+            return self._build_state
+
     def _fetch_states(self, acc) -> Optional[list]:
         """One packed device→host fetch of the whole state tuple."""
         if acc is None:
@@ -560,9 +978,16 @@ class TpuStageExec(ExecutionPlan):
         """
         from .groups import RadixOverflow
 
+        encoded_exprs = [
+            g
+            for (g, _), (kind, _s) in zip(
+                self.fused.group_exprs, self._group_plan
+            )
+            if kind == "enc"
+        ]
         code_arrays = [
             enc.encode(_eval_arr(g, batch))
-            for (g, _), enc in zip(self.fused.group_exprs, key_encoders)
+            for g, enc in zip(encoded_exprs, key_encoders)
         ]
         try:
             gids = group_table.encode(code_arrays)
@@ -598,9 +1023,41 @@ class TpuStageExec(ExecutionPlan):
         keep = np.nonzero(presence > 0)[0] if fused.group_exprs else np.arange(1)
 
         cols: list[pa.Array] = []
-        for i, ((_, _name), enc) in enumerate(zip(fused.group_exprs, key_encoders)):
-            codes = group_table.codes_for(keep, i)
-            cols.append(enc.decode(codes, schema.field(len(cols)).type))
+        jk_positions = None
+        for pos, (kind, slot) in enumerate(self._group_plan):
+            field_t = schema.field(len(cols)).type
+            if kind == "enc":
+                codes = group_table.codes_for(keep, slot)
+                cols.append(key_encoders[slot].decode(codes, field_t))
+                continue
+            # build-resolved group key: look the kept groups' probe join
+            # keys up in the sorted build table (unique keys => exact)
+            if jk_positions is None:
+                jk_codes = group_table.codes_for(keep, self._jk_slot)
+                jk_vals = (
+                    key_encoders[self._jk_slot]
+                    .decode(jk_codes, schema.field(self._jk_pos).type)
+                    .cast(pa.int64())
+                    .to_numpy(zero_copy_only=False)
+                    .astype(np.int64)
+                )
+                bkeys_host = self._build_state[4]
+                jk_positions = np.searchsorted(bkeys_host, jk_vals)
+                jk_positions = np.minimum(
+                    jk_positions, max(len(bkeys_host) - 1, 0)
+                )
+            build_table = self._build_state[5]
+            ci = fused.join.build_cols[slot]
+            vals = build_table.column(ci).take(pa.array(jk_positions))
+            if not vals.type.equals(field_t):
+                import pyarrow.compute as pc
+
+                vals = pc.cast(vals, field_t)
+            cols.append(
+                vals.combine_chunks()
+                if isinstance(vals, pa.ChunkedArray)
+                else vals
+            )
 
         partial = fused.mode == PARTIAL
         i = 0
@@ -608,6 +1065,25 @@ class TpuStageExec(ExecutionPlan):
             if spec.func in ("count", "count_star"):
                 cols.append(pa.array(host[i][keep], pa.int64()))
                 i += 1
+                continue
+            if spec.int_minmax:
+                # integer extrema stay in INT dtype end-to-end (an f64
+                # round-trip would round int64 values above 2^53 — the
+                # exactness this path exists to guarantee)
+                v_exact = host[i][keep]
+                n_arr = host[i + 1][keep]
+                i += 2
+                empty = n_arr == 0
+                field_t = schema.field(len(cols)).type
+                vals = np.where(empty, 0, v_exact).astype(np.int64)
+                if pa.types.is_date32(field_t):
+                    cols.append(
+                        pa.array(
+                            vals.astype("datetime64[D]"), field_t, mask=empty
+                        )
+                    )
+                else:
+                    cols.append(pa.array(vals, field_t, mask=empty))
                 continue
             if spec.func in ("sum", "avg") and self._mode == "x32":
                 # double-float state: hi + lo recombine in f64 on host,
@@ -634,14 +1110,21 @@ class TpuStageExec(ExecutionPlan):
                     )
                 continue
             field_t = schema.field(len(cols)).type
-            if pa.types.is_integer(field_t):
+            if pa.types.is_integer(field_t) or pa.types.is_date32(field_t):
                 # device accumulates in f64; exact for |sum| < 2^53
                 # (±inf extrema identities of empty groups are masked out,
                 # zeroed first so the int cast can't warn)
-                v_int = np.round(np.where(np.isfinite(v), v, 0.0))
-                cols.append(
-                    pa.array(v_int.astype(np.int64), field_t, mask=empty)
+                v_int = np.round(np.where(np.isfinite(v), v, 0.0)).astype(
+                    np.int64
                 )
+                if pa.types.is_date32(field_t):
+                    cols.append(
+                        pa.array(
+                            v_int.astype("datetime64[D]"), field_t, mask=empty
+                        )
+                    )
+                else:
+                    cols.append(pa.array(v_int, field_t, mask=empty))
             else:
                 cols.append(pa.array(v, field_t, mask=empty))
 
@@ -689,5 +1172,15 @@ def maybe_accelerate(plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPl
         try:
             return TpuStageExec(plan, fused, config)
         except K.NotLowerable:
+            if fused.join is not None:
+                # the folded-join shape didn't lower (e.g. a pair/cpu
+                # leaf over the build side): retry with the join on CPU
+                # so the aggregate still accelerates (round-2 shape)
+                fused = _flatten(plan, fold_join=False)
+                if fused is not None:
+                    try:
+                        return TpuStageExec(plan, fused, config)
+                    except K.NotLowerable:
+                        return plan
             return plan
     return plan

@@ -45,7 +45,10 @@ def gang_eligible(plan: ExecutionPlan) -> bool:
         for a in plan.aggs
     ):
         return False
-    return _flatten(plan) is not None
+    fused = _flatten(plan)
+    # device-join stages run sequentially for now: the gang path would
+    # need the build side replicated across shards
+    return fused is not None and fused.join is None
 
 
 class MeshGangExec(ExecutionPlan):
@@ -95,7 +98,11 @@ class MeshGangExec(ExecutionPlan):
         inner = self.input
         if not isinstance(inner, TpuStageExec):
             inner = maybe_accelerate(inner, ctx.config)
-        if isinstance(inner, TpuStageExec) and ctx.config.tpu_enable:
+        if (
+            isinstance(inner, TpuStageExec)
+            and ctx.config.tpu_enable
+            and inner.fused.join is None
+        ):
             try:
                 # fully materialized before yielding: a capacity fallback
                 # must never follow already-emitted rows with a re-run

@@ -1,0 +1,19 @@
+#!/bin/sh
+# LoC diagnostic (recorded so the round verdicts can re-run the exact
+# command — round-2 advisor finding: the numbers weren't reproducible).
+#
+# Counts non-blank lines of hand-written source: python + C++ + proto,
+# excluding generated protobuf modules (proto/gen), tests, and harnesses.
+cd "$(dirname "$0")/.."
+count() { cat "$@" 2>/dev/null | grep -vc '^[[:space:]]*$'; }
+
+echo "repo core (arrow_ballista_tpu python, excl. proto/gen):"
+count $(find arrow_ballista_tpu -name "*.py" ! -path "*/proto/gen/*")
+echo "native C++:"
+count $(find arrow_ballista_tpu/native \( -name "*.cc" -o -name "*.h" \))
+echo "proto definitions:"
+count arrow_ballista_tpu/proto/*.proto
+echo "tests:"
+count $(find tests -name "*.py")
+echo "benchmarks + entry points:"
+count $(find benchmarks -name "*.py") bench.py bench_suite.py __graft_entry__.py

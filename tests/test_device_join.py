@@ -1,0 +1,199 @@
+"""Device PK-FK join folded into the fused aggregate stage (round-3;
+SURVEY §7 hard part "hash join + shuffle on device").
+
+The probe side joins ON DEVICE via searchsorted+gather against a sorted
+unique-key build table; the match mask folds into the stage row mask so
+the joined relation is never materialized.  These tests force the x32
+matmul path on CPU and pin the edge cases: unmatched probe rows, null
+keys, null build values, build-side filters and group keys, non-unique
+build keys (fallback), empty build side, and i32-overflowing keys
+(graceful degradation to the CPU-join + device-aggregate shape).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops import kernels as K
+from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+
+@pytest.fixture(autouse=True)
+def _x32_matmul():
+    K.set_precision("x32")
+    K.set_agg_algorithm("matmul")
+    yield
+    K.set_agg_algorithm(None)
+    K.set_precision(None)
+
+
+def _ctx(tpu=True):
+    return SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": str(tpu).lower(),
+                "ballista.tpu.min_rows": "0",
+                "ballista.mesh.enable": "false",
+            }
+        )
+    )
+
+
+def _stages(plan):
+    out = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TpuStageExec):
+            out.append(n)
+        stack.extend(n.children())
+    return out
+
+
+def _run_both(tables: dict, sql: str, parts=2):
+    ctx_t, ctx_c = _ctx(True), _ctx(False)
+    for name, t in tables.items():
+        ctx_t.register_table(name, MemoryTable.from_table(t, parts))
+        ctx_c.register_table(name, MemoryTable.from_table(t, parts))
+    K.set_agg_algorithm(None)
+    want = ctx_c.sql(sql).collect()
+    K.set_agg_algorithm("matmul")
+    plan = ctx_t.sql(sql).physical_plan()
+    got = ctx_t.execute(plan)
+    return got, want, plan
+
+
+def _assert_match(got, want):
+    assert got.num_rows == want.num_rows
+    keys = [(n, "ascending") for n in want.column_names]
+    g, w = got.sort_by(keys), want.sort_by(keys)
+    for name in w.column_names:
+        for x, y in zip(g.column(name).to_pylist(), w.column(name).to_pylist()):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=1e-6), name
+            else:
+                assert x == y, name
+
+
+def _dims(n=60, seed=3):
+    rng = np.random.default_rng(seed)
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(1, n + 1), pa.int64()),
+            "dv": pa.array(rng.uniform(0, 10, n)),
+            "dtag": pa.array(rng.integers(0, 4, n), pa.int32()),
+        }
+    )
+    fact = pa.table(
+        {
+            "fk": pa.array(rng.integers(1, n + 20, 1000), pa.int64()),  # some unmatched
+            "g": pa.array(rng.integers(0, 5, 1000), pa.int64()),
+            "v": pa.array(rng.uniform(0, 100, 1000)),
+        }
+    )
+    return {"dim": dim, "fact": fact}
+
+
+def test_inner_join_agg_folds_and_matches():
+    sql = (
+        "select g, sum(v * dv) as s, count(*) as c "
+        "from dim, fact where dk = fk group by g order by g"
+    )
+    got, want, plan = _run_both(_dims(), sql)
+    stages = [s for s in _stages(plan) if s.fused.join is not None]
+    assert stages, "join did not fold into the device stage"
+    m = stages[0].metrics.to_dict()
+    assert "device_time_ns" in m and m.get("tpu_fallback", 0) == 0, m
+    _assert_match(got, want)
+
+
+def test_build_side_filter_on_device():
+    sql = (
+        "select g, sum(v) as s from dim, fact "
+        "where dk = fk and dtag = 2 group by g order by g"
+    )
+    got, want, plan = _run_both(_dims(), sql)
+    assert any(s.fused.join is not None for s in _stages(plan))
+    _assert_match(got, want)
+
+
+def test_build_group_key_resolved_at_materialize():
+    sql = (
+        "select fk, dtag, sum(v) as s from dim, fact "
+        "where dk = fk group by fk, dtag order by fk"
+    )
+    got, want, plan = _run_both(_dims(), sql)
+    joined = [s for s in _stages(plan) if s.fused.join is not None]
+    assert joined and any(k == "build" for k, _ in joined[0]._group_plan)
+    _assert_match(got, want)
+
+
+def test_null_probe_keys_drop():
+    d = _dims()
+    fk = d["fact"].column("fk").to_pylist()
+    fk[::7] = [None] * len(fk[::7])
+    fact = d["fact"].set_column(0, "fk", pa.array(fk, pa.int64()))
+    sql = (
+        "select g, count(*) as c, sum(dv) as s from dim, fact "
+        "where dk = fk group by g order by g"
+    )
+    got, want, _ = _run_both({"dim": d["dim"], "fact": fact}, sql)
+    _assert_match(got, want)
+
+
+def test_null_build_values_gather_as_null():
+    d = _dims()
+    dv = d["dim"].column("dv").to_pylist()
+    dv[::3] = [None] * len(dv[::3])
+    dim = d["dim"].set_column(1, "dv", pa.array(dv, pa.float64()))
+    sql = (
+        "select g, sum(dv) as s, count(dv) as c from dim, fact "
+        "where dk = fk group by g order by g"
+    )
+    got, want, _ = _run_both({"dim": dim, "fact": d["fact"]}, sql)
+    _assert_match(got, want)
+
+
+def test_non_unique_build_keys_fall_back_correctly():
+    d = _dims()
+    dup = pa.concat_tables([d["dim"], d["dim"].slice(0, 5)])
+    sql = "select g, sum(v * dv) as s from dim, fact where dk = fk group by g order by g"
+    got, want, plan = _run_both({"dim": dup, "fact": d["fact"]}, sql)
+    joined = [s for s in _stages(plan) if s.fused.join is not None]
+    assert joined
+    m = joined[0].metrics.to_dict()
+    assert m.get("join_fallback", 0) >= 1, m
+    _assert_match(got, want)
+
+
+def test_empty_build_side():
+    d = _dims()
+    empty = d["dim"].slice(0, 0)
+    sql = "select g, sum(v) as s from dim, fact where dk = fk group by g"
+    got, want, _ = _run_both({"dim": empty, "fact": d["fact"]}, sql)
+    assert got.num_rows == want.num_rows == 0
+
+
+def test_overflow_build_keys_degrade_to_cpu_join_device_agg():
+    d = _dims()
+    big = d["dim"].set_column(
+        0, "dk",
+        pa.array((np.arange(1, 61) + (1 << 33)).astype(np.int64), pa.int64()),
+    )
+    fact = d["fact"].set_column(
+        0, "fk",
+        pa.array(
+            (d["fact"].column("fk").to_numpy() + (1 << 33)).astype(np.int64),
+            pa.int64(),
+        ),
+    )
+    sql = "select g, sum(v * dv) as s from dim, fact where dk = fk group by g order by g"
+    got, want, plan = _run_both({"dim": big, "fact": fact}, sql)
+    joined = [s for s in _stages(plan) if s.fused.join is not None]
+    assert joined
+    m = joined[0].metrics.to_dict()
+    assert m.get("join_fallback", 0) >= 1, m
+    assert "device_time_ns" in m, m  # the aggregate still ran on device
+    _assert_match(got, want)

@@ -65,8 +65,10 @@ def test_planner_wraps_join_repartition_stages():
     for s in mesh_parts:
         # one task per mesh-exchanged stage
         assert s.output_partitioning().n == 1
-    # partial-agg stages still prefer the gang form over the exchange
-    assert any(isinstance(s.input, MeshGangExec) for s in stages)
+    # partial-agg stages (no join underneath) still prefer the gang form;
+    # q3's agg stage now folds its join INTO the device stage instead
+    q1_stages = _stages_for(QUERIES[1], _cfg())
+    assert any(isinstance(s.input, MeshGangExec) for s in q1_stages)
 
 
 def test_serde_roundtrip_mesh_repartition():

@@ -187,3 +187,37 @@ def test_timestamp_not_lowered_in_x32():
         "SELECT SUM(v) AS s FROM t WHERE ts >= TIMESTAMP '2020-06-01 00:00:00'"
     ).collect()
     assert out.column("s").to_pylist() == [2.0]
+
+
+def test_all_tpch_x32_device_path_matches_oracle():
+    """Full 22-query sweep with the device path on (x32): every query —
+    including the join-bearing ones that now fold PK-FK joins into the
+    device stage — must match the CPU oracle at 1e-6."""
+    from benchmarks.tpch.queries import QUERIES
+
+    c_cpu, c_tpu = _ctx(False), _ctx(True)
+    _register_tpch(c_cpu)
+    _register_tpch(c_tpu)
+    for qno in sorted(QUERIES):
+        cpu = c_cpu.sql(QUERIES[qno]).collect()
+        tpu = c_tpu.sql(QUERIES[qno]).collect()
+        assert cpu.num_rows == tpu.num_rows, f"q{qno}"
+        if cpu.num_rows and cpu.column_names:
+            keys = [(n, "ascending") for n in cpu.column_names]
+            try:
+                cpu = cpu.sort_by(keys)
+                tpu = tpu.sort_by(keys)
+            except Exception:
+                pass  # unsortable types: compare in engine order
+        for name in cpu.column_names:
+            for x, y in zip(
+                cpu.column(name).to_pylist(), tpu.column(name).to_pylist()
+            ):
+                if (
+                    isinstance(x, float)
+                    and isinstance(y, float)
+                    and x is not None
+                ):
+                    assert y == pytest.approx(x, rel=1e-6), (qno, name)
+                else:
+                    assert x == y, (qno, name)
