@@ -191,6 +191,139 @@ def bench_q3_sf10() -> None:
     )
 
 
+def bench_starjoin() -> None:
+    """Device PK-FK join showcase: star-schema probe⋈dim aggregate with
+    LOW-cardinality groups — the join runs on device via searchsorted +
+    gather and the joined relation never materializes (the CPU path must
+    materialize a 60M-row join first)."""
+    import numpy as np
+
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    n = int(float(os.environ.get("BENCH_STAR_N", "6e7")))
+    m = int(float(os.environ.get("BENCH_STAR_M", "1e6")))
+    rng = np.random.default_rng(9)
+    import pyarrow as pa
+
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(1, m + 1), pa.int64()),
+            "dv": pa.array(rng.uniform(0.5, 1.5, m)),
+            "dtag": pa.array(rng.integers(0, 25, m), pa.int32()),
+        }
+    )
+    fact = pa.table(
+        {
+            "fk": pa.array(rng.integers(1, int(m * 1.2), n), pa.int64()),
+            "g": pa.array(rng.integers(0, 8, n), pa.int32()),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    sql = (
+        "select g, sum(v * dv) as s, count(*) as c "
+        "from dim, fact where dk = fk group by g order by g"
+    )
+
+    def make_ctx(tpu: bool):
+        ctx = SessionContext(
+            BallistaConfig(
+                {
+                    "ballista.tpu.enable": str(tpu).lower(),
+                    "ballista.batch.size": str(1 << 23),
+                    "ballista.shuffle.partitions": "1",
+                }
+            )
+        )
+        ctx.register_table("dim", MemoryTable.from_table(dim, 1))
+        ctx.register_table("fact", MemoryTable.from_table(fact, 1))
+        return ctx
+
+    cpu_s, tpu_s, mets, ok = _run_both(make_ctx, sql, n, iters=3)
+    _emit(
+        {
+            "metric": "starjoin_%.0e_x_%.0e_tpu_rows_per_sec" % (n, m),
+            "value": round(n / tpu_s),
+            "unit": "rows/s",
+            "vs_baseline": round(cpu_s / tpu_s, 3),
+            "rows": n,
+            "dim_rows": m,
+            "cpu_rows_per_sec": round(n / cpu_s),
+            "matches_cpu_1e-6": ok,
+            "breakdown": {
+                k: mets[k]
+                for k in (
+                    "bridge_time_ns", "key_encode_time_ns", "device_time_ns",
+                    "tpu_stage_time_ns", "tpu_fallback", "join_fallback",
+                )
+                if k in mets
+            },
+        }
+    )
+
+
+def bench_full22() -> None:
+    """BASELINE config #4's shape at tractable scale: all 22 TPC-H
+    queries through the DISTRIBUTED path (standalone scheduler + 2
+    executors over real gRPC/Flight), TPU path vs CPU path."""
+    from arrow_ballista_tpu import BallistaConfig
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.shuffle import memory_store
+    from benchmarks.tpch.datagen import ALL_TABLES, gen_table
+    from benchmarks.tpch.queries import QUERIES
+
+    sf = float(os.environ.get("BENCH_FULL22_SF", "1"))
+    data = {name: gen_table(name, sf) for name in ALL_TABLES}
+    n_lineitem = data["lineitem"].num_rows
+
+    def run(tpu: bool) -> dict:
+        cfg = BallistaConfig(
+            {
+                "ballista.tpu.enable": str(tpu).lower(),
+                "ballista.shuffle.partitions": "2",
+                "ballista.batch.size": str(1 << 22),
+                "ballista.shuffle.to_memory": "true",
+            }
+        )
+        bctx = BallistaContext.standalone(
+            config=cfg, num_executors=2, concurrent_tasks=2
+        )
+        times = {}
+        try:
+            for name, tbl in data.items():
+                bctx.register_table(name, MemoryTable.from_table(tbl, 2))
+            for qno in sorted(QUERIES):
+                t0 = time.perf_counter()
+                out = bctx.sql(QUERIES[qno]).collect()
+                times[f"q{qno}"] = round(time.perf_counter() - t0, 3)
+                assert out is not None
+        finally:
+            bctx.close()
+            memory_store.clear()
+        return times
+
+    cpu_times = run(False)
+    tpu_times = run(True)
+    total_cpu = round(sum(cpu_times.values()), 3)
+    total_tpu = round(sum(tpu_times.values()), 3)
+    _emit(
+        {
+            "metric": "tpch_full22_sf%g_distributed_total_sec_tpu" % sf,
+            "value": total_tpu,
+            "unit": "s",
+            "vs_baseline": round(total_cpu / total_tpu, 3),
+            "lineitem_rows": n_lineitem,
+            "cpu_total_sec": total_cpu,
+            "executors": 2,
+            "per_query_sec": {
+                q: {"cpu": cpu_times[q], "tpu": tpu_times[q]}
+                for q in cpu_times
+            },
+        }
+    )
+
+
 def bench_h2o() -> None:
     """Config #5: h2o groupby G1_1e8, TPU vs CPU, via the real harness."""
     import io
@@ -239,6 +372,10 @@ def main() -> None:
         bench_q6_parquet()
     if which in ("q3", "all"):
         bench_q3_sf10()
+    if which in ("starjoin", "all"):
+        bench_starjoin()
+    if which in ("full22", "all"):
+        bench_full22()
     if which in ("h2o", "all"):
         bench_h2o()
 
