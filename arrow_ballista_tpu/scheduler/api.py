@@ -41,8 +41,41 @@ DASHBOARD_HTML = """<!doctype html>
  <th>id</th><th>host</th><th>flight</th><th>grpc</th><th>alive</th><th>last seen</th>
 </tr></thead><tbody></tbody></table>
 <h2>Jobs</h2><table id="jobs"><thead><tr>
- <th>job</th><th>state</th></tr></thead><tbody></tbody></table>
+ <th>job</th><th>state</th><th></th></tr></thead><tbody></tbody></table>
+<div id="detail"></div>
 <script>
+let openJob = null;
+let openJobTerminal = false;  // completed/failed details are immutable: no re-fetch
+function esc(s) {
+  return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;').replace(/>/g, '&gt;');
+}
+async function showDetail(jobId) {
+  openJob = jobId;
+  const d = await fetch('/api/job/' + encodeURIComponent(jobId)).then(r => r.json());
+  openJobTerminal = d.state === 'completed' || d.state === 'failed';
+  if (!d.stages) {  // 404 payload; d.error on a FAILED job still has stages
+    document.getElementById('detail').textContent = d.error || 'no such job';
+    return;
+  }
+  let html = `<h2>Job ${esc(jobId)} — ${esc(d.state)}` +
+    ` <a href="/api/job/${encodeURIComponent(jobId)}/dot">[dot]</a></h2>`;
+  if (d.error) html += `<p class="dead">${esc(d.error)}</p>`;
+  html += '<table><thead><tr><th>stage</th><th>state</th><th>tasks</th>' +
+          '<th>metrics</th></tr></thead><tbody>';
+  for (const s of d.stages) {
+    const done = s.completed_tasks === undefined ? '—'
+      : `${s.completed_tasks}/${s.partitions}`;
+    const mets = s.metrics
+      ? esc(Object.entries(s.metrics).map(([op, m]) =>
+          op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
+        ).join(' · '))
+      : '—';
+    html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
+            `<td>${done}</td><td>${mets}</td></tr>`;
+  }
+  html += '</tbody></table>';
+  document.getElementById('detail').innerHTML = html;
+}
 async function refresh() {
   try {
     const [state, jobs, metrics] = await Promise.all([
@@ -59,7 +92,7 @@ async function refresh() {
     for (const e of state.executors) {
       const age = e.last_seen ? Math.round(Date.now()/1000 - e.last_seen) + 's ago' : '—';
       etb.insertAdjacentHTML('beforeend',
-        `<tr><td>${e.id}</td><td>${e.host}</td><td>${e.port}</td>` +
+        `<tr><td>${esc(e.id)}</td><td>${esc(e.host)}</td><td>${e.port}</td>` +
         `<td>${e.grpc_port || '—'}</td>` +
         `<td class="${e.alive ? 'ok' : 'dead'}">${e.alive ? 'alive' : 'dead'}</td>` +
         `<td>${age}</td></tr>`);
@@ -67,9 +100,12 @@ async function refresh() {
     const jtb = document.querySelector('#jobs tbody');
     jtb.innerHTML = '';
     for (const j of jobs.jobs) {
+      const id = esc(j.job_id);
       jtb.insertAdjacentHTML('beforeend',
-        `<tr><td>${j.job_id}</td><td>${j.state}</td></tr>`);
+        `<tr><td>${id}</td><td>${esc(j.state)}</td>` +
+        `<td><a href="#" onclick="showDetail('${id}'); return false;">detail</a></td></tr>`);
     }
+    if (openJob && !openJobTerminal) showDetail(openJob);
   } catch (err) {
     document.getElementById('meta').textContent = 'scheduler unreachable: ' + err;
   }
@@ -129,6 +165,27 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
         if path == "/api/jobs":
             tm = srv.state.task_manager
             self._json({"jobs": tm.list_jobs()})
+            return
+        if path.startswith("/api/job/"):
+            tm = srv.state.task_manager
+            rest = path[len("/api/job/"):]
+            if rest.endswith("/dot"):
+                dot = tm.get_job_dot(rest[: -len("/dot")])
+                if dot is None:
+                    self._json({"error": "no such job"}, 404)
+                    return
+                body = dot.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/vnd.graphviz")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            detail = tm.get_job_detail(rest)
+            if detail is None:
+                self._json({"error": "no such job"}, 404)
+                return
+            self._json(detail)
             return
         if path == "/api/metrics":
             em = srv.state.executor_manager

@@ -73,6 +73,10 @@ class UnresolvedStage:
     output_links: List[int] = field(default_factory=list)
     inputs: Dict[int, StageInput] = field(default_factory=dict)
 
+    @property
+    def partitions(self) -> int:
+        return self.plan.output_partitioning().n
+
     def add_input_partitions(
         self, stage_id: int, locations: List[PartitionLocation]
     ) -> None:
@@ -249,6 +253,11 @@ class CompletedStage:
     @property
     def partitions(self) -> int:
         return len(self.task_statuses)
+
+    def completed_tasks(self) -> int:
+        return sum(
+            1 for t in self.task_statuses if t is not None and t.state == "completed"
+        )
 
     def to_running(self) -> RunningStage:
         """Re-run after its shuffle files were lost with an executor."""

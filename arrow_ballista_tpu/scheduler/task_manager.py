@@ -189,19 +189,7 @@ class TaskManager:
         is polled long after complete_job() evicted it, and a stray entry
         would make active_job_ids() (and the KEDA scaler's inflight metric)
         report the job forever."""
-        with self._cache_lock:
-            entry = self._cache.get(job_id)
-        if entry is not None:
-            with entry.lock:
-                graph = self._load(job_id, entry)
-                if graph is not None:
-                    return self._status_of(graph)
-        for ks in (Keyspace.CompletedJobs, Keyspace.FailedJobs, Keyspace.ActiveJobs):
-            raw = self.backend.get(ks, job_id)
-            if raw is not None:
-                g = ExecutionGraph.decode(raw, self.work_dir)
-                return self._status_of(g)
-        return None
+        return self._with_graph(job_id, self._status_of)
 
     @staticmethod
     def _status_of(graph: ExecutionGraph) -> dict:
@@ -211,6 +199,68 @@ class TaskManager:
         if graph.status == COMPLETED:
             out["locations"] = list(graph.output_locations)
         return out
+
+    def _with_graph(self, job_id: str, fn):
+        """Apply ``fn(graph)`` to the job's graph and return the result.
+
+        For a cached (live) job, ``fn`` runs UNDER the entry lock — the
+        scheduler mutates graph/stage state under that same lock from gRPC
+        threads, so unlocked reads from the REST thread would race dict
+        resizes mid-iteration.  Decoded (persisted) graphs are private
+        copies and need no lock.  Read-only like get_job_status: never
+        creates a cache entry."""
+        with self._cache_lock:
+            entry = self._cache.get(job_id)
+        if entry is not None:
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is not None:
+                    return fn(graph)
+        for ks in (Keyspace.CompletedJobs, Keyspace.FailedJobs, Keyspace.ActiveJobs):
+            raw = self.backend.get(ks, job_id)
+            if raw is not None:
+                return fn(ExecutionGraph.decode(raw, self.work_dir))
+        return None
+
+    def get_job_detail(self, job_id: str) -> Optional[dict]:
+        """Per-stage drill-down for the scheduler UI (the reference UI's
+        QueriesList row expansion, ``ballista/ui/scheduler/src/components/
+        QueriesList.tsx``): stage state machine position, task progress
+        and merged operator metrics per stage."""
+        return self._with_graph(job_id, self._detail_of)
+
+    def _detail_of(self, graph: ExecutionGraph) -> dict:
+        detail = self._status_of(graph)
+        stages = []
+        for sid in sorted(graph.stages):
+            stage = graph.stages[sid]
+            state = type(stage).__name__.replace("Stage", "")
+            row = {
+                "stage_id": sid,
+                "state": state,
+                "partitions": stage.partitions,
+            }
+            count = getattr(stage, "completed_tasks", None)
+            if count is not None:
+                row["completed_tasks"] = count()
+            metrics = getattr(stage, "stage_metrics", None)
+            if metrics:
+                row["metrics"] = {
+                    op: dict(vals) for op, vals in metrics.items()
+                }
+            err = getattr(stage, "error", "")
+            if err:
+                row["error"] = err
+            stages.append(row)
+        detail["stages"] = stages
+        return detail
+
+    def get_job_dot(self, job_id: str) -> Optional[str]:
+        """GraphViz text of the job's stage DAG (reference: the UI's plan
+        view via ``core/src/utils.rs produce_diagram``)."""
+        from ..utils.diagram import produce_diagram
+
+        return self._with_graph(job_id, produce_diagram)
 
     # ------------------------------------------------------------- updates
     def update_task_statuses(

@@ -4,12 +4,15 @@ Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
 
   #1 q6 SF1 from PARQUET (scan->HBM bridge cost is in the wall time)
   #3 q3 SF10 (join + aggregate; mesh gang + exchange paths)
+  #4 full 22 TPC-H distributed (2 executors over gRPC/Flight) at
+     tractable scale (BENCH_FULL22_SF, default 1)
   #5 h2o groupby G1_1e8 (high-cardinality aggregate), TPU vs CPU
+  plus a star-join showcase for the fused device PK-FK join
 
 Each config emits one JSON line (same shape as bench.py) and everything
 is appended to BENCH_SUITE_r03.json so the results ship with the repo.
 
-Usage: python bench_suite.py [q6|q3|h2o|all]  (default all)
+Usage: python bench_suite.py [q6|q3|starjoin|full22|h2o|all]  (default all)
 """
 
 from __future__ import annotations
@@ -277,7 +280,7 @@ def bench_full22() -> None:
     data = {name: gen_table(name, sf) for name in ALL_TABLES}
     n_lineitem = data["lineitem"].num_rows
 
-    def run(tpu: bool) -> dict:
+    def run(tpu: bool):
         cfg = BallistaConfig(
             {
                 "ballista.tpu.enable": str(tpu).lower(),
@@ -290,6 +293,7 @@ def bench_full22() -> None:
             config=cfg, num_executors=2, concurrent_tasks=2
         )
         times = {}
+        outputs = {}
         try:
             for name, tbl in data.items():
                 bctx.register_table(name, MemoryTable.from_table(tbl, 2))
@@ -297,14 +301,33 @@ def bench_full22() -> None:
                 t0 = time.perf_counter()
                 out = bctx.sql(QUERIES[qno]).collect()
                 times[f"q{qno}"] = round(time.perf_counter() - t0, 3)
-                assert out is not None
+                outputs[qno] = out
         finally:
             bctx.close()
             memory_store.clear()
-        return times
+        return times, outputs
 
-    cpu_times = run(False)
-    tpu_times = run(True)
+    def _tables_match(a, b) -> bool:
+        if a.num_rows != b.num_rows:
+            return False
+        if a.num_rows and a.column_names:
+            keys = [(c, "ascending") for c in a.column_names
+                    if not str(a.schema.field(c).type).startswith("float")]
+            if keys:
+                a, b = a.sort_by(keys), b.sort_by(keys)
+        for name in a.column_names:
+            for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+                if isinstance(x, float) and isinstance(y, float):
+                    if abs(x - y) > 1e-6 * max(abs(x), abs(y), 1.0):
+                        return False
+                elif x != y:
+                    return False
+        return True
+
+    cpu_times, cpu_out = run(False)
+    tpu_times, tpu_out = run(True)
+    mismatched = [f"q{q}" for q in sorted(QUERIES)
+                  if not _tables_match(cpu_out[q], tpu_out[q])]
     total_cpu = round(sum(cpu_times.values()), 3)
     total_tpu = round(sum(tpu_times.values()), 3)
     _emit(
@@ -316,6 +339,8 @@ def bench_full22() -> None:
             "lineitem_rows": n_lineitem,
             "cpu_total_sec": total_cpu,
             "executors": 2,
+            "matches_cpu_1e-6": not mismatched,
+            "mismatched_queries": mismatched,
             "per_query_sec": {
                 q: {"cpu": cpu_times[q], "tpu": tpu_times[q]}
                 for q in cpu_times

@@ -65,6 +65,57 @@ def test_rest_api_state(cluster):
         api.stop()
 
 
+def test_rest_api_job_detail(cluster):
+    """Per-stage drill-down + DOT graph (the reference UI's QueriesList
+    row expansion and plan view)."""
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+
+    t = pa.table({"a": [1, 2, 3, 1], "b": [1.0, 2.0, 3.0, 4.0]})
+    cluster.register_table("tdetail", MemoryTable.from_table(t, 1))
+    out = cluster.sql("select a, sum(b) from tdetail group by a").collect()
+    assert out.num_rows == 3
+
+    api = ApiServerHandle(cluster._standalone_handles[0].server, "127.0.0.1", 0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/jobs", timeout=10
+        ) as resp:
+            jobs = json.load(resp)["jobs"]
+        assert jobs, "completed job should be listed"
+        job_id = jobs[0]["job_id"]
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/job/{job_id}", timeout=10
+        ) as resp:
+            detail = json.load(resp)
+        assert detail["job_id"] == job_id
+        assert detail["stages"], "stage table must be populated"
+        for st in detail["stages"]:
+            assert {"stage_id", "state", "partitions"} <= set(st)
+        done = [s for s in detail["stages"] if s["state"] == "Completed"]
+        assert done, "a finished job has completed stages"
+        assert all(
+            s.get("completed_tasks") == s["partitions"] for s in done
+        )
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/job/{job_id}/dot", timeout=10
+        ) as resp:
+            dot = resp.read().decode()
+        assert dot.startswith("digraph") and f"job {job_id}" in dot
+
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/api/job/nonexistent", timeout=10
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        api.stop()
+
+
 # --------------------------------------------------------------- FlightSQL
 def test_flight_sql_roundtrip(cluster):
     import pyarrow.flight as flight
