@@ -10,7 +10,9 @@ numpy:
   vectorized when a radix grows);
 * known combinations resolve through ``np.searchsorted`` on a sorted
   (combined → gid) index — no Python per-row/per-group work;
-* new combinations batch-append: one ``np.unique`` over the misses only.
+* new combinations batch-append: one hash-based ``pandas.factorize``
+  over the misses only (the sort-based ``np.unique`` it replaced was
+  10x slower at q3 SF10 scale: 9.6s vs 1.0s on 30M i64 keys).
 
 Group ids are row indices of ``key_mat`` (assignment order), so device
 states stay valid as the table grows — matching the adaptive-capacity
@@ -91,13 +93,25 @@ class GroupTable:
             gids = np.full(len(combined), -1, dtype=np.int32)
 
         if not found.all():
+            import pandas as pd
+
             miss_rows = np.nonzero(~found)[0]
-            uniq, first_idx, inverse = np.unique(
-                combined[miss_rows], return_index=True, return_inverse=True
-            )
+            miss = combined[miss_rows]
+            # hash-based dedup: codes are first-appearance ordinals, uniq is
+            # in first-appearance order — new gids therefore keep the
+            # assignment-order contract (gid = key_mat row index)
+            codes, uniq = pd.factorize(miss, sort=False)
+            codes = codes.astype(np.int32, copy=False)
+            # first occurrence of code k is where the running code maximum
+            # first reaches k (codes are assigned sequentially)
+            cummax = np.maximum.accumulate(codes)
+            first = np.empty(len(codes), dtype=bool)
+            if len(codes):
+                first[0] = True
+                first[1:] = cummax[1:] > cummax[:-1]
+            rep = miss_rows[first]
             base = self.n_groups
             new_gids = base + np.arange(len(uniq), dtype=np.int32)
-            rep = miss_rows[first_idx]
             new_mat = np.stack(
                 [c[rep].astype(np.int64) for c in code_arrays], axis=1
             )
@@ -107,5 +121,5 @@ class GroupTable:
             order = np.argsort(all_combined, kind="stable")
             self._sorted_combined = all_combined[order]
             self._sorted_gids = all_gids[order]
-            gids[miss_rows] = new_gids[inverse]
+            gids[miss_rows] = base + codes
         return gids

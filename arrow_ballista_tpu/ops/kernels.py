@@ -709,21 +709,27 @@ _MATMUL_BLOCK = 1 << 14
 
 def set_agg_algorithm(algo: Optional[str]) -> None:
     """Force the device segment-reduction strategy (tests) or None=auto."""
-    if algo not in (None, "matmul", "scatter"):
+    if algo not in (None, "matmul", "scatter", "sort"):
         raise ValueError(f"agg algorithm {algo!r}")
     _AGG_ALGO["force"] = algo
 
 
 def segment_algo(capacity: int, n_rows: Optional[int] = None) -> str:
-    """Strategy for one kernel trace (n_rows static at trace time)."""
+    """Strategy for one kernel trace (n_rows static at trace time).
+
+    TPU: matmul (MXU one-hot einsum) while rows x capacity stays inside
+    the FLOP bound, else sort (one sort + segmented scan — scatter would
+    cost ~n/45M seconds PER aggregate column).  CPU: scatter (XLA:CPU
+    lowers it to a tight loop; sorting only adds work).
+    """
     if _AGG_ALGO["force"] is not None:
         return _AGG_ALGO["force"]
     if jax.default_backend() == "cpu":
         return "scatter"
     if capacity > _MATMUL_MAX_CAP:
-        return "scatter"
+        return "sort"
     if n_rows is not None and n_rows * capacity > _MATMUL_MAX_ELEMS:
-        return "scatter"
+        return "sort"
     return "matmul"
 
 
@@ -833,6 +839,111 @@ def _segment_sum_df32(v, seg_ids, capacity, block_cap: int = 4096):
     return hi[0], lo[0]
 
 
+def _sorted_segment_agg(seg_key, capacity: int, kinds: list, cols: list):
+    """Sort-based segmented reduction: the TPU-native high-cardinality path.
+
+    TPU scatter serializes (one element per cycle-ish), so at capacity
+    beyond the matmul bound the scatter path costs ~rows/45M seconds PER
+    COLUMN.  Sorting rows by group id once and running one segmented
+    ``lax.associative_scan`` over ALL columns costs one XLA sort plus a
+    handful of HBM passes, independent of capacity, amortized across every
+    aggregate in the stage — and segment boundaries come from
+    ``searchsorted`` (exact row counts, no reduction at all).
+
+    seg_key: [n] i32 group ids with base-mask-failing rows set to
+    ``capacity`` (they sort to the end, past every extracted boundary).
+    kinds: per logical column, one of
+      "df32" — double-float compensated sum; col is an (hi, lo) pair of
+               f32 arrays (normalize leaves via ``_two_sum`` first).
+               Errors stay RELATIVE TO THE SEGMENT (the scan resets at
+               boundaries), unlike global-prefix schemes.
+      "f64"  — plain f64 sum (x64 mode)
+      "i32"  — exact integer count sum
+      ("min", ident) / ("max", ident) — extremum (any dtype; masked rows
+               AND empty segments carry the identity, matching the
+               scatter path so cross-batch state merges stay correct)
+    cols: matching arrays, gathered through the sort permutation here.
+
+    Returns (per-kind segment totals [capacity], presence counts
+    [capacity]); empty segments yield 0 for sums/counts and the identity
+    for min/max.
+    """
+    n = seg_key.shape[0]
+    s2, perm = jax.lax.sort_key_val(
+        seg_key, jnp.arange(n, dtype=jnp.int32)
+    )
+    flag = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), s2[1:] != s2[:-1]]
+    )
+
+    elems = [flag]
+    slots = []  # per logical col: (kind, ident, slot index or (slot, slot))
+    for kind, col in zip(kinds, cols):
+        ident = None
+        if isinstance(kind, tuple):
+            kind, ident = kind
+        if kind == "df32":
+            hi, lo = col
+            slots.append((kind, ident, (len(elems), len(elems) + 1)))
+            elems.append(hi[perm])
+            elems.append(lo[perm])
+        else:
+            slots.append((kind, ident, len(elems)))
+            elems.append(col[perm])
+
+    flat_kinds = ["flag"]
+    for kind, _, _ in slots:
+        flat_kinds.extend(["df32_hi", "df32_lo"] if kind == "df32" else [kind])
+
+    def combine(a, b):
+        fa, fb = a[0], b[0]
+        out = [jnp.logical_or(fa, fb)]
+        i = 1
+        while i < len(flat_kinds):
+            kind = flat_kinds[i]
+            if kind == "df32_hi":
+                s, e = _two_sum(a[i], b[i])
+                hi, lo2 = _two_sum(s, a[i + 1] + b[i + 1] + e)
+                out.append(jnp.where(fb, b[i], hi))
+                out.append(jnp.where(fb, b[i + 1], lo2))
+                i += 2
+                continue
+            if kind in ("f64", "i32"):
+                merged = a[i] + b[i]
+            elif kind == "min":
+                merged = jnp.minimum(a[i], b[i])
+            else:  # max
+                merged = jnp.maximum(a[i], b[i])
+            out.append(jnp.where(fb, b[i], merged))
+            i += 1
+        return tuple(out)
+
+    scanned = jax.lax.associative_scan(combine, tuple(elems))
+
+    bounds = jnp.searchsorted(
+        s2, jnp.arange(capacity + 1, dtype=jnp.int32), side="left"
+    )
+    presence = jnp.diff(bounds)
+    last = jnp.clip(bounds[1:] - 1, 0, max(n - 1, 0))
+    occupied = presence > 0
+
+    outs = []
+    for kind, ident, slot in slots:
+        if kind == "df32":
+            hi = jnp.where(occupied, scanned[slot[0]][last], 0.0)
+            lo = jnp.where(occupied, scanned[slot[1]][last], 0.0)
+            outs.append((hi, lo))
+        else:
+            v = scanned[slot][last]
+            empty = (
+                jnp.zeros((), v.dtype)
+                if ident is None
+                else jnp.asarray(ident, v.dtype)
+            )
+            outs.append(jnp.where(occupied, v, empty))
+    return outs, presence
+
+
 def make_partial_agg_kernel(
     filter_closure: Optional[JaxClosure],
     arg_closures: list[Optional[JaxClosure]],
@@ -871,6 +982,8 @@ def make_partial_agg_kernel(
         algo = segment_algo(capacity, int(seg_ids.shape[0]))
         if algo == "matmul" and mode == "x32":
             return _fn_matmul(env, seg_ids, maskf)
+        if algo == "sort":
+            return _fn_sorted(env, seg_ids, maskf)
 
         outs = []
         for spec, closure in zip(specs, arg_closures):
@@ -929,6 +1042,92 @@ def make_partial_agg_kernel(
         presence = jax.ops.segment_sum(
             maskf.astype(_I()), seg_ids, num_segments=capacity
         )
+        return tuple(outs) + (presence,)
+
+    def _fn_sorted(env, seg_ids, maskf):
+        """High-cardinality path: one sort, one segmented scan, no scatter.
+
+        Base-mask-failing rows get the sentinel key ``capacity`` and sort
+        past every boundary; presence comes free from the boundary counts.
+        Per-argument validity folds into the columns (0 / identity), and
+        count columns dedupe by validity like the matmul path.
+        """
+        key = jnp.where(maskf, seg_ids, jnp.asarray(capacity, seg_ids.dtype))
+
+        kinds: list = []
+        cols: list = []
+        cnt_index: dict = {}  # validity id -> logical col index (None=base)
+
+        def cnt_col(m, avalid=None):
+            if avalid is None:
+                return None  # base-mask count == presence (boundary diff)
+            k = id(avalid)
+            j = cnt_index.get(k)
+            if j is None:
+                j = len(kinds)
+                cnt_index[k] = j
+                kinds.append("i32")
+                cols.append(m.astype(_I()))
+            return j
+
+        plan: list = []
+        for spec, closure in zip(specs, arg_closures):
+            if spec.func == "count_star":
+                plan.append(("count", None))
+                continue
+            val, avalid = closure(env)
+            m = maskf if avalid is None else jnp.logical_and(maskf, avalid)
+            nj = cnt_col(m, avalid)
+            if spec.func == "count":
+                plan.append(("count", nj))
+                continue
+            if spec.func in ("sum", "avg"):
+                if mode == "x32":
+                    if spec.pair:
+                        vhi, vlo = val
+                        z = jnp.zeros((), jnp.float32)
+                        h, l = _two_sum(
+                            jnp.where(m, vhi, z), jnp.where(m, vlo, z)
+                        )
+                    else:
+                        h = jnp.where(
+                            m, val.astype(jnp.float32), jnp.zeros((), jnp.float32)
+                        )
+                        l = jnp.zeros_like(h)
+                    plan.append(("sum32", len(kinds), nj))
+                    kinds.append("df32")
+                    cols.append((h, l))
+                else:
+                    v = jnp.where(m, val.astype(_F()), jnp.zeros((), _F()))
+                    plan.append(("sum64", len(kinds), nj))
+                    kinds.append("f64")
+                    cols.append(v)
+                continue
+            if spec.func in ("min", "max"):
+                v, ident = _minmax_operand(spec, val)
+                plan.append(("minmax", len(kinds), nj))
+                kinds.append((spec.func, ident))
+                cols.append(jnp.where(m, v, ident))
+                continue
+            raise ExecutionError(f"kernel agg {spec.func}")
+
+        totals, presence = _sorted_segment_agg(key, capacity, kinds, cols)
+
+        outs: list = []
+        for entry in plan:
+            if entry[0] == "count":
+                outs.append(presence if entry[1] is None else totals[entry[1]])
+            elif entry[0] == "sum32":
+                hi, lo = totals[entry[1]]
+                outs.append(hi)
+                outs.append(lo)
+                outs.append(presence if entry[2] is None else totals[entry[2]])
+            elif entry[0] == "sum64":
+                outs.append(totals[entry[1]])
+                outs.append(presence if entry[2] is None else totals[entry[2]])
+            else:  # minmax
+                outs.append(totals[entry[1]])
+                outs.append(presence if entry[2] is None else totals[entry[2]])
         return tuple(outs) + (presence,)
 
     def _fn_matmul(env, seg_ids, maskf):

@@ -50,10 +50,34 @@ def _collect_stage_metrics(plan) -> dict:
     return agg
 
 
+def _tables_match(a, b, rel: float = 1e-6) -> bool:
+    """CPU-vs-TPU oracle comparison: align rows on the non-float columns
+    (floats differ sub-tolerance between the paths and would scramble tie
+    ordering), then compare floats to ``rel`` and everything else exactly."""
+    import pyarrow as pa
+
+    if a.num_rows != b.num_rows:
+        return False
+    if a.num_rows and a.column_names:
+        keys = [
+            (c, "ascending")
+            for c in a.column_names
+            if not pa.types.is_floating(a.schema.field(c).type)
+        ]
+        if keys:
+            a, b = a.sort_by(keys), b.sort_by(keys)
+    for name in a.column_names:
+        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+            if isinstance(x, float) and isinstance(y, float):
+                if abs(x - y) > rel * max(abs(x), abs(y), 1.0):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
 def _run_both(make_ctx, sql: str, n_rows: int, iters: int = 5):
     """(cpu_best_s, tpu_best_s, tpu_metrics, match_1e6)"""
-    import pyarrow as pa  # noqa: F401
-
     results = {}
     metrics = {}
     for tpu in (False, True):
@@ -71,23 +95,7 @@ def _run_both(make_ctx, sql: str, n_rows: int, iters: int = 5):
         if tpu and plan is not None:
             metrics = _collect_stage_metrics(plan)
 
-    a, b = results[False][1], results[True][1]
-    ok = a.num_rows == b.num_rows
-    if ok:
-        keys = [(a.column_names[0], "ascending")]
-        a = a.sort_by(keys)
-        b = b.sort_by(keys)
-        for name in a.column_names:
-            for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
-                if isinstance(x, float) and isinstance(y, float):
-                    if abs(x - y) > 1e-6 * max(abs(x), abs(y), 1.0):
-                        ok = False
-                        break
-                elif x != y:
-                    ok = False
-                    break
-            if not ok:
-                break
+    ok = _tables_match(results[False][1], results[True][1])
     return results[False][0], results[True][0], metrics, ok
 
 
@@ -306,23 +314,6 @@ def bench_full22() -> None:
             bctx.close()
             memory_store.clear()
         return times, outputs
-
-    def _tables_match(a, b) -> bool:
-        if a.num_rows != b.num_rows:
-            return False
-        if a.num_rows and a.column_names:
-            keys = [(c, "ascending") for c in a.column_names
-                    if not str(a.schema.field(c).type).startswith("float")]
-            if keys:
-                a, b = a.sort_by(keys), b.sort_by(keys)
-        for name in a.column_names:
-            for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
-                if isinstance(x, float) and isinstance(y, float):
-                    if abs(x - y) > 1e-6 * max(abs(x), abs(y), 1.0):
-                        return False
-                elif x != y:
-                    return False
-        return True
 
     cpu_times, cpu_out = run(False)
     tpu_times, tpu_out = run(True)
