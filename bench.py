@@ -108,44 +108,14 @@ def main() -> None:
     RESULT["platform"] = "cpu-operator-path"
 
     # ---- TPU leg.  Backend init can HANG (not just raise) when the chip
-    # is held elsewhere, so probe it in a subprocess with a hard timeout
-    # and retry once; only if the probe succeeds does THIS process touch
-    # the device.  Otherwise fall back to the host CPU platform so the
-    # fused-kernel path still produces a (labelled) number.
-    import subprocess
+    # is held elsewhere; the shared guard probes in a subprocess with a
+    # hard timeout and retry, falling back to the host CPU platform so
+    # the fused-kernel path still produces a (labelled) number.
+    from benchmarks.device_guard import ensure_device
 
-    def _probe_device(timeout_s: float):
-        try:
-            p = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-                capture_output=True,
-                timeout=timeout_s,
-                text=True,
-            )
-            if p.returncode == 0 and p.stdout.strip():
-                return p.stdout.strip().splitlines()[-1]
-            return None
-        except subprocess.TimeoutExpired:
-            return "timeout"
-        except Exception:
-            return None
-
-    explicit_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-    if explicit_cpu:
-        probed = "cpu"  # intentional dev/test platform: no probe, no error
-    else:
-        probed = _probe_device(180)
-        if probed in (None, "timeout"):
-            time.sleep(10)
-            probed = _probe_device(120)
-
-    import jax
-
-    if probed in (None, "timeout", "cpu"):
-        if not explicit_cpu:
-            RESULT["error"] = "device init unavailable (probe=%s)" % probed
-        jax.config.update("jax_platforms", "cpu")
-    platform = jax.default_backend()
+    platform, guard_error = ensure_device()
+    if guard_error:
+        RESULT["error"] = guard_error
 
     import numpy as np
 

@@ -30,9 +30,28 @@ OUT_PATH = os.path.join(
 
 
 def _emit(rec: dict) -> None:
+    if "metric" in rec:
+        # label every record with the leg that actually produced it — a
+        # CPU fallback must not ship CPU numbers under *_tpu_* names
+        # without a trace in the artifact
+        import jax
+
+        rec.setdefault("device_platform", jax.default_backend())
     print(json.dumps(rec), flush=True)
     with open(OUT_PATH, "a") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def _guard_device() -> None:
+    """bench.py's probe/fallback policy (shared helper): the axon backend
+    can hang during init when the chip is held elsewhere; probe in a
+    subprocess with retry, else run the suite on the host CPU platform
+    with the fallback recorded in every emitted record."""
+    from benchmarks.device_guard import ensure_device
+
+    platform, error = ensure_device()
+    if error:
+        _emit({"warning": "%s: suite runs on %s platform" % (error, platform)})
 
 
 def _collect_stage_metrics(plan) -> dict:
@@ -384,6 +403,7 @@ def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
         os.remove(OUT_PATH)
+    _guard_device()  # after the reset so a fallback warning ships too
     if which in ("q6", "all"):
         bench_q6_parquet()
     if which in ("q3", "all"):

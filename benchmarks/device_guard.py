@@ -1,0 +1,62 @@
+"""Shared accelerator probe/fallback policy for the bench entry points.
+
+The axon TPU backend can HANG during init (not raise) when the tunnel or
+chip is held elsewhere, so the first touch happens in a SUBPROCESS with a
+hard timeout; on timeout the probe retries once (transient holds clear in
+seconds), and only if the device never comes up does the caller's process
+fall back to the host CPU platform.  bench.py and bench_suite.py share
+this one policy so their failure behavior cannot drift.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+
+def probe_backend(timeout_s: float) -> Optional[str]:
+    """Backend name from a throwaway subprocess, "timeout", or None."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        if p.returncode == 0 and p.stdout.strip():
+            return p.stdout.strip().splitlines()[-1]
+        return None
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    except Exception:
+        return None
+
+
+def ensure_device() -> Tuple[str, Optional[str]]:
+    """(active platform after any fallback, error string or None).
+
+    Must run BEFORE anything imports jax in the calling process.  An
+    explicit ``JAX_PLATFORMS=cpu`` is an intentional dev/test platform:
+    no probe, no error.
+    """
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        return jax.default_backend(), None
+
+    probed = probe_backend(180)
+    if probed in (None, "timeout"):
+        time.sleep(10)
+        probed = probe_backend(120)
+
+    import jax
+
+    error = None
+    if probed in (None, "timeout", "cpu"):
+        error = "device init unavailable (probe=%s)" % probed
+        jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend(), error
