@@ -31,6 +31,8 @@ TPU_BATCH_ROWS = "ballista.tpu.batch_rows"
 TPU_DTYPE = "ballista.tpu.dtype"
 TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
+TPU_HIGHCARD_MODE = "ballista.tpu.highcard_mode"
+TPU_READAHEAD = "ballista.tpu.readahead"
 MESH_ENABLE = "ballista.mesh.enable"
 MESH_DEVICES = "ballista.mesh.devices"
 MESH_EXCHANGE_MAX_ROWS = "ballista.mesh.exchange_max_rows"
@@ -50,6 +52,13 @@ def _parse_bool(v: str) -> bool:
     if v.lower() in ("false", "0", "no"):
         return False
     raise ValueError(f"not a boolean: {v!r}")
+
+
+def _parse_highcard_mode(v: str) -> str:
+    mode = v.lower()
+    if mode not in ("auto", "device"):
+        raise ValueError(f"highcard_mode must be auto|device, got {v!r}")
+    return mode
 
 
 @dataclass(frozen=True)
@@ -133,6 +142,21 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "memory so repeated queries skip host→HBM transfer",
             _parse_bool,
             "true",
+        ),
+        ConfigEntry(
+            TPU_HIGHCARD_MODE,
+            "aggregate routing when the first batch shows groups ~ rows: "
+            "'auto' hands the stage to the C++ hash aggregate (heuristic), "
+            "'device' keeps it on the sort-based device path",
+            _parse_highcard_mode,
+            "auto",
+        ),
+        ConfigEntry(
+            TPU_READAHEAD,
+            "background source-batch prefetch depth for device stages "
+            "(overlaps scan/decode IO with device compute); 0 disables",
+            int,
+            "2",
         ),
         ConfigEntry(
             MESH_ENABLE,
@@ -238,6 +262,14 @@ class BallistaConfig:
     @property
     def tpu_cache_columns(self) -> bool:
         return self._get(TPU_CACHE_COLUMNS)
+
+    @property
+    def tpu_highcard_mode(self) -> str:
+        return self._get(TPU_HIGHCARD_MODE)
+
+    @property
+    def tpu_readahead(self) -> int:
+        return self._get(TPU_READAHEAD)
 
     @property
     def tpu_min_rows(self) -> int:

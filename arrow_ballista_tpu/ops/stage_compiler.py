@@ -13,6 +13,7 @@ hook plays for third-party operators (``core/src/serde/mod.rs:82-95``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Iterator, Optional
 
@@ -70,6 +71,97 @@ class _HighCardinality(Exception):
 # 0.6x CPU — pyarrow's hash table is the right tool when groups ~ rows.
 _HIGHCARD_MIN_GROUPS = 1 << 16
 _HIGHCARD_RATIO = 0.05
+
+
+class _ReadAhead:
+    """Bounded background prefetch of source batches.
+
+    Device stages alternate host-side work (scan/decode, key encode) with
+    device dispatch; pulling the NEXT batch on a daemon thread overlaps
+    the source's IO (pyarrow readers release the GIL in C++) with the
+    current batch's device work.  The iterator is transparent: batches
+    arrive in order, source exceptions re-raise at the consumer, and
+    fallback replay (``_HighCardinality.tail``) can keep consuming it —
+    queued batches are still inside and will be yielded.
+
+    ``close()`` stops the pump before a fallback re-runs the stage on
+    CPU — otherwise the abandoned thread would keep consuming the old
+    source concurrently with the re-run's fresh iterator (a double-read
+    of e.g. a Flight stream) and then block on the bounded queue forever.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it, depth: int):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._closed = False
+        self._exhausted = False
+
+        def pump():
+            try:
+                for item in it:
+                    self._q.put(item)
+                    if self._closed:
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                self._q.put(e)
+                return
+            self._q.put(self._DONE)
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            # generator semantics: a terminal exception surfaces once,
+            # then the iterator stays exhausted
+            self._exhausted = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the pump and release the underlying source: drain the
+        queue until the thread exits (freeing queue slots unblocks a
+        pump stuck in put; the loop re-checks the flag after each put)."""
+        import queue
+
+        self._closed = True
+        self._exhausted = True
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(0.05)
+
+
+@contextlib.contextmanager
+def _closing_on_error(ra: Optional[_ReadAhead]):
+    """Stop the prefetch pump when the device stage aborts into a CPU
+    re-run (_CapacityExceeded / ExecutionError): the re-run opens a
+    FRESH source iterator, so the old pump must not keep reading the
+    abandoned one.  _HighCardinality passes through untouched — its
+    replay path keeps consuming this same iterator."""
+    try:
+        yield
+    except _HighCardinality:
+        raise
+    except BaseException:
+        if ra is not None:
+            ra.close()
+        raise
 
 
 class _BufferedExec(ExecutionPlan):
@@ -761,6 +853,11 @@ class TpuStageExec(ExecutionPlan):
                 raise _SmallInput(buffered)
             src = itertools.chain(buffered, src)
 
+        depth = self.config.tpu_readahead
+        ra: Optional[_ReadAhead] = None
+        if depth > 0:
+            src = ra = _ReadAhead(src, depth)
+
         from .bridge import make_key_encoder
         from .groups import GroupTable
 
@@ -778,7 +875,7 @@ class TpuStageExec(ExecutionPlan):
         n_rows_in = 0
         cap = self.capacity
         kernel = self._jit_kernel
-        with self.metrics.timer("tpu_stage_time_ns"):
+        with _closing_on_error(ra), self.metrics.timer("tpu_stage_time_ns"):
             for batch in src:
                 if batch.num_rows == 0:
                     continue
@@ -794,12 +891,15 @@ class TpuStageExec(ExecutionPlan):
                     if acc is None and not entries:
                         if (
                             fused.join is None
+                            and self.config.tpu_highcard_mode != "device"
                             and group_table.n_groups > _HIGHCARD_MIN_GROUPS
                             and group_table.n_groups > _HIGHCARD_RATIO * n
                         ):
                             # with a device join fused, the CPU
                             # alternative pays the join too — stay on
-                            # device even at high cardinality
+                            # device even at high cardinality;
+                            # highcard_mode=device forces the sort-based
+                            # device path regardless (A/B knob)
                             raise _HighCardinality([batch], src)
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path

@@ -332,3 +332,184 @@ def _stage_metrics(plan) -> dict:
                 agg[k] = agg.get(k, 0) + v
         stack.extend(node.children())
     return agg
+
+
+def test_readahead_prefetcher_transparent():
+    """_ReadAhead must yield identical items in order and re-raise source
+    exceptions at the consumer."""
+    from arrow_ballista_tpu.ops.stage_compiler import _ReadAhead
+
+    items = list(range(100))
+    assert list(_ReadAhead(iter(items), depth=2)) == items
+    assert list(_ReadAhead(iter([]), depth=1)) == []
+
+    def boom():
+        yield 1
+        yield 2
+        raise ValueError("source failed")
+
+    ra = _ReadAhead(boom(), depth=2)
+    assert next(ra) == 1 and next(ra) == 2
+    with pytest.raises(ValueError, match="source failed"):
+        next(ra)
+
+
+def test_readahead_on_off_same_results():
+    """The device stage with prefetch enabled (default) must match a
+    prefetch-disabled run batch-for-batch across a multi-batch source."""
+    from benchmarks.tpch.queries import QUERIES
+
+    a = _ctx(True, **{"ballista.tpu.readahead": "0"})
+    b = _ctx(True, **{"ballista.tpu.readahead": "2"})
+    _register_tpch(a)
+    _register_tpch(b)
+    key = [("l_returnflag", "ascending"), ("l_linestatus", "ascending")]
+    _assert_tables_equal(
+        a.sql(QUERIES[1]).collect().sort_by(key),
+        b.sql(QUERIES[1]).collect().sort_by(key),
+    )
+
+
+def test_highcard_mode_device_stays_on_device():
+    """highcard_mode=device must keep a groups~rows aggregate on the
+    sort-based device path (no highcard_fallback) and match the CPU
+    oracle; auto hands the same shape to the C++ hash aggregate."""
+    import numpy as np
+
+    from arrow_ballista_tpu.ops import kernels as K
+
+    rng = np.random.default_rng(5)
+    n = 1 << 17  # > _HIGHCARD_MIN_GROUPS worth of distinct keys
+    tbl = pa.table(
+        {
+            "g": pa.array(rng.permutation(n).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    sql = "select g, sum(v) as s, count(*) as c from t group by g"
+
+    cpu = _ctx(False)
+    cpu.register_arrow_table("t", tbl, partitions=1)
+    want = cpu.sql(sql).collect().sort_by([("g", "ascending")])
+
+    K.set_agg_algorithm("sort")
+    try:
+        dev = _ctx(
+            True,
+            **{
+                "ballista.tpu.highcard_mode": "device",
+                "ballista.tpu.max_capacity": str(1 << 19),
+            },
+        )
+        dev.register_arrow_table("t", tbl, partitions=1)
+        plan = dev.sql(sql).physical_plan()
+        got = dev.execute(plan)
+        m = _stage_metrics(plan)
+        assert "highcard_fallback" not in m, m
+        assert "tpu_fallback" not in m, m
+    finally:
+        K.set_agg_algorithm(None)
+    _assert_tables_equal(want, got.sort_by([("g", "ascending")]), rel=1e-6)
+
+    auto = _ctx(True)
+    auto.register_arrow_table("t", tbl, partitions=1)
+    plan2 = auto.sql(sql).physical_plan()
+    got2 = auto.execute(plan2)
+    assert _stage_metrics(plan2).get("highcard_fallback", 0) >= 1
+    _assert_tables_equal(want, got2.sort_by([("g", "ascending")]), rel=1e-6)
+
+
+def test_readahead_exhaustion_and_close():
+    """Iterator protocol after the end (keeps raising StopIteration, even
+    after a terminal source exception) and close() stopping the pump."""
+    import time
+
+    from arrow_ballista_tpu.ops.stage_compiler import _ReadAhead
+
+    ra = _ReadAhead(iter([1]), depth=1)
+    assert list(ra) == [1]
+    with pytest.raises(StopIteration):
+        next(ra)  # second probe past the end must not block
+
+    def boom():
+        yield 1
+        raise ValueError("dead")
+
+    rb = _ReadAhead(boom(), depth=1)
+    assert next(rb) == 1
+    with pytest.raises(ValueError):
+        next(rb)
+    with pytest.raises(StopIteration):
+        next(rb)  # after the terminal exception: exhausted, not hung
+
+    # close() must stop a pump blocked on the bounded queue so a CPU
+    # fallback's fresh iterator is the ONLY consumer of the source
+    pulled = []
+
+    def slow_source():
+        for i in range(1000):
+            pulled.append(i)
+            yield i
+
+    rc = _ReadAhead(slow_source(), depth=1)
+    assert next(rc) == 0
+    rc.close()
+    n_after_close = len(pulled)
+    time.sleep(0.1)
+    assert len(pulled) == n_after_close, "pump kept reading after close()"
+    assert not rc._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(rc)
+
+
+def test_highcard_mode_validated():
+    from arrow_ballista_tpu import BallistaConfig
+    from arrow_ballista_tpu.errors import BallistaError
+
+    with pytest.raises((BallistaError, ValueError)):
+        BallistaConfig({"ballista.tpu.highcard_mode": "sort"})
+    assert (
+        BallistaConfig(
+            {"ballista.tpu.highcard_mode": "Device"}
+        ).tpu_highcard_mode
+        == "device"
+    )
+
+
+def test_capacity_fallback_closes_prefetcher():
+    """A _CapacityExceeded CPU re-run must stop the prefetch pump (no
+    concurrent double-read of the source, no leaked blocked thread)."""
+    import threading
+
+    import numpy as np
+
+    before = threading.active_count()
+    n = 4096
+    rng = np.random.default_rng(9)
+    tbl = pa.table(
+        {
+            "g": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(rng.uniform(0, 1, n)),
+        }
+    )
+    ctx = _ctx(
+        True,
+        **{
+            "ballista.tpu.segment_capacity": "64",
+            "ballista.tpu.max_capacity": "256",  # forces _CapacityExceeded
+            "ballista.batch.size": "512",
+            "ballista.tpu.readahead": "2",
+        },
+    )
+    ctx.register_arrow_table("t", tbl, partitions=1)
+    plan = ctx.sql("select g, sum(v) s from t group by g").physical_plan()
+    out = ctx.execute(plan)
+    assert out.num_rows == n  # correct via the CPU re-run
+    assert _stage_metrics(plan).get("tpu_fallback", 0) >= 1
+    for _ in range(50):  # pump threads must wind down, not leak
+        if threading.active_count() <= before:
+            break
+        import time
+
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
