@@ -1302,9 +1302,21 @@ def state_is_int(spec: KernelAggSpec, mode: str) -> tuple[bool, ...]:
 _PACK_CACHE: dict = {}
 
 
-def pack_for_fetch(specs: list[KernelAggSpec], acc: tuple, mode: str):
-    """Device-side: concat all state fields into one [n_fields, cap] array."""
-    key = (tuple(specs), mode, acc[0].shape[-1])
+def pack_for_fetch(
+    specs: list[KernelAggSpec], acc: tuple, mode: str,
+    keep: Optional[int] = None,
+):
+    """Device-side: concat all state fields into one [n_fields, keep] array.
+
+    ``keep`` (static per trace; callers bucket it to a power of two so
+    retraces stay bounded) slices the fetch to the slots that hold real
+    groups — capacity grows in 4x steps, so fetching all of it moves up
+    to 4x more bytes than the group table ever assigned, and tunnel fetch
+    bandwidth is the scarce resource at high cardinality."""
+    cap = acc[0].shape[-1]
+    if keep is None or keep > cap:
+        keep = cap
+    key = (tuple(specs), mode, cap, keep)
     fn = _PACK_CACHE.get(key)
     if fn is None:
         flags = [
@@ -1315,9 +1327,9 @@ def pack_for_fetch(specs: list[KernelAggSpec], acc: tuple, mode: str):
             fdt = jnp.float64 if mode == "x64" else jnp.float32
             idt = jnp.int64 if mode == "x64" else jnp.int32
             rows = [
-                a.astype(idt)
+                a[:keep].astype(idt)
                 if is_int
-                else jax.lax.bitcast_convert_type(a.astype(fdt), idt)
+                else jax.lax.bitcast_convert_type(a[:keep].astype(fdt), idt)
                 for a, is_int in zip(states, flags)
             ]
             return jnp.stack(rows, axis=0)

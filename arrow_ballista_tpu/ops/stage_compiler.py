@@ -131,15 +131,21 @@ class _ReadAhead:
             raise item
         return item
 
-    def close(self) -> None:
-        """Stop the pump and release the underlying source: drain the
-        queue until the thread exits (freeing queue slots unblocks a
-        pump stuck in put; the loop re-checks the flag after each put)."""
+    def close(self, deadline_s: float = 1.0) -> None:
+        """Stop the pump: drain the queue until the thread exits (freeing
+        queue slots unblocks a pump stuck in put; the loop re-checks the
+        flag after each put).  Bounded wait: a pump blocked inside the
+        SOURCE's read (e.g. a stalled Flight stream) cannot be
+        interrupted — after the deadline the daemon thread is abandoned
+        (it dies with the source or the process) rather than hanging the
+        caller's CPU fallback."""
         import queue
+        import time
 
         self._closed = True
         self._exhausted = True
-        while self._thread.is_alive():
+        give_up = time.monotonic() + deadline_s
+        while self._thread.is_alive() and time.monotonic() < give_up:
             try:
                 self._q.get_nowait()
             except queue.Empty:
@@ -824,7 +830,10 @@ class TpuStageExec(ExecutionPlan):
                         for seg, valid, args in entries:
                             out = kernel(seg, valid, *args)
                             acc = K.combine_states(self.specs, acc, out, self._mode)
-                        host_states = self._fetch_states(acc)
+                        host_states = self._fetch_states(
+                            acc,
+                            group_table.n_groups if fused.group_exprs else None,
+                        )
                 self.metrics.add("cache_hits", 1)
                 yield from self._materialize(
                     host_states, key_encoders, group_table, n_rows_in, ctx,
@@ -972,7 +981,9 @@ class TpuStageExec(ExecutionPlan):
             # it lives INSIDE the device timer: device_time_ns now covers
             # queue + compute + result fetch (VERDICT round-2 weakness #2)
             with self.metrics.timer("device_time_ns"):
-                host_states = self._fetch_states(acc)
+                host_states = self._fetch_states(
+                    acc, group_table.n_groups if fused.group_exprs else None
+                )
 
         if ck is not None and acc is not None:
             device_cache.put(
@@ -1060,11 +1071,19 @@ class TpuStageExec(ExecutionPlan):
             )
             return self._build_state
 
-    def _fetch_states(self, acc) -> Optional[list]:
-        """One packed device→host fetch of the whole state tuple."""
+    def _fetch_states(self, acc, n_groups: Optional[int] = None) -> Optional[list]:
+        """One packed device→host fetch of the whole state tuple.
+
+        ``n_groups`` (when the stage aggregates by key) bounds the fetch:
+        only the pow2 bucket covering the assigned group ids moves over
+        the tunnel instead of the full grown capacity (up to 4x fewer
+        bytes at high cardinality)."""
         if acc is None:
             return None
-        packed = K.pack_for_fetch(self.specs, acc, self._mode)
+        keep = None
+        if n_groups is not None:
+            keep = 1 << max(6, (max(n_groups, 1) - 1).bit_length())
+        packed = K.pack_for_fetch(self.specs, acc, self._mode, keep=keep)
         return K.unpack_host(self.specs, np.asarray(packed), self._mode)
 
     def _encode_groups(self, batch, key_encoders, group_table):

@@ -176,12 +176,15 @@ class MeshGangExec(ExecutionPlan):
                             )
 
                             if (
-                                group_table.n_groups > _HIGHCARD_MIN_GROUPS
+                                tpu.config.tpu_highcard_mode != "device"
+                                and group_table.n_groups > _HIGHCARD_MIN_GROUPS
                                 and group_table.n_groups > _HIGHCARD_RATIO * n
                             ):
                                 # groups ~ rows: the sequential fallback
                                 # will route each partition to the C++
-                                # hash aggregate
+                                # hash aggregate; highcard_mode=device
+                                # keeps the gang on the sort-based path
+                                # (same knob TpuStageExec honors)
                                 from ..errors import ExecutionError
 
                                 raise ExecutionError(
@@ -228,8 +231,12 @@ class MeshGangExec(ExecutionPlan):
                 sharded = M.assemble_shards(mesh, n_dev_chunks, len(names))
                 out = step(*sharded)
                 # packed fetch = the only reliable sync on the tunnel TPU
-                # (block_until_ready is a no-op there); one roundtrip
-                host_states = tpu._fetch_states(tuple(out))
+                # (block_until_ready is a no-op there); one roundtrip,
+                # sliced to the assigned groups (pow2 bucket)
+                host_states = tpu._fetch_states(
+                    tuple(out),
+                    group_table.n_groups if tpu.fused.group_exprs else None,
+                )
         self.metrics.add("mesh_rows_in", n_rows)
         self.metrics.add("mesh_devices", n_dev)
         yield from tpu._materialize(

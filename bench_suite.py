@@ -404,6 +404,11 @@ def main() -> None:
     if os.path.exists(OUT_PATH) and which == "all":
         os.remove(OUT_PATH)
     _guard_device()  # after the reset so a fallback warning ships too
+    algo = os.environ.get("BENCH_AGG_ALGO")
+    if algo:  # A/B hook: force matmul | sort | scatter on the TPU legs
+        from arrow_ballista_tpu.ops import kernels as K
+
+        K.set_agg_algorithm(algo)
     if which in ("q6", "all"):
         bench_q6_parquet()
     if which in ("q3", "all"):

@@ -251,3 +251,93 @@ def test_memory_partitions_served_over_flight(tmp_path):
     finally:
         handle.shutdown()
         memory_store.delete_job("jobf")
+
+
+def test_mesh_gang_with_sort_algorithm():
+    """The gang kernel shares make_partial_agg_kernel, so on real TPU
+    hardware high cardinality routes to the SORT strategy INSIDE the
+    shard_map program — lax.sort_key_val + segmented associative_scan
+    must trace and run under the mesh (forced here on the CPU mesh)."""
+    from arrow_ballista_tpu.ops import kernels as K
+    from benchmarks.tpch.queries import QUERIES
+
+    K.set_agg_algorithm("sort")
+    try:
+        ctx_mesh = SessionContext(_cfg())
+        _register(ctx_mesh)
+        plan = ctx_mesh.sql(QUERIES[1]).physical_plan()
+        got = ctx_mesh.execute(plan)
+        gangs = _find(plan, MeshGangExec)
+        assert gangs
+        m = gangs[0].metrics.to_dict()
+        assert "mesh_fallback" not in m, m
+    finally:
+        K.set_agg_algorithm(None)
+
+    ctx_off = SessionContext(
+        _cfg(**{"ballista.mesh.enable": "false", "ballista.tpu.enable": "false"})
+    )
+    _register(ctx_off)
+    want = ctx_off.sql(QUERIES[1]).collect()
+    key = [("l_returnflag", "ascending"), ("l_linestatus", "ascending")]
+    a, b = want.sort_by(key), got.sort_by(key)
+    assert a.num_rows == b.num_rows
+    for name in a.column_names:
+        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-6 * max(abs(x), abs(y), 1.0), name
+            else:
+                assert x == y, name
+
+
+def test_mesh_gang_highcard_device_mode():
+    """highcard_mode=device must keep a groups~rows aggregate on the gang
+    (no mesh_fallback) with the sort strategy, matching the CPU oracle."""
+    import numpy as np
+
+    from arrow_ballista_tpu.ops import kernels as K
+
+    rng = np.random.default_rng(13)
+    n = 1 << 17
+    tbl = pa.table(
+        {
+            "g": pa.array(rng.permutation(n).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    sql = "select g, sum(v) as s, count(*) as c from t group by g"
+
+    off = SessionContext(
+        _cfg(**{"ballista.mesh.enable": "false", "ballista.tpu.enable": "false"})
+    )
+    off.register_arrow_table("t", tbl, partitions=4)
+    want = off.sql(sql).collect().sort_by([("g", "ascending")])
+
+    K.set_agg_algorithm("sort")
+    try:
+        ctx = SessionContext(
+            _cfg(
+                **{
+                    "ballista.tpu.highcard_mode": "device",
+                    "ballista.tpu.max_capacity": str(1 << 19),
+                }
+            )
+        )
+        ctx.register_arrow_table("t", tbl, partitions=4)
+        plan = ctx.sql(sql).physical_plan()
+        got = ctx.execute(plan)
+        gangs = _find(plan, MeshGangExec)
+        assert gangs
+        m = gangs[0].metrics.to_dict()
+        assert "mesh_fallback" not in m, m
+    finally:
+        K.set_agg_algorithm(None)
+
+    a, b = want, got.sort_by([("g", "ascending")])
+    assert a.num_rows == b.num_rows
+    for name in a.column_names:
+        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-6 * max(abs(x), abs(y), 1.0), name
+            else:
+                assert x == y, name

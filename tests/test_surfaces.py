@@ -30,10 +30,17 @@ def test_rest_api_state(cluster):
 
     api = ApiServerHandle(cluster._standalone_handles[0].server, "127.0.0.1", 0).start()
     try:
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{api.port}/api/state", timeout=10
-        ) as resp:
-            state = json.load(resp)
+        import time
+
+        state = None
+        for _ in range(100):  # executor registration is async: poll
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/api/state", timeout=10
+            ) as resp:
+                state = json.load(resp)
+            if state["executors"]:
+                break
+            time.sleep(0.1)
         assert state["version"]
         assert isinstance(state["executors"], list) and state["executors"]
         assert state["executors"][0]["id"]
