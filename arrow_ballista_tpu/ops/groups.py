@@ -3,13 +3,16 @@
 Round-2 assigned dense group ids with a Python loop over every NEW key
 combination (``stage_compiler._encode_groups``) — ~3M loop iterations on
 q3 SF10, 6 of the stage's 7.8 seconds.  This table keeps everything in
-numpy:
+numpy/pandas hash land:
 
 * per-key dictionary codes fold into ONE int64 via per-key bit radixes
   (bits grow with the observed code range; the stored table re-combines
   vectorized when a radix grows);
-* known combinations resolve through ``np.searchsorted`` on a sorted
-  (combined → gid) index — no Python per-row/per-group work;
+* known combinations resolve through a ``pandas.Index`` HASH lookup on
+  the combined keys in gid order — ``get_indexer`` IS the gid, and at
+  q3/h2o scale the hash probe is ~13x faster than the
+  ``np.searchsorted`` binary search it replaced (1.0s vs 13.1s for 15M
+  lookups into 2M groups: binary search is cache-hostile);
 * new combinations batch-append: one hash-based ``pandas.factorize``
   over the misses only (the sort-based ``np.unique`` it replaced was
   10x slower at q3 SF10 scale: 9.6s vs 1.0s on 30M i64 keys).
@@ -36,8 +39,11 @@ class GroupTable:
         self.n_keys = n_keys
         self.key_mat = np.empty((0, n_keys), dtype=np.int64)
         self._bits = [1] * n_keys
-        self._sorted_combined = np.empty(0, dtype=np.int64)
-        self._sorted_gids = np.empty(0, dtype=np.int32)
+        # combined keys in GID ORDER (row g == combined key of gid g);
+        # the pandas hash index over it is built lazily and invalidated
+        # by appends and radix regrowth
+        self._combined = np.empty(0, dtype=np.int64)
+        self._index = None
 
     @property
     def n_groups(self) -> int:
@@ -68,37 +74,35 @@ class GroupTable:
                 f"combined group-key space needs {sum(self._bits)} bits"
             )
         if changed and self.n_groups:
-            combined = self._combine(
+            self._combined = self._combine(
                 [self.key_mat[:, k] for k in range(self.n_keys)]
             )
-            order = np.argsort(combined, kind="stable")
-            self._sorted_combined = combined[order]
-            self._sorted_gids = order.astype(np.int32)
+            self._index = None
+
+    def _lookup(self, combined: np.ndarray) -> np.ndarray:
+        """gid per combined key, -1 for unknown combinations (hash probe)."""
+        if self.n_groups == 0:
+            return np.full(len(combined), -1, dtype=np.int64)
+        if self._index is None:
+            import pandas as pd
+
+            self._index = pd.Index(self._combined)
+        return self._index.get_indexer(combined)
 
     # ------------------------------------------------------------- encode
     def encode(self, code_arrays: list[np.ndarray]) -> np.ndarray:
         """Dense stable group ids for one batch of per-key code columns."""
+        import pandas as pd
+
         self._grow_radix(code_arrays)
         combined = self._combine(code_arrays)
-        known = self._sorted_combined
-        if len(known):
-            pos = np.searchsorted(known, combined)
-            pos_c = np.minimum(pos, len(known) - 1)
-            found = known[pos_c] == combined
-            gids = np.where(found, self._sorted_gids[pos_c], -1).astype(
-                np.int32
-            )
-        else:
-            found = np.zeros(len(combined), dtype=bool)
-            gids = np.full(len(combined), -1, dtype=np.int32)
+        gids = self._lookup(combined).astype(np.int32)
 
-        if not found.all():
-            import pandas as pd
-
-            miss_rows = np.nonzero(~found)[0]
+        miss_rows = np.nonzero(gids < 0)[0]
+        if len(miss_rows):
             miss = combined[miss_rows]
-            # hash-based dedup: codes are first-appearance ordinals, uniq is
-            # in first-appearance order — new gids therefore keep the
+            # hash-based dedup: codes are first-appearance ordinals, uniq
+            # is in first-appearance order — new gids therefore keep the
             # assignment-order contract (gid = key_mat row index)
             codes, uniq = pd.factorize(miss, sort=False)
             codes = codes.astype(np.int32, copy=False)
@@ -106,20 +110,15 @@ class GroupTable:
             # first reaches k (codes are assigned sequentially)
             cummax = np.maximum.accumulate(codes)
             first = np.empty(len(codes), dtype=bool)
-            if len(codes):
-                first[0] = True
-                first[1:] = cummax[1:] > cummax[:-1]
+            first[0] = True
+            first[1:] = cummax[1:] > cummax[:-1]
             rep = miss_rows[first]
             base = self.n_groups
-            new_gids = base + np.arange(len(uniq), dtype=np.int32)
             new_mat = np.stack(
                 [c[rep].astype(np.int64) for c in code_arrays], axis=1
             )
             self.key_mat = np.concatenate([self.key_mat, new_mat])
-            all_combined = np.concatenate([self._sorted_combined, uniq])
-            all_gids = np.concatenate([self._sorted_gids, new_gids])
-            order = np.argsort(all_combined, kind="stable")
-            self._sorted_combined = all_combined[order]
-            self._sorted_gids = all_gids[order]
+            self._combined = np.concatenate([self._combined, uniq])
+            self._index = None
             gids[miss_rows] = base + codes
         return gids

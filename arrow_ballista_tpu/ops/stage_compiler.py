@@ -73,6 +73,18 @@ _HIGHCARD_MIN_GROUPS = 1 << 16
 _HIGHCARD_RATIO = 0.05
 
 
+def should_highcard_fallback(config, n_groups: int, n_rows: int) -> bool:
+    """One predicate for BOTH the sequential stage and the mesh gang:
+    hand a groups~rows aggregate to the C++ hash aggregate unless
+    ``ballista.tpu.highcard_mode=device`` pins the sort-based device
+    path."""
+    return (
+        config.tpu_highcard_mode != "device"
+        and n_groups > _HIGHCARD_MIN_GROUPS
+        and n_groups > _HIGHCARD_RATIO * n_rows
+    )
+
+
 class _ReadAhead:
     """Bounded background prefetch of source batches.
 
@@ -898,17 +910,12 @@ class TpuStageExec(ExecutionPlan):
                             batch, key_encoders, group_table
                         )
                     if acc is None and not entries:
-                        if (
-                            fused.join is None
-                            and self.config.tpu_highcard_mode != "device"
-                            and group_table.n_groups > _HIGHCARD_MIN_GROUPS
-                            and group_table.n_groups > _HIGHCARD_RATIO * n
+                        if fused.join is None and should_highcard_fallback(
+                            self.config, group_table.n_groups, n
                         ):
                             # with a device join fused, the CPU
                             # alternative pays the join too — stay on
-                            # device even at high cardinality;
-                            # highcard_mode=device forces the sort-based
-                            # device path regardless (A/B knob)
+                            # device even at high cardinality
                             raise _HighCardinality([batch], src)
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path
@@ -1090,10 +1097,10 @@ class TpuStageExec(ExecutionPlan):
         """Vectorized multi-key → dense group id encoding, any key count.
 
         Per-key global dictionary codes fold into one int64 via growing
-        per-key radix bits; known combinations resolve with searchsorted
-        and only MISSES pay one np.unique (ops/groups.py — the round-2
-        design looped Python over every new combination: 6 of q3 SF10's
-        7.8 stage-seconds).
+        per-key radix bits; known combinations resolve through a pandas
+        hash-index probe and only MISSES pay one pandas.factorize
+        (ops/groups.py — the round-2 design looped Python over every new
+        combination: 6 of q3 SF10's 7.8 stage-seconds).
         """
         from .groups import RadixOverflow
 

@@ -171,20 +171,16 @@ class MeshGangExec(ExecutionPlan):
                             )
                         if n_rows == 0:
                             from ..ops.stage_compiler import (
-                                _HIGHCARD_MIN_GROUPS,
-                                _HIGHCARD_RATIO,
+                                should_highcard_fallback,
                             )
 
-                            if (
-                                tpu.config.tpu_highcard_mode != "device"
-                                and group_table.n_groups > _HIGHCARD_MIN_GROUPS
-                                and group_table.n_groups > _HIGHCARD_RATIO * n
+                            if should_highcard_fallback(
+                                tpu.config, group_table.n_groups, n
                             ):
                                 # groups ~ rows: the sequential fallback
                                 # will route each partition to the C++
                                 # hash aggregate; highcard_mode=device
                                 # keeps the gang on the sort-based path
-                                # (same knob TpuStageExec honors)
                                 from ..errors import ExecutionError
 
                                 raise ExecutionError(

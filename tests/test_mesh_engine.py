@@ -65,11 +65,18 @@ def test_local_q1_mesh_uses_collectives_and_matches():
     assert m.get("mesh_rows_in", 0) > 0, m
     assert "mesh_fallback" not in m, m
 
+    _assert_tables_close(got, want)
+
+
+def _assert_tables_close(got, want, rel=1e-9):
+    """One tolerance-compare for every mesh test (tables pre-aligned)."""
     assert got.num_rows == want.num_rows
     for name in want.schema.names:
-        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
-            if isinstance(x, float):
-                assert y == pytest.approx(x, rel=1e-9), name
+        for x, y in zip(
+            got.column(name).to_pylist(), want.column(name).to_pylist()
+        ):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=rel), name
             else:
                 assert x == y, name
 
@@ -177,19 +184,13 @@ def test_distributed_q1_zero_shuffle_files_matches_flight_path(tmp_path):
     assert mem_jobs
     assert not memory_store.job_ids()
 
-    assert got.num_rows == want.num_rows
     got = got.sort_by(
         [(got.column_names[0], "ascending"), (got.column_names[1], "ascending")]
     )
     want = want.sort_by(
         [(want.column_names[0], "ascending"), (want.column_names[1], "ascending")]
     )
-    for name in want.column_names:
-        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
-            if isinstance(x, float):
-                assert y == pytest.approx(x, rel=1e-9), name
-            else:
-                assert x == y, name
+    _assert_tables_close(got, want)
 
 
 def test_gang_streaming_shards_unequal_partitions():
@@ -225,13 +226,7 @@ def test_gang_streaming_shards_unequal_partitions():
 
     gangs = _find(plan, MeshGangExec)
     assert gangs and "mesh_fallback" not in gangs[0].metrics.to_dict()
-    assert got.num_rows == want.num_rows
-    for name in want.schema.names:
-        for x, y in zip(got.column(name).to_pylist(), want.column(name).to_pylist()):
-            if isinstance(x, float):
-                assert y == pytest.approx(x, rel=1e-9), name
-            else:
-                assert x == y, name
+    _assert_tables_close(got, want)
 
 
 def test_memory_partitions_served_over_flight(tmp_path):
@@ -280,14 +275,7 @@ def test_mesh_gang_with_sort_algorithm():
     _register(ctx_off)
     want = ctx_off.sql(QUERIES[1]).collect()
     key = [("l_returnflag", "ascending"), ("l_linestatus", "ascending")]
-    a, b = want.sort_by(key), got.sort_by(key)
-    assert a.num_rows == b.num_rows
-    for name in a.column_names:
-        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
-            if isinstance(x, float):
-                assert abs(x - y) <= 1e-6 * max(abs(x), abs(y), 1.0), name
-            else:
-                assert x == y, name
+    _assert_tables_close(got.sort_by(key), want.sort_by(key), rel=1e-6)
 
 
 def test_mesh_gang_highcard_device_mode():
@@ -333,11 +321,4 @@ def test_mesh_gang_highcard_device_mode():
     finally:
         K.set_agg_algorithm(None)
 
-    a, b = want, got.sort_by([("g", "ascending")])
-    assert a.num_rows == b.num_rows
-    for name in a.column_names:
-        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
-            if isinstance(x, float):
-                assert abs(x - y) <= 1e-6 * max(abs(x), abs(y), 1.0), name
-            else:
-                assert x == y, name
+    _assert_tables_close(got.sort_by([("g", "ascending")]), want, rel=1e-6)
