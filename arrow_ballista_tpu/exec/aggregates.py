@@ -27,10 +27,12 @@ SINGLE = "single"
 
 @dataclass(frozen=True)
 class AggSpec:
-    func: str  # sum | avg | min | max | count | count_distinct
+    func: str  # sum | avg | min | max | count | count_distinct | median
+    #            | stddev | stddev_pop | var | var_pop | corr | udaf:<name>
     arg: Optional[PhysicalExpr]  # None for count(*)
     name: str  # output column name
     out_type: pa.DataType
+    arg2: Optional[PhysicalExpr] = None  # corr's second argument
 
     def state_fields(self) -> list[pa.Field]:
         """Partial-state columns this aggregate contributes."""
@@ -106,7 +108,8 @@ class HashAggregateExec(ExecutionPlan):
             yield b
 
     def _prepared_table(self, batches: list[pa.RecordBatch]) -> Optional[pa.Table]:
-        """Evaluate group + arg exprs into a flat table g0..gk, a0..am."""
+        """Evaluate group + arg exprs into a flat table g0..gk, a0..am
+        (plus b{j} second-argument columns for corr)."""
         if not batches:
             return None
         cols: dict[str, pa.ChunkedArray] = {}
@@ -118,6 +121,10 @@ class HashAggregateExec(ExecutionPlan):
             if a.arg is not None:
                 cols[f"__a{j}"] = pa.chunked_array(
                     [_as_array_len(a.arg.evaluate(b), b.num_rows) for b in batches]
+                )
+            if a.arg2 is not None:
+                cols[f"__b{j}"] = pa.chunked_array(
+                    [_as_array_len(a.arg2.evaluate(b), b.num_rows) for b in batches]
                 )
         if not cols:  # count(*) with no groups
             return pa.table({"__dummy": pa.array([0] * sum(b.num_rows for b in batches))})
@@ -137,75 +144,163 @@ class HashAggregateExec(ExecutionPlan):
             return self._global_agg(tbl, partial)
 
         gkeys = [f"__g{i}" for i in range(n_groups)]
-        agg_requests: list[tuple[str, str]] = []
-        out_names: list[str] = []
+        requests: list[tuple] = []
+        # per OUTPUT field (schema order, after the keys): how to build it
+        #   ("col", result_name)           — direct group_by result column
+        #   ("udaf", result_name, spec)    — fold collected lists
+        #   ("median", src)                — pandas groupby merge pass
+        #   ("corr", j)                    — finalize the six sum requests
+        emit: list[tuple] = []
+        derived: dict[str, object] = {}  # extra columns for corr sums
+
+        def _single_only(what: str) -> None:
+            if partial:
+                raise ExecutionError(
+                    f"{what} must run single-stage after key repartition"
+                )
+
         for j, a in enumerate(self.aggs):
             src = f"__a{j}"
             if a.func == "sum":
-                agg_requests.append((src, "sum"))
-                out_names.append(a.name)
+                requests.append((src, "sum"))
+                emit.append(("col", f"{src}_sum"))
             elif a.func == "avg":
                 if partial:
-                    agg_requests.append((src, "sum"))
-                    out_names.append(f"{a.name}#sum")
-                    agg_requests.append((src, "count"))
-                    out_names.append(f"{a.name}#count")
+                    requests.append((src, "sum"))
+                    emit.append(("col", f"{src}_sum"))
+                    requests.append((src, "count"))
+                    emit.append(("col", f"{src}_count"))
                 else:
-                    agg_requests.append((src, "mean"))
-                    out_names.append(a.name)
+                    requests.append((src, "mean"))
+                    emit.append(("col", f"{src}_mean"))
             elif a.func == "min":
-                agg_requests.append((src, "min"))
-                out_names.append(a.name)
+                requests.append((src, "min"))
+                emit.append(("col", f"{src}_min"))
             elif a.func == "max":
-                agg_requests.append((src, "max"))
-                out_names.append(a.name)
+                requests.append((src, "max"))
+                emit.append(("col", f"{src}_max"))
             elif a.func == "count":
                 if a.arg is None:
                     # count(*) counts rows including nulls in the key column
-                    agg_requests.append(
+                    requests.append(
                         (gkeys[0], "count", pc.CountOptions(mode="all"))
                     )
+                    emit.append(("col", f"{gkeys[0]}_count"))
                 else:
-                    agg_requests.append((src, "count"))
-                out_names.append(a.name)
+                    requests.append((src, "count"))
+                    emit.append(("col", f"{src}_count"))
             elif a.func == "count_distinct":
-                if partial:
-                    raise ExecutionError(
-                        "count_distinct must run single-stage after key repartition"
-                    )
-                agg_requests.append((src, "count_distinct"))
-                out_names.append(a.name)
+                _single_only("count_distinct")
+                requests.append((src, "count_distinct"))
+                emit.append(("col", f"{src}_count_distinct"))
+            elif a.func in ("stddev", "stddev_pop", "var", "var_pop"):
+                _single_only(a.func)
+                fn = "stddev" if a.func.startswith("stddev") else "variance"
+                ddof = 0 if a.func.endswith("_pop") else 1
+                requests.append((src, fn, pc.VarianceOptions(ddof=ddof)))
+                emit.append(("col", f"{src}_{fn}"))
+            elif a.func == "median":
+                _single_only("median")
+                emit.append(("median", src))
+            elif a.func == "corr":
+                _single_only("corr")
+                # pairwise-valid sums: rows where either argument is null
+                # OR NaN drop out of every sum (pandas treats NaN values
+                # as missing in corr; the global path does the same)
+                x = pc.cast(tbl.column(src), pa.float64(), safe=False)
+                y = pc.cast(tbl.column(f"__b{j}"), pa.float64(), safe=False)
+                both = pc.and_(
+                    pc.and_(pc.is_valid(x), pc.is_valid(y)),
+                    pc.and_(
+                        pc.invert(pc.is_nan(x)), pc.invert(pc.is_nan(y))
+                    ),
+                )
+                null = pa.scalar(None, pa.float64())
+                xv = pc.if_else(both, x, null)
+                yv = pc.if_else(both, y, null)
+                # center by the GLOBAL mean (corr-invariant): the n·Σxy −
+                # Σx·Σy form cancels catastrophically on raw magnitudes
+                xm, ym = pc.mean(xv), pc.mean(yv)
+                if xm.is_valid:
+                    xv = pc.subtract(xv, xm)
+                if ym.is_valid:
+                    yv = pc.subtract(yv, ym)
+                derived[f"__c{j}x"] = xv
+                derived[f"__c{j}y"] = yv
+                derived[f"__c{j}xy"] = pc.multiply(xv, yv)
+                derived[f"__c{j}xx"] = pc.multiply(xv, xv)
+                derived[f"__c{j}yy"] = pc.multiply(yv, yv)
+                for nm in (f"__c{j}x", f"__c{j}y", f"__c{j}xy",
+                           f"__c{j}xx", f"__c{j}yy"):
+                    requests.append((nm, "sum"))
+                requests.append((f"__c{j}x", "count"))
+                emit.append(("corr", j))
             elif a.func.startswith("udaf:"):
-                if partial:
-                    raise ExecutionError(
-                        "UDAFs must run single-stage after key repartition"
-                    )
+                _single_only("UDAFs")
                 # collect each group's values; the UDF folds them below
-                agg_requests.append((src, "list"))
-                out_names.append(a.name)
+                requests.append((src, "list"))
+                emit.append(("udaf", f"{src}_list", a))
             else:
                 raise ExecutionError(f"unsupported aggregate {a.func}")
 
-        result = pa.TableGroupBy(tbl, gkeys).aggregate(agg_requests)
+        grouped_tbl = tbl
+        for nm, col in derived.items():
+            grouped_tbl = grouped_tbl.append_column(nm, col)
+        result = pa.TableGroupBy(grouped_tbl, gkeys).aggregate(requests)
+
+        medians = self._group_medians(
+            tbl, result, gkeys, sorted({e[1] for e in emit if e[0] == "median"})
+        )
+
         # group_by output columns are named "<src>_<func>", keys keep names
-        out_cols: list[pa.ChunkedArray] = []
+        out_cols: list = []
         fields = list(self._schema)
         for i in range(len(self.group_exprs)):
             out_cols.append(result.column(f"__g{i}"))
-        udaf_iter = iter(
-            [a for a in self.aggs if a.func.startswith("udaf:")]
-        )
-        for req, f in zip(agg_requests, fields[len(self.group_exprs):]):
-            src, func = req[0], req[1]
-            col = result.column(f"{src}_{func}")
-            if func == "list":
-                col = _apply_udaf(next(udaf_iter), col, f.type)
+        for entry, f in zip(emit, fields[len(self.group_exprs):]):
+            if entry[0] == "col":
+                col = result.column(entry[1])
+            elif entry[0] == "udaf":
+                col = _apply_udaf(entry[2], result.column(entry[1]), f.type)
+            elif entry[0] == "median":
+                col = medians[entry[1]]
+            else:  # corr
+                col = _finalize_corr(result, entry[1])
             if not col.type.equals(f.type):
                 col = pc.cast(col, f.type, safe=False)
             out_cols.append(col)
         return pa.Table.from_arrays(out_cols, schema=self._schema)
 
+    @staticmethod
+    def _group_medians(
+        tbl: pa.Table, result: pa.Table, gkeys: list[str], srcs: list[str]
+    ) -> dict:
+        """EXACT per-group medians (pyarrow only has approximate_median):
+        one vectorized pandas groupby, merged back onto the group_by
+        result's key rows (pandas merge matches null keys to null keys,
+        and how='left' preserves the result row order)."""
+        if not srcs:
+            return {}
+        import pandas as pd  # noqa: F401
+
+        pdf = tbl.select(gkeys + srcs).to_pandas()
+        med = (
+            # observed=True: dictionary keys become pandas Categoricals,
+            # and the default would materialize every UNOBSERVED category
+            # combination (cartesian in the key cardinalities)
+            pdf.groupby(gkeys, dropna=False, sort=False, observed=True)[srcs]
+            .median()
+            .reset_index()
+        )
+        keys_pdf = result.select(gkeys).to_pandas()
+        merged = keys_pdf.merge(med, on=gkeys, how="left")
+        return {
+            src: pa.array(merged[src].to_numpy(), pa.float64(), from_pandas=True)
+            for src in srcs
+        }
+
     def _global_agg(self, tbl: pa.Table, partial: bool) -> pa.Table:
+        import numpy as np
         cols: list[pa.Array] = []
         for j, a in enumerate(self.aggs):
             src = tbl.column(f"__a{j}") if a.arg is not None else None
@@ -229,6 +324,27 @@ class HashAggregateExec(ExecutionPlan):
                 cols.append(
                     pa.array([pc.count_distinct(src).as_py()], pa.int64())
                 )
+            elif a.func in ("stddev", "stddev_pop", "var", "var_pop"):
+                ddof = 0 if a.func.endswith("_pop") else 1
+                fn = pc.stddev if a.func.startswith("stddev") else pc.variance
+                cols.append(_scalar_col(fn(src, ddof=ddof), pa.float64()))
+            elif a.func == "median":
+                v = src.drop_null().to_numpy(zero_copy_only=False)
+                out = float(np.median(v)) if len(v) else None
+                cols.append(pa.array([out], pa.float64()))
+            elif a.func == "corr":
+                x = pc.cast(src, pa.float64(), safe=False).to_numpy(
+                    zero_copy_only=False
+                )
+                y = pc.cast(
+                    tbl.column(f"__b{j}"), pa.float64(), safe=False
+                ).to_numpy(zero_copy_only=False)
+                both = ~(np.isnan(x) | np.isnan(y))
+                xv, yv = x[both], y[both]
+                out = None
+                if len(xv) >= 2 and xv.std() > 0 and yv.std() > 0:
+                    out = float(np.corrcoef(xv, yv)[0, 1])
+                cols.append(pa.array([out], pa.float64()))
             elif a.func.startswith("udaf:"):
                 t = self._field_for(a.name).type
                 v = _resolve_udaf(a.func).fn(src.combine_chunks())
@@ -345,6 +461,32 @@ def _resolve_udaf(func: str):
             f"load it via ballista.plugin_dir"
         )
     return u
+
+
+def _finalize_corr(result: pa.Table, j: int) -> pa.Array:
+    """Pearson r from the six per-group sums (pairwise-valid rows):
+    r = (n·Σxy − Σx·Σy) / sqrt((n·Σxx − Σx²)(n·Σyy − Σy²));
+    groups with n < 2 or zero variance yield null (pandas semantics)."""
+    import numpy as np
+
+    def col(name):
+        return result.column(name).to_numpy(zero_copy_only=False).astype(
+            np.float64
+        )
+
+    sx = col(f"__c{j}x_sum")
+    sy = col(f"__c{j}y_sum")
+    sxy = col(f"__c{j}xy_sum")
+    sxx = col(f"__c{j}xx_sum")
+    syy = col(f"__c{j}yy_sum")
+    n = col(f"__c{j}x_count")
+    with np.errstate(invalid="ignore", divide="ignore"):
+        cov = n * sxy - sx * sy
+        varx = n * sxx - sx * sx
+        vary = n * syy - sy * sy
+        r = cov / np.sqrt(varx * vary)
+    bad = (n < 2) | ~np.isfinite(r)
+    return pa.array(np.where(bad, np.nan, r), pa.float64(), from_pandas=True)
 
 
 def _apply_udaf(spec: AggSpec, lists_col, out_type: pa.DataType) -> pa.ChunkedArray:

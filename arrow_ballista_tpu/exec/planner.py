@@ -215,19 +215,29 @@ class PhysicalPlanner:
                 inner.func == "count_distinct"
                 or inner.distinct
                 or inner.func.startswith("udaf:")
+                or inner.func in lex.STAT_AGGREGATES
             ):
-                # UDAFs have no partial/merge decomposition — run single
-                # stage with each group wholly in one partition, the same
-                # strategy as distinct aggregates
+                # UDAFs and the statistical aggregates (median/stddev/
+                # var/corr) have no partial/merge decomposition — run
+                # single stage with each group wholly in one partition,
+                # the same strategy as distinct aggregates
                 has_distinct = True
             arg = (
                 create_physical_expr(inner.arg, in_schema)
                 if inner.arg is not None
                 else None
             )
+            arg2 = (
+                create_physical_expr(inner.arg2, in_schema)
+                if inner.arg2 is not None
+                else None
+            )
             name = agg_schema.field(len(plan.group_exprs) + j).name
             specs.append(
-                agg.AggSpec(inner.func, arg, name, agg_schema.field(name).type)
+                agg.AggSpec(
+                    inner.func, arg, name, agg_schema.field(name).type,
+                    arg2=arg2,
+                )
             )
 
         n_part = self.config.shuffle_partitions

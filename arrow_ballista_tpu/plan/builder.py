@@ -638,7 +638,33 @@ class PlanBuilder:
             fname = e.name
             if fname == "count" and e.distinct:
                 fname = "count_distinct"
+            # synonyms → canonical names; a user-registered UDF/UDAF with
+            # the synonym's name keeps precedence (it resolved before the
+            # synonyms existed, and hijacking it silently would change
+            # that query's answer)
+            if self.udfs is None or (
+                self.udfs.scalar(fname) is None
+                and self.udfs.aggregate(fname) is None
+            ):
+                fname = {
+                    "stddev_samp": "stddev", "std": "stddev",
+                    "var_samp": "var", "variance": "var",
+                    "pow": "power",
+                }.get(fname, fname)
             if fname in ex.AGGREGATE_FUNCTIONS:
+                if e.distinct and fname not in ("count_distinct", "min", "max"):
+                    # DISTINCT would silently be ignored: refuse instead
+                    # (min/max are distinct-invariant and pass through)
+                    raise SqlError(f"DISTINCT is not supported for {fname}")
+                if fname == "corr":
+                    if len(e.args) != 2:
+                        raise SqlError("corr takes two arguments")
+                    return ex.AggregateExpr(
+                        fname,
+                        self._expr(e.args[0], schema, alias_map),
+                        False,
+                        arg2=self._expr(e.args[1], schema, alias_map),
+                    )
                 if len(e.args) == 1 and isinstance(e.args[0], ast.Star):
                     return ex.AggregateExpr(fname, None, e.distinct)
                 if len(e.args) != 1:

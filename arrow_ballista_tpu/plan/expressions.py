@@ -20,7 +20,13 @@ COMPARISON_OPS = {"=", "<>", "<", "<=", ">", ">="}
 ARITHMETIC_OPS = {"+", "-", "*", "/", "%"}
 BOOLEAN_OPS = {"AND", "OR"}
 
-AGGREGATE_FUNCTIONS = {"sum", "avg", "min", "max", "count", "count_distinct"}
+AGGREGATE_FUNCTIONS = {
+    "sum", "avg", "min", "max", "count", "count_distinct",
+    # single-stage statistical aggregates (each group wholly in one
+    # partition, like count_distinct): exact median, sample/population
+    # stddev + variance, Pearson correlation (two arguments)
+    "median", "stddev", "stddev_pop", "var", "var_pop", "corr",
+}
 
 SCALAR_FUNCTIONS = {
     # math
@@ -510,14 +516,19 @@ class ScalarUDFExpr(Expr):
         return f"{self.fname}({', '.join(str(a) for a in self.args)})"
 
 
+STAT_AGGREGATES = {"median", "stddev", "stddev_pop", "var", "var_pop", "corr"}
+
+
 @dataclass(frozen=True, eq=False)
 class AggregateExpr(Expr):
-    func: str  # sum | avg | min | max | count | count_distinct | udaf:<name>
+    func: str  # sum | avg | min | max | count | count_distinct | median
+    #            | stddev | stddev_pop | var | var_pop | corr | udaf:<name>
     arg: Optional[Expr]  # None for COUNT(*)
     distinct: bool = False
     # UDAF return type, captured at build time and shipped over the wire so
     # a scheduler that has not registered the UDAF can still plan the job
     udaf_type: Optional[pa.DataType] = None
+    arg2: Optional[Expr] = None  # corr's second argument
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         if self.func.startswith("udaf:"):
@@ -531,7 +542,7 @@ class AggregateExpr(Expr):
             return u.return_type
         if self.func.startswith("count"):
             return pa.int64()
-        if self.func == "avg":
+        if self.func == "avg" or self.func in STAT_AGGREGATES:
             return pa.float64()
         assert self.arg is not None
         t = self.arg.data_type(schema)
@@ -542,12 +553,17 @@ class AggregateExpr(Expr):
         return t  # min/max keep input type
 
     def children(self) -> list[Expr]:
-        return [self.arg] if self.arg is not None else []
+        out = [self.arg] if self.arg is not None else []
+        if self.arg2 is not None:
+            out.append(self.arg2)
+        return out
 
     def __str__(self) -> str:
         inner = "*" if self.arg is None else str(self.arg)
         if self.distinct:
             inner = f"DISTINCT {inner}"
+        if self.arg2 is not None:
+            inner = f"{inner}, {self.arg2}"
         fname = "count" if self.func == "count_distinct" else self.func
         return f"{fname}({inner})"
 
@@ -648,6 +664,7 @@ def transform(e: Expr, fn) -> Expr:
             transform(e.arg, fn) if e.arg is not None else None,
             e.distinct,
             udaf_type=e.udaf_type,
+            arg2=transform(e.arg2, fn) if e.arg2 is not None else None,
         )
     elif isinstance(e, SortExpr):
         e2 = SortExpr(transform(e.expr, fn), e.asc, e.nulls_first)

@@ -252,6 +252,9 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
         if e.arg is not None:
             n.aggregate.arg.CopyFrom(logical_expr_to_proto(e.arg))
             n.aggregate.has_arg = True
+        if e.arg2 is not None:
+            n.aggregate.arg2.CopyFrom(logical_expr_to_proto(e.arg2))
+            n.aggregate.has_arg2 = True
         n.aggregate.distinct = e.distinct
         if e.func.startswith("udaf:"):
             # ship the return type: the scheduler may not have the UDAF
@@ -359,8 +362,14 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
             if n.aggregate.udaf_out_type
             else None
         )
+        arg2 = (
+            logical_expr_from_proto(n.aggregate.arg2)
+            if n.aggregate.has_arg2
+            else None
+        )
         return lex.AggregateExpr(
-            n.aggregate.func, arg, n.aggregate.distinct, udaf_type=udaf_type
+            n.aggregate.func, arg, n.aggregate.distinct,
+            udaf_type=udaf_type, arg2=arg2,
         )
     if kind == "sort":
         nf: Optional[bool] = (

@@ -97,6 +97,9 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             if spec.arg is not None:
                 sp.arg.CopyFrom(physical_expr_to_proto(spec.arg))
                 sp.has_arg = True
+            if spec.arg2 is not None:
+                sp.arg2.CopyFrom(physical_expr_to_proto(spec.arg2))
+                sp.has_arg2 = True
             sp.name = spec.name
             sp.out_type = dtype_to_bytes(spec.out_type)
         n.aggregate.input.CopyFrom(physical_plan_to_proto(plan.input))
@@ -223,6 +226,9 @@ def physical_plan_from_proto(
                 physical_expr_from_proto(sp.arg) if sp.has_arg else None,
                 sp.name,
                 dtype_from_bytes(sp.out_type),
+                arg2=(
+                    physical_expr_from_proto(sp.arg2) if sp.has_arg2 else None
+                ),
             )
             for sp in n.aggregate.aggs
         ]
