@@ -143,6 +143,9 @@ class PhysicalPlanner:
         if isinstance(plan, lp.Aggregate):
             return self._plan_aggregate(plan)
 
+        if isinstance(plan, lp.Window):
+            return self._plan_window(plan)
+
         if isinstance(plan, lp.Sort):
             child = self._plan(plan.input)
             if child.output_partitioning().n != 1:
@@ -193,6 +196,57 @@ class PhysicalPlanner:
             return ScanExec("values", MemoryTable.from_table(tbl), None)
 
         raise NotImplementedYet(f"physical planning for {type(plan).__name__}")
+
+    # ------------------------------------------------------------- window
+    def _plan_window(self, plan: lp.Window) -> ExecutionPlan:
+        """Distribute windows with data parallelism: when every window
+        shares one non-empty PARTITION BY set, hash-repartition the input
+        on it (each hash partition holds whole window partitions); any
+        other shape coalesces to a single partition.  The reference's
+        planner raises NotImplemented here (planner.rs WindowAggExec) —
+        this surpasses it."""
+        from .window import WindowExec, WindowSpec
+
+        child = self._plan(plan.input)
+        in_schema = child.schema
+        out_schema = plan.schema
+        base = len(in_schema)
+
+        specs: list[WindowSpec] = []
+        part_sets = set()
+        for i, w in enumerate(plan.window_exprs):
+            part_phys = tuple(
+                create_physical_expr(p, in_schema) for p in w.partition_by
+            )
+            order_phys = tuple(
+                (create_physical_expr(s.expr, in_schema), s.asc, s.nulls_first)
+                for s in w.order_by
+            )
+            arg_phys = (
+                create_physical_expr(w.arg, in_schema)
+                if w.arg is not None
+                else None
+            )
+            f = out_schema.field(base + i)
+            specs.append(
+                WindowSpec(w.func, arg_phys, part_phys, order_phys, f.name, f.type)
+            )
+            part_sets.add(tuple(str(p) for p in w.partition_by))
+
+        n_part = self.config.shuffle_partitions
+        if (
+            len(part_sets) == 1
+            and next(iter(part_sets))
+            and child.output_partitioning().n > 1
+        ):
+            child = RepartitionExec(
+                child,
+                Partitioning.hash(specs[0].partition_by, n_part),
+            )
+        elif not (len(part_sets) == 1 and next(iter(part_sets))):
+            if child.output_partitioning().n != 1:
+                child = CoalescePartitionsExec(child)
+        return WindowExec(child, specs)
 
     # ----------------------------------------------------------- aggregate
     def _plan_aggregate(self, plan: lp.Aggregate) -> ExecutionPlan:

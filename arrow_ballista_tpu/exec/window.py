@@ -1,0 +1,319 @@
+"""Window evaluation operator.
+
+Reference parity note: DataFusion's single-node engine evaluates window
+functions while the reference's distributed planner raises NotImplemented
+for WindowAggExec (``scheduler/src/planner.rs`` WindowAggExec arm).  This
+engine goes further: the physical planner hash-repartitions the input on
+the PARTITION BY keys (each hash partition then holds whole window
+partitions), so windows run distributed with ordinary data parallelism.
+
+Evaluation is fully vectorized: one ``pc.sort_indices`` permutation per
+operator (all specs share the planner-enforced common partition keys),
+numpy segment boundaries, and pandas groupby ``transform`` for the
+aggregate frames — no per-row or per-group Python.
+
+Semantics (SQL defaults):
+* ranking functions need ORDER BY (row_number / rank / dense_rank);
+* aggregate functions without ORDER BY cover the whole partition;
+* with ORDER BY they run over the default frame RANGE BETWEEN UNBOUNDED
+  PRECEDING AND CURRENT ROW — peer rows (ties in the order keys) share
+  the frame, so each row sees the running value through its LAST peer;
+* output rows keep the INPUT order (windows never reorder).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..errors import ExecutionError
+from .expressions import PhysicalExpr
+from .operators import ExecutionPlan, Partitioning, TaskContext
+
+RANKING = {"row_number", "rank", "dense_rank"}
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    func: str  # row_number | rank | dense_rank | sum | avg | min | max | count
+    arg: Optional[PhysicalExpr]  # None for ranking and count(*)
+    partition_by: tuple  # of PhysicalExpr
+    order_by: tuple  # of (PhysicalExpr, asc: bool, nulls_first: Optional[bool])
+    name: str
+    out_type: pa.DataType
+
+
+class WindowExec(ExecutionPlan):
+    """Appends one column per window spec to its input."""
+
+    def __init__(self, input: ExecutionPlan, specs: list[WindowSpec]):
+        super().__init__()
+        self.input = input
+        self.specs = specs
+
+    @property
+    def schema(self) -> pa.Schema:
+        fields = list(self.input.schema)
+        fields += [pa.field(s.name, s.out_type, True) for s in self.specs]
+        return pa.schema(fields)
+
+    def output_partitioning(self) -> Partitioning:
+        return self.input.output_partitioning()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return WindowExec(children[0], self.specs)
+
+    def __str__(self) -> str:
+        return "WindowExec: " + ", ".join(
+            f"{s.func}->{s.name}" for s in self.specs
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        batches = list(self.input.execute(partition, ctx))
+        if not batches:
+            return
+        with self.metrics.timer("window_time_ns"):
+            table = pa.Table.from_batches(batches, schema=self.input.schema)
+            win_cols = [
+                self._evaluate_spec(spec, table, batches)
+                for spec in self.specs
+            ]
+            out = table
+            for spec, col in zip(self.specs, win_cols):
+                out = out.append_column(pa.field(spec.name, spec.out_type), col)
+        self.metrics.add("output_rows", out.num_rows)
+        for b in out.to_batches(max_chunksize=ctx.batch_size):
+            yield b
+
+    # ------------------------------------------------------------ evaluate
+    def _evaluate_spec(
+        self, spec: WindowSpec, table: pa.Table, batches: list[pa.RecordBatch]
+    ) -> pa.Array:
+        n = table.num_rows
+
+        def eval_col(e: PhysicalExpr):
+            parts = []
+            for b in batches:
+                v = e.evaluate(b)
+                if isinstance(v, pa.Scalar):  # literal argument
+                    v = pa.array([v.as_py()] * b.num_rows, type=v.type)
+                parts.append(v)
+            return pa.chunked_array(parts) if len(parts) > 1 else parts[0]
+
+        # ---- one permutation: partition keys, then order keys
+        key_arrays: list = []
+        keys: list[tuple] = []
+        for i, p in enumerate(spec.partition_by):
+            key_arrays.append(eval_col(p))
+            keys.append((f"__p{i}", "ascending", "at_start"))
+        for i, (e, asc, nf) in enumerate(spec.order_by):
+            if nf is None:
+                nf = not asc  # SQL default: NULLS LAST for ASC, FIRST for DESC
+            key_arrays.append(eval_col(e))
+            keys.append(
+                (
+                    f"__o{i}",
+                    "ascending" if asc else "descending",
+                    "at_start" if nf else "at_end",
+                )
+            )
+        if keys:
+            sort_tbl = pa.table(
+                {k[0]: a for k, a in zip(keys, key_arrays)}
+            )
+            perm = pc.sort_indices(sort_tbl, sort_keys=keys).to_numpy()
+        else:
+            perm = np.arange(n, dtype=np.int64)
+
+        n_part = len(spec.partition_by)
+
+        def change_flags(arrays: list) -> np.ndarray:
+            """flag[i] = row i starts a new group in SORTED order (row 0
+            always does); null == null counts as the same group."""
+            flag = np.zeros(n, dtype=bool)
+            if n:
+                flag[0] = True
+            for a in arrays:
+                s = a.take(pa.array(perm)) if n else a
+                cur, prev = s.slice(1), s.slice(0, max(n - 1, 0))
+                neq = pc.fill_null(pc.not_equal(cur, prev), False)
+                null_diff = pc.xor(pc.is_null(cur), pc.is_null(prev))
+                diff = pc.or_(neq, null_diff)
+                flag[1:] |= np.asarray(diff, dtype=bool)
+            return flag
+
+        seg_flag = change_flags(key_arrays[:n_part])
+        seg_starts = np.flatnonzero(seg_flag)
+        # per sorted row: index of its segment's first row
+        seg_first = np.zeros(n, dtype=np.int64)
+        seg_first[seg_starts] = seg_starts
+        seg_first = np.maximum.accumulate(seg_first)
+        seg_id = np.cumsum(seg_flag) - 1 if n else np.empty(0, np.int64)
+
+        if spec.func in RANKING:
+            peer_flag = change_flags(key_arrays)  # partition OR order change
+            sorted_out = self._ranking(
+                spec.func, n, seg_flag, seg_first, peer_flag
+            )
+        else:
+            sorted_out = self._aggregate(
+                spec, n, batches, eval_col, perm, seg_id, seg_first,
+                key_arrays,
+                change_flags,
+            )
+
+        # scatter back to input row order
+        inv = np.empty(n, dtype=np.int64)
+        inv[perm] = np.arange(n, dtype=np.int64)
+        if isinstance(sorted_out, (pa.Array, pa.ChunkedArray)):
+            arr = sorted_out.take(pa.array(inv)) if n else sorted_out
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+        else:
+            out = sorted_out[inv] if n else sorted_out
+            arr = pa.array(out, from_pandas=True)
+        if not arr.type.equals(spec.out_type):
+            arr = pc.cast(arr, spec.out_type, safe=False)
+        return arr
+
+    @staticmethod
+    def _ranking(func, n, seg_flag, seg_first, peer_flag) -> np.ndarray:
+        idx = np.arange(n, dtype=np.int64)
+        if func == "row_number":
+            return idx - seg_first + 1
+        # first row of each peer group
+        peer_first = np.zeros(n, dtype=np.int64)
+        starts = np.flatnonzero(peer_flag)
+        peer_first[starts] = starts
+        peer_first = np.maximum.accumulate(peer_first)
+        if func == "rank":
+            return peer_first - seg_first + 1
+        # dense_rank: count of peer-group starts within the segment
+        peers_cum = np.cumsum(peer_flag)
+        return peers_cum - peers_cum[seg_first] + 1
+
+    @staticmethod
+    def _aggregate(
+        spec, n, batches, eval_col, perm, seg_id, seg_first, key_arrays,
+        change_flags,
+    ):
+        running = bool(spec.order_by)
+        if spec.arg is None:  # count(*)
+            if not running:
+                sizes = np.bincount(seg_id, minlength=seg_id[-1] + 1 if n else 0)
+                return sizes[seg_id].astype(np.int64)
+            idx = np.arange(n, dtype=np.int64)
+            # rows count through the LAST peer (RANGE frame)
+            peer_flag = change_flags(key_arrays)
+            peer_last = _last_of_group(peer_flag, n)
+            return idx[peer_last] - seg_first + 1
+
+        v = eval_col(spec.arg)
+        vs = v.take(pa.array(perm)) if n else v
+        if isinstance(vs, pa.ChunkedArray):
+            vs = vs.combine_chunks()
+
+        if not running:
+            # whole-partition frame: one TYPE-GENERIC pyarrow hash
+            # aggregation over the dense segment ids — min/max keep the
+            # input type (strings, dates, wide ints stay exact) and an
+            # all-null group's sum is null as SQL requires
+            fn = {
+                "sum": "sum", "avg": "mean", "min": "min", "max": "max",
+                "count": "count",
+            }[spec.func]
+            seg_tbl = pa.table({"s": pa.array(seg_id), "v": vs})
+            res = pa.TableGroupBy(seg_tbl, "s").aggregate([("v", fn)])
+            res = res.sort_by([("s", "ascending")])
+            return res.column(f"v_{fn}").take(pa.array(seg_id))
+
+        # running frame: cumulative within segment, then peers share the
+        # value through their last row
+        valid = ~np.asarray(pc.is_null(vs), dtype=bool)
+        cnt = _segmented_cumsum(valid.astype(np.int64), seg_first)
+        if spec.func == "count":
+            cum = cnt
+        elif spec.func in ("sum", "avg"):
+            if pa.types.is_integer(vs.type) and vs.null_count == 0 and (
+                spec.func == "sum"
+            ):
+                # exact integer running sum (float64 loses ULPs past 2^53)
+                vals = vs.to_numpy(zero_copy_only=False).astype(np.int64)
+                cum = _segmented_cumsum(vals, seg_first)
+            else:
+                if not (
+                    pa.types.is_integer(vs.type)
+                    or pa.types.is_floating(vs.type)
+                    or pa.types.is_decimal(vs.type)
+                ):
+                    raise ExecutionError(
+                        f"running window {spec.func} needs a numeric "
+                        f"argument, got {vs.type}"
+                    )
+                vals = np.nan_to_num(
+                    pc.cast(vs, pa.float64(), safe=False).to_numpy(
+                        zero_copy_only=False
+                    ),
+                    nan=0.0,
+                )
+                total = _segmented_cumsum(vals, seg_first)
+                if spec.func == "sum":
+                    cum = np.where(cnt > 0, total, np.nan)
+                else:
+                    with np.errstate(invalid="ignore", divide="ignore"):
+                        cum = np.where(cnt > 0, total / cnt, np.nan)
+        elif spec.func in ("min", "max"):
+            if not (
+                pa.types.is_integer(vs.type)
+                or pa.types.is_floating(vs.type)
+                or pa.types.is_decimal(vs.type)
+            ):
+                raise ExecutionError(
+                    f"running window {spec.func} needs a numeric argument, "
+                    f"got {vs.type} (whole-partition {spec.func} — no ORDER "
+                    "BY in the window — supports any type)"
+                )
+            import pandas as pd
+
+            fvals = pc.cast(vs, pa.float64(), safe=False).to_numpy(
+                zero_copy_only=False
+            )
+            g = pd.Series(fvals).groupby(seg_id)
+            cum = (
+                g.cummin() if spec.func == "min" else g.cummax()
+            ).to_numpy()
+        else:
+            raise ExecutionError(f"window aggregate {spec.func}")
+        peer_flag = change_flags(key_arrays)
+        peer_last = _last_of_group(peer_flag, n)
+        return np.asarray(cum)[peer_last]
+
+
+def _segmented_cumsum(vals: np.ndarray, seg_first: np.ndarray) -> np.ndarray:
+    """Within-segment inclusive cumsum over sorted rows: the global cumsum
+    minus the global cumsum just BEFORE each row's segment start (exact
+    for int64 inputs)."""
+    if not len(vals):
+        return vals
+    cs = np.cumsum(vals)
+    before_seg = cs[seg_first] - vals[seg_first]
+    return cs - before_seg
+
+
+def _last_of_group(start_flag: np.ndarray, n: int) -> np.ndarray:
+    """Per row: index of the LAST row of its group, given group-start
+    flags over sorted rows (vectorized reverse cummax trick)."""
+    if not n:
+        return np.empty(0, np.int64)
+    # last row of group g = (next group's start) - 1; final group ends at n-1
+    starts = np.flatnonzero(start_flag)
+    nexts = np.append(starts[1:], n)
+    group_of_row = np.cumsum(start_flag) - 1
+    return nexts[group_of_row] - 1

@@ -259,6 +259,48 @@ class PlanBuilder:
                 ex.SortExpr(_rw(s.expr), s.asc, s.nulls_first) for s in order_exprs
             ]
 
+        # WINDOW functions evaluate between aggregation and projection:
+        # collect distinct window exprs from select/order, plant a Window
+        # node, then rewrite occurrences into column refs on its output
+        win_exprs: list[ex.WindowExpr] = []
+
+        def _collect_wins(e: ex.Expr) -> None:
+            for w in ex.find_windows(e):
+                if not any(str(w) == str(x) for x in win_exprs):
+                    win_exprs.append(w)
+
+        for e in select_exprs:
+            _collect_wins(e)
+        for s in order_exprs:
+            _collect_wins(s.expr)
+        if win_exprs:
+            plan = lp.Window(win_exprs, plan)
+            wschema = plan.schema
+            base = len(wschema) - len(win_exprs)
+            wmap = {
+                str(w): wschema.field(base + i).name
+                for i, w in enumerate(win_exprs)
+            }
+
+            def _rww(e: ex.Expr) -> ex.Expr:
+                def fn(node: ex.Expr) -> ex.Expr:
+                    if isinstance(node, ex.WindowExpr):
+                        return ex.col(wmap[str(node)])
+                    return node
+
+                return ex.transform(e, fn)
+
+            select_exprs = [
+                ex.Alias(_rww(e.expr), e.alias_name)
+                if isinstance(e, ex.Alias)
+                else _rww(e)
+                for e in select_exprs
+            ]
+            order_exprs = [
+                ex.SortExpr(_rww(s.expr), s.asc, s.nulls_first)
+                for s in order_exprs
+            ]
+
         plan = lp.Projection(select_exprs, plan)
 
         if q.distinct:
@@ -530,6 +572,43 @@ class PlanBuilder:
         return joined, cmp_expr
 
     # ---------------------------------------------------------- expressions
+    def _window_expr(
+        self,
+        e: ast.FunctionCall,
+        schema: pa.Schema,
+        alias_map: Optional[dict[str, ex.Expr]] = None,
+    ) -> ex.WindowExpr:
+        fname = e.name
+        if e.distinct:
+            raise SqlError(f"DISTINCT is not supported in window {fname}")
+        if fname in ex.WINDOW_RANKING_FUNCTIONS:
+            if e.args:
+                raise SqlError(f"{fname}() takes no arguments")
+            if not e.over.order_by:
+                raise SqlError(f"{fname}() requires ORDER BY in its window")
+            arg = None
+        elif fname in ("sum", "avg", "min", "max", "count"):
+            if fname == "count" and len(e.args) == 1 and isinstance(
+                e.args[0], ast.Star
+            ):
+                arg = None
+            elif len(e.args) == 1:
+                arg = self._expr(e.args[0], schema, alias_map)
+            else:
+                raise SqlError(f"window {fname} takes one argument")
+        else:
+            raise SqlError(f"unsupported window function {fname}")
+        partition_by = tuple(
+            self._expr(p, schema, alias_map) for p in e.over.partition_by
+        )
+        order_by = tuple(
+            ex.SortExpr(
+                self._expr(oi.expr, schema, alias_map), oi.asc, oi.nulls_first
+            )
+            for oi in e.over.order_by
+        )
+        return ex.WindowExpr(fname, arg, partition_by, order_by)
+
     def _expr(
         self,
         e: ast.SqlExpr,
@@ -636,6 +715,8 @@ class PlanBuilder:
             return ex.ScalarFunction("substr", tuple(args))
         if isinstance(e, ast.FunctionCall):
             fname = e.name
+            if e.over is not None:
+                return self._window_expr(e, schema, alias_map)
             if fname == "count" and e.distinct:
                 fname = "count_distinct"
             # synonyms → canonical names; a user-registered UDF/UDAF with

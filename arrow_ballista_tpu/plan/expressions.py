@@ -568,6 +568,67 @@ class AggregateExpr(Expr):
         return f"{fname}({inner})"
 
 
+# ranking window functions (the aggregate set also works over windows)
+WINDOW_RANKING_FUNCTIONS = {"row_number", "rank", "dense_rank"}
+
+
+@dataclass(frozen=True, eq=False)
+class WindowExpr(Expr):
+    """``func(...) OVER (PARTITION BY ... ORDER BY ...)``.
+
+    Reference parity note: DataFusion's single-node engine evaluates
+    window functions; Ballista's distributed planner raises
+    NotImplemented for WindowAggExec (``planner.rs`` WindowAggExec arm).
+    Here the physical planner repartitions on the PARTITION BY keys so
+    windows also run distributed — each hash partition holds whole
+    window partitions.
+
+    Semantics: ranking functions need ORDER BY; aggregate functions
+    without ORDER BY cover the whole partition, with ORDER BY they are
+    running aggregates over the default frame (RANGE UNBOUNDED PRECEDING
+    — peer rows share the value).
+    """
+
+    func: str  # row_number | rank | dense_rank | sum | avg | min | max | count
+    arg: Optional["Expr"]  # None for ranking functions and count(*)
+    partition_by: tuple = ()
+    order_by: tuple = ()  # of SortExpr
+
+    def data_type(self, schema: pa.Schema) -> pa.DataType:
+        if self.func in WINDOW_RANKING_FUNCTIONS or self.func.startswith(
+            "count"
+        ):
+            return pa.int64()
+        if self.func == "avg":
+            return pa.float64()
+        assert self.arg is not None
+        t = self.arg.data_type(schema)
+        if self.func == "sum":
+            return pa.int64() if pa.types.is_integer(t) else pa.float64()
+        return t  # min/max keep input type
+
+    def children(self) -> list["Expr"]:
+        out = [self.arg] if self.arg is not None else []
+        out.extend(self.partition_by)
+        out.extend(s.expr for s in self.order_by)
+        return out
+
+    def __str__(self) -> str:
+        inner = "*" if self.arg is None else str(self.arg)
+        if self.func in WINDOW_RANKING_FUNCTIONS:
+            inner = ""
+        parts = []
+        if self.partition_by:
+            parts.append(
+                "PARTITION BY " + ", ".join(str(p) for p in self.partition_by)
+            )
+        if self.order_by:
+            parts.append(
+                "ORDER BY " + ", ".join(str(s) for s in self.order_by)
+            )
+        return f"{self.func}({inner}) OVER ({' '.join(parts)})"
+
+
 @dataclass(frozen=True, eq=False)
 class SortExpr(Expr):
     expr: Expr
@@ -619,7 +680,15 @@ def find_columns(e: Expr) -> list[Column]:
 
 
 def find_aggregates(e: Expr) -> list[AggregateExpr]:
+    # note: a windowed aggregate (sum(x) OVER (...)) is a WindowExpr with
+    # func="sum", never a wrapped AggregateExpr — so any AggregateExpr
+    # found inside a window's arg/partition/order refers to the enclosing
+    # GROUP BY level and is correctly collected here
     return [x for x in walk(e) if isinstance(x, AggregateExpr)]
+
+
+def find_windows(e: Expr) -> list[WindowExpr]:
+    return [x for x in walk(e) if isinstance(x, WindowExpr)]
 
 
 def transform(e: Expr, fn) -> Expr:
@@ -665,6 +734,16 @@ def transform(e: Expr, fn) -> Expr:
             e.distinct,
             udaf_type=e.udaf_type,
             arg2=transform(e.arg2, fn) if e.arg2 is not None else None,
+        )
+    elif isinstance(e, WindowExpr):
+        e2 = WindowExpr(
+            e.func,
+            transform(e.arg, fn) if e.arg is not None else None,
+            tuple(transform(p, fn) for p in e.partition_by),
+            tuple(
+                SortExpr(transform(s.expr, fn), s.asc, s.nulls_first)
+                for s in e.order_by
+            ),
         )
     elif isinstance(e, SortExpr):
         e2 = SortExpr(transform(e.expr, fn), e.asc, e.nulls_first)

@@ -145,6 +145,34 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass
+class Window(LogicalPlan):
+    """Window evaluation: output = input columns + one column per
+    window expression (in original row order — windows do not reorder)."""
+
+    window_exprs: list[ex.WindowExpr]
+    input: LogicalPlan
+
+    @property
+    def schema(self) -> pa.Schema:
+        in_schema = self.input.schema
+        fields = list(in_schema)
+        fields += [
+            pa.field(str(w), w.data_type(in_schema), True)
+            for w in self.window_exprs
+        ]
+        return pa.schema(fields)
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.input]
+
+    def __str__(self) -> str:
+        return (
+            "Window: "
+            + ", ".join(str(w) for w in self.window_exprs)
+        )
+
+
+@dataclass
 class Sort(LogicalPlan):
     sort_exprs: list[ex.SortExpr]
     input: LogicalPlan
@@ -315,7 +343,10 @@ def with_new_children(plan: LogicalPlan, kids: list[LogicalPlan]) -> LogicalPlan
     import copy
 
     p = copy.copy(plan)
-    if isinstance(p, (Projection, Filter, Aggregate, Sort, Limit, Distinct, SubqueryAlias)):
+    if isinstance(
+        p,
+        (Projection, Filter, Aggregate, Window, Sort, Limit, Distinct, SubqueryAlias),
+    ):
         p.input = kids[0]
     elif isinstance(p, (Join, CrossJoin)):
         p.left, p.right = kids

@@ -365,6 +365,19 @@ def _push_proj(plan: lp.LogicalPlan, required: Optional[set[str]]) -> lp.Logical
             req = set(required) | _required_from_exprs(list(p.sort_exprs), in_schema)
         p.input = _push_proj(p.input, req)
         return p
+    if isinstance(plan, lp.Window):
+        # Window passes every input column through and appends its own
+        # outputs: keep the upstream requirement minus the window output
+        # names, plus whatever the window exprs reference
+        p = copy.copy(plan)
+        in_schema = p.input.schema
+        win_refs = _required_from_exprs(list(p.window_exprs), in_schema)
+        req = None
+        if required is not None:
+            in_names = {f.name for f in in_schema}
+            req = {r for r in required if r in in_names} | win_refs
+        p.input = _push_proj(p.input, req)
+        return p
     if isinstance(plan, (lp.Limit, lp.Distinct)):
         p = copy.copy(plan)
         p.input = _push_proj(p.input, required)

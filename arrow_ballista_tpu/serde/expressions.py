@@ -263,6 +263,21 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
                 t = e.data_type(pa.schema([]))
             n.aggregate.udaf_out_type = dtype_to_bytes(t)
         return n
+    if isinstance(e, lex.WindowExpr):
+        n.window.func = e.func
+        if e.arg is not None:
+            n.window.arg.CopyFrom(logical_expr_to_proto(e.arg))
+            n.window.has_arg = True
+        for p in e.partition_by:
+            n.window.partition_by.add().CopyFrom(logical_expr_to_proto(p))
+        for s in e.order_by:
+            so = n.window.order_by.add()
+            so.expr.CopyFrom(logical_expr_to_proto(s.expr))
+            so.asc = s.asc
+            so.nulls_first = (
+                0 if s.nulls_first is None else (1 if s.nulls_first else 2)
+            )
+        return n
     if isinstance(e, lex.SortExpr):
         n.sort.expr.CopyFrom(logical_expr_to_proto(e.expr))
         n.sort.asc = e.asc
@@ -371,6 +386,22 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
             n.aggregate.func, arg, n.aggregate.distinct,
             udaf_type=udaf_type, arg2=arg2,
         )
+    if kind == "window":
+        warg = (
+            logical_expr_from_proto(n.window.arg) if n.window.has_arg else None
+        )
+        parts = tuple(
+            logical_expr_from_proto(p) for p in n.window.partition_by
+        )
+        orders = tuple(
+            lex.SortExpr(
+                logical_expr_from_proto(s.expr),
+                s.asc,
+                None if s.nulls_first == 0 else s.nulls_first == 1,
+            )
+            for s in n.window.order_by
+        )
+        return lex.WindowExpr(n.window.func, warg, parts, orders)
     if kind == "sort":
         nf: Optional[bool] = (
             None if n.sort.nulls_first == 0 else n.sort.nulls_first == 1

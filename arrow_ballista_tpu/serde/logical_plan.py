@@ -89,6 +89,11 @@ def logical_plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
     if isinstance(plan, lp.Distinct):
         n.distinct.input.CopyFrom(logical_plan_to_proto(plan.input))
         return n
+    if isinstance(plan, lp.Window):
+        for w in plan.window_exprs:
+            n.window.window_exprs.add().CopyFrom(logical_expr_to_proto(w))
+        n.window.input.CopyFrom(logical_plan_to_proto(plan.input))
+        return n
     if isinstance(plan, lp.EmptyRelation):
         n.empty.produce_one_row = plan.produce_one_row
         n.empty.schema = schema_to_bytes(plan.schema_)
@@ -166,6 +171,11 @@ def logical_plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
         return lp.Union([logical_plan_from_proto(i) for i in n.union_all.inputs])
     if kind == "distinct":
         return lp.Distinct(logical_plan_from_proto(n.distinct.input))
+    if kind == "window":
+        return lp.Window(
+            [logical_expr_from_proto(w) for w in n.window.window_exprs],
+            logical_plan_from_proto(n.window.input),
+        )
     if kind == "empty":
         return lp.EmptyRelation(
             n.empty.produce_one_row, schema_from_bytes(n.empty.schema)

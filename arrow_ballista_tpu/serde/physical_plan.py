@@ -32,6 +32,7 @@ from ..exec.operators import (
     UnionExec,
 )
 from ..exec.planner import RenameSchemaExec
+from ..exec.window import WindowExec, WindowSpec
 from ..proto import pb
 from ..shuffle import ShuffleReaderExec, ShuffleWriterExec, UnresolvedShuffleExec
 from .arrow_utils import (
@@ -129,6 +130,24 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             k.nulls_first = 0 if nf is None else (1 if nf else 2)
         n.sort.input.CopyFrom(physical_plan_to_proto(plan.input))
         n.sort.fetch = -1 if plan.fetch is None else plan.fetch
+        return n
+    if isinstance(plan, WindowExec):
+        for s in plan.specs:
+            sp = n.window.specs.add()
+            sp.func = s.func
+            if s.arg is not None:
+                sp.arg.CopyFrom(physical_expr_to_proto(s.arg))
+                sp.has_arg = True
+            for p in s.partition_by:
+                sp.partition_by.add().CopyFrom(physical_expr_to_proto(p))
+            for e, asc, nf in s.order_by:
+                k = sp.order_by.add()
+                k.expr.CopyFrom(physical_expr_to_proto(e))
+                k.asc = asc
+                k.nulls_first = 0 if nf is None else (1 if nf else 2)
+            sp.name = s.name
+            sp.out_type = dtype_to_bytes(s.out_type)
+        n.window.input.CopyFrom(physical_plan_to_proto(plan.input))
         return n
     if isinstance(plan, LimitExec):
         n.limit.input.CopyFrom(physical_plan_to_proto(plan.input))
@@ -265,6 +284,28 @@ def physical_plan_from_proto(
         return SortExec(
             keys, rec(n.sort.input), None if n.sort.fetch < 0 else n.sort.fetch
         )
+    if kind == "window":
+        specs = [
+            WindowSpec(
+                sp.func,
+                physical_expr_from_proto(sp.arg) if sp.has_arg else None,
+                tuple(
+                    physical_expr_from_proto(p) for p in sp.partition_by
+                ),
+                tuple(
+                    (
+                        physical_expr_from_proto(k.expr),
+                        k.asc,
+                        None if k.nulls_first == 0 else k.nulls_first == 1,
+                    )
+                    for k in sp.order_by
+                ),
+                sp.name,
+                dtype_from_bytes(sp.out_type),
+            )
+            for sp in n.window.specs
+        ]
+        return WindowExec(rec(n.window.input), specs)
     if kind == "limit":
         return LimitExec(
             rec(n.limit.input),

@@ -123,10 +123,17 @@ class Like(SqlExpr):
 
 
 @dataclass
+class WindowSpec:
+    partition_by: list[SqlExpr] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+
+
+@dataclass
 class FunctionCall(SqlExpr):
     name: str
     args: list[SqlExpr]
     distinct: bool = False
+    over: Optional[WindowSpec] = None  # OVER (...) makes it a window fn
 
 
 @dataclass

@@ -523,7 +523,19 @@ class Parser:
             else:
                 args = self._expr_list()
             self.expect(TokType.RPAREN)
-            return ast.FunctionCall(name.lower(), args, distinct)
+            call = ast.FunctionCall(name.lower(), args, distinct)
+            if self.eat_kw("OVER"):
+                self.expect(TokType.LPAREN)
+                spec = ast.WindowSpec()
+                if self.eat_kw("PARTITION"):
+                    self.expect_kw("BY")
+                    spec.partition_by = self._expr_list()
+                if self.eat_kw("ORDER"):
+                    self.expect_kw("BY")
+                    spec.order_by = self._order_items()
+                self.expect(TokType.RPAREN)
+                call.over = spec
+            return call
         self.next()
         return self._maybe_compound(ast.ColumnRef(t.value))
 
