@@ -229,23 +229,25 @@ class PhysicalPlanner:
             )
             f = out_schema.field(base + i)
             specs.append(
-                WindowSpec(w.func, arg_phys, part_phys, order_phys, f.name, f.type)
+                WindowSpec(
+                    w.func, arg_phys, part_phys, order_phys, f.name, f.type,
+                    w.offset,
+                )
             )
             part_sets.add(tuple(str(p) for p in w.partition_by))
 
         n_part = self.config.shuffle_partitions
-        if (
-            len(part_sets) == 1
-            and next(iter(part_sets))
-            and child.output_partitioning().n > 1
-        ):
-            child = RepartitionExec(
-                child,
-                Partitioning.hash(specs[0].partition_by, n_part),
-            )
-        elif not (len(part_sets) == 1 and next(iter(part_sets))):
-            if child.output_partitioning().n != 1:
-                child = CoalescePartitionsExec(child)
+        # one shared NON-EMPTY partition-by set → hash repartition keeps
+        # whole window partitions together; anything else must coalesce
+        common_keys = len(part_sets) == 1 and bool(next(iter(part_sets)))
+        if common_keys:
+            if child.output_partitioning().n > 1:
+                child = RepartitionExec(
+                    child,
+                    Partitioning.hash(specs[0].partition_by, n_part),
+                )
+        elif child.output_partitioning().n != 1:
+            child = CoalescePartitionsExec(child)
         return WindowExec(child, specs)
 
     # ----------------------------------------------------------- aggregate

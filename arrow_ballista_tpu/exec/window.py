@@ -8,9 +8,10 @@ the PARTITION BY keys (each hash partition then holds whole window
 partitions), so windows run distributed with ordinary data parallelism.
 
 Evaluation is fully vectorized: one ``pc.sort_indices`` permutation per
-operator (all specs share the planner-enforced common partition keys),
-numpy segment boundaries, and pandas groupby ``transform`` for the
-aggregate frames — no per-row or per-group Python.
+DISTINCT window-key signature (specs sharing PARTITION/ORDER BY — the
+common shape — reuse one ``_SortState``), numpy segment boundaries and
+segmented cumsums, one type-generic pyarrow hash aggregation for
+whole-partition frames — no per-row or per-group Python.
 
 Semantics (SQL defaults):
 * ranking functions need ORDER BY (row_number / rank / dense_rank);
@@ -35,16 +36,19 @@ from .expressions import PhysicalExpr
 from .operators import ExecutionPlan, Partitioning, TaskContext
 
 RANKING = {"row_number", "rank", "dense_rank"}
+VALUE_FNS = {"lag", "lead", "first_value", "last_value"}
 
 
 @dataclass(frozen=True)
 class WindowSpec:
-    func: str  # row_number | rank | dense_rank | sum | avg | min | max | count
+    func: str  # row_number | rank | dense_rank | lag | lead | first_value
+    #            | last_value | sum | avg | min | max | count
     arg: Optional[PhysicalExpr]  # None for ranking and count(*)
     partition_by: tuple  # of PhysicalExpr
     order_by: tuple  # of (PhysicalExpr, asc: bool, nulls_first: Optional[bool])
     name: str
     out_type: pa.DataType
+    offset: int = 1  # lag/lead distance
 
 
 class WindowExec(ExecutionPlan):
@@ -81,10 +85,33 @@ class WindowExec(ExecutionPlan):
             return
         with self.metrics.timer("window_time_ns"):
             table = pa.Table.from_batches(batches, schema=self.input.schema)
-            win_cols = [
-                self._evaluate_spec(spec, table, batches)
-                for spec in self.specs
-            ]
+
+            def eval_col(e: PhysicalExpr):
+                parts = []
+                for b in batches:
+                    v = e.evaluate(b)
+                    if isinstance(v, pa.Scalar):  # literal argument
+                        v = pa.array([v.as_py()] * b.num_rows, type=v.type)
+                    parts.append(v)
+                return pa.chunked_array(parts) if len(parts) > 1 else parts[0]
+
+            # one _SortState (permutation + segment flags) per distinct
+            # window-key signature: specs sharing PARTITION/ORDER BY —
+            # the common many-functions-one-window shape — sort once
+            states: dict = {}
+            win_cols = []
+            for spec in self.specs:
+                sig = (
+                    tuple(str(p) for p in spec.partition_by),
+                    tuple(
+                        (str(e), asc, nf) for e, asc, nf in spec.order_by
+                    ),
+                )
+                st = states.get(sig)
+                if st is None:
+                    st = _SortState(table.num_rows, eval_col, spec)
+                    states[sig] = st
+                win_cols.append(self._evaluate_spec(spec, st, eval_col))
             out = table
             for spec, col in zip(self.specs, win_cols):
                 out = out.append_column(pa.field(spec.name, spec.out_type), col)
@@ -94,20 +121,42 @@ class WindowExec(ExecutionPlan):
 
     # ------------------------------------------------------------ evaluate
     def _evaluate_spec(
-        self, spec: WindowSpec, table: pa.Table, batches: list[pa.RecordBatch]
+        self, spec: WindowSpec, st: "_SortState", eval_col
     ) -> pa.Array:
-        n = table.num_rows
+        n = st.n
+        if spec.func in RANKING:
+            sorted_out = self._ranking(
+                spec.func, n, st.seg_flag, st.seg_first, st.peer_flag
+            )
+        elif spec.func in VALUE_FNS:
+            sorted_out = _value_fn(spec, st, eval_col)
+        else:
+            sorted_out = _aggregate(spec, st, eval_col)
 
-        def eval_col(e: PhysicalExpr):
-            parts = []
-            for b in batches:
-                v = e.evaluate(b)
-                if isinstance(v, pa.Scalar):  # literal argument
-                    v = pa.array([v.as_py()] * b.num_rows, type=v.type)
-                parts.append(v)
-            return pa.chunked_array(parts) if len(parts) > 1 else parts[0]
+        # scatter back to input row order
+        if isinstance(sorted_out, (pa.Array, pa.ChunkedArray)):
+            arr = sorted_out.take(pa.array(st.inv)) if n else sorted_out
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+        else:
+            out = sorted_out[st.inv] if n else sorted_out
+            arr = pa.array(out, from_pandas=True)
+        if not arr.type.equals(spec.out_type):
+            arr = pc.cast(arr, spec.out_type, safe=False)
+        return arr
 
-        # ---- one permutation: partition keys, then order keys
+    @staticmethod
+    def _ranking(func, n, seg_flag, seg_first, peer_flag) -> np.ndarray:
+        return _ranking_impl(func, n, seg_flag, seg_first, peer_flag)
+
+
+class _SortState:
+    """Sort/segment state shared by every spec with the same window keys:
+    one key evaluation, one ``pc.sort_indices`` permutation, one set of
+    segment/peer flags, one inverse permutation."""
+
+    def __init__(self, n: int, eval_col, spec: WindowSpec):
+        self.n = n
         key_arrays: list = []
         keys: list[tuple] = []
         for i, p in enumerate(spec.partition_by):
@@ -125,175 +174,200 @@ class WindowExec(ExecutionPlan):
                 )
             )
         if keys:
-            sort_tbl = pa.table(
-                {k[0]: a for k, a in zip(keys, key_arrays)}
-            )
-            perm = pc.sort_indices(sort_tbl, sort_keys=keys).to_numpy()
+            sort_tbl = pa.table({k[0]: a for k, a in zip(keys, key_arrays)})
+            self.perm = pc.sort_indices(sort_tbl, sort_keys=keys).to_numpy()
         else:
-            perm = np.arange(n, dtype=np.int64)
+            self.perm = np.arange(n, dtype=np.int64)
+        # key columns in SORTED order, computed once for both flag passes
+        self._sorted_keys = [
+            a.take(pa.array(self.perm)) if n else a for a in key_arrays
+        ]
+        self._n_part = len(spec.partition_by)
+        self._peer_flag: Optional[np.ndarray] = None
+        self._inv: Optional[np.ndarray] = None
 
-        n_part = len(spec.partition_by)
-
-        def change_flags(arrays: list) -> np.ndarray:
-            """flag[i] = row i starts a new group in SORTED order (row 0
-            always does); null == null counts as the same group."""
-            flag = np.zeros(n, dtype=bool)
-            if n:
-                flag[0] = True
-            for a in arrays:
-                s = a.take(pa.array(perm)) if n else a
-                cur, prev = s.slice(1), s.slice(0, max(n - 1, 0))
-                neq = pc.fill_null(pc.not_equal(cur, prev), False)
-                null_diff = pc.xor(pc.is_null(cur), pc.is_null(prev))
-                diff = pc.or_(neq, null_diff)
-                flag[1:] |= np.asarray(diff, dtype=bool)
-            return flag
-
-        seg_flag = change_flags(key_arrays[:n_part])
-        seg_starts = np.flatnonzero(seg_flag)
+        self.seg_flag = self._change_flags(self._sorted_keys[: self._n_part])
+        seg_starts = np.flatnonzero(self.seg_flag)
         # per sorted row: index of its segment's first row
         seg_first = np.zeros(n, dtype=np.int64)
         seg_first[seg_starts] = seg_starts
-        seg_first = np.maximum.accumulate(seg_first)
-        seg_id = np.cumsum(seg_flag) - 1 if n else np.empty(0, np.int64)
+        self.seg_first = np.maximum.accumulate(seg_first)
+        self.seg_id = (
+            np.cumsum(self.seg_flag) - 1 if n else np.empty(0, np.int64)
+        )
 
-        if spec.func in RANKING:
-            peer_flag = change_flags(key_arrays)  # partition OR order change
-            sorted_out = self._ranking(
-                spec.func, n, seg_flag, seg_first, peer_flag
-            )
-        else:
-            sorted_out = self._aggregate(
-                spec, n, batches, eval_col, perm, seg_id, seg_first,
-                key_arrays,
-                change_flags,
-            )
+    def _change_flags(self, sorted_arrays: list) -> np.ndarray:
+        """flag[i] = sorted row i starts a new group (row 0 always does);
+        null == null counts as the same group."""
+        n = self.n
+        flag = np.zeros(n, dtype=bool)
+        if n:
+            flag[0] = True
+        for s in sorted_arrays:
+            cur, prev = s.slice(1), s.slice(0, max(n - 1, 0))
+            neq = pc.fill_null(pc.not_equal(cur, prev), False)
+            null_diff = pc.xor(pc.is_null(cur), pc.is_null(prev))
+            diff = pc.or_(neq, null_diff)
+            flag[1:] |= np.asarray(diff, dtype=bool)
+        return flag
 
-        # scatter back to input row order
-        inv = np.empty(n, dtype=np.int64)
-        inv[perm] = np.arange(n, dtype=np.int64)
-        if isinstance(sorted_out, (pa.Array, pa.ChunkedArray)):
-            arr = sorted_out.take(pa.array(inv)) if n else sorted_out
-            if isinstance(arr, pa.ChunkedArray):
-                arr = arr.combine_chunks()
-        else:
-            out = sorted_out[inv] if n else sorted_out
-            arr = pa.array(out, from_pandas=True)
-        if not arr.type.equals(spec.out_type):
-            arr = pc.cast(arr, spec.out_type, safe=False)
-        return arr
+    @property
+    def peer_flag(self) -> np.ndarray:
+        """Partition-OR-order-key change flags (peer-group starts)."""
+        if self._peer_flag is None:
+            self._peer_flag = self._change_flags(self._sorted_keys)
+        return self._peer_flag
 
-    @staticmethod
-    def _ranking(func, n, seg_flag, seg_first, peer_flag) -> np.ndarray:
-        idx = np.arange(n, dtype=np.int64)
-        if func == "row_number":
-            return idx - seg_first + 1
-        # first row of each peer group
-        peer_first = np.zeros(n, dtype=np.int64)
-        starts = np.flatnonzero(peer_flag)
-        peer_first[starts] = starts
-        peer_first = np.maximum.accumulate(peer_first)
-        if func == "rank":
-            return peer_first - seg_first + 1
-        # dense_rank: count of peer-group starts within the segment
-        peers_cum = np.cumsum(peer_flag)
-        return peers_cum - peers_cum[seg_first] + 1
+    @property
+    def inv(self) -> np.ndarray:
+        if self._inv is None:
+            self._inv = np.empty(self.n, dtype=np.int64)
+            self._inv[self.perm] = np.arange(self.n, dtype=np.int64)
+        return self._inv
 
-    @staticmethod
-    def _aggregate(
-        spec, n, batches, eval_col, perm, seg_id, seg_first, key_arrays,
-        change_flags,
-    ):
-        running = bool(spec.order_by)
-        if spec.arg is None:  # count(*)
-            if not running:
-                sizes = np.bincount(seg_id, minlength=seg_id[-1] + 1 if n else 0)
-                return sizes[seg_id].astype(np.int64)
-            idx = np.arange(n, dtype=np.int64)
-            # rows count through the LAST peer (RANGE frame)
-            peer_flag = change_flags(key_arrays)
-            peer_last = _last_of_group(peer_flag, n)
-            return idx[peer_last] - seg_first + 1
 
-        v = eval_col(spec.arg)
-        vs = v.take(pa.array(perm)) if n else v
-        if isinstance(vs, pa.ChunkedArray):
-            vs = vs.combine_chunks()
+def _ranking_impl(func, n, seg_flag, seg_first, peer_flag) -> np.ndarray:
+    idx = np.arange(n, dtype=np.int64)
+    if func == "row_number":
+        return idx - seg_first + 1
+    # first row of each peer group
+    peer_first = np.zeros(n, dtype=np.int64)
+    starts = np.flatnonzero(peer_flag)
+    peer_first[starts] = starts
+    peer_first = np.maximum.accumulate(peer_first)
+    if func == "rank":
+        return peer_first - seg_first + 1
+    # dense_rank: count of peer-group starts within the segment
+    peers_cum = np.cumsum(peer_flag)
+    return peers_cum - peers_cum[seg_first] + 1
 
+
+def _sorted_arg(st: "_SortState", eval_col, arg) -> pa.Array:
+    v = eval_col(arg)
+    vs = v.take(pa.array(st.perm)) if st.n else v
+    return vs.combine_chunks() if isinstance(vs, pa.ChunkedArray) else vs
+
+
+def _value_fn(spec: WindowSpec, st: "_SortState", eval_col) -> pa.Array:
+    """lag/lead/first_value/last_value: pure gathers over sorted rows,
+    type-preserving.  last_value honors the default RANGE frame (the
+    frame ends at the LAST peer — the classic SQL gotcha)."""
+    n = st.n
+    vs = _sorted_arg(st, eval_col, spec.arg)
+    idx = np.arange(n, dtype=np.int64)
+    if spec.func == "first_value":
+        src, ok = st.seg_first, np.ones(n, dtype=bool)
+    elif spec.func == "last_value":
+        src, ok = _last_of_group(st.peer_flag, n), np.ones(n, dtype=bool)
+    elif spec.func == "lag":
+        src = idx - spec.offset
+        ok = src >= st.seg_first
+    else:  # lead
+        seg_last = _last_of_group(st.seg_flag, n)
+        src = idx + spec.offset
+        ok = src <= seg_last
+    taken = vs.take(pa.array(np.clip(src, 0, max(n - 1, 0))))
+    if ok.all():
+        return taken
+    return pc.if_else(pa.array(ok), taken, pa.scalar(None, vs.type))
+
+
+_NUMERIC = (pa.types.is_integer, pa.types.is_floating, pa.types.is_decimal)
+
+
+def _require_numeric(spec: WindowSpec, t: pa.DataType) -> None:
+    if not any(check(t) for check in _NUMERIC):
+        raise ExecutionError(
+            f"running window {spec.func} needs a numeric argument, got {t} "
+            f"(whole-partition {spec.func} — no ORDER BY in the window — "
+            "supports any type)"
+        )
+
+
+def _aggregate(spec: WindowSpec, st: "_SortState", eval_col):
+    n = st.n
+    seg_id, seg_first = st.seg_id, st.seg_first
+    running = bool(spec.order_by)
+    if spec.arg is None:  # count(*)
         if not running:
-            # whole-partition frame: one TYPE-GENERIC pyarrow hash
-            # aggregation over the dense segment ids — min/max keep the
-            # input type (strings, dates, wide ints stay exact) and an
-            # all-null group's sum is null as SQL requires
-            fn = {
-                "sum": "sum", "avg": "mean", "min": "min", "max": "max",
-                "count": "count",
-            }[spec.func]
-            seg_tbl = pa.table({"s": pa.array(seg_id), "v": vs})
-            res = pa.TableGroupBy(seg_tbl, "s").aggregate([("v", fn)])
-            res = res.sort_by([("s", "ascending")])
-            return res.column(f"v_{fn}").take(pa.array(seg_id))
+            sizes = np.bincount(seg_id, minlength=seg_id[-1] + 1 if n else 0)
+            return sizes[seg_id].astype(np.int64)
+        idx = np.arange(n, dtype=np.int64)
+        # rows count through the LAST peer (RANGE frame)
+        peer_last = _last_of_group(st.peer_flag, n)
+        return idx[peer_last] - seg_first + 1
 
-        # running frame: cumulative within segment, then peers share the
-        # value through their last row
-        valid = ~np.asarray(pc.is_null(vs), dtype=bool)
-        cnt = _segmented_cumsum(valid.astype(np.int64), seg_first)
-        if spec.func == "count":
-            cum = cnt
-        elif spec.func in ("sum", "avg"):
-            if pa.types.is_integer(vs.type) and vs.null_count == 0 and (
-                spec.func == "sum"
-            ):
-                # exact integer running sum (float64 loses ULPs past 2^53)
-                vals = vs.to_numpy(zero_copy_only=False).astype(np.int64)
-                cum = _segmented_cumsum(vals, seg_first)
+    vs = _sorted_arg(st, eval_col, spec.arg)
+
+    if not running:
+        # whole-partition frame: one TYPE-GENERIC pyarrow hash
+        # aggregation over the dense segment ids — min/max keep the
+        # input type (strings, dates, wide ints stay exact) and an
+        # all-null group's sum is null as SQL requires
+        fn = {
+            "sum": "sum", "avg": "mean", "min": "min", "max": "max",
+            "count": "count",
+        }[spec.func]
+        seg_tbl = pa.table({"s": pa.array(seg_id), "v": vs})
+        res = pa.TableGroupBy(seg_tbl, "s").aggregate([("v", fn)])
+        res = res.sort_by([("s", "ascending")])
+        return res.column(f"v_{fn}").take(pa.array(seg_id))
+
+    # running frame: cumulative within segment, then peers share the
+    # value through their last row
+    is_exact_int = pa.types.is_integer(vs.type) and vs.null_count == 0
+    valid = ~np.asarray(pc.is_null(vs), dtype=bool)
+    cnt = _segmented_cumsum(valid.astype(np.int64), seg_first)
+    if spec.func == "count":
+        cum = cnt
+    elif spec.func in ("sum", "avg"):
+        if is_exact_int and spec.func == "sum":
+            # exact integer running sum (float64 loses ULPs past 2^53)
+            vals = vs.to_numpy(zero_copy_only=False).astype(np.int64)
+            cum = _segmented_cumsum(vals, seg_first)
+        else:
+            _require_numeric(spec, vs.type)
+            vals = np.nan_to_num(
+                pc.cast(vs, pa.float64(), safe=False).to_numpy(
+                    zero_copy_only=False
+                ),
+                nan=0.0,
+            )
+            total = _segmented_cumsum(vals, seg_first)
+            if spec.func == "sum":
+                cum = np.where(cnt > 0, total, np.nan)
             else:
-                if not (
-                    pa.types.is_integer(vs.type)
-                    or pa.types.is_floating(vs.type)
-                    or pa.types.is_decimal(vs.type)
-                ):
-                    raise ExecutionError(
-                        f"running window {spec.func} needs a numeric "
-                        f"argument, got {vs.type}"
-                    )
-                vals = np.nan_to_num(
-                    pc.cast(vs, pa.float64(), safe=False).to_numpy(
-                        zero_copy_only=False
-                    ),
-                    nan=0.0,
-                )
-                total = _segmented_cumsum(vals, seg_first)
-                if spec.func == "sum":
-                    cum = np.where(cnt > 0, total, np.nan)
-                else:
-                    with np.errstate(invalid="ignore", divide="ignore"):
-                        cum = np.where(cnt > 0, total / cnt, np.nan)
-        elif spec.func in ("min", "max"):
-            if not (
-                pa.types.is_integer(vs.type)
-                or pa.types.is_floating(vs.type)
-                or pa.types.is_decimal(vs.type)
-            ):
-                raise ExecutionError(
-                    f"running window {spec.func} needs a numeric argument, "
-                    f"got {vs.type} (whole-partition {spec.func} — no ORDER "
-                    "BY in the window — supports any type)"
-                )
-            import pandas as pd
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    cum = np.where(cnt > 0, total / cnt, np.nan)
+    elif spec.func in ("min", "max"):
+        _require_numeric(spec, vs.type)
+        import pandas as pd
 
+        if is_exact_int:
+            # int64 stays exact past 2^53 (pandas cummin/cummax keep dtype)
+            g = pd.Series(
+                vs.to_numpy(zero_copy_only=False).astype(np.int64)
+            ).groupby(seg_id)
+            cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
+        else:
             fvals = pc.cast(vs, pa.float64(), safe=False).to_numpy(
                 zero_copy_only=False
             )
-            g = pd.Series(fvals).groupby(seg_id)
-            cum = (
-                g.cummin() if spec.func == "min" else g.cummax()
-            ).to_numpy()
-        else:
-            raise ExecutionError(f"window aggregate {spec.func}")
-        peer_flag = change_flags(key_arrays)
-        peer_last = _last_of_group(peer_flag, n)
-        return np.asarray(cum)[peer_last]
+            # null/NaN rows must still see the running min/max of PRIOR
+            # valid rows (pandas cummin leaves NaN at NaN positions):
+            # substitute the identity, then null out rows before the
+            # first valid value via the running count
+            miss = np.isnan(fvals)
+            ident = np.inf if spec.func == "min" else -np.inf
+            filled = np.where(miss, ident, fvals)
+            cnt_mm = _segmented_cumsum((~miss).astype(np.int64), seg_first)
+            g = pd.Series(filled).groupby(seg_id)
+            cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
+            cum = np.where(cnt_mm > 0, cum, np.nan)
+    else:
+        raise ExecutionError(f"window aggregate {spec.func}")
+    peer_last = _last_of_group(st.peer_flag, n)
+    return np.asarray(cum)[peer_last]
 
 
 def _segmented_cumsum(vals: np.ndarray, seg_first: np.ndarray) -> np.ndarray:

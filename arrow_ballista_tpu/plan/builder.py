@@ -581,12 +581,30 @@ class PlanBuilder:
         fname = e.name
         if e.distinct:
             raise SqlError(f"DISTINCT is not supported in window {fname}")
+        offset = 1
         if fname in ex.WINDOW_RANKING_FUNCTIONS:
             if e.args:
                 raise SqlError(f"{fname}() takes no arguments")
             if not e.over.order_by:
                 raise SqlError(f"{fname}() requires ORDER BY in its window")
             arg = None
+        elif fname in ex.WINDOW_VALUE_FUNCTIONS:
+            if not e.over.order_by:
+                raise SqlError(f"{fname}() requires ORDER BY in its window")
+            max_args = 2 if fname in ("lag", "lead") else 1
+            if not 1 <= len(e.args) <= max_args:
+                raise SqlError(f"bad argument count for window {fname}")
+            arg = self._expr(e.args[0], schema, alias_map)
+            if len(e.args) == 2:
+                if not isinstance(e.args[1], ast.NumberLit):
+                    raise SqlError(f"{fname} offset must be a literal integer")
+                try:
+                    offset = int(e.args[1].value)
+                except ValueError as err:
+                    raise SqlError(
+                        f"{fname} offset must be a literal integer, "
+                        f"got {e.args[1].value!r}"
+                    ) from err
         elif fname in ("sum", "avg", "min", "max", "count"):
             if fname == "count" and len(e.args) == 1 and isinstance(
                 e.args[0], ast.Star
@@ -607,7 +625,7 @@ class PlanBuilder:
             )
             for oi in e.over.order_by
         )
-        return ex.WindowExpr(fname, arg, partition_by, order_by)
+        return ex.WindowExpr(fname, arg, partition_by, order_by, offset)
 
     def _expr(
         self,

@@ -570,6 +570,8 @@ class AggregateExpr(Expr):
 
 # ranking window functions (the aggregate set also works over windows)
 WINDOW_RANKING_FUNCTIONS = {"row_number", "rank", "dense_rank"}
+# value window functions: argument-typed, ORDER BY required
+WINDOW_VALUE_FUNCTIONS = {"lag", "lead", "first_value", "last_value"}
 
 
 @dataclass(frozen=True, eq=False)
@@ -589,10 +591,12 @@ class WindowExpr(Expr):
     — peer rows share the value).
     """
 
-    func: str  # row_number | rank | dense_rank | sum | avg | min | max | count
+    func: str  # row_number | rank | dense_rank | lag | lead | first_value
+    #            | last_value | sum | avg | min | max | count
     arg: Optional["Expr"]  # None for ranking functions and count(*)
     partition_by: tuple = ()
     order_by: tuple = ()  # of SortExpr
+    offset: int = 1  # lag/lead distance
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         if self.func in WINDOW_RANKING_FUNCTIONS or self.func.startswith(
@@ -605,7 +609,7 @@ class WindowExpr(Expr):
         t = self.arg.data_type(schema)
         if self.func == "sum":
             return pa.int64() if pa.types.is_integer(t) else pa.float64()
-        return t  # min/max keep input type
+        return t  # min/max and the value functions keep the input type
 
     def children(self) -> list["Expr"]:
         out = [self.arg] if self.arg is not None else []
@@ -617,6 +621,8 @@ class WindowExpr(Expr):
         inner = "*" if self.arg is None else str(self.arg)
         if self.func in WINDOW_RANKING_FUNCTIONS:
             inner = ""
+        if self.func in ("lag", "lead"):
+            inner = f"{inner}, {self.offset}"
         parts = []
         if self.partition_by:
             parts.append(
@@ -744,6 +750,7 @@ def transform(e: Expr, fn) -> Expr:
                 SortExpr(transform(s.expr, fn), s.asc, s.nulls_first)
                 for s in e.order_by
             ),
+            e.offset,
         )
     elif isinstance(e, SortExpr):
         e2 = SortExpr(transform(e.expr, fn), e.asc, e.nulls_first)

@@ -265,6 +265,7 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
         return n
     if isinstance(e, lex.WindowExpr):
         n.window.func = e.func
+        n.window.offset = e.offset
         if e.arg is not None:
             n.window.arg.CopyFrom(logical_expr_to_proto(e.arg))
             n.window.has_arg = True
@@ -401,7 +402,9 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
             )
             for s in n.window.order_by
         )
-        return lex.WindowExpr(n.window.func, warg, parts, orders)
+        return lex.WindowExpr(
+            n.window.func, warg, parts, orders, n.window.offset
+        )
     if kind == "sort":
         nf: Optional[bool] = (
             None if n.sort.nulls_first == 0 else n.sort.nulls_first == 1

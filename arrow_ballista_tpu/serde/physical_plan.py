@@ -147,6 +147,7 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
                 k.nulls_first = 0 if nf is None else (1 if nf else 2)
             sp.name = s.name
             sp.out_type = dtype_to_bytes(s.out_type)
+            sp.offset = s.offset
         n.window.input.CopyFrom(physical_plan_to_proto(plan.input))
         return n
     if isinstance(plan, LimitExec):
@@ -302,6 +303,7 @@ def physical_plan_from_proto(
                 ),
                 sp.name,
                 dtype_from_bytes(sp.out_type),
+                sp.offset,
             )
             for sp in n.window.specs
         ]

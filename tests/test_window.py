@@ -284,3 +284,111 @@ def test_window_projection_pushdown_prunes_scan():
         stack.extend(node.children())
     assert scans and scans[0].projection is not None
     assert set(scans[0].projection) == {"g", "v"}  # w pruned
+
+
+def test_value_window_functions_match_pandas():
+    t, df = _data(10_000)
+    ctx = _ctx(t)
+    out = (
+        ctx.sql(
+            "select g, v, w, "
+            "lag(v) over (partition by g order by v, w) l1, "
+            "lag(v, 2) over (partition by g order by v, w) l2, "
+            "lead(v) over (partition by g order by v, w) ld, "
+            "first_value(v) over (partition by g order by v, w) fv "
+            "from t"
+        )
+        .collect()
+        .to_pandas()
+        .sort_values(["g", "v", "w"])
+        .reset_index(drop=True)
+    )
+    df = df.sort_values(["g", "v", "w"]).reset_index(drop=True)
+    gb = df.groupby("g")["v"]
+    for col, want in (
+        ("l1", gb.shift(1)),
+        ("l2", gb.shift(2)),
+        ("ld", gb.shift(-1)),
+        ("fv", gb.transform("first")),
+    ):
+        a, b = out[col].to_numpy(), want.to_numpy()
+        assert ((np.isnan(a) == np.isnan(b)).all()
+                and np.allclose(a[~np.isnan(b)], b[~np.isnan(b)])), col
+
+
+def test_last_value_default_frame_ends_at_peer():
+    """The classic gotcha: last_value over the default RANGE frame is the
+    last PEER row, not the partition's last row."""
+    t = pa.table(
+        {"g": pa.array([1, 1, 1]), "v": pa.array([1.0, 2.0, 2.0])}
+    )
+    ctx = _ctx(t, partitions=1)
+    out = ctx.sql(
+        "select v, last_value(v) over (partition by g order by v) lv from t"
+    ).collect().sort_by([("v", "ascending")]).to_pydict()
+    assert out["lv"] == [1.0, 2.0, 2.0]
+
+
+def test_lag_preserves_type():
+    t = pa.table(
+        {"g": pa.array([1, 1]), "s": pa.array(["a", "b"])}
+    )
+    ctx = _ctx(t, partitions=1)
+    out = ctx.sql(
+        "select s, lag(s) over (partition by g order by s) p from t"
+    ).collect().sort_by([("s", "ascending")]).to_pydict()
+    assert out["p"] == [None, "a"]
+
+
+def test_running_minmax_skips_nulls():
+    """A NULL argument row still sees the running min/max of PRIOR valid
+    rows (SQL frame semantics), and int64 running min stays exact."""
+    t = pa.table(
+        {
+            "g": pa.array([1, 1, 1]),
+            "o": pa.array([1, 2, 3]),
+            "v": pa.array([2.0, None, 1.0]),
+        }
+    )
+    ctx = _ctx(t, partitions=1)
+    out = ctx.sql(
+        "select o, min(v) over (partition by g order by o) m from t"
+    ).collect().sort_by([("o", "ascending")]).to_pydict()
+    assert out["m"] == [2.0, 2.0, 1.0]
+
+    big = (1 << 60) + 1
+    t2 = pa.table(
+        {"g": pa.array([1, 1]), "o": pa.array([1, 2]),
+         "v": pa.array([big, big - 1])}
+    )
+    ctx2 = _ctx(t2, partitions=1)
+    out2 = ctx2.sql(
+        "select o, min(v) over (partition by g order by o) m from t2"
+        .replace("t2", "t")
+    ).collect().sort_by([("o", "ascending")]).to_pydict()
+    assert out2["m"] == [big, big - 1]  # float64 would collapse these
+
+
+def test_lag_zero_offset_roundtrips_serde():
+    """lag(v, 0) is the current row; serde must not coerce 0 -> 1."""
+    from arrow_ballista_tpu.serde import BallistaCodec
+
+    t = pa.table({"v": pa.array([1.0, 2.0])})
+    ctx = _ctx(t, partitions=1)
+    df = ctx.sql("select v, lag(v, 0) over (order by v) z from t")
+    pplan = df.physical_plan()
+    back = BallistaCodec.decode_physical(
+        BallistaCodec.encode_physical(pplan), "/tmp/unused"
+    )
+    assert "WindowExec" in back.display()
+    out = df.collect().sort_by([("v", "ascending")]).to_pydict()
+    assert out["z"] == [1.0, 2.0]
+
+
+def test_lag_bad_offset_is_sql_error():
+    from arrow_ballista_tpu.errors import BallistaError
+
+    t = pa.table({"v": pa.array([1.0])})
+    ctx = _ctx(t, partitions=1)
+    with pytest.raises(BallistaError, match="offset"):
+        ctx.sql("select lag(v, 1.5) over (order by v) from t").collect()
