@@ -35,7 +35,7 @@ from ..errors import ExecutionError
 from .expressions import PhysicalExpr
 from .operators import ExecutionPlan, Partitioning, TaskContext
 
-RANKING = {"row_number", "rank", "dense_rank"}
+RANKING = {"row_number", "rank", "dense_rank", "ntile"}
 VALUE_FNS = {"lag", "lead", "first_value", "last_value"}
 
 
@@ -124,7 +124,9 @@ class WindowExec(ExecutionPlan):
         self, spec: WindowSpec, st: "_SortState", eval_col
     ) -> pa.Array:
         n = st.n
-        if spec.func in RANKING:
+        if spec.func == "ntile":
+            sorted_out = _ntile(spec.offset, n, st.seg_id, st.seg_first)
+        elif spec.func in RANKING:
             sorted_out = self._ranking(
                 spec.func, n, st.seg_flag, st.seg_first, st.peer_flag
             )
@@ -242,6 +244,23 @@ def _ranking_impl(func, n, seg_flag, seg_first, peer_flag) -> np.ndarray:
     return peers_cum - peers_cum[seg_first] + 1
 
 
+def _ntile(k: int, n: int, seg_id: np.ndarray, seg_first: np.ndarray) -> np.ndarray:
+    """SQL ntile(k): rows split into k buckets by order; the first
+    (size % k) buckets get one extra row."""
+    if not n:
+        return np.empty(0, np.int64)
+    sizes = np.bincount(seg_id)[seg_id]  # per-row partition size
+    pos = np.arange(n, dtype=np.int64) - seg_first
+    q, r = sizes // k, sizes % k
+    big = r * (q + 1)  # rows covered by the (q+1)-sized buckets
+    # when q == 0 every row is in a "big" (1-row) bucket, so the small
+    # branch's divisor q only matters where q >= 1
+    in_big = pos < big
+    bucket_big = pos // (q + 1) + 1
+    bucket_small = r + (pos - big) // np.maximum(q, 1) + 1
+    return np.where(in_big, bucket_big, bucket_small)
+
+
 def _sorted_arg(st: "_SortState", eval_col, arg) -> pa.Array:
     v = eval_col(arg)
     vs = v.take(pa.array(st.perm)) if st.n else v
@@ -277,10 +296,14 @@ _NUMERIC = (pa.types.is_integer, pa.types.is_floating, pa.types.is_decimal)
 
 def _require_numeric(spec: WindowSpec, t: pa.DataType) -> None:
     if not any(check(t) for check in _NUMERIC):
+        extra = (
+            f" (whole-partition {spec.func} — no ORDER BY in the window — "
+            "supports any ordered type)"
+            if spec.func in ("min", "max")
+            else ""
+        )
         raise ExecutionError(
-            f"running window {spec.func} needs a numeric argument, got {t} "
-            f"(whole-partition {spec.func} — no ORDER BY in the window — "
-            "supports any type)"
+            f"window {spec.func} needs a numeric argument, got {t}{extra}"
         )
 
 
@@ -308,6 +331,8 @@ def _aggregate(spec: WindowSpec, st: "_SortState", eval_col):
             "sum": "sum", "avg": "mean", "min": "min", "max": "max",
             "count": "count",
         }[spec.func]
+        if spec.func in ("sum", "avg"):
+            _require_numeric(spec, vs.type)  # else raw pyarrow kernel error
         seg_tbl = pa.table({"s": pa.array(seg_id), "v": vs})
         res = pa.TableGroupBy(seg_tbl, "s").aggregate([("v", fn)])
         res = res.sort_by([("s", "ascending")])

@@ -583,7 +583,19 @@ class PlanBuilder:
             raise SqlError(f"DISTINCT is not supported in window {fname}")
         offset = 1
         if fname in ex.WINDOW_RANKING_FUNCTIONS:
-            if e.args:
+            if fname == "ntile":
+                if len(e.args) != 1 or not isinstance(e.args[0], ast.NumberLit):
+                    raise SqlError("ntile takes one literal integer argument")
+                try:
+                    offset = int(e.args[0].value)
+                except ValueError as err:
+                    raise SqlError(
+                        f"ntile bucket count must be an integer, "
+                        f"got {e.args[0].value!r}"
+                    ) from err
+                if offset < 1:
+                    raise SqlError("ntile bucket count must be >= 1")
+            elif e.args:
                 raise SqlError(f"{fname}() takes no arguments")
             if not e.over.order_by:
                 raise SqlError(f"{fname}() requires ORDER BY in its window")

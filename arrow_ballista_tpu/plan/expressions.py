@@ -568,8 +568,9 @@ class AggregateExpr(Expr):
         return f"{fname}({inner})"
 
 
-# ranking window functions (the aggregate set also works over windows)
-WINDOW_RANKING_FUNCTIONS = {"row_number", "rank", "dense_rank"}
+# ranking window functions (the aggregate set also works over windows);
+# ntile(k) carries its bucket count in WindowExpr.offset
+WINDOW_RANKING_FUNCTIONS = {"row_number", "rank", "dense_rank", "ntile"}
 # value window functions: argument-typed, ORDER BY required
 WINDOW_VALUE_FUNCTIONS = {"lag", "lead", "first_value", "last_value"}
 
@@ -620,7 +621,10 @@ class WindowExpr(Expr):
     def __str__(self) -> str:
         inner = "*" if self.arg is None else str(self.arg)
         if self.func in WINDOW_RANKING_FUNCTIONS:
-            inner = ""
+            # ntile's bucket count must stay visible: the builder dedups
+            # window exprs BY THIS STRING, so ntile(2) and ntile(3) over
+            # the same window must not collapse into one column
+            inner = str(self.offset) if self.func == "ntile" else ""
         if self.func in ("lag", "lead"):
             inner = f"{inner}, {self.offset}"
         parts = []

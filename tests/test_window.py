@@ -392,3 +392,48 @@ def test_lag_bad_offset_is_sql_error():
     ctx = _ctx(t, partitions=1)
     with pytest.raises(BallistaError, match="offset"):
         ctx.sql("select lag(v, 1.5) over (order by v) from t").collect()
+
+
+def test_ntile():
+    """SQL ntile: first (n % k) buckets get the extra row."""
+    t = pa.table({"g": pa.array([1] * 7 + [2] * 2), "v": pa.array(range(9))})
+    ctx = _ctx(t, partitions=1)
+    out = (
+        ctx.sql(
+            "select g, v, ntile(3) over (partition by g order by v) b from t"
+        )
+        .collect()
+        .sort_by([("g", "ascending"), ("v", "ascending")])
+        .to_pydict()
+    )
+    # g=1: 7 rows into 3 buckets -> sizes 3,2,2; g=2: 2 rows into 3 -> 1,1
+    assert out["b"] == [1, 1, 1, 2, 2, 3, 3, 1, 2]
+
+    from arrow_ballista_tpu.errors import BallistaError
+
+    with pytest.raises(BallistaError, match="ntile"):
+        ctx.sql("select ntile(0) over (order by v) from t").collect()
+    with pytest.raises(BallistaError, match="ntile"):
+        ctx.sql("select ntile(v) over (order by v) from t").collect()
+
+
+def test_distinct_ntile_buckets_not_collapsed():
+    """ntile(2) and ntile(3) over the same window are different columns
+    (the builder dedups window exprs by string — bucket count included)."""
+    t = pa.table({"v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])})
+    ctx = _ctx(t, partitions=1)
+    out = ctx.sql(
+        "select v, ntile(2) over (order by v) a, "
+        "ntile(3) over (order by v) b from t"
+    ).collect().sort_by([("v", "ascending")]).to_pydict()
+    assert out["a"] == [1, 1, 1, 2, 2, 2]
+    assert out["b"] == [1, 1, 2, 2, 3, 3]
+
+
+def test_window_sum_string_is_engine_error():
+    from arrow_ballista_tpu.errors import BallistaError
+
+    t = pa.table({"g": pa.array([1, 1]), "s": pa.array(["a", "b"])})
+    ctx = _ctx(t, partitions=1)
+    with pytest.raises(BallistaError, match="numeric"):
+        ctx.sql("select sum(s) over (partition by g) from t").collect()
