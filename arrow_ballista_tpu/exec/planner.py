@@ -231,7 +231,7 @@ class PhysicalPlanner:
             specs.append(
                 WindowSpec(
                     w.func, arg_phys, part_phys, order_phys, f.name, f.type,
-                    w.offset,
+                    w.offset, w.frame,
                 )
             )
             part_sets.add(tuple(str(p) for p in w.partition_by))

@@ -48,7 +48,9 @@ class WindowSpec:
     order_by: tuple  # of (PhysicalExpr, asc: bool, nulls_first: Optional[bool])
     name: str
     out_type: pa.DataType
-    offset: int = 1  # lag/lead distance
+    offset: int = 1  # lag/lead distance; ntile bucket count
+    # explicit ROWS frame (start, end) row offsets; None = default RANGE
+    frame: Optional[tuple] = None
 
 
 class WindowExec(ExecutionPlan):
@@ -307,7 +309,103 @@ def _require_numeric(spec: WindowSpec, t: pa.DataType) -> None:
         )
 
 
+def _running_minmax(spec: WindowSpec, vs, seg_id, seg_first):
+    """(cum, cnt_mm): row-exact running min/max over sorted rows, shared
+    by the ROWS-framed and default-RANGE paths.  Exact-int inputs return
+    a pa.Array (int64 stays exact past 2^53) with cnt_mm None; the float
+    path returns a numpy array already NaN-gated on the running count of
+    non-missing values (null/NaN rows see the prior valid extremum)."""
+    _require_numeric(spec, vs.type)
+    import pandas as pd
+
+    if pa.types.is_integer(vs.type) and vs.null_count == 0:
+        g = pd.Series(
+            vs.to_numpy(zero_copy_only=False).astype(np.int64)
+        ).groupby(seg_id)
+        cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
+        return pa.array(cum, pa.int64()), None
+    fvals = pc.cast(vs, pa.float64(), safe=False).to_numpy(
+        zero_copy_only=False
+    )
+    miss = np.isnan(fvals)
+    ident = np.inf if spec.func == "min" else -np.inf
+    cnt_mm = _segmented_cumsum((~miss).astype(np.int64), seg_first)
+    g = pd.Series(np.where(miss, ident, fvals)).groupby(seg_id)
+    cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
+    return np.where(cnt_mm > 0, cum, np.nan), cnt_mm
+
+
+def _rows_frame_aggregate(spec: WindowSpec, st: "_SortState", eval_col):
+    """Explicit ROWS frames: row-exact sliding windows (no peer sharing).
+
+    sum/avg/count reduce to two gathers on a segment-clamped prefix sum
+    — O(n) regardless of frame width; bounded min/max would need a
+    monotonic-deque pass and is not implemented."""
+    n = st.n
+    seg_first = st.seg_first
+    start, end = spec.frame
+    idx = np.arange(n, dtype=np.int64)
+    seg_last = _last_of_group(st.seg_flag, n)
+    lo = seg_first if start is None else np.maximum(seg_first, idx + start)
+    hi = seg_last if end is None else np.minimum(seg_last, idx + end)
+    empty = hi < lo
+
+    if spec.func in ("min", "max"):
+        if not (start is None and end == 0):
+            raise ExecutionError(
+                f"ROWS-framed {spec.func} supports only UNBOUNDED "
+                "PRECEDING AND CURRENT ROW"
+            )
+        vs = _sorted_arg(st, eval_col, spec.arg)
+        cum, _ = _running_minmax(spec, vs, st.seg_id, seg_first)
+        if isinstance(cum, pa.Array):  # exact-int path
+            return pc.if_else(pa.array(~empty), cum, pa.scalar(None, cum.type))
+        return np.where(~empty, cum, np.nan)  # cum already NaN-gated
+
+    if spec.arg is None:  # count(*)
+        out = hi - lo + 1
+        return np.where(empty, 0, out)
+
+    vs = _sorted_arg(st, eval_col, spec.arg)
+    if spec.func in ("sum", "avg"):
+        _require_numeric(spec, vs.type)
+    valid = ~np.asarray(pc.is_null(vs), dtype=bool)
+
+    # bounds can point past the partition (e.g. 2 FOLLOWING at the last
+    # row): clamp the prefix indexes; the empty-frame mask nulls those
+    lo_c = np.clip(lo, 0, n)
+    hi_c = np.clip(hi + 1, 0, n)
+
+    def range_sum(vals):
+        c = np.concatenate([[0], np.cumsum(vals)])  # exclusive prefix
+        return c[hi_c] - c[lo_c]
+
+    cnt = range_sum(valid.astype(np.int64))
+    cnt = np.where(empty, 0, cnt)
+    if spec.func == "count":
+        return cnt
+    if pa.types.is_integer(vs.type) and vs.null_count == 0 and (
+        spec.func == "sum"
+    ):
+        vals = vs.to_numpy(zero_copy_only=False).astype(np.int64)
+        total = range_sum(vals)
+        # int64 exactness survives: null out empty frames via an Arrow
+        # mask instead of routing the values through float64
+        return pa.array(total, pa.int64(), mask=cnt == 0)
+    fvals = np.nan_to_num(
+        pc.cast(vs, pa.float64(), safe=False).to_numpy(zero_copy_only=False),
+        nan=0.0,
+    )
+    total = range_sum(fvals)
+    if spec.func == "sum":
+        return np.where(cnt > 0, total, np.nan)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(cnt > 0, total / cnt, np.nan)
+
+
 def _aggregate(spec: WindowSpec, st: "_SortState", eval_col):
+    if spec.frame is not None:
+        return _rows_frame_aggregate(spec, st, eval_col)
     n = st.n
     seg_id, seg_first = st.seg_id, st.seg_first
     running = bool(spec.order_by)
@@ -365,33 +463,12 @@ def _aggregate(spec: WindowSpec, st: "_SortState", eval_col):
                 with np.errstate(invalid="ignore", divide="ignore"):
                     cum = np.where(cnt > 0, total / cnt, np.nan)
     elif spec.func in ("min", "max"):
-        _require_numeric(spec, vs.type)
-        import pandas as pd
-
-        if is_exact_int:
-            # int64 stays exact past 2^53 (pandas cummin/cummax keep dtype)
-            g = pd.Series(
-                vs.to_numpy(zero_copy_only=False).astype(np.int64)
-            ).groupby(seg_id)
-            cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
-        else:
-            fvals = pc.cast(vs, pa.float64(), safe=False).to_numpy(
-                zero_copy_only=False
-            )
-            # null/NaN rows must still see the running min/max of PRIOR
-            # valid rows (pandas cummin leaves NaN at NaN positions):
-            # substitute the identity, then null out rows before the
-            # first valid value via the running count
-            miss = np.isnan(fvals)
-            ident = np.inf if spec.func == "min" else -np.inf
-            filled = np.where(miss, ident, fvals)
-            cnt_mm = _segmented_cumsum((~miss).astype(np.int64), seg_first)
-            g = pd.Series(filled).groupby(seg_id)
-            cum = (g.cummin() if spec.func == "min" else g.cummax()).to_numpy()
-            cum = np.where(cnt_mm > 0, cum, np.nan)
+        cum, _ = _running_minmax(spec, vs, seg_id, seg_first)
     else:
         raise ExecutionError(f"window aggregate {spec.func}")
     peer_last = _last_of_group(st.peer_flag, n)
+    if isinstance(cum, pa.Array):  # exact-int running min/max
+        return cum.take(pa.array(peer_last))
     return np.asarray(cum)[peer_last]
 
 

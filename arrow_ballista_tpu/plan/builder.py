@@ -637,7 +637,23 @@ class PlanBuilder:
             )
             for oi in e.over.order_by
         )
-        return ex.WindowExpr(fname, arg, partition_by, order_by, offset)
+        frame = None
+        if e.over.frame is not None:
+            if fname not in ("sum", "avg", "min", "max", "count"):
+                raise SqlError(
+                    f"a ROWS frame applies to aggregate windows, not {fname}"
+                )
+            if not order_by:
+                raise SqlError("a ROWS frame requires ORDER BY in its window")
+            f = e.over.frame
+            if (
+                f.start is not None
+                and f.end is not None
+                and f.start > f.end
+            ):
+                raise SqlError("ROWS frame start is after its end")
+            frame = (f.start, f.end)
+        return ex.WindowExpr(fname, arg, partition_by, order_by, offset, frame)
 
     def _expr(
         self,

@@ -592,12 +592,16 @@ class WindowExpr(Expr):
     — peer rows share the value).
     """
 
-    func: str  # row_number | rank | dense_rank | lag | lead | first_value
-    #            | last_value | sum | avg | min | max | count
+    func: str  # row_number | rank | dense_rank | ntile | lag | lead
+    #            | first_value | last_value | sum | avg | min | max | count
     arg: Optional["Expr"]  # None for ranking functions and count(*)
     partition_by: tuple = ()
     order_by: tuple = ()  # of SortExpr
-    offset: int = 1  # lag/lead distance
+    offset: int = 1  # lag/lead distance; ntile bucket count
+    # explicit ROWS frame as (start, end) row offsets relative to the
+    # current row (negative = preceding, None = unbounded); None = the
+    # default RANGE frame
+    frame: Optional[tuple] = None
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         if self.func in WINDOW_RANKING_FUNCTIONS or self.func.startswith(
@@ -635,6 +639,21 @@ class WindowExpr(Expr):
         if self.order_by:
             parts.append(
                 "ORDER BY " + ", ".join(str(s) for s in self.order_by)
+            )
+        if self.frame is not None:
+            # part of the dedup identity: same window, different frame
+            # must stay a different column
+
+            def b(v, side):
+                if v is None:
+                    return f"UNBOUNDED {side}"
+                if v == 0:
+                    return "CURRENT ROW"
+                return f"{-v} PRECEDING" if v < 0 else f"{v} FOLLOWING"
+
+            parts.append(
+                f"ROWS BETWEEN {b(self.frame[0], 'PRECEDING')} "
+                f"AND {b(self.frame[1], 'FOLLOWING')}"
             )
         return f"{self.func}({inner}) OVER ({' '.join(parts)})"
 
@@ -755,6 +774,7 @@ def transform(e: Expr, fn) -> Expr:
                 for s in e.order_by
             ),
             e.offset,
+            e.frame,
         )
     elif isinstance(e, SortExpr):
         e2 = SortExpr(transform(e.expr, fn), e.asc, e.nulls_first)

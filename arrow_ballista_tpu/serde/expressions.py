@@ -167,6 +167,25 @@ def physical_expr_from_proto(n: pb.ExprNode) -> pex.PhysicalExpr:
 # ---------------------------------------------------------------------------
 
 
+def _frame_to_proto(frame: tuple, node) -> None:
+    start, end = frame
+    if start is None:
+        node.start_unbounded = True
+    else:
+        node.start = start
+    if end is None:
+        node.end_unbounded = True
+    else:
+        node.end = end
+
+
+def _frame_from_proto(node) -> tuple:
+    return (
+        None if node.start_unbounded else node.start,
+        None if node.end_unbounded else node.end,
+    )
+
+
 def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
     n = pb.ExprNode()
     if isinstance(e, lex.Column):
@@ -266,6 +285,8 @@ def logical_expr_to_proto(e: lex.Expr) -> pb.ExprNode:
     if isinstance(e, lex.WindowExpr):
         n.window.func = e.func
         n.window.offset = e.offset
+        if e.frame is not None:
+            _frame_to_proto(e.frame, n.window.frame)
         if e.arg is not None:
             n.window.arg.CopyFrom(logical_expr_to_proto(e.arg))
             n.window.has_arg = True
@@ -403,7 +424,10 @@ def logical_expr_from_proto(n: pb.ExprNode) -> lex.Expr:
             for s in n.window.order_by
         )
         return lex.WindowExpr(
-            n.window.func, warg, parts, orders, n.window.offset
+            n.window.func, warg, parts, orders, n.window.offset,
+            _frame_from_proto(n.window.frame)
+            if n.window.HasField("frame")
+            else None,
         )
     if kind == "sort":
         nf: Optional[bool] = (

@@ -41,7 +41,12 @@ from .arrow_utils import (
     schema_from_bytes,
     schema_to_bytes,
 )
-from .expressions import physical_expr_from_proto, physical_expr_to_proto
+from .expressions import (
+    _frame_from_proto,
+    _frame_to_proto,
+    physical_expr_from_proto,
+    physical_expr_to_proto,
+)
 from .scheduler_types import PartitionLocation
 
 
@@ -148,6 +153,8 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             sp.name = s.name
             sp.out_type = dtype_to_bytes(s.out_type)
             sp.offset = s.offset
+            if s.frame is not None:
+                _frame_to_proto(s.frame, sp.frame)
         n.window.input.CopyFrom(physical_plan_to_proto(plan.input))
         return n
     if isinstance(plan, LimitExec):
@@ -304,6 +311,9 @@ def physical_plan_from_proto(
                 sp.name,
                 dtype_from_bytes(sp.out_type),
                 sp.offset,
+                _frame_from_proto(sp.frame)
+                if sp.HasField("frame")
+                else None,
             )
             for sp in n.window.specs
         ]

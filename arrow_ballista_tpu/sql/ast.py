@@ -123,9 +123,20 @@ class Like(SqlExpr):
 
 
 @dataclass
+class WindowFrame:
+    """ROWS frame bounds as row offsets relative to the current row:
+    negative = preceding, 0 = current row, positive = following,
+    None = unbounded in that direction."""
+
+    start: Optional[int]
+    end: Optional[int]
+
+
+@dataclass
 class WindowSpec:
     partition_by: list[SqlExpr] = field(default_factory=list)
     order_by: list["OrderItem"] = field(default_factory=list)
+    frame: Optional[WindowFrame] = None  # None = default RANGE frame
 
 
 @dataclass

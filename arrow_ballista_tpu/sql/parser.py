@@ -282,6 +282,53 @@ class Parser:
             alias = self.next().value
         return ast.NamedTable(name, alias)
 
+    def _rows_frame(self) -> ast.WindowFrame:
+        """ROWS BETWEEN <bound> AND <bound>, or the one-bound shorthand
+        ROWS <bound> (= BETWEEN <bound> AND CURRENT ROW)."""
+
+        def bound():
+            """(offset | None, direction) — direction disambiguates which
+            side UNBOUNDED points to."""
+            if self.eat_kw("UNBOUNDED"):
+                if self.eat_kw("PRECEDING"):
+                    return None, "preceding"
+                self.expect_kw("FOLLOWING")
+                return None, "following"
+            if self.eat_kw("CURRENT"):
+                self.expect_kw("ROW")
+                return 0, "current"
+            tok = self.expect(TokType.NUMBER)
+            try:
+                n = int(tok.value)
+            except ValueError as err:
+                raise SqlError(
+                    f"ROWS frame bound must be an integer, got {tok.value!r}"
+                ) from err
+            if self.eat_kw("PRECEDING"):
+                return -n, "preceding"
+            self.expect_kw("FOLLOWING")
+            return n, "following"
+
+        if self.eat_kw("BETWEEN"):
+            start, sdir = bound()
+            self.expect_kw("AND")
+            end, edir = bound()
+            if start is None and sdir == "following":
+                raise SqlError("frame start cannot be UNBOUNDED FOLLOWING")
+            if end is None and edir == "preceding":
+                raise SqlError("frame end cannot be UNBOUNDED PRECEDING")
+        else:
+            start, sdir = bound()
+            if sdir == "following" and start is not None and start > 0:
+                raise SqlError(
+                    "a one-bound ROWS frame must start at or before "
+                    "CURRENT ROW"
+                )
+            if start is None and sdir == "following":
+                raise SqlError("frame start cannot be UNBOUNDED FOLLOWING")
+            end = 0
+        return ast.WindowFrame(start, end)
+
     def _order_items(self) -> list[ast.OrderItem]:
         items = []
         while True:
@@ -533,6 +580,8 @@ class Parser:
                 if self.eat_kw("ORDER"):
                     self.expect_kw("BY")
                     spec.order_by = self._order_items()
+                if self.eat_kw("ROWS"):
+                    spec.frame = self._rows_frame()
                 self.expect(TokType.RPAREN)
                 call.over = spec
             return call
