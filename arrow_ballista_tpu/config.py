@@ -56,8 +56,8 @@ def _parse_bool(v: str) -> bool:
 
 def _parse_highcard_mode(v: str) -> str:
     mode = v.lower()
-    if mode not in ("auto", "device"):
-        raise ValueError(f"highcard_mode must be auto|device, got {v!r}")
+    if mode not in ("auto", "device", "cpu"):
+        raise ValueError(f"highcard_mode must be auto|cpu|device, got {v!r}")
     return mode
 
 
@@ -146,8 +146,9 @@ _ENTRIES: dict[str, ConfigEntry] = {
         ConfigEntry(
             TPU_HIGHCARD_MODE,
             "aggregate routing when the first batch shows groups ~ rows: "
-            "'auto' hands the stage to the C++ hash aggregate (heuristic), "
-            "'device' keeps it on the sort-based device path",
+            "'auto'/'device' run the device-KEYED aggregation (group ids "
+            "assigned by the device sort, no host hash encode); 'cpu' "
+            "hands the stage to the C++ hash aggregate (A/B baseline)",
             _parse_highcard_mode,
             "auto",
         ),

@@ -872,6 +872,22 @@ def _sorted_segment_agg(seg_key, capacity: int, kinds: list, cols: list):
     s2, perm = jax.lax.sort_key_val(
         seg_key, jnp.arange(n, dtype=jnp.int32)
     )
+    outs, presence, _ = _scan_segments(s2, perm, capacity, kinds, cols)
+    return outs, presence
+
+
+def _scan_segments(s2, perm, capacity: int, kinds: list, cols: list):
+    """Segmented reduction over PRE-SORTED segment ids.
+
+    ``s2``: [n] non-decreasing segment ids; rows excluded from every
+    segment carry a sentinel >= capacity and sit at the end.  ``perm`` is
+    the permutation that sorted the original rows into ``s2`` order;
+    ``cols`` are in ORIGINAL row order and are gathered through ``perm``
+    here.  Shared by :func:`_sorted_segment_agg` (which sorts host gids)
+    and the keyed path (which sorts raw key codes and derives gids from
+    key-change boundaries on device).  Returns (outs, presence, bounds).
+    """
+    n = s2.shape[0]
     flag = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), s2[1:] != s2[:-1]]
     )
@@ -941,7 +957,7 @@ def _sorted_segment_agg(seg_key, capacity: int, kinds: list, cols: list):
                 else jnp.asarray(ident, v.dtype)
             )
             outs.append(jnp.where(occupied, v, empty))
-    return outs, presence
+    return outs, presence, bounds
 
 
 def make_partial_agg_kernel(
@@ -1053,82 +1069,11 @@ def make_partial_agg_kernel(
         count columns dedupe by validity like the matmul path.
         """
         key = jnp.where(maskf, seg_ids, jnp.asarray(capacity, seg_ids.dtype))
-
-        kinds: list = []
-        cols: list = []
-        cnt_index: dict = {}  # validity id -> logical col index (None=base)
-
-        def cnt_col(m, avalid=None):
-            if avalid is None:
-                return None  # base-mask count == presence (boundary diff)
-            k = id(avalid)
-            j = cnt_index.get(k)
-            if j is None:
-                j = len(kinds)
-                cnt_index[k] = j
-                kinds.append("i32")
-                cols.append(m.astype(_I()))
-            return j
-
-        plan: list = []
-        for spec, closure in zip(specs, arg_closures):
-            if spec.func == "count_star":
-                plan.append(("count", None))
-                continue
-            val, avalid = closure(env)
-            m = maskf if avalid is None else jnp.logical_and(maskf, avalid)
-            nj = cnt_col(m, avalid)
-            if spec.func == "count":
-                plan.append(("count", nj))
-                continue
-            if spec.func in ("sum", "avg"):
-                if mode == "x32":
-                    if spec.pair:
-                        vhi, vlo = val
-                        z = jnp.zeros((), jnp.float32)
-                        h, l = _two_sum(
-                            jnp.where(m, vhi, z), jnp.where(m, vlo, z)
-                        )
-                    else:
-                        h = jnp.where(
-                            m, val.astype(jnp.float32), jnp.zeros((), jnp.float32)
-                        )
-                        l = jnp.zeros_like(h)
-                    plan.append(("sum32", len(kinds), nj))
-                    kinds.append("df32")
-                    cols.append((h, l))
-                else:
-                    v = jnp.where(m, val.astype(_F()), jnp.zeros((), _F()))
-                    plan.append(("sum64", len(kinds), nj))
-                    kinds.append("f64")
-                    cols.append(v)
-                continue
-            if spec.func in ("min", "max"):
-                v, ident = _minmax_operand(spec, val)
-                plan.append(("minmax", len(kinds), nj))
-                kinds.append((spec.func, ident))
-                cols.append(jnp.where(m, v, ident))
-                continue
-            raise ExecutionError(f"kernel agg {spec.func}")
-
+        kinds, cols, plan = _build_scan_plan(
+            env, maskf, specs, arg_closures, mode
+        )
         totals, presence = _sorted_segment_agg(key, capacity, kinds, cols)
-
-        outs: list = []
-        for entry in plan:
-            if entry[0] == "count":
-                outs.append(presence if entry[1] is None else totals[entry[1]])
-            elif entry[0] == "sum32":
-                hi, lo = totals[entry[1]]
-                outs.append(hi)
-                outs.append(lo)
-                outs.append(presence if entry[2] is None else totals[entry[2]])
-            elif entry[0] == "sum64":
-                outs.append(totals[entry[1]])
-                outs.append(presence if entry[2] is None else totals[entry[2]])
-            else:  # minmax
-                outs.append(totals[entry[1]])
-                outs.append(presence if entry[2] is None else totals[entry[2]])
-        return tuple(outs) + (presence,)
+        return tuple(_emit_scan_outs(plan, totals, presence)) + (presence,)
 
     def _fn_matmul(env, seg_ids, maskf):
         """x32 MXU path: one einsum reduces all sums AND all counts.
@@ -1218,6 +1163,285 @@ def make_partial_agg_kernel(
         return tuple(outs) + (counts[:, presence_j],)
 
     return fn
+
+
+def _build_scan_plan(env, maskf, specs, arg_closures, mode):
+    """Column/plan construction shared by the sort-based reductions.
+
+    Evaluates every aggregate argument closure against ``env``, folds the
+    base mask + per-argument validity into masked SCAN-FORM columns, and
+    returns ``(kinds, cols, plan)``:
+
+    * ``kinds``/``cols`` — per logical column, the scan element kind and
+      array(s) as documented on :func:`_sorted_segment_agg` (min/max
+      identities are PYTHON scalars so kinds stays hashable for kernel
+      cache keys);
+    * ``plan`` — per aggregate spec, the static emission recipe consumed
+      by :func:`_emit_scan_outs`.
+
+    Count columns dedupe by argument-validity identity (like the matmul
+    path); a ``None`` count index means "use presence" (base mask).
+    """
+    kinds: list = []
+    cols: list = []
+    cnt_index: dict = {}  # validity id -> logical col index (None=base)
+
+    def cnt_col(m, avalid=None):
+        if avalid is None:
+            return None  # base-mask count == presence (boundary diff)
+        k = id(avalid)
+        j = cnt_index.get(k)
+        if j is None:
+            j = len(kinds)
+            cnt_index[k] = j
+            kinds.append("i32")
+            cols.append(m.astype(_I()))
+        return j
+
+    plan: list = []
+    for spec, closure in zip(specs, arg_closures):
+        if spec.func == "count_star":
+            plan.append(("count", None))
+            continue
+        val, avalid = closure(env)
+        m = maskf if avalid is None else jnp.logical_and(maskf, avalid)
+        nj = cnt_col(m, avalid)
+        if spec.func == "count":
+            plan.append(("count", nj))
+            continue
+        if spec.func in ("sum", "avg"):
+            if mode == "x32":
+                if spec.pair:
+                    vhi, vlo = val
+                    z = jnp.zeros((), jnp.float32)
+                    h, l = _two_sum(
+                        jnp.where(m, vhi, z), jnp.where(m, vlo, z)
+                    )
+                else:
+                    h = jnp.where(
+                        m, val.astype(jnp.float32), jnp.zeros((), jnp.float32)
+                    )
+                    l = jnp.zeros_like(h)
+                plan.append(("sum32", len(kinds), nj))
+                kinds.append("df32")
+                cols.append((h, l))
+            else:
+                v = jnp.where(m, val.astype(_F()), jnp.zeros((), _F()))
+                plan.append(("sum64", len(kinds), nj))
+                kinds.append("f64")
+                cols.append(v)
+            continue
+        if spec.func in ("min", "max"):
+            v, ident = _minmax_operand(spec, val)
+            # identity as a PYTHON scalar: kinds must stay hashable for
+            # kernel cache keys, and tracers have no .item() under jit
+            if spec.int_minmax:
+                info = jnp.iinfo(_I())
+                ident_py = int(
+                    info.max if spec.func == "min" else info.min
+                )
+            else:
+                ident_py = float("inf" if spec.func == "min" else "-inf")
+            plan.append(("minmax", len(kinds), nj))
+            kinds.append((spec.func, ident_py))
+            cols.append(jnp.where(m, v, ident))
+            continue
+        raise ExecutionError(f"kernel agg {spec.func}")
+    return kinds, cols, plan
+
+
+def _emit_scan_outs(plan, totals, presence) -> list:
+    """Expand scan totals into the kernel's per-spec state-field order."""
+    outs: list = []
+    for entry in plan:
+        if entry[0] == "count":
+            outs.append(presence if entry[1] is None else totals[entry[1]])
+        elif entry[0] == "sum32":
+            hi, lo = totals[entry[1]]
+            outs.append(hi)
+            outs.append(lo)
+            outs.append(presence if entry[2] is None else totals[entry[2]])
+        else:  # sum64 / minmax
+            outs.append(totals[entry[1]])
+            outs.append(presence if entry[2] is None else totals[entry[2]])
+    return outs
+
+
+# --------------------------------------------------------- keyed aggregate
+# Device-KEYED aggregation: the host never assigns group ids at all.  Raw
+# per-key dictionary/identity CODES ship to the device; one multi-key
+# ``lax.sort`` orders the rows, group ids fall out of key-change
+# boundaries (cumsum of change flags), and the packed fetch returns the
+# unique key codes alongside the states.  This replaces the host
+# hash-probe/factorize encode (``ops/groups.py``) on the high-cardinality
+# path — 44% of q3 SF10 wall in BENCH_SUITE_r03 — with one astype per key
+# per batch.  Counterpart of the reference's per-batch hash repartition
+# loop (``shuffle_writer.rs:214-256``), redesigned sort-first for a
+# scatter-hostile device.
+
+
+def make_keyed_prep_kernel(
+    filter_closure: Optional[JaxClosure],
+    arg_closures: list[Optional[JaxClosure]],
+    specs: list[KernelAggSpec],
+    flat_names: list[str],
+    holder: dict,
+):
+    """Per-batch half of the keyed aggregation.
+
+    ``fn(keys, valid, *leaf_arrays) -> (mask, *keys, *flat_cols)``: runs
+    the fused filter (and, wrapped in :func:`make_join_kernel`, the
+    device join) and emits masked scan-form columns that BUFFER in HBM
+    until the final sort.  ``keys`` is a tuple of per-key code arrays and
+    passes through untouched (it rides the ``seg_ids`` slot so the join
+    wrapper composes unchanged).  ``holder`` captures the static
+    ``kinds``/``plan`` during the first trace for the finish kernel.
+    """
+    mode = precision_mode()
+
+    def fn(keys, valid, *arrays):
+        env = dict(zip(flat_names, arrays))
+        mask = valid
+        if filter_closure is not None:
+            pred, pvalid = filter_closure(env)
+            if pvalid is not None:
+                pred = jnp.logical_and(pred, pvalid)
+            mask = jnp.logical_and(mask, pred)
+        kinds, cols, plan = _build_scan_plan(
+            env, mask, specs, arg_closures, mode
+        )
+        holder["kinds"] = tuple(kinds)
+        holder["plan"] = tuple(plan)
+        flat: list = []
+        for kind, col in zip(kinds, cols):
+            if kind == "df32":
+                flat.extend(col)
+            else:
+                flat.append(col)
+        return (mask,) + tuple(keys) + tuple(flat)
+
+    return fn
+
+
+_KEYED_SORT_CACHE: dict = {}
+
+
+def keyed_sort_kernel(n_keys: int):
+    """Phase 1 of the keyed aggregation (cached per key count).
+
+    ``fn(mask, *keys) -> (s2, perm, *sorted_keys, n_groups)``: one
+    multi-key sort with the inverted mask as the MAJOR key (masked rows
+    sink past every boundary), then group ids from key-change boundaries.
+    ``s2`` is non-decreasing with masked rows at INT32_MAX, exactly the
+    contract :func:`_scan_segments` wants; ``n_groups`` is the only value
+    the host fetches before building the capacity-sized finish kernel.
+    """
+    fn = _KEYED_SORT_CACHE.get(n_keys)
+    if fn is not None:
+        return fn
+
+    def sort_fn(mask, *keys):
+        n = mask.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        inv = jnp.logical_not(mask).astype(jnp.int32)
+        sorted_ = jax.lax.sort((inv, *keys, iota), num_keys=1 + n_keys)
+        sk = sorted_[1:1 + n_keys]
+        perm = sorted_[-1]
+        valid = sorted_[0] == 0
+        diff = sk[0][1:] != sk[0][:-1]
+        for k in sk[1:]:
+            diff = jnp.logical_or(diff, k[1:] != k[:-1])
+        first = jnp.concatenate([jnp.ones((1,), jnp.bool_), diff])
+        flag = jnp.logical_and(first, valid)
+        gid = jnp.cumsum(flag.astype(jnp.int32)) - 1
+        sentinel = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+        s2 = jnp.where(valid, gid, sentinel)
+        n_groups = jnp.sum(flag.astype(jnp.int32))
+        return (s2, perm) + tuple(sk) + (n_groups,)
+
+    fn = jax.jit(sort_fn)
+    _KEYED_SORT_CACHE[n_keys] = fn
+    return fn
+
+
+_KEYED_FINISH_CACHE: dict = {}
+
+
+def keyed_finish_kernel(
+    kinds: tuple,
+    plan: tuple,
+    specs: list[KernelAggSpec],
+    n_keys: int,
+    capacity: int,
+    mode: str,
+):
+    """Phase 2: gather + segmented scan + key extraction + pack, one jit.
+
+    ``fn(s2, perm, sk, flat_cols) -> packed [n_state_fields + 1 + n_keys,
+    capacity]`` integer array (floats bitcast like
+    :func:`pack_for_fetch`): per-spec state fields, presence, then the
+    unique key CODES gathered at each segment's first sorted row — so one
+    tunnel roundtrip returns both the states and the group keys.
+    """
+    cache_key = (kinds, plan, tuple(specs), n_keys, capacity, mode)
+    fn = _KEYED_FINISH_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    flags = [f for spec in specs for f in state_is_int(spec, mode)] + [True]
+
+    def finish_fn(s2, perm, sk, flat):
+        cols: list = []
+        i = 0
+        for kind in kinds:
+            if kind == "df32":
+                cols.append((flat[i], flat[i + 1]))
+                i += 2
+            else:
+                cols.append(flat[i])
+                i += 1
+        totals, presence, bounds = _scan_segments(
+            s2, perm, capacity, list(kinds), cols
+        )
+        outs = _emit_scan_outs(list(plan), totals, presence) + [presence]
+        n = s2.shape[0]
+        starts = jnp.clip(bounds[:-1], 0, max(n - 1, 0))
+        occupied = presence > 0
+        fdt = jnp.float64 if mode == "x64" else jnp.float32
+        idt = jnp.int64 if mode == "x64" else jnp.int32
+        rows = [
+            a.astype(idt)
+            if is_int
+            else jax.lax.bitcast_convert_type(a.astype(fdt), idt)
+            for a, is_int in zip(outs, flags)
+        ]
+        for k in sk:
+            rows.append(
+                jnp.where(occupied, k[starts], jnp.zeros((), k.dtype)).astype(
+                    idt
+                )
+            )
+        return jnp.stack(rows, axis=0)
+
+    fn = jax.jit(finish_fn)
+    _KEYED_FINISH_CACHE[cache_key] = fn
+    return fn
+
+
+def unpack_keyed_host(
+    specs: list[KernelAggSpec], packed: np.ndarray, mode: str, n_keys: int
+) -> tuple[list[np.ndarray], list[np.ndarray]]:
+    """Host inverse of :func:`keyed_finish_kernel`'s pack: (state arrays
+    incl. trailing presence, per-key unique code arrays as int64)."""
+    flags = [f for spec in specs for f in state_is_int(spec, mode)] + [True]
+    fdt = np.float64 if mode == "x64" else np.float32
+    states = [
+        row if is_int else row.view(fdt)
+        for row, is_int in zip(packed[: len(flags)], flags)
+    ]
+    keys = [
+        packed[len(flags) + k].astype(np.int64) for k in range(n_keys)
+    ]
+    return states, keys
 
 
 def _minmax_operand(spec: KernelAggSpec, val):

@@ -55,10 +55,10 @@ class _SmallInput(Exception):
 
 
 class _HighCardinality(Exception):
-    """Control flow: the first batch showed groups ~ rows — the C++ hash
-    aggregate beats transfer + device scatter for that shape, so the stage
-    hands back to the CPU path, replaying the consumed batch and chaining
-    the still-live source iterator (no re-scan)."""
+    """Control flow: the first batch showed groups ~ rows and
+    ``highcard_mode=cpu`` pins the C++ hash aggregate — the stage hands
+    back to the CPU path, replaying the consumed batch and chaining the
+    still-live source iterator (no re-scan)."""
 
     def __init__(self, batches: list, tail):
         super().__init__("high-cardinality aggregate")
@@ -66,22 +66,75 @@ class _HighCardinality(Exception):
         self.tail = tail
 
 
-# High-cardinality CPU selection: below either bound the device path wins
-# (measured q1 SF10: 38x); above both, q3 SF10's 3M-group aggregate ran
-# 0.6x CPU — pyarrow's hash table is the right tool when groups ~ rows.
+class _KeyedRoute(Exception):
+    """Control flow: the first batch showed groups ~ rows — route the
+    stage to the device-KEYED aggregation (raw key codes sort on device,
+    group ids from key-change boundaries; no host hash encode).  Carries
+    the consumed batch (with its already-computed key codes) and the
+    still-live source iterator."""
+
+    def __init__(self, batches: list, tail, key_encoders, ra):
+        super().__init__("keyed high-cardinality aggregate")
+        self.batches = batches  # [(RecordBatch, code_arrays)]
+        self.tail = tail
+        self.key_encoders = key_encoders
+        self.ra = ra
+
+
+class _TrackingIter:
+    """Iterator wrapper recording whether any item was actually yielded —
+    lets the keyed fallback replay buffered batches + chain the tail when
+    the failure happened before the live source was touched."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self.consumed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._it)
+        self.consumed = True
+        return item
+
+
+class _KeyedGroups:
+    """GroupTable-shaped view over DEVICE-assigned groups: the fetched
+    unique key codes (gid order = key-sorted order) satisfy the
+    ``n_groups`` / ``codes_for`` surface ``_materialize`` reads."""
+
+    def __init__(self, key_codes: list, n_groups: int):
+        self._codes = key_codes
+        self.n_groups = n_groups
+
+    def codes_for(self, gids: np.ndarray, key: int) -> np.ndarray:
+        return self._codes[key][gids]
+
+
+# High-cardinality routing: below either bound the gid-table device path
+# wins outright (measured q1 SF10: 38x).  Above both, the host group-id
+# encode used to dominate (q3 SF10: 44% of wall was key_encode) — the
+# keyed path moves that to the device sort, so 'auto' now stays on
+# device; 'cpu' preserves the old C++-hash-aggregate handoff for A/B.
 _HIGHCARD_MIN_GROUPS = 1 << 16
 _HIGHCARD_RATIO = 0.05
 
 
-def should_highcard_fallback(config, n_groups: int, n_rows: int) -> bool:
-    """One predicate for BOTH the sequential stage and the mesh gang:
-    hand a groups~rows aggregate to the C++ hash aggregate unless
-    ``ballista.tpu.highcard_mode=device`` pins the sort-based device
-    path."""
+def _highcard_detect(n_groups: int, n_rows: int) -> bool:
+    """Raw groups~rows detector (first data batch), mode-independent."""
     return (
-        config.tpu_highcard_mode != "device"
-        and n_groups > _HIGHCARD_MIN_GROUPS
+        n_groups > _HIGHCARD_MIN_GROUPS
         and n_groups > _HIGHCARD_RATIO * n_rows
+    )
+
+
+def should_highcard_fallback(config, n_groups: int, n_rows: int) -> bool:
+    """Mesh-gang predicate: the gang has no keyed path, so groups~rows
+    hands the stage to the sequential fallback unless
+    ``ballista.tpu.highcard_mode=device`` pins the sort-based gid path."""
+    return config.tpu_highcard_mode != "device" and _highcard_detect(
+        n_groups, n_rows
     )
 
 
@@ -170,11 +223,11 @@ def _closing_on_error(ra: Optional[_ReadAhead]):
     """Stop the prefetch pump when the device stage aborts into a CPU
     re-run (_CapacityExceeded / ExecutionError): the re-run opens a
     FRESH source iterator, so the old pump must not keep reading the
-    abandoned one.  _HighCardinality passes through untouched — its
-    replay path keeps consuming this same iterator."""
+    abandoned one.  _HighCardinality / _KeyedRoute pass through untouched
+    — their replay paths keep consuming this same iterator."""
     try:
         yield
-    except _HighCardinality:
+    except (_HighCardinality, _KeyedRoute):
         raise
     except BaseException:
         if ra is not None:
@@ -766,9 +819,52 @@ class TpuStageExec(ExecutionPlan):
                     )
                 ]
             )
+        except _KeyedRoute as kr:
+            # groups ~ rows: device-keyed aggregation (group ids assigned
+            # by the device sort, no host hash encode); late key overflow,
+            # cardinality past the segment ceiling, or device OOM (the
+            # keyed path buffers the stage input in HBM) drop to the CPU
+            # operator path below
+            self.metrics.add("keyed_path", 1)
+            tail = _TrackingIter(kr.tail)
+            try:
+                host_states, groups, n_rows_in = self._run_keyed(
+                    kr.batches, tail, kr.key_encoders, ctx
+                )
+            except (_CapacityExceeded, ExecutionError, RuntimeError):
+                self.metrics.add("tpu_fallback", 1)
+                if not tail.consumed:
+                    # failed before touching the live source: replay the
+                    # already-buffered batches + chain the tail (no
+                    # re-scan, _HighCardinality-style)
+                    cpu_plan = self.original.with_new_children(
+                        [
+                            _replace_leaf(
+                                self.original.input,
+                                self.fused.source,
+                                _BufferedExec(
+                                    self.fused.source,
+                                    [b for b, _ in kr.batches],
+                                    tail,
+                                ),
+                            )
+                        ]
+                    )
+                else:
+                    if kr.ra is not None:
+                        kr.ra.close()
+                    cpu_plan = self.original
+                yield from cpu_plan.execute(partition, ctx)
+                return
+            yield from self._materialize(
+                host_states, kr.key_encoders, groups, n_rows_in, ctx,
+                partition,
+            )
+            return
         except _HighCardinality as hc:
-            # groups ~ rows: hand the stage to the C++ hash aggregate,
-            # replaying the consumed batch + chaining the live source
+            # groups ~ rows with highcard_mode=cpu: hand the stage to the
+            # C++ hash aggregate, replaying the consumed batch + chaining
+            # the live source
             self.metrics.add("highcard_fallback", 1)
             cpu_plan = self.original.with_new_children(
                 [
@@ -906,17 +1002,46 @@ class TpuStageExec(ExecutionPlan):
 
                 if fused.group_exprs:
                     with self.metrics.timer("key_encode_time_ns"):
-                        seg = self._encode_groups(
-                            batch, key_encoders, group_table
-                        )
+                        codes = self._encode_codes(batch, key_encoders)
                     if acc is None and not entries:
-                        if fused.join is None and should_highcard_fallback(
-                            self.config, group_table.n_groups, n
+                        try:
+                            with self.metrics.timer("key_encode_time_ns"):
+                                seg = self._assign_gids(codes, group_table)
+                            first_groups = group_table.n_groups
+                        except _CapacityExceeded:
+                            # ONE batch outran the gid table / key radix:
+                            # definitionally high-cardinality
+                            first_groups = None
+                        if first_groups is None or _highcard_detect(
+                            first_groups, n
                         ):
-                            # with a device join fused, the CPU
-                            # alternative pays the join too — stay on
-                            # device even at high cardinality
-                            raise _HighCardinality([batch], src)
+                            # keys the device can't take raw (i32 overflow
+                            # in x32) disqualify the keyed path up front:
+                            # host-assigned gids are always dense i32, so
+                            # the gid-table path stays available
+                            keyed_ok = self._mode != "x32" or all(
+                                len(c) == 0
+                                or (
+                                    c.min() >= -(1 << 31)
+                                    and c.max() < (1 << 31)
+                                )
+                                for c in codes
+                            )
+                            if (
+                                self.config.tpu_highcard_mode != "cpu"
+                                and keyed_ok
+                            ):
+                                raise _KeyedRoute(
+                                    [(batch, codes)], src, key_encoders, ra
+                                )
+                            if fused.join is None:
+                                raise _HighCardinality([batch], src)
+                            # fused device join at high cardinality with
+                            # the keyed path unavailable (cpu mode or
+                            # unshippable keys): the CPU alternative pays
+                            # the join too — stay on the gid-table path
+                            if first_groups is None:
+                                raise _CapacityExceeded()
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path
                         # FLOPs scale with capacity, so a 6-group q1 must
@@ -927,6 +1052,9 @@ class TpuStageExec(ExecutionPlan):
                         if tight < cap:
                             cap = min(tight, self.max_capacity)
                             _, kernel = self._kernel_for(cap)
+                    else:
+                        with self.metrics.timer("key_encode_time_ns"):
+                            seg = self._assign_gids(codes, group_table)
                     # adaptive capacity: grow the segment table in 4x
                     # buckets when the data's cardinality outruns it,
                     # padding accumulated states (VERDICT round-1: fixed
@@ -945,33 +1073,7 @@ class TpuStageExec(ExecutionPlan):
                 valid[:n] = True
 
                 with self.metrics.timer("bridge_time_ns"):
-                    env = K.build_env(batch, self.leaves, n_pad)
-                    args = [
-                        env[nm]
-                        for nm in self._flat_names
-                        if nm not in self._join_slots
-                    ]
-                    if fused.join is not None:
-                        pk = _eval_arr(fused.join.probe_key, batch)
-                        from .bridge import arrow_to_numpy
-
-                        pkv, pk_valid = arrow_to_numpy(pk)
-                        pkv = pkv.astype(np.int64)
-                        if pk_valid is None:
-                            pk_valid = np.ones(n, dtype=bool)
-                        if self._mode == "x32":
-                            # probe keys outside i32 cannot match the
-                            # (range-checked) build keys: mask, don't fail
-                            in_range = (pkv >= -(1 << 31)) & (pkv < 1 << 31)
-                            if not in_range.all():
-                                pk_valid = pk_valid & in_range
-                                pkv = np.where(in_range, pkv, 0)
-                            pkv = pkv.astype(np.int32)
-                        args += [
-                            K._pad(pkv, n_pad),
-                            K._pad(pk_valid, n_pad),
-                            build[1],  # bkeys (device)
-                        ] + build[2] + build[3]  # bvals, bvalids
+                    args = self._kernel_args(batch, n, n_pad, build)
                 with self.metrics.timer("device_time_ns"):
                     if ck is not None:
                         import jax
@@ -1000,6 +1102,159 @@ class TpuStageExec(ExecutionPlan):
         yield from self._materialize(
             host_states, key_encoders, group_table, n_rows_in, ctx, partition
         )
+
+    def _kernel_args(self, batch, n: int, n_pad: int, build) -> list:
+        """Host-side leaf env + join operands for one batch (the bridge
+        work shared by the gid-table and keyed execution paths)."""
+        env = K.build_env(batch, self.leaves, n_pad)
+        args = [
+            env[nm]
+            for nm in self._flat_names
+            if nm not in self._join_slots
+        ]
+        if self.fused.join is not None:
+            pk = _eval_arr(self.fused.join.probe_key, batch)
+            from .bridge import arrow_to_numpy
+
+            pkv, pk_valid = arrow_to_numpy(pk)
+            pkv = pkv.astype(np.int64)
+            if pk_valid is None:
+                pk_valid = np.ones(n, dtype=bool)
+            if self._mode == "x32":
+                # probe keys outside i32 cannot match the
+                # (range-checked) build keys: mask, don't fail
+                in_range = (pkv >= -(1 << 31)) & (pkv < 1 << 31)
+                if not in_range.all():
+                    pk_valid = pk_valid & in_range
+                    pkv = np.where(in_range, pkv, 0)
+                pkv = pkv.astype(np.int32)
+            args += [
+                K._pad(pkv, n_pad),
+                K._pad(pk_valid, n_pad),
+                build[1],  # bkeys (device)
+            ] + build[2] + build[3]  # bvals, bvalids
+        return args
+
+    # ---------------------------------------------------- keyed aggregate
+    def _keyed_prep(self):
+        """(holder, jitted prep kernel) for the keyed path, cached with
+        the other compiled kernels on the stage signature."""
+        key = self._sig + ("keyed_prep",) + K.algo_cache_token()
+        cached = _KERNEL_CACHE.get(key)
+        if cached is None:
+            import jax
+
+            holder: dict = {}
+            inner = K.make_keyed_prep_kernel(
+                self._filter_closure,
+                self._arg_closures,
+                self.specs,
+                self._flat_names,
+                holder,
+            )
+            if self.fused.join is not None:
+                kernel = K.make_join_kernel(
+                    inner,
+                    self._flat_names,
+                    self._join_slots,
+                    len(self._device_build_cols),
+                )
+            else:
+                kernel = inner
+            cached = (holder, jax.jit(kernel))
+            _KERNEL_CACHE[key] = cached
+        return cached
+
+    def _run_keyed(self, first: list, src, key_encoders, ctx: TaskContext):
+        """Device-keyed aggregation (VERDICT r3 item 2): per batch the
+        fused filter/join/project runs and masked scan-form columns
+        buffer in HBM alongside the RAW key codes; at stream end ONE
+        multi-key sort assigns group ids from key-change boundaries, one
+        segmented scan reduces every aggregate, and one packed fetch
+        returns states + unique key codes.  Host work per batch is one
+        astype per key — no hash probe, no factorize.
+
+        Returns ``(host_states, _KeyedGroups, n_rows_in)``; raises
+        ``ExecutionError`` (keys can't ship) or ``_CapacityExceeded``
+        (cardinality past tpu.max_capacity) for the caller's CPU
+        fallback.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        fused = self.fused
+        build = None
+        if fused.join is not None:
+            # cached by the _execute_device run that raised _KeyedRoute
+            # (an empty build side returns there, before any routing)
+            build = self._prepare_build(ctx)
+        holder, prep = self._keyed_prep()
+        n_keys = self._n_encoded_groups
+        buf: list = []
+        n_rows_in = 0
+
+        def feed(batch, codes):
+            n = batch.num_rows
+            n_pad = K.bucket_rows(n)
+            keys = tuple(
+                K._pad(K.coerce_host_values(c), n_pad) for c in codes
+            )
+            valid = np.zeros(n_pad, dtype=bool)
+            valid[:n] = True
+            with self.metrics.timer("bridge_time_ns"):
+                args = self._kernel_args(batch, n, n_pad, build)
+            with self.metrics.timer("device_time_ns"):
+                buf.append(prep(keys, valid, *args))
+
+        with self.metrics.timer("tpu_stage_time_ns"):
+            for batch, codes in first:
+                n_rows_in += batch.num_rows
+                feed(batch, codes)
+            for batch in src:
+                if batch.num_rows == 0:
+                    continue
+                n_rows_in += batch.num_rows
+                with self.metrics.timer("key_encode_time_ns"):
+                    codes = self._encode_codes(batch, key_encoders)
+                feed(batch, codes)
+
+            with self.metrics.timer("device_time_ns"):
+                parts = list(zip(*buf))
+                if len(buf) == 1:
+                    fields = [p[0] for p in parts]
+                else:
+                    fields = [jnp.concatenate(p) for p in parts]
+                total = int(fields[0].shape[0])
+                n2 = K.bucket_rows(total)
+                if n2 != total:
+                    # pad rows carry mask=False and sink past every
+                    # boundary in the sort — values never read
+                    fields = [
+                        jnp.pad(f, (0, n2 - total)) for f in fields
+                    ]
+                mask = fields[0]
+                keys = fields[1:1 + n_keys]
+                flat_cols = fields[1 + n_keys:]
+                out = K.keyed_sort_kernel(n_keys)(mask, *keys)
+                s2, perm = out[0], out[1]
+                sk = out[2:-1]
+                # the scalar fetch is the one host sync before capacity
+                # is known (~one tunnel roundtrip)
+                n_groups = int(np.asarray(out[-1]))
+            if n_groups > self.max_capacity:
+                raise _CapacityExceeded()
+            cap = max(64, 1 << (max(n_groups, 1) - 1).bit_length())
+            finish = K.keyed_finish_kernel(
+                holder["kinds"], holder["plan"], self.specs, n_keys, cap,
+                self._mode,
+            )
+            with self.metrics.timer("device_time_ns"):
+                packed = finish(s2, perm, tuple(sk), tuple(flat_cols))
+                host = np.asarray(packed)
+        states, key_codes = K.unpack_keyed_host(
+            self.specs, host, self._mode, n_keys
+        )
+        return states, _KeyedGroups(key_codes, n_groups), n_rows_in
 
     # ------------------------------------------------------- device join
     def _nojoin_stage(self) -> "TpuStageExec":
@@ -1100,10 +1355,16 @@ class TpuStageExec(ExecutionPlan):
         per-key radix bits; known combinations resolve through a pandas
         hash-index probe and only MISSES pay one pandas.factorize
         (ops/groups.py — the round-2 design looped Python over every new
-        combination: 6 of q3 SF10's 7.8 stage-seconds).
+        combination: 6 of q3 SF10's 7.8 stage-seconds).  The keyed path
+        (:meth:`_run_keyed`) skips the gid table entirely and ships the
+        per-key codes raw.
         """
-        from .groups import RadixOverflow
+        return self._assign_gids(
+            self._encode_codes(batch, key_encoders), group_table
+        )
 
+    def _encode_codes(self, batch, key_encoders) -> list[np.ndarray]:
+        """Per-key dictionary/identity code arrays for one batch."""
         encoded_exprs = [
             g
             for (g, _), (kind, _s) in zip(
@@ -1111,10 +1372,14 @@ class TpuStageExec(ExecutionPlan):
             )
             if kind == "enc"
         ]
-        code_arrays = [
+        return [
             enc.encode(_eval_arr(g, batch))
             for g, enc in zip(encoded_exprs, key_encoders)
         ]
+
+    def _assign_gids(self, code_arrays: list, group_table) -> np.ndarray:
+        from .groups import RadixOverflow
+
         try:
             gids = group_table.encode(code_arrays)
         except RadixOverflow:

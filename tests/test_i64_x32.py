@@ -196,15 +196,30 @@ def test_udaf_rejected_at_plan_time():
 
 
 def test_high_cardinality_routes_to_cpu_hash_agg():
-    """Groups ~ rows: the stage must hand off to the C++ hash aggregate
-    (highcard_fallback) without re-scanning the source, and still be
-    correct.  Measured basis: q3 SF10's 3M-group aggregate ran 0.6x CPU
-    through the device path."""
+    """Groups ~ rows with highcard_mode=cpu: the stage must hand off to
+    the C++ hash aggregate (highcard_fallback) without re-scanning the
+    source, and still be correct.  (Default 'auto' now runs the keyed
+    device path — tests/test_keyed_agg.py.)"""
     rng = np.random.default_rng(5)
     n = 300_000
     keys = rng.integers(0, 150_000, n).astype(np.int64)  # ~50% distinct
     t = pa.table({"k": pa.array(keys), "v": pa.array(np.ones(n))})
-    out, m = _run("select k, sum(v) from t group by k order by k limit 5", t)
+    ctx = SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true",
+                "ballista.tpu.min_rows": "0",
+                "ballista.mesh.enable": "false",
+                "ballista.tpu.highcard_mode": "cpu",
+            }
+        )
+    )
+    ctx.register_table("t", MemoryTable.from_table(t, 2))
+    plan = ctx.sql(
+        "select k, sum(v) from t group by k order by k limit 5"
+    ).physical_plan()
+    out = ctx.execute(plan)
+    m = _metrics(plan)
     assert m.get("highcard_fallback", 0) >= 1, m
     assert "device_time_ns" not in m, m  # never touched the device
     assert out.num_rows == 5

@@ -372,8 +372,8 @@ def test_readahead_on_off_same_results():
 
 def test_highcard_mode_device_stays_on_device():
     """highcard_mode=device must keep a groups~rows aggregate on the
-    sort-based device path (no highcard_fallback) and match the CPU
-    oracle; auto hands the same shape to the C++ hash aggregate."""
+    device (keyed path, no highcard_fallback) and match the CPU oracle;
+    'cpu' hands the same shape to the C++ hash aggregate."""
     import numpy as np
 
     from arrow_ballista_tpu.ops import kernels as K
@@ -411,10 +411,10 @@ def test_highcard_mode_device_stays_on_device():
         K.set_agg_algorithm(None)
     _assert_tables_equal(want, got.sort_by([("g", "ascending")]), rel=1e-6)
 
-    auto = _ctx(True)
-    auto.register_arrow_table("t", tbl, partitions=1)
-    plan2 = auto.sql(sql).physical_plan()
-    got2 = auto.execute(plan2)
+    cpu_mode = _ctx(True, **{"ballista.tpu.highcard_mode": "cpu"})
+    cpu_mode.register_arrow_table("t", tbl, partitions=1)
+    plan2 = cpu_mode.sql(sql).physical_plan()
+    got2 = cpu_mode.execute(plan2)
     assert _stage_metrics(plan2).get("highcard_fallback", 0) >= 1
     _assert_tables_equal(want, got2.sort_by([("g", "ascending")]), rel=1e-6)
 
