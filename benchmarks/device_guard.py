@@ -46,6 +46,10 @@ def ensure_device() -> Tuple[str, Optional[str]]:
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         import jax
 
+        # the env var alone is NOT enough: a session-level axon pin wins
+        # over it and the first backend touch would hang on the tunnel —
+        # the config API is the reliable override
+        jax.config.update("jax_platforms", "cpu")
         return jax.default_backend(), None
 
     probed = probe_backend(180)
