@@ -46,13 +46,19 @@ DEFAULT_LOCK_TTL_S = 30.0
 DEFAULT_LOCK_WAIT_S = 20.0
 
 
+class LeaseFenced(Exception):
+    """A fenced transaction was rejected: the guarding lease expired or
+    changed hands between the write's dispatch and its application."""
+
+
 # ------------------------------------------------------------------ server
 class _Lease:
-    __slots__ = ("owner", "expires")
+    __slots__ = ("owner", "expires", "token")
 
-    def __init__(self, owner: str, expires: float):
+    def __init__(self, owner: str, expires: float, token: int):
         self.owner = owner
         self.expires = expires
+        self.token = token
 
 
 class KvStoreService:
@@ -62,6 +68,7 @@ class KvStoreService:
         self.backend = backend
         self._leases: Dict[Tuple[str, str], _Lease] = {}
         self._lease_guard = threading.Lock()
+        self._next_token = 0  # guarded by _lease_guard
 
     # ---- kv ----
     def Get(self, req: pb.KvGetParams, ctx) -> pb.KvGetResult:
@@ -87,6 +94,32 @@ class KvStoreService:
         return pb.KvPutResult()
 
     def PutTxn(self, req: pb.KvTxnParams, ctx) -> pb.KvTxnResult:
+        if req.HasField("fence"):
+            f = req.fence
+            now = time.monotonic()
+            with self._lease_guard:
+                lease = self._leases.get((f.keyspace, f.key))
+                ok = (
+                    lease is not None
+                    and lease.expires > now
+                    and lease.owner == f.owner
+                    and lease.token == f.token
+                )
+                if ok:
+                    # apply under the guard: the lease cannot expire or
+                    # be re-granted between the check and the write
+                    self.backend.put_txn(
+                        [
+                            (Keyspace(op.keyspace), op.key, op.value)
+                            for op in req.ops
+                        ]
+                    )
+                    return pb.KvTxnResult()
+            ctx.abort(
+                grpc.StatusCode.ABORTED,
+                f"fenced: lease {f.keyspace}/{f.key} no longer held by "
+                f"{f.owner} with token {f.token}",
+            )
         self.backend.put_txn(
             [(Keyspace(op.keyspace), op.key, op.value) for op in req.ops]
         )
@@ -105,16 +138,28 @@ class KvStoreService:
     # ---- leases ----
     def Lock(self, req: pb.KvLockParams, ctx) -> pb.KvLockResult:
         ttl = req.ttl_s or DEFAULT_LOCK_TTL_S
-        wait = req.wait_s or DEFAULT_LOCK_WAIT_S
+        wait = req.wait_s if req.wait_s > 0 else DEFAULT_LOCK_WAIT_S
         key = (req.keyspace, req.key)
         deadline = time.monotonic() + wait
         while True:
             now = time.monotonic()
             with self._lease_guard:
                 lease = self._leases.get(key)
-                if lease is None or lease.expires <= now or lease.owner == req.owner:
-                    self._leases[key] = _Lease(req.owner, now + ttl)
-                    return pb.KvLockResult(acquired=True)
+                if lease is not None and lease.owner == req.owner and (
+                    lease.expires > now
+                ):
+                    # keep-alive refresh of a LIVE lease: extend the
+                    # expiry, keep the grant's fencing token
+                    lease.expires = now + ttl
+                    return pb.KvLockResult(acquired=True, token=lease.token)
+                if lease is None or lease.expires <= now:
+                    self._next_token += 1
+                    self._leases[key] = _Lease(
+                        req.owner, now + ttl, self._next_token
+                    )
+                    return pb.KvLockResult(
+                        acquired=True, token=self._next_token
+                    )
             if now >= deadline:
                 return pb.KvLockResult(acquired=False)
             time.sleep(0.01)
@@ -167,13 +212,29 @@ class KvStoreHandle:
 # ------------------------------------------------------------------ client
 class _RemoteLock:
     """Context-manager lock over the store's lease API (etcd lock shape:
-    acquire with TTL, release explicitly, expire on crash)."""
+    acquire with TTL, release explicitly, expire on crash).
 
-    def __init__(self, stub, keyspace: str, key: str, owner: str):
+    While held, a daemon refresher thread re-Locks every ``ttl/3`` —
+    etcd's lease keep-alive (`etcd.rs:333-345`) — so an operation that
+    outlives the TTL keeps its lease instead of silently losing it.  If a
+    refresh ever comes back with a DIFFERENT token (the lease lapsed and
+    was re-granted, i.e. another owner could have acted in the gap) the
+    lock marks itself ``lost`` and stops refreshing; fenced writes
+    carrying the original token are then rejected by the store.
+    """
+
+    def __init__(
+        self, stub, keyspace: str, key: str, owner: str,
+        ttl_s: float = DEFAULT_LOCK_TTL_S,
+    ):
         self._stub = stub
         self._keyspace = keyspace
         self._key = key
         self._owner = owner
+        self._ttl = ttl_s
+        self.token: Optional[int] = None
+        self.lost = False
+        self._stop: Optional[threading.Event] = None
 
     def acquire(self, timeout: Optional[float] = None) -> bool:
         res = self._stub.Lock(
@@ -181,16 +242,72 @@ class _RemoteLock:
                 keyspace=self._keyspace,
                 key=self._key,
                 owner=self._owner,
+                ttl_s=self._ttl,
                 wait_s=timeout or 0.0,
             )
         )
+        if res.acquired:
+            self.token = res.token
+            self.lost = False
+            self._start_keepalive()
         return res.acquired
 
-    def release(self) -> None:
+    def _start_keepalive(self) -> None:
+        self._stop = stop = threading.Event()
+        interval = max(0.05, self._ttl / 3.0)
+
+        def refresh():
+            while not stop.wait(interval):
+                try:
+                    res = self._stub.Lock(
+                        pb.KvLockParams(
+                            keyspace=self._keyspace,
+                            key=self._key,
+                            owner=self._owner,
+                            ttl_s=self._ttl,
+                            wait_s=0.001,
+                        )
+                    )
+                except Exception:  # store away: next write gets fenced
+                    continue
+                if not res.acquired or res.token != self.token:
+                    if res.acquired:
+                        # we re-won a NEW grant after a gap: release it —
+                        # the original critical section must not continue
+                        # under a token its fenced writes don't carry
+                        try:
+                            self._unlock()
+                        except Exception:
+                            pass
+                    self.lost = True
+                    return
+
+        t = threading.Thread(
+            target=refresh,
+            name=f"kv-lease-{self._keyspace}/{self._key}",
+            daemon=True,
+        )
+        t.start()
+
+    def _unlock(self) -> None:
         self._stub.Unlock(
             pb.KvUnlockParams(
                 keyspace=self._keyspace, key=self._key, owner=self._owner
             )
+        )
+
+    def release(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+            self._stop = None
+        self._unlock()
+
+    def fence(self) -> pb.KvFence:
+        return pb.KvFence(
+            keyspace=self._keyspace,
+            key=self._key,
+            owner=self._owner,
+            token=self.token or 0,
         )
 
     def __enter__(self):
@@ -256,15 +373,21 @@ class RemoteBackend(StateBackend):
             )
         )
 
-    def put_txn(self, ops):
-        self._stub.PutTxn(
-            pb.KvTxnParams(
-                ops=[
-                    pb.KvTxnOp(keyspace=ks.value, key=self._k(k), value=v)
-                    for ks, k, v in ops
-                ]
-            )
+    def put_txn(self, ops, fence: Optional[_RemoteLock] = None):
+        params = pb.KvTxnParams(
+            ops=[
+                pb.KvTxnOp(keyspace=ks.value, key=self._k(k), value=v)
+                for ks, k, v in ops
+            ]
         )
+        if fence is not None:
+            params.fence.CopyFrom(fence.fence())
+        try:
+            self._stub.PutTxn(params)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.ABORTED:
+                raise LeaseFenced(str(e.details())) from e
+            raise
 
     def mv(self, from_keyspace, to_keyspace, key):
         self._stub.Mv(
@@ -280,10 +403,14 @@ class RemoteBackend(StateBackend):
             pb.KvDeleteParams(keyspace=keyspace.value, key=self._k(key))
         )
 
-    def lock(self, keyspace: Keyspace, key: str):
+    def lock(
+        self, keyspace: Keyspace, key: str,
+        ttl_s: float = DEFAULT_LOCK_TTL_S,
+    ):
         return _RemoteLock(
             self._stub, keyspace.value, self._k(key),
             f"{self._owner}:{threading.get_ident()}",
+            ttl_s=ttl_s,
         )
 
     def watch(self, keyspace: Keyspace, prefix: str, watcher: Watcher) -> Callable:

@@ -144,7 +144,10 @@ class TaskManager:
         ``execution_graph.rs:99-101``; with a shared etcd-style backend any
         surviving scheduler can resume them).  Returns adopted job ids."""
         out: List[str] = []
-        with self.backend.lock(Keyspace.ActiveJobs, f"takeover:{dead_scheduler_id}"):
+        lk = self.backend.lock(
+            Keyspace.ActiveJobs, f"takeover:{dead_scheduler_id}"
+        )
+        with lk:
             for job_id in self.backend.scan_keys(Keyspace.ActiveJobs):
                 entry = self._entry(job_id)
                 with entry.lock:
@@ -156,7 +159,24 @@ class TaskManager:
                         continue
                     graph.scheduler_id = self.scheduler_id
                     graph.revive()
-                    self._persist(graph)
+                    if hasattr(lk, "fence"):
+                        # remote lease: the adoption write carries the
+                        # grant's fencing token — if this sweeper's lease
+                        # lapsed (TTL outlived without a refresh), the
+                        # store rejects the write and a live sweeper wins
+                        try:
+                            self.backend.put_txn(
+                                [(
+                                    Keyspace.ActiveJobs, job_id,
+                                    graph.encode(),
+                                )],
+                                fence=lk,
+                            )
+                        except Exception:
+                            entry.graph = None  # store refused: reload
+                            raise
+                    else:
+                        self._persist(graph)
                     out.append(job_id)
         return out
 

@@ -106,6 +106,112 @@ def test_remote_lock_lease_semantics(store):
     b2.close()
 
 
+def test_lease_keepalive_outlives_ttl(store):
+    """etcd keep-alive (etcd.rs:333-345): a holder whose critical section
+    outlives the TTL KEEPS the lock — the refresher extends the lease, a
+    rival cannot acquire, and fenced writes keep landing."""
+    b1, b2 = _remote(store), _remote(store)
+    l1 = b1.lock(Keyspace.Slots, "ka", ttl_s=0.3)
+    assert l1.acquire(timeout=1.0)
+    token = l1.token
+    time.sleep(1.0)  # > 3x TTL: without keep-alive the lease is long gone
+    assert not l1.lost
+    assert l1.token == token  # same grant, not a lapse-and-rewin
+    l2 = b2.lock(Keyspace.Slots, "ka", ttl_s=0.3)
+    assert not l2.acquire(timeout=0.2)  # still held
+    b1.put_txn([(Keyspace.Slots, "guarded", b"v")], fence=l1)  # not fenced
+    assert b1.get(Keyspace.Slots, "guarded") == b"v"
+    l1.release()
+    assert l2.acquire(timeout=1.0)
+    l2.release()
+    b1.close()
+    b2.close()
+
+
+def test_expired_holder_writes_are_fenced(store):
+    """A holder that loses its lease (refresher stalled past TTL) must
+    have its guarded writes REJECTED — the split-brain window fencing
+    tokens exist to close."""
+    from arrow_ballista_tpu.scheduler.kvstore import LeaseFenced
+
+    b1, b2 = _remote(store), _remote(store)
+    l1 = b1.lock(Keyspace.Slots, "fence", ttl_s=0.3)
+    assert l1.acquire(timeout=1.0)
+    l1._stop.set()  # simulate a stalled holder: keep-alive stops
+    time.sleep(0.5)  # lease expires
+    l2 = b2.lock(Keyspace.Slots, "fence", ttl_s=30.0)
+    assert l2.acquire(timeout=1.0)  # rival takes over the expired lease
+    with pytest.raises(LeaseFenced):
+        b1.put_txn([(Keyspace.Slots, "guarded2", b"stale")], fence=l1)
+    assert b1.get(Keyspace.Slots, "guarded2") is None
+    # the new holder's fenced writes land
+    b2.put_txn([(Keyspace.Slots, "guarded2", b"fresh")], fence=l2)
+    assert b2.get(Keyspace.Slots, "guarded2") == b"fresh"
+    l2.release()
+    b1.close()
+    b2.close()
+
+
+def test_jobs_survive_store_bounce(tmp_path):
+    """The kvstore process restarts mid-job (same sqlite file): watch
+    streams retry, the channel reconnects, and the job completes —
+    the scheduler survives a store outage without losing state."""
+    db = str(tmp_path / "bounce.db")
+    handle = KvStoreHandle(SqliteBackend(db), "127.0.0.1", 0).start()
+    port = handle.port
+    sched, back = _make_scheduler(handle, "sched-BNC")
+    try:
+        sched.state.executor_manager.register_executor(EXEC)
+        ctx = sched.state.session_manager.create_session(
+            {"ballista.shuffle.partitions": "2", "ballista.tpu.enable": "false"}
+        )
+        ctx.register_arrow_table(
+            "t",
+            pa.table({"g": pa.array(["a", "b", "a"]), "v": pa.array([1.0, 2.0, 3.0])}),
+            partitions=2,
+        )
+        plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
+        sched.submit_job("bounce-job", ctx.session_id, plan)
+        assert sched.drain(5.0)
+        ran, _ = _run_one_task(sched)
+        assert ran == 1
+
+        # ---- bounce the store: stop, restart on the SAME port + sqlite
+        handle.stop()
+        new_handle = None
+        deadline = time.time() + 10
+        while new_handle is None and time.time() < deadline:
+            try:
+                new_handle = KvStoreHandle(
+                    SqliteBackend(db), "127.0.0.1", port
+                ).start()
+            except Exception:
+                time.sleep(0.2)
+        assert new_handle is not None, "store could not rebind its port"
+
+        # the channel reconnects; remaining tasks run to completion
+        done = False
+        for _ in range(30):
+            try:
+                ran, pending = _run_one_task(sched)
+            except Exception:
+                time.sleep(0.3)  # channel still reconnecting
+                continue
+            if ran == 0 and pending == 0:
+                done = True
+                break
+        assert done
+        status = sched.state.task_manager.get_job_status("bounce-job")
+        assert status["state"] == "completed", status
+        new_handle.stop()
+    finally:
+        try:
+            sched.stop()
+        except Exception:
+            pass
+        back.close()
+
+
 def _make_scheduler(store, scheduler_id):
     from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
 
