@@ -87,6 +87,8 @@ class BallistaContext:
         policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
         work_dir: Optional[str] = None,
         heartbeat_interval_s: float = 5.0,
+        task_isolation: str = "thread",
+        plugin_dir: str = "",
     ) -> "BallistaContext":
         """In-proc cluster: scheduler + executors over real gRPC/Flight on
         random localhost ports (reference: context.rs:140-210)."""
@@ -102,6 +104,8 @@ class BallistaContext:
                 policy=policy,
                 work_dir=work_dir,
                 heartbeat_interval_s=heartbeat_interval_s,
+                task_isolation=task_isolation,
+                plugin_dir=plugin_dir,
             )
             for _ in range(num_executors)
         ]

@@ -30,6 +30,13 @@ CONFIG_KEYS = {
     "work_dir": (str, "", "shuffle data dir (default: tmp)"),
     "concurrent_tasks": (int, 4, "task slots"),
     "task_scheduling_policy": (str, "pull-staged", "pull-staged | push-staged"),
+    "task_isolation": (
+        str, "thread",
+        "thread | process: 'process' runs file-shuffle tasks in pooled "
+        "worker subprocesses so plan execution (e.g. a GIL-pegging UDF) "
+        "cannot starve Flight serving/CancelTasks/heartbeats (reference "
+        "DedicatedExecutor); device stages always stay in-process",
+    ),
     "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
     "job_data_ttl_seconds": (int, 604800, "delete job dirs older than this"),
@@ -172,7 +179,10 @@ def main(argv=None) -> None:
         grpc_port=cfg["bind_grpc_port"] if policy == TaskSchedulingPolicy.PUSH_STAGED else 0,
         specification=ExecutorSpecification(task_slots=cfg["concurrent_tasks"]),
     )
-    executor = Executor(metadata, work_dir, cfg["concurrent_tasks"])
+    executor = Executor(
+        metadata, work_dir, cfg["concurrent_tasks"],
+        task_isolation=cfg["task_isolation"], plugin_dir=cfg["plugin_dir"],
+    )
     log.info(
         "executor %s starting: flight :%d, policy=%s, work_dir=%s",
         executor.id, flight.port, policy.value, work_dir,
@@ -242,6 +252,7 @@ def main(argv=None) -> None:
             server.stop()
         if janitor is not None:
             janitor.stop(final_sweep=True)
+        executor.shutdown_workers()
         flight.shutdown()
 
 

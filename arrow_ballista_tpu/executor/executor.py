@@ -45,6 +45,84 @@ class LoggingMetricsCollector:
         )
 
 
+class _ProcessWorker:
+    """One persistent task-runner subprocess (see ``task_runner.py``)."""
+
+    def __init__(self, executor_id: str, work_dir: str, plugin_dir: str = ""):
+        import os
+        import subprocess
+        import sys
+
+        args = [
+            sys.executable, "-m", "arrow_ballista_tpu.executor.task_runner",
+            "--executor-id", executor_id, "--work-dir", work_dir,
+        ]
+        if plugin_dir:
+            args += ["--plugin-dir", plugin_dir]
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        self._proc = subprocess.Popen(
+            args, env=env,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        )
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def run(self, task_bytes: bytes) -> Optional[bytes]:
+        """Execute one task; returns TaskStatus bytes or None if the
+        worker died mid-task (killed by cancel, or crashed)."""
+        import struct
+
+        try:
+            self._proc.stdin.write(struct.pack(">I", len(task_bytes)))
+            self._proc.stdin.write(task_bytes)
+            self._proc.stdin.flush()
+            hdr = self._proc.stdout.read(4)
+            if len(hdr) < 4:
+                return None
+            n = struct.unpack(">I", hdr)[0]
+            out = b""
+            while len(out) < n:
+                chunk = self._proc.stdout.read(n - len(out))
+                if not chunk:
+                    return None
+                out += chunk
+            return out
+        except (BrokenPipeError, ValueError, OSError):
+            return None
+
+    def kill(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.kill()
+
+    def close(self) -> None:
+        """Ask for a clean exit; kill if it doesn't comply."""
+        import struct
+
+        try:
+            self._proc.stdin.write(struct.pack(">I", 0))
+            self._proc.stdin.flush()
+            self._proc.wait(timeout=3)
+        except Exception:
+            self.kill()
+
+
+class _WorkerAbort:
+    """Duck-types threading.Event.set() for the abort-handle table: a
+    cancelled process-isolated task dies by worker kill."""
+
+    def __init__(self, worker: _ProcessWorker):
+        self._worker = worker
+
+    def set(self) -> None:
+        self._worker.kill()
+
+
 class Executor:
     def __init__(
         self,
@@ -52,13 +130,19 @@ class Executor:
         work_dir: str,
         concurrent_tasks: int = 4,
         metrics_collector: Optional[LoggingMetricsCollector] = None,
+        task_isolation: str = "thread",
+        plugin_dir: str = "",
     ):
         self.metadata = metadata
         self.work_dir = work_dir
         self.concurrent_tasks = concurrent_tasks
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
+        self.task_isolation = task_isolation
+        self.plugin_dir = plugin_dir
         self._abort_handles: Dict[PartitionId, threading.Event] = {}
         self._abort_lock = threading.Lock()
+        self._idle_workers: List[_ProcessWorker] = []
+        self._worker_lock = threading.Lock()
 
     @property
     def id(self) -> str:
@@ -68,6 +152,8 @@ class Executor:
     def execute_task(self, task: pb.TaskDefinition) -> pb.TaskStatus:
         """Run one shuffle-write task to completion; never raises — any
         error becomes a Failed TaskStatus."""
+        if self.task_isolation == "process" and self._worker_eligible(task):
+            return self._execute_in_worker(task)
         pid = PartitionId.from_proto(task.task_id)
         cancel_event = threading.Event()
         with self._abort_lock:
@@ -126,6 +212,66 @@ class Executor:
         return ShuffleWriterExec(
             pid.job_id, pid.stage_id, inner, self.work_dir, partitioning
         )
+
+    # ---------------------------------------------------- process isolation
+    def _worker_eligible(self, task: pb.TaskDefinition) -> bool:
+        """Process isolation runs tasks whose outputs OUTLIVE the worker:
+        file shuffle (shared work_dir).  Memory-shuffle tasks publish into
+        this process's ``mem://`` store and device stages need this
+        process's XLA client — both keep the thread path."""
+        props = dict(task.props)
+        if props.get("ballista.shuffle.to_memory", "false").lower() in (
+            "true", "1", "yes",
+        ):
+            return False
+        if props.get("ballista.tpu.enable", "true").lower() in (
+            "true", "1", "yes",
+        ):
+            import jax
+
+            # CPU platform: "device" stages are host jit — safe in a
+            # worker.  A real accelerator belongs to THIS process only.
+            if jax.default_backend() != "cpu":
+                return False
+        return True
+
+    def _execute_in_worker(self, task: pb.TaskDefinition) -> pb.TaskStatus:
+        """Run the task in a pooled task-runner subprocess (reference
+        DedicatedExecutor property: plan execution cannot starve Flight
+        serving / CancelTasks / heartbeats in this process)."""
+        pid = PartitionId.from_proto(task.task_id)
+        with self._worker_lock:
+            worker = (
+                self._idle_workers.pop() if self._idle_workers else None
+            )
+        if worker is None or not worker.alive():
+            worker = _ProcessWorker(self.id, self.work_dir, self.plugin_dir)
+        with self._abort_lock:
+            self._abort_handles[pid] = _WorkerAbort(worker)
+        try:
+            out = worker.run(task.SerializeToString())
+        finally:
+            with self._abort_lock:
+                self._abort_handles.pop(pid, None)
+        if out is None:
+            worker.kill()
+            info = TaskInfo(
+                pid, "failed",
+                error="ExecutionError: task worker terminated "
+                      "(cancelled or crashed)",
+            )
+            return task_info_to_proto(info)
+        with self._worker_lock:
+            self._idle_workers.append(worker)
+        status = pb.TaskStatus()
+        status.ParseFromString(out)
+        return status
+
+    def shutdown_workers(self) -> None:
+        with self._worker_lock:
+            workers, self._idle_workers = self._idle_workers, []
+        for w in workers:
+            w.close()
 
     # --------------------------------------------------------------- abort
     def cancel_task(self, pid: PartitionId) -> bool:

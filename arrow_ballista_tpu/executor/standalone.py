@@ -48,6 +48,7 @@ class StandaloneExecutor:
             self.poll_loop.stop()
         if self.server is not None:
             self.server.stop()
+        self.executor.shutdown_workers()
         self.flight.shutdown()
 
 
@@ -59,6 +60,8 @@ def new_standalone_executor(
     policy: TaskSchedulingPolicy = TaskSchedulingPolicy.PULL_STAGED,
     poll_interval_s: float = 0.02,
     heartbeat_interval_s: float = 5.0,
+    task_isolation: str = "thread",
+    plugin_dir: str = "",
 ) -> StandaloneExecutor:
     """Start an in-proc executor registered with the given scheduler.
 
@@ -74,7 +77,10 @@ def new_standalone_executor(
         grpc_port=0,
         specification=ExecutorSpecification(task_slots=concurrent_tasks),
     )
-    executor = Executor(metadata, work_dir, concurrent_tasks)
+    executor = Executor(
+        metadata, work_dir, concurrent_tasks,
+        task_isolation=task_isolation, plugin_dir=plugin_dir,
+    )
 
     if policy == TaskSchedulingPolicy.PUSH_STAGED:
         server = ExecutorServer(

@@ -1,0 +1,88 @@
+"""Process-isolated task worker (the DedicatedExecutor slot).
+
+Counterpart of the reference's ``executor/src/cpu_bound_executor.rs:37-131``:
+CPU-bound plan execution must not be able to starve the executor's service
+plane — Flight shuffle serving, CancelTasks, heartbeats.  The reference
+isolates with a second prioritized tokio runtime; a Python executor
+isolates with a second PROCESS: the worker executes the (protobuf) task
+plan against the shared ``work_dir`` and the parent's GIL never runs plan
+code, so a pure-Python UDF pegging every worker cannot slow a downstream
+stage's shuffle fetch.
+
+Protocol (stdin/stdout, length-prefixed): the parent writes
+``[u32 BE len][TaskDefinition]``; the worker replies
+``[u32 BE len][TaskStatus]``.  ``len == 0`` → clean exit; stdin EOF (the
+parent died) → exit.  The worker pins the CPU platform before anything
+touches jax — device stages belong to the PARENT process (XLA client
+state is per-process), which keeps the in-thread path for them; the
+executor only routes memory-shuffle-free tasks here.
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+
+
+def _read_exact(f, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="arrow_ballista_tpu.executor.task_runner"
+    )
+    parser.add_argument("--executor-id", required=True)
+    parser.add_argument("--work-dir", required=True)
+    parser.add_argument("--plugin-dir", default="")
+    args = parser.parse_args()
+
+    # never the device: a second process must not try to claim the chip
+    # (the env var alone loses to a session-level platform pin)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..proto import pb
+    from ..serde.scheduler_types import ExecutorMetadata, ExecutorSpecification
+    from ..udf import load_udf_plugins
+    from .executor import Executor
+
+    if args.plugin_dir:
+        load_udf_plugins(args.plugin_dir)
+    metadata = ExecutorMetadata(
+        args.executor_id, "127.0.0.1", 0, 0, ExecutorSpecification(1)
+    )
+    ex = Executor(metadata, args.work_dir, concurrent_tasks=1)
+
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    while True:
+        hdr = _read_exact(stdin, 4)
+        if hdr is None:
+            return  # parent died or closed us
+        n = struct.unpack(">I", hdr)[0]
+        if n == 0:
+            return  # clean shutdown
+        payload = _read_exact(stdin, n)
+        if payload is None:
+            return
+        task = pb.TaskDefinition()
+        task.ParseFromString(payload)
+        status = ex.execute_task(task)  # never raises
+        out = status.SerializeToString()
+        stdout.write(struct.pack(">I", len(out)))
+        stdout.write(out)
+        stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

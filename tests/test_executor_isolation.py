@@ -137,6 +137,172 @@ def test_sidecar_beats_without_parent_threads(tmp_path):
         bctx.close()
 
 
+_BURN_PLUGIN = '''
+import time
+
+import pyarrow as pa
+
+
+def _burn(seconds):
+    def fn(arr):
+        end = time.time() + seconds
+        acc = 0
+        while time.time() < end:
+            # one long C call per iteration: sum(range(...)) never reaches
+            # a bytecode switch point, so the GIL is held for its whole
+            # duration — the worst starvation payload a UDF can produce
+            acc += sum(range(10**8))
+        return pa.array([1.0] * len(arr), pa.float64())
+    return fn
+
+
+def register_udfs(registry):
+    from arrow_ballista_tpu.udf import ScalarUDF
+    registry.register_scalar(
+        ScalarUDF("burn_hard", _burn(4.0), (pa.float64(),), pa.float64())
+    )
+    registry.register_scalar(
+        ScalarUDF("burn_long", _burn(20.0), (pa.float64(),), pa.float64())
+    )
+'''
+
+
+def _process_cluster(tmp_path, **kw):
+    import os
+
+    plugin_dir = str(tmp_path / "plugins")
+    os.makedirs(plugin_dir, exist_ok=True)
+    with open(os.path.join(plugin_dir, "burn.py"), "w") as f:
+        f.write(_BURN_PLUGIN)
+    # the scheduler process needs the UDFs too (schema inference)
+    from arrow_ballista_tpu.udf import load_udf_plugins
+
+    load_udf_plugins(plugin_dir)
+    return BallistaContext.standalone(
+        config=BallistaConfig(
+            {"ballista.shuffle.partitions": "2", "ballista.tpu.enable": "false"}
+        ),
+        work_dir=str(tmp_path / "wd"),
+        concurrent_tasks=2,
+        task_isolation="process",
+        plugin_dir=plugin_dir,
+        **kw,
+    )
+
+
+def test_flight_serving_survives_gil_holding_task(tmp_path):
+    """The reference DedicatedExecutor property (cpu_bound_executor.rs:
+    37-131): plan execution must not starve shuffle serving.  With
+    task_isolation=process, a downstream-style Flight fetch completes
+    promptly while BOTH task slots run a UDF that holds the GIL inside
+    multi-second C calls — in thread mode those calls would freeze the
+    executor's Python Flight handler for their whole duration."""
+    import glob
+    import os
+    import threading
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.flight.client import BallistaClient
+
+    bctx = _process_cluster(tmp_path)
+    try:
+        exec_handle = bctx._standalone_handles[1][0]
+        work_dir = exec_handle.executor.work_dir
+        flight_port = exec_handle.flight.port
+
+        bctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table({"x": pa.array([1.0, 2.0, 3.0, 4.0])}), 2
+            ),
+        )
+        # a completed stage leaves shuffle files to serve downstream
+        out0 = bctx.sql("select x, sum(x) as s from t group by x").collect()
+        assert out0.num_rows == 4
+        files = [
+            p
+            for p in glob.glob(os.path.join(work_dir, "**", "*"), recursive=True)
+            if os.path.isfile(p)
+        ]
+        assert files, "no shuffle files on disk"
+        target = max(files, key=os.path.getsize)
+
+        results, errors = [], []
+
+        def run_burn():
+            try:
+                results.append(
+                    bctx.sql("select sum(burn_hard(x)) as s from t").collect()
+                )
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        burner = threading.Thread(target=run_burn)
+        burner.start()
+        time.sleep(1.0)  # let both worker processes enter the burn
+
+        client = BallistaClient.get("127.0.0.1", flight_port)
+        latencies = []
+        for _ in range(6):
+            t0 = time.time()
+            batches = list(client.fetch_partition("j", 1, 0, target))
+            latencies.append(time.time() - t0)
+            assert batches is not None
+            time.sleep(0.2)
+        burner.join(timeout=60)
+        assert not errors, errors
+        assert results and results[0].column("s")[0].as_py() == 4.0
+        # each fetch must come back far inside one GIL-hold period (~2-4s);
+        # generous bound for the 1-core CI box under full CPU contention
+        assert max(latencies) < 2.0, latencies
+    finally:
+        bctx.close()
+
+
+def test_cancel_kills_process_isolated_task(tmp_path):
+    """CancelTasks on a process-isolated task kills the worker: the
+    20s-burn job dies promptly instead of running to completion."""
+    import threading
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    bctx = _process_cluster(tmp_path)
+    try:
+        exec_handle = bctx._standalone_handles[1][0]
+        executor = exec_handle.executor
+
+        bctx.register_table(
+            "t",
+            MemoryTable.from_table(pa.table({"x": pa.array([1.0, 2.0])}), 2),
+        )
+        outcome = {}
+
+        def run():
+            t0 = time.time()
+            try:
+                bctx.sql("select sum(burn_long(x)) as s from t").collect()
+                outcome["state"] = "completed"
+            except Exception as e:
+                outcome["state"] = "failed"
+                outcome["error"] = str(e)
+            outcome["wall"] = time.time() - t0
+
+        th = threading.Thread(target=run)
+        th.start()
+        deadline = time.time() + 15
+        while executor.active_task_count() == 0 and time.time() < deadline:
+            time.sleep(0.1)
+        assert executor.active_task_count() > 0, "burn task never started"
+        cancelled = executor.cancel_all()
+        assert cancelled > 0
+        th.join(timeout=30)
+        assert outcome.get("state") == "failed", outcome
+        # 20s burn died early: cancellation reached the worker process
+        assert outcome["wall"] < 15, outcome
+    finally:
+        bctx.close()
+
+
 def test_sidecar_exits_when_parent_dies():
     """A sidecar bound to a dead parent pid exits by itself (it must never
     keep a dead executor looking alive)."""
