@@ -281,12 +281,16 @@ def _value_fn(spec: WindowSpec, st: "_SortState", eval_col) -> pa.Array:
     elif spec.func == "last_value":
         src, ok = _last_of_group(st.peer_flag, n), np.ones(n, dtype=bool)
     elif spec.func == "lag":
+        # clamp BOTH frame sides: a negative offset (unreachable from SQL
+        # but possible via serde / programmatic WindowSpec) reads forward,
+        # so the partition end must bound it too
+        seg_last = _last_of_group(st.seg_flag, n)
         src = idx - spec.offset
-        ok = src >= st.seg_first
+        ok = (src >= st.seg_first) & (src <= seg_last)
     else:  # lead
         seg_last = _last_of_group(st.seg_flag, n)
         src = idx + spec.offset
-        ok = src <= seg_last
+        ok = (src <= seg_last) & (src >= st.seg_first)
     taken = vs.take(pa.array(np.clip(src, 0, max(n - 1, 0))))
     if ok.all():
         return taken

@@ -153,6 +153,11 @@ class _ReadAhead:
     CPU — otherwise the abandoned thread would keep consuming the old
     source concurrently with the re-run's fresh iterator (a double-read
     of e.g. a Flight stream) and then block on the bounded queue forever.
+    Residual race: a pump already blocked INSIDE the source's read when
+    ``close()`` lands cannot be interrupted and may consume ONE more item
+    before it sees the flag (the item is dropped, never yielded); the
+    double-read window is mitigated to that single in-flight read, not
+    eliminated.
     """
 
     _DONE = object()
@@ -168,6 +173,8 @@ class _ReadAhead:
         def pump():
             try:
                 for item in it:
+                    if self._closed:
+                        return  # drop: a fallback re-run owns the source
                     self._q.put(item)
                     if self._closed:
                         return

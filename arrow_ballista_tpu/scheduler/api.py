@@ -47,7 +47,8 @@ DASHBOARD_HTML = """<!doctype html>
 let openJob = null;
 let openJobTerminal = false;  // completed/failed details are immutable: no re-fetch
 function esc(s) {
-  return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;').replace(/>/g, '&gt;');
+  return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;').replace(/>/g, '&gt;')
+    .replace(/"/g, '&quot;').replace(/'/g, '&#39;');
 }
 async function showDetail(jobId) {
   openJob = jobId;
@@ -100,10 +101,17 @@ async function refresh() {
     const jtb = document.querySelector('#jobs tbody');
     jtb.innerHTML = '';
     for (const j of jobs.jobs) {
-      const id = esc(j.job_id);
+      // no inline handlers: the raw id rides a data- attribute (read back
+      // via dataset, so escaping concerns stay purely textual)
       jtb.insertAdjacentHTML('beforeend',
-        `<tr><td>${id}</td><td>${esc(j.state)}</td>` +
-        `<td><a href="#" onclick="showDetail('${id}'); return false;">detail</a></td></tr>`);
+        `<tr><td>${esc(j.job_id)}</td><td>${esc(j.state)}</td>` +
+        `<td><a href="#" class="detail-link" data-job="${esc(j.job_id)}">detail</a></td></tr>`);
+    }
+    for (const a of jtb.querySelectorAll('a.detail-link')) {
+      a.addEventListener('click', (ev) => {
+        ev.preventDefault();
+        showDetail(a.dataset.job);
+      });
     }
     if (openJob && !openJobTerminal) showDetail(openJob);
   } catch (err) {

@@ -71,20 +71,39 @@ def _collect_stage_metrics(plan) -> dict:
 
 def _tables_match(a, b, rel: float = 1e-6) -> bool:
     """CPU-vs-TPU oracle comparison: align rows on the non-float columns
-    (floats differ sub-tolerance between the paths and would scramble tie
-    ordering), then compare floats to ``rel`` and everything else exactly."""
+    first, then on floats ROUNDED to ~8 significant digits (sub-tolerance
+    float diffs between the paths must not scramble tie ordering when
+    rows agree on every non-float key), then compare floats to ``rel``
+    and everything else exactly."""
     import pyarrow as pa
 
     if a.num_rows != b.num_rows:
         return False
     if a.num_rows and a.column_names:
-        keys = [
-            (c, "ascending")
-            for c in a.column_names
-            if not pa.types.is_floating(a.schema.field(c).type)
-        ]
-        if keys:
-            a, b = a.sort_by(keys), b.sort_by(keys)
+
+        def sorted_rounded(t):
+            keys = []
+            drop = []
+            for c in t.column_names:
+                if not pa.types.is_floating(t.schema.field(c).type):
+                    keys.append((c, "ascending"))
+                    continue
+                kc = f"__sortkey_{c}"
+                t = t.append_column(
+                    kc,
+                    pa.array(
+                        [
+                            None if x is None else "%.8e" % x
+                            for x in t.column(c).to_pylist()
+                        ]
+                    ),
+                )
+                keys.append((kc, "ascending"))
+                drop.append(kc)
+            t = t.sort_by(keys)
+            return t.drop_columns(drop) if drop else t
+
+        a, b = sorted_rounded(a), sorted_rounded(b)
     for name in a.column_names:
         for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
             if isinstance(x, float) and isinstance(y, float):
