@@ -1561,6 +1561,15 @@ def maybe_accelerate(plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPl
     kids = plan.children()
     if kids:
         plan = plan.with_new_children([maybe_accelerate(c, config) for c in kids])
+    from ..exec.window import WindowExec
+
+    if isinstance(plan, WindowExec):
+        from .window_compiler import TpuWindowExec
+
+        try:
+            return TpuWindowExec(plan, config)
+        except K.NotLowerable:
+            return plan
     if isinstance(plan, HashAggregateExec) and plan.mode in (PARTIAL, SINGLE):
         if any(a.func == "count_distinct" for a in plan.aggs):
             return plan

@@ -1,0 +1,405 @@
+"""Device lowering of WindowExec (VERDICT r3 item 7).
+
+Pure TPU-first differentiation: the reference's distributed planner
+raises NotImplemented for WindowAggExec (``scheduler/src/planner.rs:81-
+170``); this engine evaluates eligible window stages as ONE device
+program per window signature (``ops/window_kernel.py``): multi-key sort,
+boundary flags, segmented scans, gathers, packed fetch.
+
+Host responsibilities here:
+* eligibility (plan time): supported function set, default RANGE frames,
+  numeric/date ORDER BY, numeric arguments — anything else stays on the
+  vectorized CPU path (``exec/window.py``), which remains the oracle;
+* ORDER-preserving integer key encoding: every ORDER BY key becomes a
+  null-rank flag plus integer key(s) whose SIGNED order equals the SQL
+  order — an i64 in x64 mode, an (hi, lo) i32 pair in x32 mode, so f64 /
+  i64 / date keys sort EXACTLY on a device without 64-bit dtypes (tie
+  structure, and therefore rank/dense_rank, cannot drift);
+* PARTITION BY keys ride the group-key encoders (identity / dict codes —
+  equality-only, which is all partitioning needs);
+* output materialization: bitcast unpack, empty-frame NULL masks, dtype
+  casts mirroring the CPU operator.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..config import BallistaConfig
+from ..errors import ExecutionError
+from ..exec.operators import ExecutionPlan, Partitioning, TaskContext
+from ..exec.window import RANKING, VALUE_FNS, WindowExec, WindowSpec
+from . import kernels as K
+from .bridge import arrow_to_numpy, make_key_encoder
+
+_AGG_FNS = {"sum", "avg", "min", "max", "count"}
+
+
+# ------------------------------------------------------- key encoding
+def _to_u64_order(values: np.ndarray) -> np.ndarray:
+    """uint64 whose unsigned order equals the values' natural order."""
+    if values.dtype.kind == "f":
+        v = values.astype(np.float64)
+        bits = v.view(np.uint64)
+        neg = (bits >> np.uint64(63)) == 1
+        mask = np.where(
+            neg,
+            np.uint64(0xFFFFFFFFFFFFFFFF),
+            np.uint64(1) << np.uint64(63),
+        )
+        return bits ^ mask
+    return values.astype(np.int64).view(np.uint64) ^ (
+        np.uint64(1) << np.uint64(63)
+    )
+
+
+def _split_u64(u: np.ndarray, mode: str) -> list:
+    """Integer key arrays whose lexicographic SIGNED order equals the
+    unsigned order of ``u``: one i64 (x64) or an (hi, lo) i32 pair."""
+    if mode == "x64":
+        return [(u ^ (np.uint64(1) << np.uint64(63))).view(np.int64)]
+    hi = (u >> np.uint64(32)).astype(np.int64) - (1 << 31)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.int64) - (1 << 31)
+    return [hi.astype(np.int32), lo.astype(np.int32)]
+
+
+def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],
+                mode: str) -> list:
+    """[null_rank, key...] integer arrays for one ORDER BY expression."""
+    if nulls_first is None:
+        nulls_first = not asc  # SQL default: NULLS LAST for ASC
+    t = arr.type
+    if not (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_date(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_timestamp(t)
+        or pa.types.is_decimal(t)
+    ):
+        raise K.NotLowerable(f"window ORDER BY type {t}")
+    if pa.types.is_decimal(t):
+        import pyarrow.compute as pc
+
+        arr = pc.cast(arr, pa.float64())
+    if pa.types.is_boolean(t):
+        import pyarrow.compute as pc
+
+        arr = pc.cast(arr, pa.int32())
+    values, validity = arrow_to_numpy(arr)
+    u = _to_u64_order(values)
+    if not asc:
+        u = ~u
+    if validity is None:
+        null_rank = np.zeros(len(values), dtype=np.int32)
+    else:
+        is_null = ~validity
+        null_rank = np.where(is_null, 0 if nulls_first else 1,
+                             1 if nulls_first else 0).astype(np.int32)
+        u = np.where(is_null, np.uint64(0), u)  # nulls are peers
+    return [null_rank] + _split_u64(u, mode)
+
+
+class TpuWindowExec(ExecutionPlan):
+    """WindowExec evaluated on device; falls back to the CPU operator
+    per partition on runtime ineligibility (no source re-scan — windows
+    buffer their input anyway)."""
+
+    def __init__(self, original: WindowExec, config: BallistaConfig):
+        super().__init__()
+        self.original = original
+        self.input = original.input
+        self.config = config
+        self._mode = K.precision_mode()
+        # group specs by window signature (like the CPU operator): one
+        # kernel invocation per distinct (PARTITION BY, ORDER BY)
+        self._groups: dict = {}
+        schema = original.input.schema
+        for pos, spec in enumerate(original.specs):
+            self._check_spec(spec)
+            for e, _a, _nf in spec.order_by:
+                t = K._infer_pa_type(e, schema)
+                if not (
+                    pa.types.is_integer(t)
+                    or pa.types.is_floating(t)
+                    or pa.types.is_date(t)
+                    or pa.types.is_boolean(t)
+                    or pa.types.is_timestamp(t)
+                    or pa.types.is_decimal(t)
+                ):
+                    raise K.NotLowerable(f"window ORDER BY type {t}")
+            if spec.arg is not None:
+                t = K._infer_pa_type(spec.arg, schema)
+                if not (
+                    pa.types.is_integer(t)
+                    or pa.types.is_floating(t)
+                    or pa.types.is_date(t)
+                    or pa.types.is_boolean(t)
+                    or pa.types.is_decimal(t)
+                ):
+                    raise K.NotLowerable(f"window argument type {t}")
+            sig = (
+                tuple(str(p) for p in spec.partition_by),
+                tuple((str(e), a, nf) for e, a, nf in spec.order_by),
+            )
+            self._groups.setdefault(sig, []).append((pos, spec))
+
+    def _check_spec(self, spec: WindowSpec) -> None:
+        if spec.frame is not None:
+            raise K.NotLowerable("window ROWS frame")  # CPU handles these
+        if spec.func in RANKING:
+            return
+        if spec.func in VALUE_FNS:
+            if spec.offset < 0:
+                raise K.NotLowerable("negative lag/lead offset")
+            return
+        if spec.func not in _AGG_FNS:
+            raise K.NotLowerable(f"window fn {spec.func}")
+        if spec.arg is None and spec.func != "count":
+            raise K.NotLowerable(f"window {spec.func} without argument")
+
+    # ------------------------------------------------------------- plan
+    @property
+    def schema(self) -> pa.Schema:
+        return self.original.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return self.original.output_partitioning()
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        new_original = self.original.with_new_children(children)
+        try:
+            return TpuWindowExec(new_original, self.config)
+        except K.NotLowerable:
+            return new_original
+
+    def __str__(self) -> str:
+        return "TpuWindowExec: " + ", ".join(
+            f"{s.func}->{s.name}" for s in self.original.specs
+        )
+
+    # ---------------------------------------------------------- execute
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        batches = list(self.input.execute(partition, ctx))
+        if not batches:
+            return
+        n = sum(b.num_rows for b in batches)
+        if n == 0 or n < self.config.tpu_min_rows:
+            yield from self._cpu(batches, partition, ctx)
+            return
+        try:
+            with self.metrics.timer("window_time_ns"):
+                win_cols = self._device_eval(batches, n)
+        except (K.NotLowerable, ExecutionError, RuntimeError) as e:
+            self.metrics.add("tpu_fallback", 1)
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "window device path fell back: %s", e
+            )
+            yield from self._cpu(batches, partition, ctx)
+            return
+        table = pa.Table.from_batches(batches, schema=self.input.schema)
+        out = table
+        for spec, col in zip(self.original.specs, win_cols):
+            out = out.append_column(pa.field(spec.name, spec.out_type), col)
+        self.metrics.add("output_rows", out.num_rows)
+        self.metrics.add("tpu_window", 1)
+        for b in out.to_batches(max_chunksize=ctx.batch_size):
+            yield b
+
+    def _cpu(self, batches, partition, ctx):
+        from .stage_compiler import _BufferedExec
+
+        cpu = self.original.with_new_children(
+            [_BufferedExec(self.input, batches)]
+        )
+        cpu.metrics = self.metrics
+        yield from cpu.execute(partition, ctx)
+
+    # ------------------------------------------------------ device eval
+    def _device_eval(self, batches, n: int) -> list:
+        mode = self._mode
+
+        def eval_col(e):
+            parts = []
+            for b in batches:
+                v = e.evaluate(b)
+                if isinstance(v, pa.Scalar):
+                    v = pa.array([v.as_py()] * b.num_rows, type=v.type)
+                parts.append(v)
+            arr = (
+                pa.chunked_array(parts).combine_chunks()
+                if len(parts) > 1
+                else parts[0]
+            )
+            return arr
+
+        n_pad = K.bucket_rows(n)
+        is_pad = np.zeros(n_pad, dtype=np.int32)
+        is_pad[n:] = 1
+
+        win_cols: list = [None] * len(self.original.specs)
+        for sig, members in self._groups.items():
+            spec0 = members[0][1]
+            # ---- keys
+            part_keys: list = [is_pad]
+            for p in spec0.partition_by:
+                codes = make_key_encoder(
+                    K._infer_pa_type(p, self.input.schema)
+                ).encode(eval_col(p))
+                u = _to_u64_order(codes.astype(np.int64))
+                part_keys.extend(
+                    K._pad(k, n_pad) for k in _split_u64(u, mode)
+                )
+            order_keys: list = []
+            for e, asc, nf in spec0.order_by:
+                for k in _order_keys(eval_col(e), asc, nf, mode):
+                    order_keys.append(K._pad(k, n_pad))
+
+            # ---- args (deduped per expression)
+            slot_of: dict = {}
+            args: list = []
+            kspecs: list = []
+            for _pos, spec in members:
+                kspecs.append(self._kernel_spec(spec, slot_of, args,
+                                                eval_col, n_pad))
+            from .window_kernel import make_window_kernel
+
+            kernel = make_window_kernel(
+                tuple(kspecs), len(part_keys), len(order_keys),
+                len(args), mode,
+            )
+            packed = np.asarray(
+                kernel(tuple(part_keys), tuple(order_keys), tuple(args))
+            )
+            self._unpack(packed, members, kspecs, n, win_cols)
+        return win_cols
+
+    def _kernel_spec(self, spec, slot_of, args, eval_col, n_pad):
+        if spec.func == "ntile":
+            return ("ntile", spec.offset)
+        if spec.func in RANKING:
+            return (spec.func,)
+        if spec.func == "count" and spec.arg is None:
+            return ("agg", "count", None)
+        # argument slot (value + validity), padded & coerced
+        key = str(spec.arg)
+        slot = slot_of.get(key)
+        if slot is None:
+            arr = eval_col(spec.arg)
+            t = arr.type
+            if not (
+                pa.types.is_integer(t)
+                or pa.types.is_floating(t)
+                or pa.types.is_date(t)
+                or pa.types.is_boolean(t)
+                or pa.types.is_decimal(t)
+            ):
+                raise K.NotLowerable(f"window argument type {t}")
+            if pa.types.is_decimal(t) or pa.types.is_boolean(t):
+                import pyarrow.compute as pc
+
+                arr = pc.cast(arr, pa.float64())
+            values, validity = arrow_to_numpy(arr)
+            values = K.coerce_host_values(values)
+            if validity is None:
+                validity = np.ones(len(values), dtype=bool)
+            slot = len(args)
+            args.append(
+                (K._pad(values, n_pad), K._pad(validity, n_pad))
+            )
+            slot_of[key] = slot
+        if spec.func in VALUE_FNS:
+            return ("val", spec.func, slot, spec.offset)
+        return ("agg", spec.func, slot)
+
+    # -------------------------------------------------------- unpack
+    def _unpack(self, packed, members, kspecs, n, win_cols) -> None:
+        mode = self._mode
+        fdt = np.float64 if mode == "x64" else np.float32
+        ri = 0
+
+        def int_row():
+            nonlocal ri
+            r = packed[ri][:n]
+            ri += 1
+            return r
+
+        def float_row():
+            nonlocal ri
+            r = packed[ri][:n].view(fdt).astype(np.float64)
+            ri += 1
+            return r
+
+        for (pos, spec), kspec in zip(members, kspecs):
+            kind = kspec[0]
+            if kind in ("row_number", "rank", "dense_rank", "ntile"):
+                col = pa.array(int_row().astype(np.int64), pa.int64())
+            elif kind == "agg":
+                fn = kspec[1]
+                if fn == "count":
+                    col = pa.array(int_row().astype(np.int64), pa.int64())
+                elif fn in ("sum", "avg"):
+                    if mode == "x32":
+                        v = float_row() + float_row()
+                    else:
+                        v = float_row()
+                    cnt = int_row()
+                    empty = cnt == 0
+                    if fn == "avg":
+                        denom = np.where(empty, 1, cnt)
+                        col = pa.array(v / denom, pa.float64(), mask=empty)
+                    elif pa.types.is_integer(spec.out_type):
+                        vi = np.round(
+                            np.where(np.isfinite(v), v, 0.0)
+                        ).astype(np.int64)
+                        col = pa.array(vi, pa.int64(), mask=empty)
+                    else:
+                        col = pa.array(v, pa.float64(), mask=empty)
+                else:  # min / max
+                    if pa.types.is_integer(spec.out_type) or pa.types.is_date(
+                        spec.out_type
+                    ):
+                        v = int_row().astype(np.int64)
+                        cnt = int_row()
+                        empty = cnt == 0
+                        col = pa.array(
+                            np.where(empty, 0, v), pa.int64(), mask=empty
+                        )
+                    else:
+                        v = float_row()
+                        cnt = int_row()
+                        empty = cnt == 0
+                        col = pa.array(
+                            np.where(empty, 0.0, v), pa.float64(),
+                            mask=empty,
+                        )
+            else:  # val fns
+                int_arg = pa.types.is_integer(spec.out_type) or (
+                    pa.types.is_date(spec.out_type)
+                )
+                v = (
+                    int_row().astype(np.int64)
+                    if int_arg
+                    else float_row()
+                )
+                ok = int_row() != 0
+                col = pa.array(
+                    np.where(ok, v, 0),
+                    pa.int64() if int_arg else pa.float64(),
+                    mask=~ok,
+                )
+            if not col.type.equals(spec.out_type):
+                import pyarrow.compute as pc
+
+                col = pc.cast(col, spec.out_type, safe=False)
+            win_cols[pos] = col

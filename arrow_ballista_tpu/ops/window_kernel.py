@@ -1,0 +1,303 @@
+"""Device window-function kernel (sort + segmented scans + gathers).
+
+TPU-first lowering of :class:`~..exec.window.WindowExec` — a capability
+the reference lacks entirely (its distributed planner raises
+NotImplemented for WindowAggExec, ``scheduler/src/planner.rs:81-170``):
+
+* ONE multi-key integer ``lax.sort`` orders rows by (pad flag, PARTITION
+  BY codes, per-ORDER-BY null flag + order-preserving integer encoding);
+  the host pre-encodes every key into integers whose signed order equals
+  the SQL order (``window_compiler._order_encode``), so the device sort
+  is exact for any numeric/date/dict key in BOTH dtype modes;
+* partition / peer boundaries fall out of key-change flags; ranking
+  functions are arithmetic over boundary indices; running (default
+  RANGE) aggregates are ONE segmented inclusive ``associative_scan``
+  with reset-at-boundary (df32-compensated sums in x32, the same 2Sum
+  discipline as the aggregate kernels); value functions are clamped
+  gathers;
+* results return to INPUT row order via an inverse-permutation GATHER
+  (scatter serializes on TPU; ``sort_key_val(perm, iota)`` gives the
+  inverse as a second sort), and one packed fetch moves every output
+  column in a single tunnel roundtrip.
+
+Spec encoding (static per kernel): tuples
+  ("row_number",) | ("rank",) | ("dense_rank",) | ("ntile", k)
+  | ("agg", fn, arg_slot)            # fn in sum|count|avg|min|max, RANGE
+  | ("val", fn, arg_slot, offset)    # fn in lag|lead|first_value|last_value
+arg slots index the (value, validity) array pairs passed after the keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import kernels as K
+
+_WINDOW_KERNEL_CACHE: dict = {}
+
+
+def _seg_first(flag, idx):
+    """Per sorted row: index of its segment's first row (cummax trick)."""
+    return jax.lax.cummax(jnp.where(flag, idx, 0))
+
+
+def _seg_last(flag, n):
+    """Per sorted row: index of its segment's LAST row.  A row is last
+    when the next row starts a new segment (or is the final row); in the
+    flipped array those become segment firsts."""
+    last_marker = jnp.concatenate(
+        [flag[1:], jnp.ones((1,), jnp.bool_)]
+    )
+    fm = jnp.flip(last_marker)
+    fidx = jnp.arange(n, dtype=jnp.int32)
+    ffirst = jax.lax.cummax(jnp.where(fm, fidx, 0))
+    return (n - 1) - jnp.flip(ffirst)
+
+
+def _change_flag(keys: list):
+    """flag[i] = row i differs from row i-1 on ANY key (row 0 starts)."""
+    diff = keys[0][1:] != keys[0][:-1]
+    for k in keys[1:]:
+        diff = jnp.logical_or(diff, k[1:] != k[:-1])
+    return jnp.concatenate([jnp.ones((1,), jnp.bool_), diff])
+
+
+def _seg_scan(flag, elems: list, kinds: list):
+    """Segmented inclusive scan resetting at ``flag``.
+
+    kinds per element: "df32" (the element is an (hi, lo) pair summed
+    with 2Sum compensation), "sum" (plain add), "min", "max".  Returns
+    per-row scanned values in the same structure.
+    """
+    flat = [flag]
+    layout = []
+    for kind, e in zip(kinds, elems):
+        if kind == "df32":
+            layout.append((kind, len(flat)))
+            flat.extend(e)
+        else:
+            layout.append((kind, len(flat)))
+            flat.append(e)
+    flat_kinds = ["flag"]
+    for kind, _ in layout:
+        flat_kinds.extend(
+            ["df32_hi", "df32_lo"] if kind == "df32" else [kind]
+        )
+
+    def combine(a, b):
+        fb = b[0]
+        out = [jnp.logical_or(a[0], fb)]
+        i = 1
+        while i < len(flat_kinds):
+            kind = flat_kinds[i]
+            if kind == "df32_hi":
+                s, e = K._two_sum(a[i], b[i])
+                hi, lo2 = K._two_sum(s, a[i + 1] + b[i + 1] + e)
+                out.append(jnp.where(fb, b[i], hi))
+                out.append(jnp.where(fb, b[i + 1], lo2))
+                i += 2
+                continue
+            if kind == "sum":
+                merged = a[i] + b[i]
+            elif kind == "min":
+                merged = jnp.minimum(a[i], b[i])
+            else:  # max
+                merged = jnp.maximum(a[i], b[i])
+            out.append(jnp.where(fb, b[i], merged))
+            i += 1
+        return tuple(out)
+
+    scanned = jax.lax.associative_scan(combine, tuple(flat))
+    outs = []
+    for kind, slot in layout:
+        if kind == "df32":
+            outs.append((scanned[slot], scanned[slot + 1]))
+        else:
+            outs.append(scanned[slot])
+    return outs
+
+
+def make_window_kernel(
+    specs: tuple,
+    n_part_keys: int,
+    n_order_keys: int,
+    n_args: int,
+    mode: str,
+):
+    """Jitted ``fn(part_keys, order_keys, valid, args) -> packed``.
+
+    ``part_keys``/``order_keys`` are tuples of integer key arrays (the
+    pad flag is part_keys[0]); ``args`` is a tuple of (value, validity)
+    pairs.  ``packed`` is an [n_out_rows, n] integer array in INPUT row
+    order — float rows bitcast exactly like the aggregate packed fetch.
+    Per-spec output layout (host side must mirror):
+      ranking/ntile → 1 int row
+      agg count     → 1 int row
+      agg sum/avg   → x32: hi, lo, cnt  | x64: val, cnt
+      agg min/max   → val, cnt
+      val fns       → val (arg dtype), ok flag
+    """
+    cache_key = (specs, n_part_keys, n_order_keys, n_args, mode,
+                 jax.default_backend())
+    fn = _WINDOW_KERNEL_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+
+    fdt = jnp.float64 if mode == "x64" else jnp.float32
+    idt = jnp.int64 if mode == "x64" else jnp.int32
+
+    def kernel(part_keys, order_keys, args):
+        n = part_keys[0].shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        all_keys = tuple(part_keys) + tuple(order_keys)
+        sorted_ = jax.lax.sort(
+            all_keys + (iota,), num_keys=len(all_keys)
+        )
+        perm = sorted_[-1]
+        s_part = sorted_[: len(part_keys)]
+        s_all = sorted_[:-1]
+        # inverse permutation as a SORT (gather-friendly), not a scatter
+        _, inv = jax.lax.sort_key_val(perm, iota)
+
+        idx = jnp.arange(n, dtype=jnp.int32)
+        seg_flag = _change_flag(list(s_part))
+        peer_flag = _change_flag(list(s_all))
+        seg_first = _seg_first(seg_flag, idx)
+        peer_last = _seg_last(peer_flag, n)
+
+        s_args = [
+            (a[0][perm], a[1][perm]) for a in args
+        ]
+
+        rows: list = []  # (array, is_int) in sorted order pre-inverse
+
+        def emit(arr, is_int):
+            rows.append((arr, is_int))
+
+        # lazily-computed shared quantities
+        shared: dict = {}
+
+        def get(name):
+            if name in shared:
+                return shared[name]
+            if name == "seg_last":
+                v = _seg_last(seg_flag, n)
+            elif name == "peer_first":
+                v = _seg_first(peer_flag, idx)
+            elif name == "peers_cum":
+                v = jnp.cumsum(peer_flag.astype(jnp.int32))
+            else:
+                raise KeyError(name)
+            shared[name] = v
+            return v
+
+        for spec in specs:
+            kind = spec[0]
+            if kind == "row_number":
+                emit(idx - seg_first + 1, True)
+                continue
+            if kind == "rank":
+                emit(get("peer_first") - seg_first + 1, True)
+                continue
+            if kind == "dense_rank":
+                pc_ = get("peers_cum")
+                emit(pc_ - pc_[seg_first] + 1, True)
+                continue
+            if kind == "ntile":
+                k = spec[1]
+                seg_last = get("seg_last")
+                sizes = seg_last - seg_first + 1
+                pos = idx - seg_first
+                q, r = sizes // k, sizes % k
+                big = r * (q + 1)
+                in_big = pos < big
+                bucket_big = pos // (q + 1) + 1
+                bucket_small = r + (pos - big) // jnp.maximum(q, 1) + 1
+                emit(jnp.where(in_big, bucket_big, bucket_small), True)
+                continue
+            if kind == "agg":
+                _, fn_name, slot = spec
+                if fn_name == "count" and slot is None:
+                    # count(*): rows from segment start through last peer
+                    cnt = idx - seg_first + 1
+                    emit(cnt[peer_last], True)
+                    continue
+                val, avalid = s_args[slot]
+                m = avalid
+                cnt_run = _seg_scan(
+                    seg_flag, [m.astype(jnp.int32)], ["sum"]
+                )[0]
+                if fn_name == "count":
+                    emit(cnt_run[peer_last], True)
+                    continue
+                if fn_name in ("sum", "avg"):
+                    if mode == "x32":
+                        h = jnp.where(m, val.astype(jnp.float32), 0.0)
+                        l = jnp.zeros_like(h)
+                        (hi, lo), = _seg_scan(
+                            seg_flag, [(h, l)], ["df32"]
+                        )
+                        emit(hi[peer_last], False)
+                        emit(lo[peer_last], False)
+                    else:
+                        v = jnp.where(m, val.astype(fdt), 0.0)
+                        s, = _seg_scan(seg_flag, [v], ["sum"])
+                        emit(s[peer_last], False)
+                    emit(cnt_run[peer_last], True)
+                    continue
+                # min / max (numeric; identity = +/- inf in float domain,
+                # int idents for exact-int operands)
+                if jnp.issubdtype(val.dtype, jnp.integer):
+                    info = jnp.iinfo(idt)
+                    ident = info.max if fn_name == "min" else info.min
+                    v = jnp.where(m, val.astype(idt), ident)
+                    is_int = True
+                else:
+                    ident = jnp.inf if fn_name == "min" else -jnp.inf
+                    v = jnp.where(m, val.astype(fdt), ident)
+                    is_int = False
+                s, = _seg_scan(seg_flag, [v], [fn_name])
+                emit(s[peer_last], is_int)
+                emit(cnt_run[peer_last], True)
+                continue
+            if kind == "val":
+                _, fn_name, slot, offset = spec
+                val, avalid = s_args[slot]
+                seg_last = get("seg_last")
+                if fn_name == "first_value":
+                    src = seg_first
+                    ok = jnp.ones(n, jnp.bool_)
+                elif fn_name == "last_value":
+                    src = peer_last
+                    ok = jnp.ones(n, jnp.bool_)
+                elif fn_name == "lag":
+                    src = idx - offset
+                    ok = jnp.logical_and(src >= seg_first, src <= seg_last)
+                else:  # lead
+                    src = idx + offset
+                    ok = jnp.logical_and(src <= seg_last, src >= seg_first)
+                src = jnp.clip(src, 0, n - 1)
+                emit(val[src], jnp.issubdtype(val.dtype, jnp.integer))
+                emit(
+                    jnp.logical_and(ok, avalid[src]).astype(jnp.int32),
+                    True,
+                )
+                continue
+            raise AssertionError(f"window spec {spec}")
+
+        packed_rows = []
+        for arr, is_int in rows:
+            a = arr[inv]  # back to INPUT row order
+            if is_int:
+                packed_rows.append(a.astype(idt))
+            else:
+                packed_rows.append(
+                    jax.lax.bitcast_convert_type(a.astype(fdt), idt)
+                )
+        return jnp.stack(packed_rows, axis=0)
+
+    fn = jax.jit(kernel)
+    _WINDOW_KERNEL_CACHE[cache_key] = fn
+    return fn

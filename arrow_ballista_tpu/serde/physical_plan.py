@@ -64,12 +64,14 @@ def partitioning_from_proto(msg: pb.PhysicalPartitioning) -> Partitioning:
 
 def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
     from ..ops.stage_compiler import TpuStageExec
+    from ..ops.window_compiler import TpuWindowExec
 
-    if isinstance(plan, TpuStageExec):
-        # the TPU-fused stage travels as its unaccelerated operator subtree;
-        # the receiving executor re-applies maybe_accelerate under its own
-        # session config (acceleration is a local physical-optimizer rule,
-        # mirroring the reference's PhysicalExtensionCodec plugin hook)
+    if isinstance(plan, (TpuStageExec, TpuWindowExec)):
+        # accelerated stages travel as their unaccelerated operator
+        # subtree; the receiving executor re-applies maybe_accelerate
+        # under its own session config (acceleration is a local
+        # physical-optimizer rule, mirroring the reference's
+        # PhysicalExtensionCodec plugin hook)
         return physical_plan_to_proto(plan.original)
 
     n = pb.PhysicalPlanNode()

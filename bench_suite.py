@@ -7,12 +7,13 @@ Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
   #4 full 22 TPC-H distributed (2 executors over gRPC/Flight) at
      tractable scale (BENCH_FULL22_SF, default 1)
   #5 h2o groupby G1_1e8 (high-cardinality aggregate), TPU vs CPU
-  plus a star-join showcase for the fused device PK-FK join
+  plus a star-join showcase for the fused device PK-FK join and a window
+  showcase (ranking + running sum + lag on TpuWindowExec)
 
 Each config emits one JSON line (same shape as bench.py) and everything
-is appended to BENCH_SUITE_r03.json so the results ship with the repo.
+is appended to BENCH_SUITE_r04.json so the results ship with the repo.
 
-Usage: python bench_suite.py [q6|q3|starjoin|full22|h2o|all]  (default all)
+Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|all]  (default all)
 """
 
 from __future__ import annotations
@@ -25,7 +26,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 OUT_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r03.json"
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r04.json"
 )
 
 
@@ -378,6 +379,102 @@ def bench_full22() -> None:
     )
 
 
+def bench_window() -> None:
+    """Device window showcase (capability the reference lacks: its
+    planner raises NotImplemented for WindowAggExec): ranking + running
+    sum + lag over partitioned data, TpuWindowExec vs the CPU window
+    operator."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    n = int(float(os.environ.get("BENCH_WINDOW_N", "2e7")))
+    parts = int(float(os.environ.get("BENCH_WINDOW_PARTS", "5e4")))
+    rng = np.random.default_rng(3)
+    t = pa.table(
+        {
+            "g": pa.array(rng.integers(0, parts, n).astype(np.int64)),
+            "o": pa.array(rng.integers(0, 1 << 30, n).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    sql = (
+        "select g, o, "
+        "row_number() over (partition by g order by o) rn, "
+        "rank() over (partition by g order by o) rk, "
+        "sum(v) over (partition by g order by o) rs, "
+        "lag(v) over (partition by g order by o) lg "
+        "from t"
+    )
+
+    def make_ctx(tpu: bool):
+        ctx = SessionContext(
+            BallistaConfig(
+                {
+                    "ballista.tpu.enable": str(tpu).lower(),
+                    "ballista.batch.size": str(1 << 23),
+                    "ballista.shuffle.partitions": "1",
+                }
+            )
+        )
+        ctx.register_table("t", MemoryTable.from_table(t, 1))
+        return ctx
+
+    def cheap_match(a, b) -> bool:
+        """Numpy oracle: the 2e7-row x 6-col window output would cost
+        more to compare via _tables_match (per-value Python strings)
+        than the whole measurement — lexsort ints exactly, allclose
+        floats with aligned NaN masks."""
+        if a.num_rows != b.num_rows:
+            return False
+        ints = ("g", "o", "rn", "rk")
+        ka = [a.column(c).to_numpy(zero_copy_only=False) for c in ints]
+        kb = [b.column(c).to_numpy(zero_copy_only=False) for c in ints]
+        oa = np.lexsort(tuple(reversed(ka)))
+        ob = np.lexsort(tuple(reversed(kb)))
+        for ca, cb in zip(ka, kb):
+            if not np.array_equal(ca[oa], cb[ob]):
+                return False
+        for c in ("rs", "lg"):
+            va = a.column(c).to_numpy(zero_copy_only=False)[oa]
+            vb = b.column(c).to_numpy(zero_copy_only=False)[ob]
+            na, nb_ = np.isnan(va), np.isnan(vb)
+            if not np.array_equal(na, nb_):
+                return False
+            if not np.allclose(va[~na], vb[~nb_], rtol=1e-6):
+                return False
+        return True
+
+    results = {}
+    for tpu in (False, True):
+        ctx = make_ctx(tpu)
+        df = ctx.sql(sql)
+        best = float("inf")
+        table = None
+        for _ in range(3):
+            plan = df.physical_plan()
+            t0 = time.perf_counter()
+            table = ctx.execute(plan)
+            best = min(best, time.perf_counter() - t0)
+        results[tpu] = (best, table)
+    cpu_s, tpu_s = results[False][0], results[True][0]
+    ok = cheap_match(results[False][1], results[True][1])
+    _emit(
+        {
+            "metric": "window_rank_runsum_%.0e_tpu_rows_per_sec" % n,
+            "value": round(n / tpu_s),
+            "unit": "rows/s",
+            "vs_baseline": round(cpu_s / tpu_s, 3),
+            "rows": n,
+            "partitions": parts,
+            "cpu_rows_per_sec": round(n / cpu_s),
+            "matches_cpu_1e-6": ok,
+        }
+    )
+
+
 def bench_h2o() -> None:
     """Config #5: h2o groupby G1_1e8, TPU vs CPU, via the real harness."""
     import io
@@ -436,6 +533,8 @@ def main() -> None:
         bench_starjoin()
     if which in ("full22", "all"):
         bench_full22()
+    if which in ("window", "all"):
+        bench_window()
     if which in ("h2o", "all"):
         bench_h2o()
 
