@@ -84,63 +84,97 @@ class DictEncoder:
     Per-batch ``dictionary_encode`` yields batch-local codes; group keys
     must agree across every batch of a stage (and across partitions when
     the codes feed a device segment-sum), so this encoder owns the global
-    value → code map.  The reverse table materializes the key column of the
-    aggregate output.
+    value → code map: an ARROW array whose position IS the code, probed
+    with ``pc.index_in`` (C++ hash).  The round-3 design round-tripped
+    every batch's local dictionary through Python objects — seconds per
+    batch at h2o id3 scale (~1e6 distinct strings); no Python value ever
+    materializes here.  NULL keys get a real (null) slot in the array, so
+    ``decode`` is a single ``take``.
     """
 
-    values: dict = None  # value -> code
-    reverse: list = None
-
-    def __post_init__(self) -> None:
-        self.values = {}
-        self.reverse = []
+    _dict: Optional[pa.Array] = None  # position == code; may hold 1 null
 
     def encode(self, arr: pa.Array) -> np.ndarray:
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
         enc = arr.dictionary_encode()
-        local_dict = enc.dictionary.to_pylist()
-        mapping = np.empty(len(local_dict), dtype=np.int32)
-        for i, v in enumerate(local_dict):
-            code = self.values.get(v)
-            if code is None:
-                code = len(self.reverse)
-                self.values[v] = code
-                self.reverse.append(v)
-            mapping[i] = code
+        local = enc.dictionary  # distinct NON-NULL values, arrow-native
+        n_local = len(local)
+        if self._dict is not None and not self._dict.type.equals(local.type):
+            local = local.cast(self._dict.type)
+        if self._dict is None or len(self._dict) == 0:
+            got_np = np.full(n_local, -1, dtype=np.int64)
+        else:
+            got = pc.index_in(local, value_set=self._dict)
+            got_np = np.asarray(got.fill_null(-1)).astype(np.int64)
+        mapping = got_np
+        miss = mapping < 0
+        n_miss = int(miss.sum())
+        if n_miss:
+            new_vals = local.filter(pa.array(miss))
+            base = len(self._dict) if self._dict is not None else 0
+            self._dict = (
+                pa.concat_arrays([self._dict, new_vals])
+                if self._dict is not None
+                else new_vals
+            )
+            mapping = mapping.copy()
+            mapping[miss] = base + np.arange(n_miss)
         idx = enc.indices
         has_null = idx.null_count > 0 or arr.null_count > 0
         codes = np.asarray(idx.fill_null(0))
-        out = mapping[codes] if len(mapping) else np.zeros(len(arr), np.int32)
+        out = mapping[codes] if n_local else np.zeros(len(arr), np.int64)
         if has_null:
-            null_code = self.values.get(None)
-            if null_code is None:
-                null_code = len(self.reverse)
-                self.values[None] = null_code
-                self.reverse.append(None)
-            mask = np.asarray(pc.is_null(arr))
-            out = np.where(mask, np.int32(null_code), out)
+            out = np.where(
+                np.asarray(pc.is_null(arr)), self._null_code(local.type), out
+            )
         return out.astype(np.int32)
+
+    def _null_code(self, t: pa.DataType) -> int:
+        """Code of the NULL key: a real null slot in the value array, so
+        decode's take materializes it as null with no special case."""
+        if self._dict is not None:
+            nulls = np.asarray(pc.is_null(self._dict))
+            hit = np.nonzero(nulls)[0]
+            if len(hit):
+                return int(hit[0])
+        code = len(self._dict) if self._dict is not None else 0
+        null1 = pa.nulls(1, self._dict.type if self._dict is not None else t)
+        self._dict = (
+            pa.concat_arrays([self._dict, null1])
+            if self._dict is not None
+            else null1
+        )
+        return code
 
     @property
     def size(self) -> int:
-        return len(self.reverse)
+        return len(self._dict) if self._dict is not None else 0
 
     def to_arrow(self, dtype: pa.DataType) -> pa.Array:
-        return pa.array(self.reverse, dtype)
+        if self._dict is None:
+            return pa.nulls(0, dtype)
+        return (
+            self._dict
+            if self._dict.type.equals(dtype)
+            else self._dict.cast(dtype)
+        )
 
     def decode(
         self, codes: np.ndarray, t: pa.DataType,
         mask: Optional[np.ndarray] = None,
     ) -> pa.Array:
-        """codes → original values (vectorized object fancy-index);
-        ``mask`` marks null rows (their codes may be garbage)."""
-        rev = np.asarray(self.reverse, dtype=object)
-        if mask is not None:
-            safe = np.where(mask, 0, codes)
-            vals = rev[safe] if len(rev) else np.full(len(safe), None)
-            return pa.array(vals.tolist(), t, mask=mask)
-        return pa.array(rev[codes].tolist(), t)
+        """codes → original values (one arrow ``take``); ``mask`` marks
+        null rows (their codes may be garbage)."""
+        if self._dict is None or len(self._dict) == 0:
+            return pa.nulls(len(codes), t)
+        safe = np.where(mask, 0, codes) if mask is not None else codes
+        vals = self._dict.take(pa.array(safe.astype(np.int64)))
+        if not vals.type.equals(t):
+            vals = vals.cast(t)
+        if mask is not None and mask.any():
+            vals = pc.if_else(pa.array(mask), pa.scalar(None, t), vals)
+        return vals
 
 
 class IdentityKeyEncoder:
