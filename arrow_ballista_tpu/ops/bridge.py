@@ -77,6 +77,48 @@ def _zero_for(t: pa.DataType):
     return 0
 
 
+def to_u64_order(values: np.ndarray) -> np.ndarray:
+    """uint64 whose unsigned order equals the values' natural order
+    (IEEE-754 sign-flip trick for floats, bias flip for ints)."""
+    if values.dtype.kind == "f":
+        v = values.astype(np.float64)
+        bits = v.view(np.uint64)
+        neg = (bits >> np.uint64(63)) == 1
+        mask = np.where(
+            neg,
+            np.uint64(0xFFFFFFFFFFFFFFFF),
+            np.uint64(1) << np.uint64(63),
+        )
+        return bits ^ mask
+    return values.astype(np.int64).view(np.uint64) ^ (
+        np.uint64(1) << np.uint64(63)
+    )
+
+
+def split_u64_i32(u: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(hi, lo) i32 pair whose LEXICOGRAPHIC signed order equals the
+    unsigned order of ``u`` — 64-bit order relations on a device without
+    64-bit dtypes (sort keys, exact f64 min/max in x32 mode)."""
+    hi = ((u >> np.uint64(32)).astype(np.int64) - (1 << 31)).astype(np.int32)
+    lo = ((u & np.uint64(0xFFFFFFFF)).astype(np.int64) - (1 << 31)).astype(
+        np.int32
+    )
+    return hi, lo
+
+
+def order_decode_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Inverse of ``to_u64_order`` + ``split_u64_i32`` for f64 values."""
+    u = (
+        ((hi.astype(np.int64) + (1 << 31)).astype(np.uint64) << np.uint64(32))
+        | (lo.astype(np.int64) + (1 << 31)).astype(np.uint64)
+    )
+    neg = (u >> np.uint64(63)) == 0  # sign bit was flipped on encode
+    mask = np.where(
+        neg, np.uint64(0xFFFFFFFFFFFFFFFF), np.uint64(1) << np.uint64(63)
+    )
+    return (u ^ mask).view(np.float64)
+
+
 @dataclass
 class DictEncoder:
     """Stable host-side dictionary encoder shared across batches.

@@ -48,7 +48,10 @@ class LeafSpec:
     the values, so wide i64 key columns don't cross the bridge at all),
     "column_pair" (i64 as an exact f32 (hi, lo) pair in x32 mode — hi/lo
     and validity; 48-bit exact, so big-key sums survive the i32-less
-    device).
+    device), "column_ord_pair" (f64 as an ORDER-preserving (hi, lo) i32
+    pair — lexicographic comparisons equal f64 comparisons, so x32
+    min/max over f64 columns is bit-EXACT, the q2 decorrelated-equality
+    requirement).
     """
 
     name: str
@@ -183,6 +186,21 @@ class JaxExprCompiler:
 
         def run(env: dict):
             return (env[f"{name}__hi"], env[f"{name}__lo"]), env[vname]
+
+        return run
+
+    def ord_pair_column(self, e: pe.Col) -> JaxClosure:
+        """f64 column as an order-preserving (hi, lo) i32 pair (x32
+        mode): consumed only by ord_pair min/max kernels, where
+        lexicographic integer comparison IS f64 comparison."""
+        name = f"col_{e.index}__ordpair"
+        self.leaves[name] = LeafSpec(
+            name, "column_ord_pair", col_index=e.index
+        )
+        vname = f"{name}__valid"
+
+        def run(env: dict):
+            return (env[f"{name}__ohi"], env[f"{name}__olo"]), env[vname]
 
         return run
 
@@ -545,6 +563,13 @@ def build_env(
                 (v - hi.astype(np.float64)).astype(np.float32), n_padded
             )
             continue
+        if spec.kind == "column_ord_pair":
+            from .bridge import split_u64_i32, to_u64_order
+
+            ohi, olo = split_u64_i32(to_u64_order(values))
+            env[f"{name}__ohi"] = _pad(ohi, n_padded)
+            env[f"{name}__olo"] = _pad(olo, n_padded)
+            continue
         env[name] = _pad(coerce_host_values(values), n_padded)
     return env
 
@@ -578,6 +603,8 @@ def flat_arg_names(leaves: dict[str, LeafSpec]) -> list[str]:
             out.append(f"{n}__valid")
         elif spec.kind == "column_pair":
             out.extend([f"{n}__hi", f"{n}__lo", f"{n}__valid"])
+        elif spec.kind == "column_ord_pair":
+            out.extend([f"{n}__ohi", f"{n}__olo", f"{n}__valid"])
         else:
             out.extend([n, f"{n}__valid"])
     return out
@@ -653,6 +680,10 @@ class KernelAggSpec:
     # casting to f32 rounds above 2^24, and a min/max that comes back
     # sub-ulp wrong breaks decorrelated equality predicates (q2)
     int_minmax: bool = False
+    # x32 only: min/max over an f64 COLUMN rides an order-preserving
+    # (hi, lo) i32 pair — lexicographic integer min/max IS f64 min/max,
+    # so the extremum is bit-exact without f64 device dtypes
+    ord_pair: bool = False
 
 
 def state_fields(spec: KernelAggSpec, mode: str) -> tuple[str, ...]:
@@ -668,8 +699,12 @@ def state_fields(spec: KernelAggSpec, mode: str) -> tuple[str, ...]:
     if spec.func in ("sum", "avg"):
         return ("add", "add", "add") if mode == "x32" else ("add", "add")
     if spec.func == "min":
+        if spec.ord_pair:
+            return ("omin_hi", "omin_lo", "add")
         return ("min", "add")
     if spec.func == "max":
+        if spec.ord_pair:
+            return ("omax_hi", "omax_lo", "add")
         return ("max", "add")
     raise ExecutionError(f"kernel agg {spec.func}")
 
@@ -680,6 +715,20 @@ def _two_sum(a, b):
     bb = s - a
     e = (a - (s - bb)) + (b - bb)
     return s, e
+
+
+def _lex_merge(a_hi, a_lo, b_hi, b_lo, is_min: bool):
+    """Lexicographic (hi, lo) extremum merge — the order-pair encoding of
+    f64 makes this identical to an f64 min/max."""
+    if is_min:
+        better_b = jnp.logical_or(
+            b_hi < a_hi, jnp.logical_and(b_hi == a_hi, b_lo < a_lo)
+        )
+    else:
+        better_b = jnp.logical_or(
+            b_hi > a_hi, jnp.logical_and(b_hi == a_hi, b_lo > a_lo)
+        )
+    return jnp.where(better_b, b_hi, a_hi), jnp.where(better_b, b_lo, a_lo)
 
 
 # ------------------------------------------------------- algorithm choice
@@ -898,7 +947,7 @@ def _scan_segments(s2, perm, capacity: int, kinds: list, cols: list):
         ident = None
         if isinstance(kind, tuple):
             kind, ident = kind
-        if kind == "df32":
+        if kind in ("df32", "omin", "omax"):
             hi, lo = col
             slots.append((kind, ident, (len(elems), len(elems) + 1)))
             elems.append(hi[perm])
@@ -909,7 +958,12 @@ def _scan_segments(s2, perm, capacity: int, kinds: list, cols: list):
 
     flat_kinds = ["flag"]
     for kind, _, _ in slots:
-        flat_kinds.extend(["df32_hi", "df32_lo"] if kind == "df32" else [kind])
+        if kind == "df32":
+            flat_kinds.extend(["df32_hi", "df32_lo"])
+        elif kind in ("omin", "omax"):
+            flat_kinds.extend([f"{kind}_hi", f"{kind}_lo"])
+        else:
+            flat_kinds.append(kind)
 
     def combine(a, b):
         fa, fb = a[0], b[0]
@@ -922,6 +976,14 @@ def _scan_segments(s2, perm, capacity: int, kinds: list, cols: list):
                 hi, lo2 = _two_sum(s, a[i + 1] + b[i + 1] + e)
                 out.append(jnp.where(fb, b[i], hi))
                 out.append(jnp.where(fb, b[i + 1], lo2))
+                i += 2
+                continue
+            if kind in ("omin_hi", "omax_hi"):
+                hi, lo = _lex_merge(
+                    a[i], a[i + 1], b[i], b[i + 1], kind == "omin_hi"
+                )
+                out.append(jnp.where(fb, b[i], hi))
+                out.append(jnp.where(fb, b[i + 1], lo))
                 i += 2
                 continue
             if kind in ("f64", "i32"):
@@ -949,6 +1011,16 @@ def _scan_segments(s2, perm, capacity: int, kinds: list, cols: list):
             hi = jnp.where(occupied, scanned[slot[0]][last], 0.0)
             lo = jnp.where(occupied, scanned[slot[1]][last], 0.0)
             outs.append((hi, lo))
+        elif kind in ("omin", "omax"):
+            hi_s = scanned[slot[0]][last]
+            lo_s = scanned[slot[1]][last]
+            empty = jnp.asarray(ident, hi_s.dtype)
+            outs.append(
+                (
+                    jnp.where(occupied, hi_s, empty),
+                    jnp.where(occupied, lo_s, empty),
+                )
+            )
         else:
             v = scanned[slot][last]
             empty = (
@@ -1042,6 +1114,12 @@ def make_partial_agg_kernel(
                     )
                 outs.append(n)
                 continue
+            if spec.func in ("min", "max") and spec.ord_pair:
+                outs.extend(
+                    _ord_segment_extremum(spec, val, m, seg_ids, capacity)
+                )
+                outs.append(n)
+                continue
             if spec.func in ("min", "max"):
                 v, ident = _minmax_operand(spec, val)
                 red = (
@@ -1125,6 +1203,11 @@ def make_partial_agg_kernel(
                     jnp.where(m, val.astype(jnp.float32), jnp.zeros((), jnp.float32))
                 )
                 plan.append(("sum", sj, nj))
+            elif spec.func in ("min", "max") and spec.ord_pair:
+                plan.append(("ominmax", len(minmax), nj))
+                minmax.append(
+                    _ord_segment_extremum(spec, val, m, seg_ids, capacity)
+                )
             elif spec.func in ("min", "max"):
                 v, ident = _minmax_operand(spec, val)
                 red = (
@@ -1156,6 +1239,11 @@ def make_partial_agg_kernel(
             elif entry[0] == "sum":
                 outs.append(hi[:, entry[1]])
                 outs.append(lo[:, entry[1]])
+                outs.append(counts[:, entry[2]])
+            elif entry[0] == "ominmax":
+                ohi, olo = minmax[entry[1]]
+                outs.append(ohi)
+                outs.append(olo)
                 outs.append(counts[:, entry[2]])
             else:  # minmax
                 outs.append(minmax[entry[1]])
@@ -1231,6 +1319,16 @@ def _build_scan_plan(env, maskf, specs, arg_closures, mode):
                 kinds.append("f64")
                 cols.append(v)
             continue
+        if spec.func in ("min", "max") and spec.ord_pair:
+            vhi, vlo = val
+            info = jnp.iinfo(jnp.int32)
+            ident = int(info.max if spec.func == "min" else info.min)
+            plan.append(("ominmax", len(kinds), nj))
+            kinds.append((f"o{spec.func}", ident))
+            cols.append(
+                (jnp.where(m, vhi, ident), jnp.where(m, vlo, ident))
+            )
+            continue
         if spec.func in ("min", "max"):
             v, ident = _minmax_operand(spec, val)
             # identity as a PYTHON scalar: kinds must stay hashable for
@@ -1256,7 +1354,7 @@ def _emit_scan_outs(plan, totals, presence) -> list:
     for entry in plan:
         if entry[0] == "count":
             outs.append(presence if entry[1] is None else totals[entry[1]])
-        elif entry[0] == "sum32":
+        elif entry[0] in ("sum32", "ominmax"):
             hi, lo = totals[entry[1]]
             outs.append(hi)
             outs.append(lo)
@@ -1444,6 +1542,24 @@ def unpack_keyed_host(
     return states, keys
 
 
+def _ord_segment_extremum(spec, val, m, seg_ids, capacity):
+    """Exact segment extremum over an order-pair operand: reduce hi, then
+    reduce lo among the rows tied at the extremal hi (two segment
+    reductions = one lexicographic 64-bit extremum)."""
+    vhi, vlo = val
+    info = jnp.iinfo(jnp.int32)
+    if spec.func == "min":
+        red, ident = jax.ops.segment_min, info.max
+    else:
+        red, ident = jax.ops.segment_max, info.min
+    hi_m = jnp.where(m, vhi, ident)
+    seg_hi = red(hi_m, seg_ids, num_segments=capacity)
+    tie = jnp.logical_and(m, hi_m == seg_hi[seg_ids])
+    lo_m = jnp.where(tie, vlo, ident)
+    seg_lo = red(lo_m, seg_ids, num_segments=capacity)
+    return [seg_hi, seg_lo]
+
+
 def _minmax_operand(spec: KernelAggSpec, val):
     """(operand, identity) for a min/max reduction, dtype-preserving for
     the integer path (exactness) and float for the rest."""
@@ -1464,13 +1580,13 @@ def _minmax_operand(spec: KernelAggSpec, val):
 def _pad_ident(role: str, dtype):
     """Growth-padding identity per state field, dtype-aware (integer
     min/max states must not pad with float inf)."""
-    if role == "min":
+    if role in ("min", "omin_hi", "omin_lo"):
         return (
             jnp.iinfo(dtype).max
             if jnp.issubdtype(dtype, jnp.integer)
             else jnp.inf
         )
-    if role == "max":
+    if role in ("max", "omax_hi", "omax_lo"):
         return (
             jnp.iinfo(dtype).min
             if jnp.issubdtype(dtype, jnp.integer)
@@ -1512,6 +1628,8 @@ def state_is_int(spec: KernelAggSpec, mode: str) -> tuple[bool, ...]:
         return (True,)
     if spec.func in ("sum", "avg"):
         return (False, False, True) if mode == "x32" else (False, True)
+    if spec.ord_pair:
+        return (True, True, True)  # (hi, lo, n) — all integer
     return (spec.int_minmax, True)  # min/max: (value, n)
 
 
@@ -1599,6 +1717,16 @@ def combine_states(
             s, e = _two_sum(acc[i], new[i])
             out.append(s)
             out.append(acc[i + 1] + new[i + 1] + e)
+            out.append(acc[i + 2] + new[i + 2])
+            i += 3
+            continue
+        if spec.ord_pair and spec.func in ("min", "max"):
+            hi, lo = _lex_merge(
+                acc[i], acc[i + 1], new[i], new[i + 1],
+                spec.func == "min",
+            )
+            out.append(hi)
+            out.append(lo)
             out.append(acc[i + 2] + new[i + 2])
             i += 3
             continue

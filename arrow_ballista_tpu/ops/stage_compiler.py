@@ -582,11 +582,22 @@ class TpuStageExec(ExecutionPlan):
                 if x32 and not int_mm and not (
                     t is not None and pa.types.is_float32(t)
                 ):
-                    # f64 min/max would come back f32-rounded: a sub-ulp
-                    # wrong extremum breaks decorrelated equality (q2's
-                    # ps_supplycost = (select min(...))) — CPU keeps it
-                    # exact; ints/dates stay on device in INT dtype
-                    raise K.NotLowerable("x32 min/max over f64")
+                    # f64 min/max must not come back f32-rounded: a
+                    # sub-ulp wrong extremum breaks decorrelated equality
+                    # (q2's ps_supplycost = (select min(...))).  Plain f64
+                    # COLUMNS ride an order-preserving (hi, lo) i32 pair —
+                    # lexicographic integer extremum IS the f64 extremum,
+                    # bit-exact; computed f64 expressions (already
+                    # f32-rounded on device) stay on CPU
+                    if isinstance(a.arg, pe.Col) and t is not None and (
+                        pa.types.is_float64(t)
+                    ):
+                        pending[idx] = (
+                            K.KernelAggSpec(a.func, True, ord_pair=True),
+                            compiler.ord_pair_column(a.arg),
+                        )
+                        continue
+                    raise K.NotLowerable("x32 min/max over f64 expression")
                 pending[idx] = (
                     K.KernelAggSpec(a.func, True, int_minmax=int_mm),
                     compiler._lower(a.arg),
@@ -1463,6 +1474,23 @@ class TpuStageExec(ExecutionPlan):
             if spec.func in ("count", "count_star"):
                 cols.append(pa.array(host[i][keep], pa.int64()))
                 i += 1
+                continue
+            if spec.ord_pair:
+                # order-pair f64 extremum: lexicographic (hi, lo) i32
+                # decodes to the BIT-exact f64 min/max
+                from .bridge import order_decode_f64
+
+                ohi = host[i][keep]
+                olo = host[i + 1][keep]
+                n_arr = host[i + 2][keep]
+                i += 3
+                empty = n_arr == 0
+                v = order_decode_f64(
+                    np.where(empty, 0, ohi).astype(np.int32),
+                    np.where(empty, 0, olo).astype(np.int32),
+                )
+                field_t = schema.field(len(cols)).type
+                cols.append(pa.array(v, field_t, mask=empty))
                 continue
             if spec.int_minmax:
                 # integer extrema stay in INT dtype end-to-end (an f64

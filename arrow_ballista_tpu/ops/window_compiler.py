@@ -39,21 +39,9 @@ _AGG_FNS = {"sum", "avg", "min", "max", "count"}
 
 
 # ------------------------------------------------------- key encoding
-def _to_u64_order(values: np.ndarray) -> np.ndarray:
-    """uint64 whose unsigned order equals the values' natural order."""
-    if values.dtype.kind == "f":
-        v = values.astype(np.float64)
-        bits = v.view(np.uint64)
-        neg = (bits >> np.uint64(63)) == 1
-        mask = np.where(
-            neg,
-            np.uint64(0xFFFFFFFFFFFFFFFF),
-            np.uint64(1) << np.uint64(63),
-        )
-        return bits ^ mask
-    return values.astype(np.int64).view(np.uint64) ^ (
-        np.uint64(1) << np.uint64(63)
-    )
+from .bridge import split_u64_i32, to_u64_order  # noqa: E402
+
+_to_u64_order = to_u64_order
 
 
 def _split_u64(u: np.ndarray, mode: str) -> list:
@@ -61,9 +49,7 @@ def _split_u64(u: np.ndarray, mode: str) -> list:
     unsigned order of ``u``: one i64 (x64) or an (hi, lo) i32 pair."""
     if mode == "x64":
         return [(u ^ (np.uint64(1) << np.uint64(63))).view(np.int64)]
-    hi = (u >> np.uint64(32)).astype(np.int64) - (1 << 31)
-    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.int64) - (1 << 31)
-    return [hi.astype(np.int32), lo.astype(np.int32)]
+    return list(split_u64_i32(u))
 
 
 def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],

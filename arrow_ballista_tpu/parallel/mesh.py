@@ -63,7 +63,25 @@ def make_distributed_agg_step(
         out = []
         i = 0
         for spec in specs:
-            for role in K.state_fields(spec, mode):
+            fields = K.state_fields(spec, mode)
+            if spec.ord_pair and spec.func in ("min", "max"):
+                # lexicographic 64-bit extremum over ICI: reduce hi, then
+                # reduce lo among chips tied at the extremal hi (ties
+                # carry the identity so they drop out)
+                red = (
+                    jax.lax.pmin if spec.func == "min" else jax.lax.pmax
+                )
+                info = jnp.iinfo(states[i].dtype)
+                ident = info.max if spec.func == "min" else info.min
+                g_hi = red(states[i], DATA_AXIS)
+                lo_cand = jnp.where(states[i] == g_hi, states[i + 1], ident)
+                g_lo = red(lo_cand, DATA_AXIS)
+                out.extend(
+                    [g_hi, g_lo, jax.lax.psum(states[i + 2], DATA_AXIS)]
+                )
+                i += 3
+                continue
+            for role in fields:
                 if role == "min":
                     out.append(jax.lax.pmin(states[i], DATA_AXIS))
                 elif role == "max":

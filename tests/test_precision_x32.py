@@ -221,3 +221,96 @@ def test_all_tpch_x32_device_path_matches_oracle():
                     assert y == pytest.approx(x, rel=1e-6), (qno, name)
                 else:
                     assert x == y, (qno, name)
+
+
+def _minmax_adversarial_table(n=6000, n_groups=30, seed=13):
+    """f64 values whose differences vanish under f32 rounding: only an
+    exact 64-bit order comparison can pick the right extremum."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, n_groups, n)
+    base = rng.uniform(1.0, 100.0, n_groups)[k]
+    v = base * (1.0 + rng.integers(-4, 5, n) * 1e-13)
+    vmask = rng.uniform(size=n) < 0.05
+    return pa.table(
+        {
+            "k": pa.array(k.astype(np.int64)),
+            "v": pa.array(v, pa.float64(), mask=vmask),
+        }
+    )
+
+
+@pytest.mark.parametrize("algo", ["matmul", "scatter", "sort"])
+def test_x32_minmax_f64_bit_exact(algo):
+    """min/max over an f64 column must be BIT-exact in x32 mode (order-
+    pair path): sub-f32-ulp differences decide the answer, the q2
+    decorrelated-equality requirement.  All three segment strategies."""
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+    t = _minmax_adversarial_table()
+    sql = (
+        "select k, min(v) as mn, max(v) as mx, count(v) as c "
+        "from t group by k order by k"
+    )
+    cpu = _ctx(False)
+    cpu.register_table("t", MemoryTable.from_table(t, 2))
+    want = cpu.sql(sql).collect()
+
+    K.set_agg_algorithm(algo)
+    try:
+        dev = _ctx(True)
+        dev.register_table("t", MemoryTable.from_table(t, 2))
+        plan = dev.sql(sql).physical_plan()
+        got = dev.execute(plan)
+        m = {}
+        stack = [plan]
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, TpuStageExec):
+                for kk, vv in nd.metrics.values.items():
+                    m[kk] = m.get(kk, 0) + vv
+            stack.extend(nd.children())
+        assert m.get("tpu_fallback", 0) == 0, m
+    finally:
+        K.set_agg_algorithm(None)
+
+    for name in ("mn", "mx"):
+        a = want.column(name).to_pylist()
+        b = got.column(name).to_pylist()
+        assert a == b, name  # EXACT equality, not approx
+
+
+def test_x32_minmax_f64_bit_exact_keyed():
+    """Same exactness through the device-KEYED high-cardinality path."""
+    import arrow_ballista_tpu.ops.stage_compiler as SC
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    t = _minmax_adversarial_table(n=4000, n_groups=1200)
+    sql = "select k, min(v) as mn, max(v) as mx from t group by k order by k"
+    cpu = _ctx(False)
+    cpu.register_table("t", MemoryTable.from_table(t, 1))
+    want = cpu.sql(sql).collect()
+
+    old = SC._HIGHCARD_MIN_GROUPS
+    SC._HIGHCARD_MIN_GROUPS = 16
+    try:
+        dev = _ctx(True)
+        dev.register_table("t", MemoryTable.from_table(t, 1))
+        plan = dev.sql(sql).physical_plan()
+        got = dev.execute(plan)
+        m = {}
+        stack = [plan]
+        from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, TpuStageExec):
+                for kk, vv in nd.metrics.values.items():
+                    m[kk] = m.get(kk, 0) + vv
+            stack.extend(nd.children())
+        assert m.get("keyed_path", 0) >= 1, m
+        assert m.get("tpu_fallback", 0) == 0, m
+    finally:
+        SC._HIGHCARD_MIN_GROUPS = old
+
+    assert want.column("mn").to_pylist() == got.column("mn").to_pylist()
+    assert want.column("mx").to_pylist() == got.column("mx").to_pylist()
