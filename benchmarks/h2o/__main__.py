@@ -105,15 +105,17 @@ def run_groupby(
     data = gen_groupby(n, k)
     gen_s = time.perf_counter() - t0
 
-    ctx = SessionContext(
-        BallistaConfig(
-            {
-                "ballista.tpu.enable": "true" if tpu else "false",
-                "ballista.batch.size": str(1 << 21),
-                "ballista.shuffle.partitions": str(partitions),
-            }
-        )
-    )
+    settings = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.batch.size": str(1 << 21),
+        "ballista.shuffle.partitions": str(partitions),
+    }
+    # A/B hook: route groups~rows aggregates to the keyed device path
+    # (auto), the C++ hash aggregate (cpu), or pin the device (device)
+    hc = os.environ.get("BENCH_HIGHCARD_MODE")
+    if hc:
+        settings["ballista.tpu.highcard_mode"] = hc
+    ctx = SessionContext(BallistaConfig(settings))
     ctx.register_table("x", MemoryTable.from_table(data, partitions))
 
     results = []

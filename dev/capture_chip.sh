@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Serialized chip-measurement protocol (VERDICT r3 item 1).
+#
+# Run ONLY when the device probe answers (the tunnel wedges if a process
+# is killed mid-device-op, so every job gets a generous timeout and
+# nothing here SIGTERMs an in-flight device op).  One job at a time —
+# the box has a single CPU core and an exclusive chip.
+#
+#   bash dev/capture_chip.sh            # full capture (~1-2h)
+#   bash dev/capture_chip.sh quick      # bench.py + q6/q3 only
+#
+# Outputs: BENCH_r04_dev.json (bench.py line), BENCH_SUITE_r04.json,
+# KERNELBENCH_r04.json, AB_r04.log (A/B knob runs).
+
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 200 python -c "
+from benchmarks.device_guard import probe_backend
+import sys
+p = probe_backend(180)
+print('probe:', p)
+sys.exit(0 if p not in (None, 'timeout', 'cpu') else 1)
+"
+}
+
+echo "== probing device =="
+if ! probe; then
+  echo "device unavailable — aborting capture (nothing written)"
+  exit 2
+fi
+
+mode="${1:-full}"
+
+echo "== bench.py (q1 SF10) =="
+timeout 3600 python bench.py | tee BENCH_r04_dev.json
+
+echo "== suite: q6 =="
+timeout 3600 python bench_suite.py q6
+echo "== suite: q3 =="
+timeout 5400 python bench_suite.py q3
+
+if [ "$mode" = "full" ]; then
+  echo "== suite: starjoin =="
+  timeout 3600 python bench_suite.py starjoin
+  echo "== suite: full22 =="
+  timeout 5400 python bench_suite.py full22
+  echo "== suite: window =="
+  timeout 3600 python bench_suite.py window
+  echo "== suite: h2o =="
+  timeout 7200 python bench_suite.py h2o
+
+  echo "== A/B: q3 agg algorithm sort vs scatter ==" | tee AB_r04.log
+  BENCH_AGG_ALGO=sort timeout 5400 python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r04.log
+  BENCH_AGG_ALGO=scatter timeout 5400 python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r04.log
+
+  echo "== A/B: h2o highcard routing cpu vs auto(keyed) ==" | tee -a AB_r04.log
+  # highcard_mode=cpu reproduces the pre-keyed C++-hash-aggregate handoff
+  BENCH_HIGHCARD_MODE=cpu BENCH_H2O_N=1e8 timeout 7200 python bench_suite.py h2o 2>&1 | tail -1 | tee -a AB_r04.log
+
+  echo "== kernel microbench grid =="
+  timeout 5400 python benchmarks/kernels.py --iters 3 --host-encode --out KERNELBENCH_r04.json
+fi
+
+echo "== capture complete =="
