@@ -553,10 +553,23 @@ def build_env(
         env[f"{name}__valid"] = _pad(validity, n_padded)
         if spec.kind == "column_pair":
             v = values.astype(np.float64)
-            if len(v) and np.abs(v).max() >= float(1 << 48):
+            if (
+                values.dtype.kind in "iu"
+                and len(v)
+                and np.abs(v).max() >= float(1 << 48)
+            ):
+                # integer pairs must be EXACT: beyond 48 bits the split
+                # loses low bits.  Float pairs are exact at any magnitude
+                # (hi carries the exponent) up to f32 range.
                 raise ExecutionError(
                     "int64 column exceeds 48-bit pair range in x32 mode"
                 )
+            if (
+                values.dtype.kind == "f"
+                and len(v)
+                and np.abs(v).max() >= 3e38
+            ):
+                raise ExecutionError("f64 column exceeds f32 range")
             hi = v.astype(np.float32)
             env[f"{name}__hi"] = _pad(hi, n_padded)
             env[f"{name}__lo"] = _pad(
@@ -715,6 +728,48 @@ def _two_sum(a, b):
     bb = s - a
     e = (a - (s - bb)) + (b - bb)
     return s, e
+
+
+def _two_product_f32(a, b):
+    """Dekker two-product: p = fl(a*b) plus the EXACT rounding error e
+    (Veltkamp split; no FMA assumed — XLA contracting into FMA only
+    makes the error term more accurate)."""
+    p = a * b
+    c = jnp.asarray(4097.0, jnp.float32)  # 2^12 + 1 splits f32 mantissas
+    ac = a * c
+    a_hi = ac - (ac - a)
+    a_lo = a - a_hi
+    bc = b * c
+    b_hi = bc - (bc - b)
+    b_lo = b - b_hi
+    e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+    return p, e
+
+
+def square_pair_closure(pair_closure: JaxClosure) -> JaxClosure:
+    """x² as a double-float pair from a double-float x (variance family,
+    x32): x = hi+lo exactly, so x² = hi² + 2·hi·lo + lo² — hi² splits
+    error-free via Dekker, the cross/low terms fold into the error word
+    (their own rounding sits at ~2^-48 of x²)."""
+
+    def run(env: dict):
+        (hi, lo), valid = pair_closure(env)
+        p, e = _two_product_f32(hi, hi)
+        e = e + jnp.asarray(2.0, jnp.float32) * hi * lo + lo * lo
+        return (p, e), valid
+
+    return run
+
+
+def square_closure(closure: JaxClosure) -> JaxClosure:
+    """x² in the value dtype (variance family, x64 mode)."""
+
+    def run(env: dict):
+        v, valid = closure(env)
+        v = v.astype(_F())
+        return v * v, valid
+
+    return run
 
 
 def _lex_merge(a_hi, a_lo, b_hi, b_lo, is_min: bool):
@@ -1038,6 +1093,7 @@ def make_partial_agg_kernel(
     specs: list[KernelAggSpec],
     capacity: int,
     flat_names: list[str],
+    force_sort: bool = False,
 ):
     """Build the fused filter→project→segment-aggregate device function.
 
@@ -1066,8 +1122,17 @@ def make_partial_agg_kernel(
         maskf = mask
 
         # strategy is static per trace: jit re-traces per row-count shape,
-        # so the rows x capacity bound sees the actual batch size
-        algo = segment_algo(capacity, int(seg_ids.shape[0]))
+        # so the rows x capacity bound sees the actual batch size.
+        # force_sort (variance family, x32): the scatter/matmul pair sums
+        # compensate only across BLOCKS — in-block f32 rounding leaves
+        # ~eps32·sqrt(block) relative error, which the Σx²−(Σx)²/n
+        # cancellation amplifies by the conditioning number.  The sorted
+        # scan 2Sums at EVERY combine (~2^-45 relative), keeping raw
+        # moments usable.
+        if force_sort and mode == "x32":
+            algo = "sort"
+        else:
+            algo = segment_algo(capacity, int(seg_ids.shape[0]))
         if algo == "matmul" and mode == "x32":
             return _fn_matmul(env, seg_ids, maskf)
         if algo == "sort":

@@ -558,6 +558,44 @@ class TpuStageExec(ExecutionPlan):
                     raise K.NotLowerable(a.func)
                 pending[idx] = (K.KernelAggSpec("count_star", False), None)
                 continue
+            if a.func in ("stddev", "stddev_pop", "var", "var_pop"):
+                # variance family lowers as compensated Σx + Σx² (+ the
+                # sum's own count): x32 ships x as an exact double-float
+                # pair and squares it error-free via Dekker two-product,
+                # so the host-side cancellation (Σx² − (Σx)²/n) starts
+                # from ~48-bit-exact moments; a conditioning guard at
+                # materialize falls back to CPU when even that is not
+                # enough (κ = Σx²/(n·var) past 1e8)
+                if fused.mode == PARTIAL:
+                    raise K.NotLowerable("variance family is single-stage")
+                if a.arg is None:
+                    raise K.NotLowerable(a.func)
+                ddof = 0 if a.func.endswith("_pop") else 1
+                use_sqrt = a.func.startswith("stddev")
+                if x32:
+                    if not isinstance(a.arg, pe.Col):
+                        raise K.NotLowerable("x32 variance over expression")
+                    at = compile_schema.field(a.arg.index).type
+                    if not (
+                        pa.types.is_floating(at) or pa.types.is_integer(at)
+                    ):
+                        raise K.NotLowerable(f"variance over {at}")
+                    pairc = compiler.pair_column(a.arg)
+                    parts = [
+                        (K.KernelAggSpec("sum", True, pair=True), pairc),
+                        (
+                            K.KernelAggSpec("sum", True, pair=True),
+                            K.square_pair_closure(pairc),
+                        ),
+                    ]
+                else:
+                    c = compiler._lower(a.arg)
+                    parts = [
+                        (K.KernelAggSpec("sum", True), c),
+                        (K.KernelAggSpec("sum", True), K.square_closure(c)),
+                    ]
+                pending[idx] = ("var", ddof, use_sqrt, parts)
+                continue
             if a.func not in ("count", "sum", "avg", "min", "max"):
                 # count_distinct, udaf:*, anything unknown: reject at PLAN
                 # time so no partition pays a failed device trace
@@ -636,8 +674,26 @@ class TpuStageExec(ExecutionPlan):
             else:
                 closure = compiler.validity_only(colarg)
             pending[idx] = (K.KernelAggSpec("count", True), closure)
-        specs = [s for s, _ in pending]
-        arg_closures: list[Optional[K.JaxClosure]] = [c for _, c in pending]
+        # flatten per-OUTPUT entries into kernel specs + an emission plan
+        # (the variance family expands one output into two kernel sums)
+        specs: list[K.KernelAggSpec] = []
+        arg_closures: list[Optional[K.JaxClosure]] = []
+        emit: list[tuple] = []
+        for entry in pending:
+            if isinstance(entry, tuple) and entry[0] == "var":
+                _, ddof, use_sqrt, parts = entry
+                emit.append(
+                    ("var", len(specs), len(specs) + 1, ddof, use_sqrt)
+                )
+                for s, c in parts:
+                    specs.append(s)
+                    arg_closures.append(c)
+            else:
+                s, c = entry
+                emit.append(("plain", len(specs)))
+                specs.append(s)
+                arg_closures.append(c)
+        self._emit = emit
         self.leaves = compiler.leaves
         self.specs = specs
         self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
@@ -693,7 +749,14 @@ class TpuStageExec(ExecutionPlan):
             )
         sig = (
             tuple(str(f) for f in fused.filters),
-            tuple((s.func, str(a.arg)) for s, a in zip(specs, fused.aggs)),
+            (
+                tuple(
+                    (s.func, s.pair, s.int_minmax, s.ord_pair)
+                    for s in specs
+                ),
+                tuple(str(a.arg) for a in fused.aggs),
+                tuple(e[0] for e in emit),
+            ),
             self.capacity,
             tuple(self._flat_names),
             str(fused.source.schema),
@@ -762,6 +825,8 @@ class TpuStageExec(ExecutionPlan):
                 self.specs,
                 capacity,
                 self._flat_names,
+                # variance moments need the per-element-compensated scan
+                force_sort=any(e[0] == "var" for e in self._emit),
             )
             if self.fused.join is not None:
                 kernel = K.make_join_kernel(
@@ -849,6 +914,12 @@ class TpuStageExec(ExecutionPlan):
                 host_states, groups, n_rows_in = self._run_keyed(
                     kr.batches, tail, kr.key_encoders, ctx
                 )
+                out_batches = list(
+                    self._materialize(
+                        host_states, kr.key_encoders, groups, n_rows_in,
+                        ctx, partition,
+                    )
+                )
             except (_CapacityExceeded, ExecutionError, RuntimeError):
                 self.metrics.add("tpu_fallback", 1)
                 if not tail.consumed:
@@ -874,10 +945,7 @@ class TpuStageExec(ExecutionPlan):
                     cpu_plan = self.original
                 yield from cpu_plan.execute(partition, ctx)
                 return
-            yield from self._materialize(
-                host_states, kr.key_encoders, groups, n_rows_in, ctx,
-                partition,
-            )
+            yield from out_batches
             return
         except _HighCardinality as hc:
             # groups ~ rows with highcard_mode=cpu: hand the stage to the
@@ -1469,8 +1537,61 @@ class TpuStageExec(ExecutionPlan):
             )
 
         partial = fused.mode == PARTIAL
-        i = 0
-        for spec, a in zip(self.specs, fused.aggs):
+        # state-field offset of each kernel spec in the host arrays
+        offs: list[int] = []
+        off = 0
+        for spec in self.specs:
+            offs.append(off)
+            off += len(K.state_fields(spec, self._mode))
+
+        def sum_and_n(o: int):
+            """(Σ as f64, count) of a sum-spec's states at offset o."""
+            if self._mode == "x32":
+                v = (
+                    host[o][keep].astype(np.float64)
+                    + host[o + 1][keep].astype(np.float64)
+                )
+                return v, host[o + 2][keep]
+            return host[o][keep].astype(np.float64), host[o + 1][keep]
+
+        for entry in self._emit:
+            if entry[0] == "var":
+                _, si, qi, ddof, use_sqrt = entry
+                s_v, n_arr = sum_and_n(offs[si])
+                q_v, _n2 = sum_and_n(offs[qi])
+                n_f = n_arr.astype(np.float64)
+                empty = n_arr < (ddof + 1)
+                with np.errstate(all="ignore"):
+                    var = (
+                        q_v - s_v * s_v / np.maximum(n_f, 1.0)
+                    ) / np.maximum(n_f - ddof, 1.0)
+                # conditioning guard: when the subtraction consumed more
+                # reliable digits than the compensated moments carry
+                # (~2^-45 in x32 via the forced scan path, ~2^-52 in
+                # x64), only the exact CPU path can answer — incl. var
+                # cancelled all the way to <= 0.  Constant columns trip
+                # too (their true variance IS the rounding floor); the
+                # CPU re-run returns the exact 0.
+                with np.errstate(all="ignore"):
+                    m2 = q_v / np.maximum(n_f, 1.0)
+                live = (~empty) & (m2 > 0)
+                kmax = 1e-6 if self._mode == "x32" else 1e-8
+                if bool(np.any(live & (var < m2 * kmax))):
+                    raise ExecutionError(
+                        "variance cancellation past device moment precision"
+                    )
+                var = np.where(var < 0, 0.0, var)  # rounding guard
+                out_v = np.sqrt(var) if use_sqrt else var
+                field_t = schema.field(len(cols)).type
+                arr = pa.array(out_v, pa.float64(), mask=empty)
+                if not arr.type.equals(field_t):
+                    import pyarrow.compute as pc
+
+                    arr = pc.cast(arr, field_t, safe=False)
+                cols.append(arr)
+                continue
+            spec = self.specs[entry[1]]
+            i = offs[entry[1]]
             if spec.func in ("count", "count_star"):
                 cols.append(pa.array(host[i][keep], pa.int64()))
                 i += 1
