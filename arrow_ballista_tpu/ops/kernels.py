@@ -579,7 +579,9 @@ def build_env(
         if spec.kind == "column_ord_pair":
             from .bridge import split_u64_i32, to_u64_order
 
-            ohi, olo = split_u64_i32(to_u64_order(values))
+            # always encode the f64 VALUE (ints cast exactly below 2^53):
+            # consumers decode through order_decode_f64
+            ohi, olo = split_u64_i32(to_u64_order(values.astype(np.float64)))
             env[f"{name}__ohi"] = _pad(ohi, n_padded)
             env[f"{name}__olo"] = _pad(olo, n_padded)
             continue
@@ -1449,16 +1451,19 @@ def make_keyed_prep_kernel(
     specs: list[KernelAggSpec],
     flat_names: list[str],
     holder: dict,
+    extra_names: tuple = (),
 ):
     """Per-batch half of the keyed aggregation.
 
-    ``fn(keys, valid, *leaf_arrays) -> (mask, *keys, *flat_cols)``: runs
-    the fused filter (and, wrapped in :func:`make_join_kernel`, the
-    device join) and emits masked scan-form columns that BUFFER in HBM
-    until the final sort.  ``keys`` is a tuple of per-key code arrays and
-    passes through untouched (it rides the ``seg_ids`` slot so the join
-    wrapper composes unchanged).  ``holder`` captures the static
-    ``kinds``/``plan`` during the first trace for the finish kernel.
+    ``fn(keys, valid, *leaf_arrays) -> (mask, *keys, *flat_cols,
+    *extras)``: runs the fused filter (and, wrapped in
+    :func:`make_join_kernel`, the device join) and emits masked
+    scan-form columns that BUFFER in HBM until the final sort.  ``keys``
+    is a tuple of per-key code arrays and passes through untouched (it
+    rides the ``seg_ids`` slot so the join wrapper composes unchanged).
+    ``extra_names`` are env arrays buffered RAW for post-sort passes
+    (device median).  ``holder`` captures the static ``kinds``/``plan``
+    during the first trace for the finish kernel.
     """
     mode = precision_mode()
 
@@ -1481,8 +1486,73 @@ def make_keyed_prep_kernel(
                 flat.extend(col)
             else:
                 flat.append(col)
-        return (mask,) + tuple(keys) + tuple(flat)
+        extras = tuple(env[nm] for nm in extra_names)
+        return (mask,) + tuple(keys) + tuple(flat) + extras
 
+    return fn
+
+
+_KEYED_MEDIAN_CACHE: dict = {}
+
+
+def keyed_median_kernel(n_keys: int, capacity: int):
+    """Exact per-group median on device (cached per key count/capacity).
+
+    ``fn(mask, keys, vhi, vlo, vvalid) -> packed [5, capacity]``: ONE
+    multi-key sort by (masked-last, *group keys, arg-null-last, value
+    order-pair) places each group's valid values ascending; group
+    boundaries come from a doubled segment id (gid*2 + null_flag) so the
+    VALID-value count per group needs no scatter; the two middle values
+    gather per group and decode/average on host.  Output rows: hi@lo_idx,
+    lo@lo_idx, hi@hi_idx, lo@hi_idx, valid_count.
+    """
+    key = (n_keys, capacity)
+    fn = _KEYED_MEDIAN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def median_fn(mask, keys, vhi, vlo, vvalid):
+        n = mask.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        inv = jnp.logical_not(mask).astype(jnp.int32)
+        argnull = jnp.logical_not(vvalid).astype(jnp.int32)
+        ops = (inv,) + tuple(keys) + (argnull, vhi, vlo, iota)
+        sorted_ = jax.lax.sort(ops, num_keys=3 + n_keys)
+        sinv = sorted_[0]
+        sk = sorted_[1:1 + n_keys]
+        snull = sorted_[1 + n_keys]
+        shi = sorted_[2 + n_keys]
+        slo = sorted_[3 + n_keys]
+        valid = sinv == 0
+        diff = sk[0][1:] != sk[0][:-1]
+        for k in sk[1:]:
+            diff = jnp.logical_or(diff, k[1:] != k[:-1])
+        first = jnp.concatenate([jnp.ones((1,), jnp.bool_), diff])
+        flag = jnp.logical_and(first, valid)
+        gid = jnp.cumsum(flag.astype(jnp.int32)) - 1
+        # doubled id: even slot = valid-arg rows, odd = null-arg rows;
+        # masked rows park past every boundary
+        big = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+        s2 = jnp.where(valid, gid * 2 + snull, big)
+        bounds = jnp.searchsorted(
+            s2, jnp.arange(2 * capacity + 1, dtype=jnp.int32), side="left"
+        )
+        start = bounds[0::2][:capacity]
+        cnt = bounds[1::2] - start
+        lo_idx = jnp.clip(start + (cnt - 1) // 2, 0, max(n - 1, 0))
+        hi_idx = jnp.clip(start + cnt // 2, 0, max(n - 1, 0))
+        idt = jnp.int32 if precision_mode() == "x32" else jnp.int64
+        rows = [
+            shi[lo_idx].astype(idt),
+            slo[lo_idx].astype(idt),
+            shi[hi_idx].astype(idt),
+            slo[hi_idx].astype(idt),
+            cnt.astype(idt),
+        ]
+        return jnp.stack(rows, axis=0)
+
+    fn = jax.jit(median_fn)
+    _KEYED_MEDIAN_CACHE[key] = fn
     return fn
 
 

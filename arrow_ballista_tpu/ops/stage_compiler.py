@@ -558,6 +558,25 @@ class TpuStageExec(ExecutionPlan):
                     raise K.NotLowerable(a.func)
                 pending[idx] = (K.KernelAggSpec("count_star", False), None)
                 continue
+            if a.func == "median":
+                # exact device median: the keyed path sorts each group's
+                # values (order-pair encoded) and gathers the two middle
+                # rows — no host percentile pass.  Needs the keyed
+                # buffering, so the stage is FORCED onto that route.
+                if fused.mode == PARTIAL:
+                    raise K.NotLowerable("median is single-stage")
+                if not fused.group_exprs:
+                    raise K.NotLowerable("global median stays on CPU")
+                if not isinstance(a.arg, pe.Col):
+                    raise K.NotLowerable("median over expression")
+                at = compile_schema.field(a.arg.index).type
+                if not (
+                    pa.types.is_floating(at) or pa.types.is_integer(at)
+                ):
+                    raise K.NotLowerable(f"median over {at}")
+                compiler.ord_pair_column(a.arg)  # ships the encoded pair
+                pending[idx] = ("median", a.arg.index)
+                continue
             if a.func in ("stddev", "stddev_pop", "var", "var_pop"):
                 # variance family lowers as compensated Σx + Σx² (+ the
                 # sum's own count): x32 ships x as an exact double-float
@@ -679,6 +698,7 @@ class TpuStageExec(ExecutionPlan):
         specs: list[K.KernelAggSpec] = []
         arg_closures: list[Optional[K.JaxClosure]] = []
         emit: list[tuple] = []
+        self._median_cols: list[int] = []
         for entry in pending:
             if isinstance(entry, tuple) and entry[0] == "var":
                 _, ddof, use_sqrt, parts = entry
@@ -688,12 +708,17 @@ class TpuStageExec(ExecutionPlan):
                 for s, c in parts:
                     specs.append(s)
                     arg_closures.append(c)
+            elif isinstance(entry, tuple) and entry[0] == "median":
+                emit.append(("median", len(self._median_cols)))
+                self._median_cols.append(entry[1])
             else:
                 s, c = entry
                 emit.append(("plain", len(specs)))
                 specs.append(s)
                 arg_closures.append(c)
         self._emit = emit
+        # medians require the keyed path's buffered columns
+        self._needs_keyed = bool(self._median_cols)
         self.leaves = compiler.leaves
         self.specs = specs
         self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
@@ -911,13 +936,13 @@ class TpuStageExec(ExecutionPlan):
             self.metrics.add("keyed_path", 1)
             tail = _TrackingIter(kr.tail)
             try:
-                host_states, groups, n_rows_in = self._run_keyed(
-                    kr.batches, tail, kr.key_encoders, ctx
+                host_states, groups, n_rows_in, med_results = (
+                    self._run_keyed(kr.batches, tail, kr.key_encoders, ctx)
                 )
                 out_batches = list(
                     self._materialize(
                         host_states, kr.key_encoders, groups, n_rows_in,
-                        ctx, partition,
+                        ctx, partition, med_results=med_results,
                     )
                 )
             except (_CapacityExceeded, ExecutionError, RuntimeError):
@@ -1010,9 +1035,13 @@ class TpuStageExec(ExecutionPlan):
                 )
                 return
         # the device column cache keys on scan inputs; join stages add
-        # build-side state, so they skip it (probe sources are usually
-        # joins/filters anyway)
-        ck = self._cache_key(ctx) if fused.join is None else None
+        # build-side state and median stages must route keyed, so both
+        # skip it (probe sources are usually joins/filters anyway)
+        ck = (
+            self._cache_key(ctx)
+            if fused.join is None and not self._needs_keyed
+            else None
+        )
         if ck is not None:
             cached = device_cache.get(ck[0], partition, ck[1])
             if cached is not None:
@@ -1090,6 +1119,26 @@ class TpuStageExec(ExecutionPlan):
                     with self.metrics.timer("key_encode_time_ns"):
                         codes = self._encode_codes(batch, key_encoders)
                     if acc is None and not entries:
+                        # keys the device can't take raw (i32 overflow
+                        # in x32) disqualify the keyed path up front:
+                        # host-assigned gids are always dense i32, so
+                        # the gid-table path stays available
+                        keyed_ok = self._mode != "x32" or all(
+                            len(c) == 0
+                            or (
+                                c.min() >= -(1 << 31)
+                                and c.max() < (1 << 31)
+                            )
+                            for c in codes
+                        )
+                        if self._needs_keyed:
+                            # median stages live on the keyed path at any
+                            # cardinality; unshippable keys → CPU (replay)
+                            if keyed_ok:
+                                raise _KeyedRoute(
+                                    [(batch, codes)], src, key_encoders, ra
+                                )
+                            raise _HighCardinality([batch], src)
                         try:
                             with self.metrics.timer("key_encode_time_ns"):
                                 seg = self._assign_gids(codes, group_table)
@@ -1101,18 +1150,6 @@ class TpuStageExec(ExecutionPlan):
                         if first_groups is None or _highcard_detect(
                             first_groups, n
                         ):
-                            # keys the device can't take raw (i32 overflow
-                            # in x32) disqualify the keyed path up front:
-                            # host-assigned gids are always dense i32, so
-                            # the gid-table path stays available
-                            keyed_ok = self._mode != "x32" or all(
-                                len(c) == 0
-                                or (
-                                    c.min() >= -(1 << 31)
-                                    and c.max() < (1 << 31)
-                                )
-                                for c in codes
-                            )
                             if (
                                 self.config.tpu_highcard_mode != "cpu"
                                 and keyed_ok
@@ -1237,6 +1274,7 @@ class TpuStageExec(ExecutionPlan):
                 self.specs,
                 self._flat_names,
                 holder,
+                extra_names=self._median_extra_names(),
             )
             if self.fused.join is not None:
                 kernel = K.make_join_kernel(
@@ -1250,6 +1288,15 @@ class TpuStageExec(ExecutionPlan):
             cached = (holder, jax.jit(kernel))
             _KERNEL_CACHE[key] = cached
         return cached
+
+    def _median_extra_names(self) -> tuple:
+        """Env names of the median arguments' order-pair leaves, buffered
+        raw through the keyed prep for the post-sort median pass."""
+        out: list[str] = []
+        for ci in self._median_cols:
+            base = f"col_{ci}__ordpair"
+            out.extend([f"{base}__ohi", f"{base}__olo", f"{base}__valid"])
+        return tuple(out)
 
     def _run_keyed(self, first: list, src, key_encoders, ctx: TaskContext):
         """Device-keyed aggregation (VERDICT r3 item 2): per batch the
@@ -1319,8 +1366,11 @@ class TpuStageExec(ExecutionPlan):
                         jnp.pad(f, (0, n2 - total)) for f in fields
                     ]
                 mask = fields[0]
+                n_extras = 3 * len(self._median_cols)
                 keys = fields[1:1 + n_keys]
-                flat_cols = fields[1 + n_keys:]
+                flat_end = len(fields) - n_extras
+                flat_cols = fields[1 + n_keys:flat_end]
+                extras = fields[flat_end:]
                 out = K.keyed_sort_kernel(n_keys)(mask, *keys)
                 s2, perm = out[0], out[1]
                 sk = out[2:-1]
@@ -1337,10 +1387,22 @@ class TpuStageExec(ExecutionPlan):
             with self.metrics.timer("device_time_ns"):
                 packed = finish(s2, perm, tuple(sk), tuple(flat_cols))
                 host = np.asarray(packed)
+                med_results: list[np.ndarray] = []
+                for j in range(len(self._median_cols)):
+                    med_fn = K.keyed_median_kernel(n_keys, cap)
+                    med_packed = med_fn(
+                        mask, tuple(keys),
+                        extras[3 * j], extras[3 * j + 1],
+                        extras[3 * j + 2],
+                    )
+                    med_results.append(np.asarray(med_packed))
         states, key_codes = K.unpack_keyed_host(
             self.specs, host, self._mode, n_keys
         )
-        return states, _KeyedGroups(key_codes, n_groups), n_rows_in
+        return (
+            states, _KeyedGroups(key_codes, n_groups), n_rows_in,
+            med_results,
+        )
 
     # ------------------------------------------------------- device join
     def _nojoin_stage(self) -> "TpuStageExec":
@@ -1477,7 +1539,7 @@ class TpuStageExec(ExecutionPlan):
     # ------------------------------------------------------- materialize
     def _materialize(
         self, host_states, key_encoders, group_table, n_rows_in,
-        ctx: TaskContext, partition: int,
+        ctx: TaskContext, partition: int, med_results=None,
     ) -> Iterator[pa.RecordBatch]:
         """Build the output batch from already-fetched numpy state arrays
         (``host_states`` comes from :meth:`_fetch_states`; device work and
@@ -1555,6 +1617,32 @@ class TpuStageExec(ExecutionPlan):
             return host[o][keep].astype(np.float64), host[o + 1][keep]
 
         for entry in self._emit:
+            if entry[0] == "median":
+                if med_results is None:
+                    # only the keyed path buffers the value columns
+                    raise ExecutionError("median requires the keyed path")
+                from .bridge import order_decode_f64
+
+                med = med_results[entry[1]]
+                cv = med[4][keep]
+                empty = cv == 0
+                va = order_decode_f64(
+                    np.where(empty, 0, med[0][keep]).astype(np.int32),
+                    np.where(empty, 0, med[1][keep]).astype(np.int32),
+                )
+                vb = order_decode_f64(
+                    np.where(empty, 0, med[2][keep]).astype(np.int32),
+                    np.where(empty, 0, med[3][keep]).astype(np.int32),
+                )
+                v = (va + vb) / 2.0
+                field_t = schema.field(len(cols)).type
+                arr = pa.array(v, pa.float64(), mask=empty)
+                if not arr.type.equals(field_t):
+                    import pyarrow.compute as pc
+
+                    arr = pc.cast(arr, field_t, safe=False)
+                cols.append(arr)
+                continue
             if entry[0] == "var":
                 _, si, qi, ddof, use_sqrt = entry
                 s_v, n_arr = sum_and_n(offs[si])

@@ -1,0 +1,162 @@
+"""Exact device median (keyed-path sort + middle-row gather).
+
+The stage ships each median argument as an order-preserving (hi, lo) i32
+pair; ONE multi-key device sort per median column places each group's
+valid values ascending, a doubled segment id separates null-argument
+rows without any scatter, and the two middle rows gather per group —
+decode + average happen on host.  Stages containing a median are FORCED
+onto the keyed route at any cardinality.
+
+Oracle: the CPU operator path (pandas group medians).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops import kernels as K
+from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    K.set_precision(None)
+
+
+def _ctx(tpu: bool) -> SessionContext:
+    return SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": str(tpu).lower(),
+                "ballista.tpu.min_rows": "0",
+                "ballista.mesh.enable": "false",
+            }
+        )
+    )
+
+
+def _both(sql, t, mode, partitions=1):
+    K.set_precision(None)
+    cpu = _ctx(False)
+    cpu.register_table("t", MemoryTable.from_table(t, partitions))
+    want = cpu.sql(sql).collect()
+    K.set_precision(mode)
+    dev = _ctx(True)
+    dev.register_table("t", MemoryTable.from_table(t, partitions))
+    plan = dev.sql(sql).physical_plan()
+    got = dev.execute(plan)
+    m: dict = {}
+    stack = [plan]
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, TpuStageExec):
+            for kk, vv in nd.metrics.values.items():
+                m[kk] = m.get(kk, 0) + vv
+        stack.extend(nd.children())
+    key = [("k", "ascending")]
+    return want.sort_by(key), got.sort_by(key), m
+
+
+def _assert_close(a, b, rel=1e-6):
+    assert a.num_rows == b.num_rows
+    for name in a.schema.names:
+        for x, y in zip(a.column(name).to_pylist(), b.column(name).to_pylist()):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=rel), name
+            else:
+                assert x == y, (name, x, y)
+
+
+def _data(n=5000, n_groups=37, seed=17, null_frac=0.07):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, n_groups, n)
+    v = rng.uniform(0, 1000, n)
+    vmask = rng.uniform(size=n) < null_frac
+    iv = rng.integers(-500, 500, n)
+    return pa.table(
+        {
+            "k": pa.array(k.astype(np.int64)),
+            "v": pa.array(v, pa.float64(), mask=vmask),
+            "iv": pa.array(iv, pa.int64()),
+        }
+    )
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_median_exact_on_device(mode):
+    t = _data()
+    want, got, m = _both(
+        "select k, median(v) as md, count(*) as c from t group by k",
+        t, mode,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    # medians are gathers of exact order-pairs: EXACT equality
+    assert want.column("md").to_pylist() == got.column("md").to_pylist()
+    _assert_close(want, got)
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_median_mixed_with_stddev_and_sums(mode):
+    """h2o q6 shape: median + stddev (+ sum/avg) in one stage."""
+    t = _data()
+    want, got, m = _both(
+        "select k, median(v) as md, stddev(v) as sd, avg(v) as a, "
+        "sum(iv) as s from t group by k",
+        t, mode,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got)
+
+
+def test_median_int_column_and_two_medians():
+    t = _data()
+    want, got, m = _both(
+        "select k, median(v) as mv, median(iv) as mi from t group by k",
+        t, "x32",
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert want.column("mi").to_pylist() == got.column("mi").to_pylist()
+    _assert_close(want, got)
+
+
+def test_median_all_null_group_and_tiny_groups():
+    k = pa.array([1, 1, 2, 2, 2, 3, 4, 4], pa.int64())
+    v = pa.array(
+        [10.0, 20.0, None, None, None, 7.5, 1.0, None], pa.float64()
+    )
+    t = pa.table({"k": k, "v": v})
+    want, got, m = _both(
+        "select k, median(v) as md from t group by k", t, "x64"
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert got.column("md").to_pylist() == [15.0, None, 7.5, 1.0]
+    _assert_close(want, got)
+
+
+def test_median_multi_partition_and_batches():
+    t = _data(n=8000)
+    K.set_precision(None)
+    cpu = _ctx(False)
+    cpu.register_table("t", MemoryTable.from_table(t, 3))
+    want = cpu.sql(
+        "select k, median(v) as md from t group by k"
+    ).collect()
+    dev = SessionContext(
+        BallistaConfig(
+            {
+                "ballista.tpu.enable": "true",
+                "ballista.tpu.min_rows": "0",
+                "ballista.mesh.enable": "false",
+                "ballista.batch.size": "1000",
+            }
+        )
+    )
+    dev.register_table("t", MemoryTable.from_table(t, 3))
+    got = dev.sql("select k, median(v) as md from t group by k").collect()
+    key = [("k", "ascending")]
+    _assert_close(want.sort_by(key), got.sort_by(key))
