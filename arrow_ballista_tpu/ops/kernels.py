@@ -1496,15 +1496,17 @@ _KEYED_MEDIAN_CACHE: dict = {}
 
 
 def keyed_median_kernel(n_keys: int, capacity: int):
-    """Exact per-group median on device (cached per key count/capacity).
+    """Per-group sorted-argument pass: exact median AND distinct count
+    (cached per key count/capacity).
 
-    ``fn(mask, keys, vhi, vlo, vvalid) -> packed [5, capacity]``: ONE
+    ``fn(mask, keys, vhi, vlo, vvalid) -> packed [6, capacity]``: ONE
     multi-key sort by (masked-last, *group keys, arg-null-last, value
     order-pair) places each group's valid values ascending; group
     boundaries come from a doubled segment id (gid*2 + null_flag) so the
     VALID-value count per group needs no scatter; the two middle values
-    gather per group and decode/average on host.  Output rows: hi@lo_idx,
-    lo@lo_idx, hi@hi_idx, lo@hi_idx, valid_count.
+    gather per group (decode/average on host) and distinct values count
+    as run-starts via one cumsum.  Output rows: hi@lo_idx, lo@lo_idx,
+    hi@hi_idx, lo@hi_idx, valid_count, distinct_count.
     """
     key = (n_keys, capacity)
     fn = _KEYED_MEDIAN_CACHE.get(key)
@@ -1538,9 +1540,25 @@ def keyed_median_kernel(n_keys: int, capacity: int):
             s2, jnp.arange(2 * capacity + 1, dtype=jnp.int32), side="left"
         )
         start = bounds[0::2][:capacity]
-        cnt = bounds[1::2] - start
+        end_valid = bounds[1::2]
+        cnt = end_valid - start
         lo_idx = jnp.clip(start + (cnt - 1) // 2, 0, max(n - 1, 0))
         hi_idx = jnp.clip(start + cnt // 2, 0, max(n - 1, 0))
+        # distinct count: value-run starts among each group's valid rows
+        vdiff = jnp.logical_or(shi[1:] != shi[:-1], slo[1:] != slo[:-1])
+        runfirst = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), jnp.logical_or(diff, vdiff)]
+        )
+        dflag = jnp.logical_and(
+            jnp.logical_and(runfirst, valid), snull == 0
+        )
+        cum0 = jnp.concatenate(
+            [
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(dflag.astype(jnp.int32)),
+            ]
+        )
+        distinct = cum0[end_valid] - cum0[start]
         idt = jnp.int32 if precision_mode() == "x32" else jnp.int64
         rows = [
             shi[lo_idx].astype(idt),
@@ -1548,6 +1566,7 @@ def keyed_median_kernel(n_keys: int, capacity: int):
             shi[hi_idx].astype(idt),
             slo[hi_idx].astype(idt),
             cnt.astype(idt),
+            distinct.astype(idt),
         ]
         return jnp.stack(rows, axis=0)
 

@@ -577,6 +577,27 @@ class TpuStageExec(ExecutionPlan):
                 compiler.ord_pair_column(a.arg)  # ships the encoded pair
                 pending[idx] = ("median", a.arg.index)
                 continue
+            if a.func == "count_distinct":
+                # per-group distinct count rides the same sorted-argument
+                # pass as median: run-starts among each group's sorted
+                # valid values, one cumsum (q16's count(distinct
+                # ps_suppkey) shape)
+                if fused.mode == PARTIAL:
+                    raise K.NotLowerable("count_distinct is single-stage")
+                if not fused.group_exprs:
+                    raise K.NotLowerable("global count_distinct on CPU")
+                if not isinstance(a.arg, pe.Col):
+                    raise K.NotLowerable("count_distinct over expression")
+                at = compile_schema.field(a.arg.index).type
+                if not (
+                    pa.types.is_floating(at)
+                    or pa.types.is_integer(at)
+                    or pa.types.is_date(at)
+                ):
+                    raise K.NotLowerable(f"count_distinct over {at}")
+                compiler.ord_pair_column(a.arg)
+                pending[idx] = ("cdist", a.arg.index)
+                continue
             if a.func in ("stddev", "stddev_pop", "var", "var_pop"):
                 # variance family lowers as compensated Σx + Σx² (+ the
                 # sum's own count): x32 ships x as an exact double-float
@@ -708,9 +729,14 @@ class TpuStageExec(ExecutionPlan):
                 for s, c in parts:
                     specs.append(s)
                     arg_closures.append(c)
-            elif isinstance(entry, tuple) and entry[0] == "median":
-                emit.append(("median", len(self._median_cols)))
-                self._median_cols.append(entry[1])
+            elif isinstance(entry, tuple) and entry[0] in ("median", "cdist"):
+                ci = entry[1]
+                if ci in self._median_cols:
+                    slot = self._median_cols.index(ci)
+                else:
+                    slot = len(self._median_cols)
+                    self._median_cols.append(ci)
+                emit.append((entry[0], slot))
             else:
                 s, c = entry
                 emit.append(("plain", len(specs)))
@@ -1617,6 +1643,20 @@ class TpuStageExec(ExecutionPlan):
             return host[o][keep].astype(np.float64), host[o + 1][keep]
 
         for entry in self._emit:
+            if entry[0] == "cdist":
+                if med_results is None:
+                    raise ExecutionError(
+                        "count_distinct requires the keyed path"
+                    )
+                cd = med_results[entry[1]][5][keep].astype(np.int64)
+                field_t = schema.field(len(cols)).type
+                arr = pa.array(cd, pa.int64())
+                if not arr.type.equals(field_t):
+                    import pyarrow.compute as pc
+
+                    arr = pc.cast(arr, field_t, safe=False)
+                cols.append(arr)
+                continue
             if entry[0] == "median":
                 if med_results is None:
                     # only the keyed path buffers the value columns
@@ -1808,8 +1848,6 @@ def maybe_accelerate(plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPl
         except K.NotLowerable:
             return plan
     if isinstance(plan, HashAggregateExec) and plan.mode in (PARTIAL, SINGLE):
-        if any(a.func == "count_distinct" for a in plan.aggs):
-            return plan
         fused = _flatten(plan)
         if fused is None:
             return plan

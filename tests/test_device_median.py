@@ -160,3 +160,48 @@ def test_median_multi_partition_and_batches():
     got = dev.sql("select k, median(v) as md from t group by k").collect()
     key = [("k", "ascending")]
     _assert_close(want.sort_by(key), got.sort_by(key))
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_count_distinct_on_device(mode):
+    """count(distinct x) rides the sorted-argument pass: run-start
+    counting among each group's sorted valid values (q16 shape)."""
+    t = _data()
+    want, got, m = _both(
+        "select k, count(distinct iv) as cd, count(distinct v) as cdv, "
+        "count(*) as c from t group by k",
+        t, mode,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    assert want.column("cd").to_pylist() == got.column("cd").to_pylist()
+    assert want.column("cdv").to_pylist() == got.column("cdv").to_pylist()
+    _assert_close(want, got)
+
+
+def test_count_distinct_with_median_same_column_one_pass():
+    """median + count_distinct over the SAME column share one sorted
+    pass (deduped slot)."""
+    t = _data()
+    want, got, m = _both(
+        "select k, median(v) as md, count(distinct v) as cd "
+        "from t group by k",
+        t, "x64",
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert want.column("cd").to_pylist() == got.column("cd").to_pylist()
+    _assert_close(want, got)
+
+
+def test_count_distinct_all_null_group_is_zero():
+    t = pa.table(
+        {
+            "k": pa.array([1, 1, 2, 2], pa.int64()),
+            "v": pa.array([5.0, 5.0, None, None], pa.float64()),
+        }
+    )
+    want, got, m = _both(
+        "select k, count(distinct v) as cd from t group by k", t, "x64"
+    )
+    assert got.column("cd").to_pylist() == [1, 0]
+    _assert_close(want, got)
