@@ -1462,8 +1462,9 @@ def make_keyed_prep_kernel(
     is a tuple of per-key code arrays and passes through untouched (it
     rides the ``seg_ids`` slot so the join wrapper composes unchanged).
     ``extra_names`` are env arrays buffered RAW for post-sort passes
-    (device median).  ``holder`` captures the static ``kinds``/``plan``
-    during the first trace for the finish kernel.
+    (device median / count_distinct / corr).  ``holder`` captures the
+    static ``kinds``/``plan`` during the first trace for the finish
+    kernel.
     """
     mode = precision_mode()
 
@@ -1676,6 +1677,130 @@ def keyed_finish_kernel(
 
     fn = jax.jit(finish_fn)
     _KEYED_FINISH_CACHE[cache_key] = fn
+    return fn
+
+
+_KEYED_CORR_CACHE: dict = {}
+
+
+def keyed_corr_kernel(capacity: int, mode: str):
+    """Per-group Pearson correlation moments, PER-GROUP centered.
+
+    Reuses the keyed path's phase-1 sort (``s2``/``perm``): pass 1 scans
+    per-group Σx, Σy, n over pairwise-valid rows (null or NaN in either
+    argument drops the row from every sum, pandas semantics); the
+    per-group means gather back to rows; pass 2 scans the CENTERED
+    products Σx'y', Σx'², Σy'².  Centering by each group's own mean is
+    strictly stronger conditioning than the CPU operator's global-mean
+    centering — the center constant need not be exact, it only has to
+    kill the magnitude.
+
+    x32: ``fn(s2, perm, xhi, xlo, xvalid, yhi, ylo, yvalid)``; x64:
+    ``fn(s2, perm, x, xvalid, y, yvalid)``.  Returns packed integer rows
+    [Σxy(hi,lo) Σxx(hi,lo) Σyy(hi,lo) n] (x32) / [Σxy Σxx Σyy n] (x64);
+    the host finalizes Σxy/√(Σxx·Σyy).
+    """
+    key = (capacity, mode)
+    fn = _KEYED_CORR_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    if mode == "x32":
+
+        def corr_fn(s2, perm, xhi, xlo, xvalid, yhi, ylo, yvalid):
+            m = jnp.logical_and(xvalid, yvalid)
+            m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(xhi)))
+            m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(yhi)))
+            z = jnp.zeros((), jnp.float32)
+            kinds1 = ["df32", "df32", "i32"]
+            cols1 = [
+                (jnp.where(m, xhi, z), jnp.where(m, xlo, z)),
+                (jnp.where(m, yhi, z), jnp.where(m, ylo, z)),
+                m.astype(jnp.int32),
+            ]
+            (sx, sy, n_pair), _pres, _b = _scan_segments(
+                s2, perm, capacity, kinds1, cols1
+            )
+            nf = jnp.maximum(n_pair, 1).astype(jnp.float32)
+            mx = (sx[0] + sx[1]) / nf
+            my = (sy[0] + sy[1]) / nf
+            gid = jnp.clip(s2, 0, capacity - 1)
+            # centered values in sorted-row order: gather means per row
+            mxr = mx[gid]
+            myr = my[gid]
+            # perm-gathered (sorted) argument rows
+            xs_hi, xs_lo = xhi[perm], xlo[perm]
+            ys_hi, ys_lo = yhi[perm], ylo[perm]
+            ms = m[perm]
+            xc = (xs_hi - mxr) + xs_lo
+            yc = (ys_hi - myr) + ys_lo
+            kinds2 = ["df32", "df32", "df32"]
+            zero = jnp.zeros_like(xc)
+            cols2 = [
+                (jnp.where(ms, xc * yc, z), zero),
+                (jnp.where(ms, xc * xc, z), zero),
+                (jnp.where(ms, yc * yc, z), zero),
+            ]
+            # cols are already in SORTED order: identity perm for pass 2
+            iota = jnp.arange(s2.shape[0], dtype=jnp.int32)
+            (sxy, sxx, syy), _p2, _b2 = _scan_segments(
+                s2, iota, capacity, kinds2, cols2
+            )
+            idt = jnp.int32
+            rows = [
+                jax.lax.bitcast_convert_type(sxy[0], idt),
+                jax.lax.bitcast_convert_type(sxy[1], idt),
+                jax.lax.bitcast_convert_type(sxx[0], idt),
+                jax.lax.bitcast_convert_type(sxx[1], idt),
+                jax.lax.bitcast_convert_type(syy[0], idt),
+                jax.lax.bitcast_convert_type(syy[1], idt),
+                n_pair.astype(idt),
+            ]
+            return jnp.stack(rows, axis=0)
+
+    else:
+
+        def corr_fn(s2, perm, x, xvalid, y, yvalid):
+            m = jnp.logical_and(xvalid, yvalid)
+            m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(x)))
+            m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(y)))
+            z = jnp.zeros((), jnp.float64)
+            kinds1 = ["f64", "f64", "i32"]
+            cols1 = [
+                jnp.where(m, x, z),
+                jnp.where(m, y, z),
+                m.astype(jnp.int64),
+            ]
+            (sx, sy, n_pair), _pres, _b = _scan_segments(
+                s2, perm, capacity, kinds1, cols1
+            )
+            nf = jnp.maximum(n_pair, 1).astype(jnp.float64)
+            mx = sx / nf
+            my = sy / nf
+            gid = jnp.clip(s2, 0, capacity - 1)
+            xs, ys, ms = x[perm], y[perm], m[perm]
+            xc = xs - mx[gid]
+            yc = ys - my[gid]
+            iota = jnp.arange(s2.shape[0], dtype=jnp.int32)
+            (sxy, sxx, syy), _p2, _b2 = _scan_segments(
+                s2, iota, capacity, ["f64", "f64", "f64"],
+                [
+                    jnp.where(ms, xc * yc, z),
+                    jnp.where(ms, xc * xc, z),
+                    jnp.where(ms, yc * yc, z),
+                ],
+            )
+            idt = jnp.int64
+            rows = [
+                jax.lax.bitcast_convert_type(sxy, idt),
+                jax.lax.bitcast_convert_type(sxx, idt),
+                jax.lax.bitcast_convert_type(syy, idt),
+                n_pair.astype(idt),
+            ]
+            return jnp.stack(rows, axis=0)
+
+    fn = jax.jit(corr_fn)
+    _KEYED_CORR_CACHE[key] = fn
     return fn
 
 

@@ -369,7 +369,9 @@ def _flatten(
         group_exprs = [(_subst(g, mapping), name) for g, name in agg.group_exprs]
         aggs = [
             dataclasses.replace(
-                a, arg=_subst(a.arg, mapping) if a.arg is not None else None
+                a,
+                arg=_subst(a.arg, mapping) if a.arg is not None else None,
+                arg2=_subst(a.arg2, mapping) if a.arg2 is not None else None,
             )
             for a in agg.aggs
         ]
@@ -448,6 +450,8 @@ def _maybe_fold_join(fused: _FusedStage) -> Optional[_FusedStage]:
     for a in fused.aggs:
         if a.arg is not None:
             _cols_used(a.arg, used)
+        if a.arg2 is not None:
+            _cols_used(a.arg2, used)
 
     build_cols: list[int] = []
     remap: dict = {}
@@ -489,7 +493,13 @@ def _maybe_fold_join(fused: _FusedStage) -> Optional[_FusedStage]:
         ]
         aggs = [
             dataclasses.replace(
-                a, arg=_shift_cols(a.arg, remap) if a.arg is not None else None
+                a,
+                arg=_shift_cols(a.arg, remap) if a.arg is not None else None,
+                arg2=(
+                    _shift_cols(a.arg2, remap)
+                    if a.arg2 is not None
+                    else None
+                ),
             )
             for a in fused.aggs
         ]
@@ -597,6 +607,31 @@ class TpuStageExec(ExecutionPlan):
                     raise K.NotLowerable(f"count_distinct over {at}")
                 compiler.ord_pair_column(a.arg)
                 pending[idx] = ("cdist", a.arg.index)
+                continue
+            if a.func == "corr":
+                # Pearson r on the keyed path, PER-GROUP centered (the
+                # CPU operator centers by the global mean; per-group is
+                # strictly better conditioned).  Null/NaN in either
+                # argument drops the row pairwise (pandas semantics).
+                if fused.mode == PARTIAL:
+                    raise K.NotLowerable("corr is single-stage")
+                if not fused.group_exprs:
+                    raise K.NotLowerable("global corr stays on CPU")
+                for e in (a.arg, a.arg2):
+                    if not isinstance(e, pe.Col):
+                        raise K.NotLowerable("corr over expression")
+                    at = compile_schema.field(e.index).type
+                    if not (
+                        pa.types.is_floating(at) or pa.types.is_integer(at)
+                    ):
+                        raise K.NotLowerable(f"corr over {at}")
+                if x32:
+                    compiler.pair_column(a.arg)
+                    compiler.pair_column(a.arg2)
+                else:
+                    compiler._leaf_column(a.arg)
+                    compiler._leaf_column(a.arg2)
+                pending[idx] = ("corr", a.arg.index, a.arg2.index)
                 continue
             if a.func in ("stddev", "stddev_pop", "var", "var_pop"):
                 # variance family lowers as compensated Σx + Σx² (+ the
@@ -720,6 +755,8 @@ class TpuStageExec(ExecutionPlan):
         arg_closures: list[Optional[K.JaxClosure]] = []
         emit: list[tuple] = []
         self._median_cols: list[int] = []
+        self._corr_cols: list[int] = []
+        self._corr_pairs: list[tuple] = []
         for entry in pending:
             if isinstance(entry, tuple) and entry[0] == "var":
                 _, ddof, use_sqrt, parts = entry
@@ -737,14 +774,33 @@ class TpuStageExec(ExecutionPlan):
                     slot = len(self._median_cols)
                     self._median_cols.append(ci)
                 emit.append((entry[0], slot))
+            elif isinstance(entry, tuple) and entry[0] == "corr":
+                slots = []
+                for ci in (entry[1], entry[2]):
+                    if ci in self._corr_cols:
+                        slots.append(self._corr_cols.index(ci))
+                    else:
+                        slots.append(len(self._corr_cols))
+                        self._corr_cols.append(ci)
+                # r is symmetric: canonicalize so corr(x,y) and
+                # corr(y,x) share one device pass
+                pair = tuple(sorted(slots))
+                if pair in self._corr_pairs:
+                    pslot = self._corr_pairs.index(pair)
+                else:
+                    pslot = len(self._corr_pairs)
+                    self._corr_pairs.append(pair)
+                emit.append(("corr", pslot))
             else:
                 s, c = entry
                 emit.append(("plain", len(specs)))
                 specs.append(s)
                 arg_closures.append(c)
         self._emit = emit
-        # medians require the keyed path's buffered columns
-        self._needs_keyed = bool(self._median_cols)
+        # median/count_distinct/corr require the keyed path's buffers
+        self._needs_keyed = bool(self._median_cols) or bool(
+            self._corr_pairs
+        )
         self.leaves = compiler.leaves
         self.specs = specs
         self.capacity = config.tpu_segment_capacity if fused.group_exprs else 1
@@ -962,13 +1018,13 @@ class TpuStageExec(ExecutionPlan):
             self.metrics.add("keyed_path", 1)
             tail = _TrackingIter(kr.tail)
             try:
-                host_states, groups, n_rows_in, med_results = (
+                host_states, groups, n_rows_in, aux = (
                     self._run_keyed(kr.batches, tail, kr.key_encoders, ctx)
                 )
                 out_batches = list(
                     self._materialize(
                         host_states, kr.key_encoders, groups, n_rows_in,
-                        ctx, partition, med_results=med_results,
+                        ctx, partition, aux=aux,
                     )
                 )
             except (_CapacityExceeded, ExecutionError, RuntimeError):
@@ -1316,12 +1372,20 @@ class TpuStageExec(ExecutionPlan):
         return cached
 
     def _median_extra_names(self) -> tuple:
-        """Env names of the median arguments' order-pair leaves, buffered
-        raw through the keyed prep for the post-sort median pass."""
+        """Env names of the median/corr argument leaves, buffered raw
+        through the keyed prep for the post-sort passes."""
         out: list[str] = []
         for ci in self._median_cols:
             base = f"col_{ci}__ordpair"
             out.extend([f"{base}__ohi", f"{base}__olo", f"{base}__valid"])
+        for ci in self._corr_cols:
+            if self._mode == "x32":
+                base = f"col_{ci}__pair"
+                out.extend(
+                    [f"{base}__hi", f"{base}__lo", f"{base}__valid"]
+                )
+            else:
+                out.extend([f"col_{ci}", f"col_{ci}__valid"])
         return tuple(out)
 
     def _run_keyed(self, first: list, src, key_encoders, ctx: TaskContext):
@@ -1333,10 +1397,11 @@ class TpuStageExec(ExecutionPlan):
         returns states + unique key codes.  Host work per batch is one
         astype per key — no hash probe, no factorize.
 
-        Returns ``(host_states, _KeyedGroups, n_rows_in)``; raises
-        ``ExecutionError`` (keys can't ship) or ``_CapacityExceeded``
-        (cardinality past tpu.max_capacity) for the caller's CPU
-        fallback.
+        Returns ``(host_states, _KeyedGroups, n_rows_in, aux)`` where
+        ``aux = {"median": [...], "corr": [...]}`` holds the post-sort
+        pass results; raises ``ExecutionError`` (keys can't ship) or
+        ``_CapacityExceeded`` (cardinality past tpu.max_capacity) for
+        the caller's CPU fallback.
         """
         import jax
         import jax.numpy as jnp
@@ -1392,7 +1457,10 @@ class TpuStageExec(ExecutionPlan):
                         jnp.pad(f, (0, n2 - total)) for f in fields
                     ]
                 mask = fields[0]
-                n_extras = 3 * len(self._median_cols)
+                per_corr = 3 if self._mode == "x32" else 2
+                n_extras = 3 * len(self._median_cols) + per_corr * len(
+                    self._corr_cols
+                )
                 keys = fields[1:1 + n_keys]
                 flat_end = len(fields) - n_extras
                 flat_cols = fields[1 + n_keys:flat_end]
@@ -1422,13 +1490,24 @@ class TpuStageExec(ExecutionPlan):
                         extras[3 * j + 2],
                     )
                     med_results.append(np.asarray(med_packed))
+                corr_results: list[np.ndarray] = []
+                corr_base = 3 * len(self._median_cols)
+
+                def corr_col(slot: int):
+                    o = corr_base + per_corr * slot
+                    return extras[o:o + per_corr]
+
+                for sx, sy in self._corr_pairs:
+                    cf = K.keyed_corr_kernel(cap, self._mode)
+                    packed_c = cf(
+                        s2, perm, *corr_col(sx), *corr_col(sy)
+                    )
+                    corr_results.append(np.asarray(packed_c))
         states, key_codes = K.unpack_keyed_host(
             self.specs, host, self._mode, n_keys
         )
-        return (
-            states, _KeyedGroups(key_codes, n_groups), n_rows_in,
-            med_results,
-        )
+        aux = {"median": med_results, "corr": corr_results}
+        return states, _KeyedGroups(key_codes, n_groups), n_rows_in, aux
 
     # ------------------------------------------------------- device join
     def _nojoin_stage(self) -> "TpuStageExec":
@@ -1565,7 +1644,7 @@ class TpuStageExec(ExecutionPlan):
     # ------------------------------------------------------- materialize
     def _materialize(
         self, host_states, key_encoders, group_table, n_rows_in,
-        ctx: TaskContext, partition: int, med_results=None,
+        ctx: TaskContext, partition: int, aux=None,
     ) -> Iterator[pa.RecordBatch]:
         """Build the output batch from already-fetched numpy state arrays
         (``host_states`` comes from :meth:`_fetch_states`; device work and
@@ -1643,12 +1722,48 @@ class TpuStageExec(ExecutionPlan):
             return host[o][keep].astype(np.float64), host[o + 1][keep]
 
         for entry in self._emit:
+            if entry[0] == "corr":
+                if aux is None:
+                    raise ExecutionError("corr requires the keyed path")
+                pkd = aux["corr"][entry[1]]
+                if self._mode == "x32":
+                    f32 = np.float32
+                    sxy = (
+                        pkd[0][keep].view(f32).astype(np.float64)
+                        + pkd[1][keep].view(f32)
+                    )
+                    sxx = (
+                        pkd[2][keep].view(f32).astype(np.float64)
+                        + pkd[3][keep].view(f32)
+                    )
+                    syy = (
+                        pkd[4][keep].view(f32).astype(np.float64)
+                        + pkd[5][keep].view(f32)
+                    )
+                    n_arr = pkd[6][keep]
+                else:
+                    sxy = pkd[0][keep].view(np.float64)
+                    sxx = pkd[1][keep].view(np.float64)
+                    syy = pkd[2][keep].view(np.float64)
+                    n_arr = pkd[3][keep]
+                empty = (n_arr < 2) | (sxx <= 0) | (syy <= 0)
+                with np.errstate(all="ignore"):
+                    r = sxy / np.sqrt(sxx * syy)
+                r = np.where(empty, 0.0, r)
+                field_t = schema.field(len(cols)).type
+                arr = pa.array(r, pa.float64(), mask=empty)
+                if not arr.type.equals(field_t):
+                    import pyarrow.compute as pc
+
+                    arr = pc.cast(arr, field_t, safe=False)
+                cols.append(arr)
+                continue
             if entry[0] == "cdist":
-                if med_results is None:
+                if aux is None:
                     raise ExecutionError(
                         "count_distinct requires the keyed path"
                     )
-                cd = med_results[entry[1]][5][keep].astype(np.int64)
+                cd = aux["median"][entry[1]][5][keep].astype(np.int64)
                 field_t = schema.field(len(cols)).type
                 arr = pa.array(cd, pa.int64())
                 if not arr.type.equals(field_t):
@@ -1658,12 +1773,12 @@ class TpuStageExec(ExecutionPlan):
                 cols.append(arr)
                 continue
             if entry[0] == "median":
-                if med_results is None:
+                if aux is None:
                     # only the keyed path buffers the value columns
                     raise ExecutionError("median requires the keyed path")
                 from .bridge import order_decode_f64
 
-                med = med_results[entry[1]]
+                med = aux["median"][entry[1]]
                 cv = med[4][keep]
                 empty = cv == 0
                 va = order_decode_f64(

@@ -205,3 +205,49 @@ def test_count_distinct_all_null_group_is_zero():
     )
     assert got.column("cd").to_pylist() == [1, 0]
     _assert_close(want, got)
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_corr_on_device(mode):
+    """corr(x, y) on the keyed path, per-group centered moments (h2o q9
+    shape): pairwise null/NaN drop, 1e-6 vs the CPU operator oracle."""
+    rng = np.random.default_rng(29)
+    n = 6000
+    k = rng.integers(0, 30, n)
+    x = rng.uniform(0, 100, n)
+    y = 3.0 * x + rng.normal(0, 25, n)  # correlated with noise
+    xmask = rng.uniform(size=n) < 0.05
+    ymask = rng.uniform(size=n) < 0.05
+    t = pa.table(
+        {
+            "k": pa.array(k.astype(np.int64)),
+            "x": pa.array(x, pa.float64(), mask=xmask),
+            "y": pa.array(y, pa.float64(), mask=ymask),
+        }
+    )
+    want, got, m = _both(
+        "select k, corr(x, y) as r, count(*) as c from t group by k",
+        t, mode,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got)
+
+
+def test_corr_degenerate_groups_null():
+    """n < 2 or zero variance yields NULL (pandas semantics)."""
+    t = pa.table(
+        {
+            "k": pa.array([1, 2, 2, 3, 3, 3], pa.int64()),
+            "x": pa.array([1.0, 5.0, 5.0, 1.0, 2.0, 3.0]),
+            "y": pa.array([2.0, 1.0, 9.0, 2.0, 4.0, 6.0]),
+        }
+    )
+    want, got, m = _both(
+        "select k, corr(x, y) as r from t group by k", t, "x64"
+    )
+    # k=1: one row -> null; k=2: x constant -> null; k=3: perfect corr
+    assert got.column("r").to_pylist()[0] is None
+    assert got.column("r").to_pylist()[1] is None
+    assert got.column("r").to_pylist()[2] == pytest.approx(1.0, rel=1e-9)
+    _assert_close(want, got)

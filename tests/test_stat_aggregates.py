@@ -164,19 +164,20 @@ def test_median_null_and_even_groups():
 
 
 def test_stat_agg_device_lowering_boundaries():
-    """median/stddev/var/count_distinct now LOWER to the device stage
+    """The whole statistical family now LOWERS to the device stage
     (keyed path / moment sums — tests/test_device_median.py,
-    test_precision_x32.py); corr still rejects at plan time (no failed
-    device trace, no fallback counters)."""
+    test_precision_x32.py); GLOBAL (ungrouped) medians and UDAFs still
+    reject at plan time (no failed device trace, no fallback
+    counters)."""
     t, _ = _data(8_000)
     ctx = _ctx(t)
     plan = ctx.sql(
-        "select g, median(v3), stddev(v1), count(distinct v1), sum(v1) "
-        "from t group by g"
+        "select g, median(v3), stddev(v1), count(distinct v1), "
+        "corr(v1, v2), sum(v1) from t group by g"
     ).physical_plan()
     assert "TpuStageExec" in plan.display()
 
-    plan = ctx.sql("select g, corr(v1, v2) from t group by g").physical_plan()
+    plan = ctx.sql("select median(v3) from t").physical_plan()
     assert "TpuStageExec" not in plan.display()
     assert "MeshGangExec" not in plan.display()
 
