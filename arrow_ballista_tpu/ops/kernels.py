@@ -1804,6 +1804,105 @@ def keyed_corr_kernel(capacity: int, mode: str):
     return fn
 
 
+def merge_keyed_host(
+    specs: list[KernelAggSpec],
+    mode: str,
+    per_dev: list,
+) -> tuple[list[np.ndarray], list[np.ndarray], int]:
+    """Merge per-shard keyed results BY KEY on host (numpy, vectorized).
+
+    ``per_dev``: list of (states, key_cols, n_groups) as returned by
+    :func:`unpack_keyed_host` (+ group count).  The merge is
+    [total distinct]-sized — the O(rows) work stayed on the shards; an
+    ICI tree-merge is a future optimization.  Returns (merged states
+    incl. trailing presence, merged key code arrays, n_groups).
+    """
+    live = [(s, k, n) for s, k, n in per_dev if n > 0]
+    if not live:
+        empty = [np.zeros(0, dtype=np.int64) for _ in per_dev[0][0]]
+        return empty, [np.zeros(0, np.int64) for _ in per_dev[0][1]], 0
+    n_keys = len(live[0][1])
+    keys = [
+        np.concatenate([k[j][:n] for _s, k, n in live])
+        for j in range(n_keys)
+    ]
+    states = [
+        np.concatenate([s[i][:n] for s, _k, n in live])
+        for i in range(len(live[0][0]))
+    ]
+    order = np.lexsort(tuple(reversed(keys)))
+    keys = [k[order] for k in keys]
+    states = [s[order] for s in states]
+    n_rows = len(keys[0])
+    newflag = np.ones(n_rows, dtype=bool)
+    for k in keys:
+        nf = np.empty(n_rows, dtype=bool)
+        nf[0] = True
+        nf[1:] = k[1:] != k[:-1]
+        if k is keys[0]:
+            newflag = nf
+        else:
+            newflag |= nf
+    starts = np.flatnonzero(newflag)
+    out_keys = [k[starts] for k in keys]
+
+    def _reduceat(a, how):
+        if how == "sum":
+            return np.add.reduceat(a.astype(np.float64), starts)
+        if how == "isum":
+            return np.add.reduceat(a.astype(np.int64), starts)
+        if how == "min":
+            return np.minimum.reduceat(a, starts)
+        return np.maximum.reduceat(a, starts)
+
+    def _lex_reduceat(hi, lo, how):
+        # lexicographic (hi, lo) i32 extremum via one biased i64 key
+        v = (
+            ((hi.astype(np.int64) + (1 << 31)) << 32)
+            | (lo.astype(np.int64) + (1 << 31))
+        )
+        m = _reduceat(v, how)
+        return (
+            ((m >> 32) - (1 << 31)).astype(np.int64),
+            ((m & 0xFFFFFFFF) - (1 << 31)).astype(np.int64),
+        )
+
+    out: list[np.ndarray] = []
+    i = 0
+    for spec in specs:
+        if spec.func in ("sum", "avg") and mode == "x32":
+            # recombine the pair in f64; compensation already happened
+            # on-device — the per-group cross-shard sum is tiny
+            v = states[i].astype(np.float64) + states[i + 1].astype(
+                np.float64
+            )
+            out.append(_reduceat(v, "sum"))
+            out.append(np.zeros(len(starts)))  # lo absorbed into hi
+            out.append(_reduceat(states[i + 2], "isum"))
+            i += 3
+            continue
+        if spec.ord_pair and spec.func in ("min", "max"):
+            hi, lo = _lex_reduceat(
+                states[i], states[i + 1], spec.func
+            )
+            out.extend([hi, lo, _reduceat(states[i + 2], "isum")])
+            i += 3
+            continue
+        for role in state_fields(spec, mode):
+            if role == "min":
+                out.append(_reduceat(states[i], "min"))
+            elif role == "max":
+                out.append(_reduceat(states[i], "max"))
+            else:  # additive
+                is_int = states[i].dtype.kind in "iu"
+                out.append(
+                    _reduceat(states[i], "isum" if is_int else "sum")
+                )
+            i += 1
+    out.append(_reduceat(states[-1], "isum"))  # presence
+    return out, out_keys, len(starts)
+
+
 def unpack_keyed_host(
     specs: list[KernelAggSpec], packed: np.ndarray, mode: str, n_keys: int
 ) -> tuple[list[np.ndarray], list[np.ndarray]]:

@@ -31,6 +31,17 @@ from ..exec.operators import ExecutionPlan, Partitioning, TaskContext
 _MESH_STEP_CACHE: dict = {}
 
 
+class _MeshKeyedRoute(Exception):
+    """Control flow: the gang's first batch showed groups ~ rows — run
+    the KEYED reduction per shard (every device concurrently) and merge
+    the [distinct]-sized results on host, instead of abandoning the
+    mesh for the sequential fallback."""
+
+    def __init__(self, n_dev: int):
+        super().__init__("mesh keyed high-cardinality")
+        self.n_dev = n_dev
+
+
 def gang_eligible(plan: ExecutionPlan) -> bool:
     """Structural check (no kernel build, no device touch — safe on the
     scheduler): does this stage subtree fuse into a partial-aggregate
@@ -109,6 +120,15 @@ class MeshGangExec(ExecutionPlan):
                 batches = list(self._execute_mesh(inner, ctx))
                 yield from batches
                 return
+            except _MeshKeyedRoute as route:
+                try:
+                    batches = list(
+                        self._execute_mesh_keyed(inner, ctx, route.n_dev)
+                    )
+                    yield from batches
+                    return
+                except (_CapacityExceeded, ExecutionError):
+                    self.metrics.add("mesh_fallback", 1)
             except (_CapacityExceeded, ExecutionError):
                 # group capacity overflow or a type that slipped past
                 # plan-time lowering: re-run sequentially (Cancelled and
@@ -177,10 +197,13 @@ class MeshGangExec(ExecutionPlan):
                             if should_highcard_fallback(
                                 tpu.config, group_table.n_groups, n
                             ):
-                                # groups ~ rows: the sequential fallback
-                                # will route each partition to the C++
-                                # hash aggregate; highcard_mode=device
-                                # keeps the gang on the sort-based path
+                                if tpu.config.tpu_highcard_mode != "cpu":
+                                    # groups ~ rows: per-shard KEYED
+                                    # reduction keeps the whole mesh busy
+                                    raise _MeshKeyedRoute(n_dev)
+                                # highcard_mode=cpu: the sequential
+                                # fallback routes each partition to the
+                                # C++ hash aggregate
                                 from ..errors import ExecutionError
 
                                 raise ExecutionError(
@@ -237,6 +260,147 @@ class MeshGangExec(ExecutionPlan):
         self.metrics.add("mesh_devices", n_dev)
         yield from tpu._materialize(
             host_states, key_encoders, group_table, n_rows, ctx, 0
+        )
+
+
+    def _execute_mesh_keyed(
+        self, tpu, ctx: TaskContext, n_dev: int
+    ) -> Iterator[pa.RecordBatch]:
+        """High-cardinality gang: per-shard KEYED reduction on every
+        device CONCURRENTLY (async dispatch of the single-chip keyed
+        kernels — sort by raw key codes, gids from key-change
+        boundaries), then a [distinct]-sized vectorized host merge by
+        key.  The O(rows) sort/scan work stays on the shards; only the
+        per-shard (unique keys, states) cross to host.  An ICI
+        tree-merge is the future optimization; the host merge is already
+        orders of magnitude below row scale."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..errors import ExecutionError
+        from ..ops import kernels as K
+        from ..ops.bridge import make_key_encoder
+        from ..ops.stage_compiler import _CapacityExceeded, _KeyedGroups
+        from . import mesh as M
+
+        fused = tpu.fused
+        holder, prep = tpu._keyed_prep()
+        key_encoders = [
+            make_key_encoder(tpu._schema.field(pos).type)
+            for pos, (kind, _s) in enumerate(tpu._group_plan)
+            if kind == "enc"
+        ]
+        n_keys = tpu._n_encoded_groups
+        mesh = M.make_mesh(n_dev)
+        devices = list(mesh.devices.flatten())
+        per_dev_buf: list[list] = [[] for _ in devices]
+        n_rows = 0
+        with self.metrics.timer("mesh_stage_time_ns"):
+            n_parts = fused.source.output_partitioning().n
+            for p in range(n_parts):
+                for batch in fused.source.execute(p, ctx):
+                    ctx.check_cancelled()
+                    n = batch.num_rows
+                    if n == 0:
+                        continue
+                    with self.metrics.timer("key_encode_time_ns"):
+                        codes = tpu._encode_codes(batch, key_encoders)
+                    if tpu._mode == "x32":
+                        for c in codes:
+                            if len(c) and (
+                                c.min() < -(1 << 31)
+                                or c.max() >= (1 << 31)
+                            ):
+                                raise ExecutionError(
+                                    "gang keys exceed i32"
+                                )
+                    n_pad = K.bucket_rows(n)
+                    keys = tuple(
+                        K._pad(K.coerce_host_values(c), n_pad)
+                        for c in codes
+                    )
+                    valid = np.zeros(n_pad, dtype=bool)
+                    valid[:n] = True
+                    with self.metrics.timer("bridge_time_ns"):
+                        args = tpu._kernel_args(batch, n, n_pad, None)
+                    dev = devices[p % n_dev]
+                    with self.metrics.timer("device_time_ns"):
+                        keys_d = tuple(
+                            jax.device_put(k, dev) for k in keys
+                        )
+                        valid_d = jax.device_put(valid, dev)
+                        args_d = [jax.device_put(a, dev) for a in args]
+                        per_dev_buf[p % n_dev].append(
+                            prep(keys_d, valid_d, *args_d)
+                        )
+                    n_rows += n
+
+            if n_rows == 0:
+                yield from tpu._materialize(
+                    None, key_encoders, _KeyedGroups([], 0), 0, ctx, 0
+                )
+                return
+
+            with self.metrics.timer("device_time_ns"):
+                # per-device concat + phase-1 sort (dispatches overlap
+                # across devices; only the scalar fetches serialize)
+                sort_out: list = []
+                for buf in per_dev_buf:
+                    if not buf:
+                        sort_out.append(None)
+                        continue
+                    parts = list(zip(*buf))
+                    if len(buf) == 1:
+                        fields = [q[0] for q in parts]
+                    else:
+                        fields = [jnp.concatenate(q) for q in parts]
+                    total = int(fields[0].shape[0])
+                    n2 = K.bucket_rows(total)
+                    if n2 != total:
+                        fields = [
+                            jnp.pad(f, (0, n2 - total)) for f in fields
+                        ]
+                    mask = fields[0]
+                    keys_f = fields[1:1 + n_keys]
+                    flat = fields[1 + n_keys:]
+                    out = K.keyed_sort_kernel(n_keys)(mask, *keys_f)
+                    sort_out.append((out, flat))
+                counts = [
+                    int(np.asarray(so[0][-1])) if so is not None else 0
+                    for so in sort_out
+                ]
+                if max(counts, default=0) > tpu.max_capacity:
+                    raise _CapacityExceeded()
+                cap = max(64, 1 << (max(max(counts), 1) - 1).bit_length())
+                fetches = []
+                for so, ng in zip(sort_out, counts):
+                    if so is None:
+                        continue
+                    out, flat = so
+                    s2, perm, sk = out[0], out[1], out[2:-1]
+                    finish = K.keyed_finish_kernel(
+                        holder["kinds"], holder["plan"], tpu.specs,
+                        n_keys, cap, tpu._mode,
+                    )
+                    fetches.append(
+                        (finish(s2, perm, tuple(sk), tuple(flat)), ng)
+                    )
+                per_dev = []
+                for packed, ng in fetches:
+                    host = np.asarray(packed)
+                    states, kc = K.unpack_keyed_host(
+                        tpu.specs, host, tpu._mode, n_keys
+                    )
+                    per_dev.append((states, kc, ng))
+            merged_states, merged_keys, n_groups = K.merge_keyed_host(
+                tpu.specs, tpu._mode, per_dev
+            )
+        self.metrics.add("mesh_rows_in", n_rows)
+        self.metrics.add("mesh_devices", n_dev)
+        self.metrics.add("mesh_keyed", 1)
+        yield from tpu._materialize(
+            merged_states, key_encoders,
+            _KeyedGroups(merged_keys, n_groups), n_rows, ctx, 0,
         )
 
 

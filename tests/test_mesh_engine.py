@@ -322,3 +322,52 @@ def test_mesh_gang_highcard_device_mode():
         K.set_agg_algorithm(None)
 
     _assert_tables_close(got.sort_by([("g", "ascending")]), want, rel=1e-6)
+
+
+def test_mesh_gang_highcard_keyed_across_shards(monkeypatch):
+    """Default (auto) routing: a groups~rows gang runs the KEYED
+    reduction per shard — every device concurrently — with a
+    [distinct]-sized host merge (mesh_keyed metric), matching the CPU
+    oracle.  Groups straddle shard boundaries, so the merge must
+    combine cross-shard states by key."""
+    import numpy as np
+
+    from arrow_ballista_tpu.ops import stage_compiler as SC
+
+    # per-partition batches cap first-batch group counts well below the
+    # production threshold: shrink the detector for the fixture
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 1024)
+
+    rng = np.random.default_rng(31)
+    n = 1 << 17
+    # every group appears in EVERY partition (round-robin keys)
+    g = np.arange(n) % (n // 8)
+    tbl = pa.table(
+        {
+            "g": pa.array(g.astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+            "w": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+        }
+    )
+    sql = (
+        "select g, sum(v) as s, count(*) as c, min(w) as mn, max(w) as mx "
+        "from t group by g"
+    )
+
+    off = SessionContext(
+        _cfg(**{"ballista.mesh.enable": "false", "ballista.tpu.enable": "false"})
+    )
+    off.register_arrow_table("t", tbl, partitions=4)
+    want = off.sql(sql).collect().sort_by([("g", "ascending")])
+
+    ctx = SessionContext(_cfg(**{"ballista.tpu.max_capacity": str(1 << 19)}))
+    ctx.register_arrow_table("t", tbl, partitions=4)
+    plan = ctx.sql(sql).physical_plan()
+    got = ctx.execute(plan)
+    gangs = _find(plan, MeshGangExec)
+    assert gangs
+    m = gangs[0].metrics.to_dict()
+    assert m.get("mesh_keyed", 0) >= 1, m
+    assert "mesh_fallback" not in m, m
+    assert m.get("mesh_devices") == 8, m
+    _assert_tables_close(got.sort_by([("g", "ascending")]), want, rel=1e-6)
