@@ -134,8 +134,11 @@ class TpuWindowExec(ExecutionPlan):
             self._groups.setdefault(sig, []).append((pos, spec))
 
     def _check_spec(self, spec: WindowSpec) -> None:
-        if spec.frame is not None:
-            raise K.NotLowerable("window ROWS frame")  # CPU handles these
+        if spec.frame is not None and spec.func not in (
+            "sum", "count", "avg",
+        ):
+            # framed min/max need a monotonic deque — CPU handles those
+            raise K.NotLowerable(f"window ROWS frame for {spec.func}")
         if spec.func in RANKING:
             return
         if spec.func in VALUE_FNS:
@@ -276,6 +279,8 @@ class TpuWindowExec(ExecutionPlan):
         if spec.func in RANKING:
             return (spec.func,)
         if spec.func == "count" and spec.arg is None:
+            if spec.frame is not None:
+                return ("aggf", "count", None, spec.frame[0], spec.frame[1])
             return ("agg", "count", None)
         # argument slot (value + validity), padded & coerced
         key = str(spec.arg)
@@ -306,6 +311,8 @@ class TpuWindowExec(ExecutionPlan):
             slot_of[key] = slot
         if spec.func in VALUE_FNS:
             return ("val", spec.func, slot, spec.offset)
+        if spec.frame is not None:
+            return ("aggf", spec.func, slot, spec.frame[0], spec.frame[1])
         return ("agg", spec.func, slot)
 
     # -------------------------------------------------------- unpack
@@ -369,6 +376,32 @@ class TpuWindowExec(ExecutionPlan):
                             np.where(empty, 0.0, v), pa.float64(),
                             mask=empty,
                         )
+            elif kind == "aggf":
+                fn = kspec[1]
+                if kspec[2] is None or fn == "count":
+                    col = pa.array(int_row().astype(np.int64), pa.int64())
+                else:
+                    if mode == "x32":
+                        hi_v = float_row() + float_row()
+                        lo_v = float_row() + float_row()
+                    else:
+                        hi_v = float_row()
+                        lo_v = float_row()
+                    cnt = int_row()
+                    v = hi_v - lo_v
+                    emptym = cnt == 0
+                    if fn == "avg":
+                        col = pa.array(
+                            v / np.where(emptym, 1, cnt), pa.float64(),
+                            mask=emptym,
+                        )
+                    elif pa.types.is_integer(spec.out_type):
+                        vi = np.round(
+                            np.where(np.isfinite(v), v, 0.0)
+                        ).astype(np.int64)
+                        col = pa.array(vi, pa.int64(), mask=emptym)
+                    else:
+                        col = pa.array(v, pa.float64(), mask=emptym)
             else:  # val fns
                 int_arg = pa.types.is_integer(spec.out_type) or (
                     pa.types.is_date(spec.out_type)

@@ -23,8 +23,11 @@ NotImplemented for WindowAggExec, ``scheduler/src/planner.rs:81-170``):
 Spec encoding (static per kernel): tuples
   ("row_number",) | ("rank",) | ("dense_rank",) | ("ntile", k)
   | ("agg", fn, arg_slot)            # fn in sum|count|avg|min|max, RANGE
+  | ("aggf", fn, arg_slot, a, b)     # ROWS frame [i+a, i+b]; None=UNBOUNDED
   | ("val", fn, arg_slot, offset)    # fn in lag|lead|first_value|last_value
 arg slots index the (value, validity) array pairs passed after the keys.
+ROWS-framed sums are two gathers on a compensated prefix (global prefix:
+both frame bounds live in one segment, so earlier segments subtract out).
 """
 
 from __future__ import annotations
@@ -261,6 +264,72 @@ def make_window_kernel(
                 s, = _seg_scan(seg_flag, [v], [fn_name])
                 emit(s[peer_last], is_int)
                 emit(cnt_run[peer_last], True)
+                continue
+            if kind == "aggf":
+                _, fn_name, slot, fstart, fend = spec
+                seg_last = get("seg_last")
+                lo = (
+                    seg_first
+                    if fstart is None
+                    else jnp.maximum(seg_first, idx + fstart)
+                )
+                hi = (
+                    seg_last
+                    if fend is None
+                    else jnp.minimum(seg_last, idx + fend)
+                )
+                empty = hi < lo
+                if slot is None:  # count(*)
+                    emit(jnp.where(empty, 0, hi - lo + 1), True)
+                    continue
+                val, avalid = s_args[slot]
+                lo_c = jnp.clip(lo, 0, n)
+                hi_c = jnp.clip(hi + 1, 0, n)
+                cnt_prefix = jnp.concatenate(
+                    [
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.cumsum(avalid.astype(jnp.int32)),
+                    ]
+                )
+                cnt = jnp.where(
+                    empty, 0, cnt_prefix[hi_c] - cnt_prefix[lo_c]
+                )
+                if fn_name == "count":
+                    emit(cnt, True)
+                    continue
+                # sum / avg: compensated inclusive prefix, two gathers;
+                # index -1 (empty prefix) reads 0
+                vm = jnp.where(avalid, val.astype(fdt), 0.0)
+                if mode == "x32":
+
+                    def comb(a, b):
+                        s, e = K._two_sum(a[0], b[0])
+                        return (s, a[1] + b[1] + e)
+
+                    ph, pl = jax.lax.associative_scan(
+                        comb, (vm, jnp.zeros_like(vm))
+                    )
+
+                    def take(p, i):
+                        return jnp.where(
+                            i > 0, p[jnp.clip(i - 1, 0, n - 1)], 0.0
+                        )
+
+                    emit(take(ph, hi_c), False)
+                    emit(take(pl, hi_c), False)
+                    emit(take(ph, lo_c), False)
+                    emit(take(pl, lo_c), False)
+                else:
+                    p = jnp.cumsum(vm)
+
+                    def take(pp, i):
+                        return jnp.where(
+                            i > 0, pp[jnp.clip(i - 1, 0, n - 1)], 0.0
+                        )
+
+                    emit(take(p, hi_c), False)
+                    emit(take(p, lo_c), False)
+                emit(cnt, True)
                 continue
             if kind == "val":
                 _, fn_name, slot, offset = spec

@@ -184,14 +184,37 @@ def test_running_sum_with_null_args():
     _assert_close(want, got)
 
 
-def test_rows_frame_stays_on_cpu():
-    """ROWS frames are not lowered: the plan must keep the CPU operator
-    (correctness preserved, no device attempt)."""
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_rows_framed_aggregates_on_device(mode):
+    """ROWS-framed sum/count/avg lower as prefix differences (two
+    gathers on a compensated prefix)."""
+    t = _data()
+    sql = (
+        "select g, iv, w, "
+        "sum(w) over (partition by g order by iv, w "
+        "rows between 2 preceding and current row) ms, "
+        "count(v) over (partition by g order by iv, w "
+        "rows between 1 preceding and 1 following) mc, "
+        "avg(w) over (partition by g order by iv, w "
+        "rows between unbounded preceding and 1 following) ma, "
+        "count(*) over (partition by g order by iv, w "
+        "rows between 3 preceding and current row) mcs "
+        "from t"
+    )
+    want, got, m = _both(sql, t, mode, ["g", "iv", "w"])
+    assert m.get("tpu_window", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got)
+
+
+def test_rows_framed_minmax_stays_on_cpu():
+    """Framed min/max need a monotonic deque: the plan must keep the CPU
+    operator (correctness preserved, no device attempt)."""
     t = _data(n=2000)
     ctx = _ctx(t, True)
     sql = (
-        "select g, iv, sum(w) over (partition by g order by iv "
-        "rows between 2 preceding and current row) ms from t"
+        "select g, iv, min(w) over (partition by g order by iv "
+        "rows between unbounded preceding and current row) mm from t"
     )
     plan = ctx.sql(sql).physical_plan()
     names = []
@@ -205,7 +228,7 @@ def test_rows_frame_stays_on_cpu():
     K.set_precision(None)
     want = _ctx(t, False).sql(sql).collect()
     got = ctx.execute(plan)
-    key = [("g", "ascending"), ("iv", "ascending"), ("ms", "ascending")]
+    key = [("g", "ascending"), ("iv", "ascending"), ("mm", "ascending")]
     _assert_close(want.sort_by(key), got.sort_by(key))
 
 
