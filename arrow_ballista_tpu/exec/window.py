@@ -376,13 +376,23 @@ def _rows_frame_aggregate(spec: WindowSpec, st: "_SortState", eval_col):
     valid = ~np.asarray(pc.is_null(vs), dtype=bool)
 
     # bounds can point past the partition (e.g. 2 FOLLOWING at the last
-    # row): clamp the prefix indexes; the empty-frame mask nulls those
-    lo_c = np.clip(lo, 0, n)
-    hi_c = np.clip(hi + 1, 0, n)
+    # row): clamp the prefix indexes; the empty-frame mask nulls those.
+    # Prefixes are SEGMENT-LOCAL (pandas grouped cumsum): a global prefix
+    # makes the P[hi]-P[lo-1] cancellation scale with the whole-table
+    # magnitude — measured 4e-4 relative error on a small-valued
+    # partition following a 1e6-valued one.
+    hi_g = np.clip(hi, 0, max(n - 1, 0))
+    lom1_g = np.clip(lo - 1, 0, max(n - 1, 0))
+    lo_open = lo > seg_first  # P[lo-1] lies inside the segment
 
     def range_sum(vals):
-        c = np.concatenate([[0], np.cumsum(vals)])  # exclusive prefix
-        return c[hi_c] - c[lo_c]
+        import pandas as pd
+
+        ps = (
+            pd.Series(vals).groupby(st.seg_id).cumsum().to_numpy()
+        )  # inclusive, resets per segment
+        base = np.where(lo_open, ps[lom1_g], 0)
+        return np.where(empty, 0, ps[hi_g] - base)
 
     cnt = range_sum(valid.astype(np.int64))
     cnt = np.where(empty, 0, cnt)

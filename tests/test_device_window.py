@@ -198,13 +198,42 @@ def test_rows_framed_aggregates_on_device(mode):
         "avg(w) over (partition by g order by iv, w "
         "rows between unbounded preceding and 1 following) ma, "
         "count(*) over (partition by g order by iv, w "
-        "rows between 3 preceding and current row) mcs "
+        "rows between 3 preceding and current row) mcs, "
+        "sum(w) over (partition by g order by iv, w "
+        "rows between 3 following and 5 following) mf, "
+        "sum(w) over (partition by g order by iv, w "
+        "rows between 5 preceding and 3 preceding) mp "
         "from t"
     )
     want, got, m = _both(sql, t, mode, ["g", "iv", "w"])
     assert m.get("tpu_window", 0) >= 1, m
     assert m.get("tpu_fallback", 0) == 0, m
     _assert_close(want, got)
+
+
+def test_rows_framed_sum_mixed_magnitude_partitions():
+    """Segment-reset prefixes: a tiny-valued partition next to a huge-
+    valued one must not inherit the neighbor's cancellation error (the
+    review-reproduced failure of a global prefix)."""
+    rng = np.random.default_rng(41)
+    n = 20000
+    g = (np.arange(n) >= n // 2).astype(np.int64)
+    w = np.where(g == 0, rng.uniform(1e6, 2e6, n), rng.uniform(1e-3, 2e-3, n))
+    t = pa.table(
+        {
+            "g": pa.array(g),
+            "iv": pa.array(np.arange(n, dtype=np.int64)),
+            "w": pa.array(w),
+        }
+    )
+    sql = (
+        "select g, iv, sum(w) over (partition by g order by iv "
+        "rows between 2 preceding and current row) ms from t"
+    )
+    for mode in ("x32", "x64"):
+        want, got, m = _both(sql, t, mode, ["g", "iv"])
+        assert m.get("tpu_window", 0) >= 1, m
+        _assert_close(want, got, rel=1e-6)
 
 
 def test_rows_framed_minmax_stays_on_cpu():
