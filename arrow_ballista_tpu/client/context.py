@@ -197,9 +197,15 @@ class BallistaContext:
         ]
 
     def _collect_distributed(self, plan) -> pa.Table:
+        import os
+
         job_id = self.execute_logical_plan(plan)
         self._job_ids.add(job_id)
-        status = self.wait_for_job(job_id)
+        # cold XLA compiles on a slow host can push a legitimate job past
+        # the default 300s (observed: full-TPC-H sweeps on a 1-core box);
+        # benchmarks/operators raise it via env without touching the API
+        timeout_s = float(os.environ.get("BALLISTA_JOB_TIMEOUT_S", "300"))
+        status = self.wait_for_job(job_id, timeout_s=timeout_s)
         return self.fetch_job_output(status)
 
     def execute_logical_plan(self, plan) -> str:

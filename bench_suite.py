@@ -324,6 +324,10 @@ def bench_full22() -> None:
     from benchmarks.tpch.queries import QUERIES
 
     sf = float(os.environ.get("BENCH_FULL22_SF", "1"))
+    # cold-compile-heavy sweep: a single job must never hit the client's
+    # default 300s ceiling just because XLA is compiling 22 queries'
+    # worth of kernels on a busy host
+    os.environ.setdefault("BALLISTA_JOB_TIMEOUT_S", "1800")
     data = {name: gen_table(name, sf) for name in ALL_TABLES}
     n_lineitem = data["lineitem"].num_rows
 
