@@ -1483,7 +1483,7 @@ def make_keyed_prep_kernel(
         holder["plan"] = tuple(plan)
         flat: list = []
         for kind, col in zip(kinds, cols):
-            if kind == "df32":
+            if _is_pair_kind(kind):
                 flat.extend(col)
             else:
                 flat.append(col)
@@ -1491,6 +1491,16 @@ def make_keyed_prep_kernel(
         return (mask,) + tuple(keys) + tuple(flat) + extras
 
     return fn
+
+
+def _is_pair_kind(kind) -> bool:
+    """Scan-plan kinds whose column is an (hi, lo) ARRAY PAIR: df32
+    compensated sums and order-pair extrema.  Pair columns must flatten
+    into two buffer slots (the multi-batch path concatenates and pads
+    per slot) and re-pair inside the finish kernel."""
+    return kind == "df32" or (
+        isinstance(kind, tuple) and kind[0] in ("omin", "omax")
+    )
 
 
 _KEYED_MEDIAN_CACHE: dict = {}
@@ -1519,8 +1529,11 @@ def keyed_median_kernel(n_keys: int, capacity: int):
         iota = jnp.arange(n, dtype=jnp.int32)
         inv = jnp.logical_not(mask).astype(jnp.int32)
         argnull = jnp.logical_not(vvalid).astype(jnp.int32)
+        # vlo MUST be a sort key too: values whose hi words collide
+        # (within ~1.2e-7 relative) otherwise stay unordered, gathering
+        # the wrong middle element and overcounting distinct run-starts
         ops = (inv,) + tuple(keys) + (argnull, vhi, vlo, iota)
-        sorted_ = jax.lax.sort(ops, num_keys=3 + n_keys)
+        sorted_ = jax.lax.sort(ops, num_keys=4 + n_keys)
         sinv = sorted_[0]
         sk = sorted_[1:1 + n_keys]
         snull = sorted_[1 + n_keys]
@@ -1646,7 +1659,7 @@ def keyed_finish_kernel(
         cols: list = []
         i = 0
         for kind in kinds:
-            if kind == "df32":
+            if _is_pair_kind(kind):
                 cols.append((flat[i], flat[i + 1]))
                 i += 2
             else:
@@ -1856,15 +1869,17 @@ def merge_keyed_host(
         return np.maximum.reduceat(a, starts)
 
     def _lex_reduceat(hi, lo, how):
-        # lexicographic (hi, lo) i32 extremum via one biased i64 key
+        # lexicographic (hi, lo) i32 extremum via one biased u64 key;
+        # packing in i64 would wrap negative whenever biased hi >= 2^31
+        # (every non-negative f64 extremum), inverting the order
         v = (
-            ((hi.astype(np.int64) + (1 << 31)) << 32)
-            | (lo.astype(np.int64) + (1 << 31))
+            ((hi.astype(np.int64) + (1 << 31)).astype(np.uint64) << np.uint64(32))
+            | (lo.astype(np.int64) + (1 << 31)).astype(np.uint64)
         )
         m = _reduceat(v, how)
         return (
-            ((m >> 32) - (1 << 31)).astype(np.int64),
-            ((m & 0xFFFFFFFF) - (1 << 31)).astype(np.int64),
+            (m >> np.uint64(32)).astype(np.int64) - (1 << 31),
+            (m & np.uint64(0xFFFFFFFF)).astype(np.int64) - (1 << 31),
         )
 
     out: list[np.ndarray] = []

@@ -280,12 +280,12 @@ class TpuWindowExec(ExecutionPlan):
             return (spec.func,)
         if spec.func == "count" and spec.arg is None:
             if spec.frame is not None:
-                return ("aggf", "count", None, spec.frame[0], spec.frame[1])
-            return ("agg", "count", None)
-        # argument slot (value + validity), padded & coerced
+                return ("aggf", "count", None, spec.frame[0],
+                        spec.frame[1], False)
+            return ("agg", "count", None, False)
         key = str(spec.arg)
-        slot = slot_of.get(key)
-        if slot is None:
+
+        def checked_arr():
             arr = eval_col(spec.arg)
             t = arr.type
             if not (
@@ -300,7 +300,49 @@ class TpuWindowExec(ExecutionPlan):
                 import pyarrow.compute as pc
 
                 arr = pc.cast(arr, pa.float64())
-            values, validity = arrow_to_numpy(arr)
+            return arr
+
+        # x32 integer sum/avg: an f32 cast at the scan input loses low
+        # bits above 2^24 and the int-typed output rounds the inexact
+        # total — ship the argument as an exact (hi, lo) f32 pair, same
+        # 48-bit discipline as the aggregate path's column_pair
+        if (
+            self._mode == "x32"
+            and spec.func in ("sum", "avg")
+            and pa.types.is_integer(
+                K._infer_pa_type(spec.arg, self.input.schema)
+            )
+        ):
+            pkey = (key, "pair")
+            slot = slot_of.get(pkey)
+            if slot is None:
+                values, validity = arrow_to_numpy(checked_arr())
+                v = values.astype(np.float64)
+                if len(v) and np.abs(v).max() >= float(1 << 48):
+                    raise K.NotLowerable(
+                        "int window sum exceeds 48-bit pair range in x32"
+                    )
+                hi = v.astype(np.float32)
+                lo = (v - hi.astype(np.float64)).astype(np.float32)
+                if validity is None:
+                    validity = np.ones(len(v), dtype=bool)
+                slot = len(args)
+                args.append(
+                    (
+                        (K._pad(hi, n_pad), K._pad(lo, n_pad)),
+                        K._pad(validity, n_pad),
+                    )
+                )
+                slot_of[pkey] = slot
+            if spec.frame is not None:
+                return ("aggf", spec.func, slot, spec.frame[0],
+                        spec.frame[1], True)
+            return ("agg", spec.func, slot, True)
+
+        # plain argument slot (value + validity), padded & coerced
+        slot = slot_of.get(key)
+        if slot is None:
+            values, validity = arrow_to_numpy(checked_arr())
             values = K.coerce_host_values(values)
             if validity is None:
                 validity = np.ones(len(values), dtype=bool)
@@ -312,8 +354,9 @@ class TpuWindowExec(ExecutionPlan):
         if spec.func in VALUE_FNS:
             return ("val", spec.func, slot, spec.offset)
         if spec.frame is not None:
-            return ("aggf", spec.func, slot, spec.frame[0], spec.frame[1])
-        return ("agg", spec.func, slot)
+            return ("aggf", spec.func, slot, spec.frame[0],
+                    spec.frame[1], False)
+        return ("agg", spec.func, slot, False)
 
     # -------------------------------------------------------- unpack
     def _unpack(self, packed, members, kspecs, n, win_cols) -> None:

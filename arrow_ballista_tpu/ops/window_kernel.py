@@ -22,10 +22,13 @@ NotImplemented for WindowAggExec, ``scheduler/src/planner.rs:81-170``):
 
 Spec encoding (static per kernel): tuples
   ("row_number",) | ("rank",) | ("dense_rank",) | ("ntile", k)
-  | ("agg", fn, arg_slot)            # fn in sum|count|avg|min|max, RANGE
-  | ("aggf", fn, arg_slot, a, b)     # ROWS frame [i+a, i+b]; None=UNBOUNDED
-  | ("val", fn, arg_slot, offset)    # fn in lag|lead|first_value|last_value
-arg slots index the (value, validity) array pairs passed after the keys.
+  | ("agg", fn, arg_slot, pair)        # fn in sum|count|avg|min|max, RANGE
+  | ("aggf", fn, arg_slot, a, b, pair) # ROWS frame [i+a, i+b]; None=UNBOUNDED
+  | ("val", fn, arg_slot, offset)      # fn in lag|lead|first_value|last_value
+arg slots index the (value, validity) array pairs passed after the keys;
+``pair`` marks slots whose value is an exact f32 (hi, lo) tuple — x32
+integer sum/avg args ride the aggregate path's column_pair discipline so
+values above 2^24 don't lose low bits at an f32 cast.
 ROWS-framed sums are two gathers on a compensated prefix (global prefix:
 both frame bounds live in one segment, so earlier segments subtract out).
 """
@@ -129,11 +132,12 @@ def make_window_kernel(
     n_args: int,
     mode: str,
 ):
-    """Jitted ``fn(part_keys, order_keys, valid, args) -> packed``.
+    """Jitted ``fn(part_keys, order_keys, args) -> packed``.
 
     ``part_keys``/``order_keys`` are tuples of integer key arrays (the
     pad flag is part_keys[0]); ``args`` is a tuple of (value, validity)
-    pairs.  ``packed`` is an [n_out_rows, n] integer array in INPUT row
+    pairs, where a pair-slot's value is itself an (hi, lo) f32 tuple.
+    ``packed`` is an [n_out_rows, n] integer array in INPUT row
     order — float rows bitcast exactly like the aggregate packed fetch.
     Per-spec output layout (host side must mirror):
       ranking/ntile → 1 int row
@@ -173,9 +177,13 @@ def make_window_kernel(
         seg_first = _seg_first(seg_flag, idx)
         peer_last = _seg_last(peer_flag, n)
 
-        s_args = [
-            (a[0][perm], a[1][perm]) for a in args
-        ]
+        s_args = []
+        for a in args:
+            v, m_ = a
+            if isinstance(v, tuple):  # pair slot: (hi, lo) f32 arrays
+                s_args.append(((v[0][perm], v[1][perm]), m_[perm]))
+            else:
+                s_args.append((v[perm], m_[perm]))
 
         rows: list = []  # (array, is_int) in sorted order pre-inverse
 
@@ -224,7 +232,7 @@ def make_window_kernel(
                 emit(jnp.where(in_big, bucket_big, bucket_small), True)
                 continue
             if kind == "agg":
-                _, fn_name, slot = spec
+                _, fn_name, slot, is_pair = spec
                 if fn_name == "count" and slot is None:
                     # count(*): rows from segment start through last peer
                     cnt = idx - seg_first + 1
@@ -240,8 +248,12 @@ def make_window_kernel(
                     continue
                 if fn_name in ("sum", "avg"):
                     if mode == "x32":
-                        h = jnp.where(m, val.astype(jnp.float32), 0.0)
-                        l = jnp.zeros_like(h)
+                        if is_pair:
+                            h = jnp.where(m, val[0], 0.0)
+                            l = jnp.where(m, val[1], 0.0)
+                        else:
+                            h = jnp.where(m, val.astype(jnp.float32), 0.0)
+                            l = jnp.zeros_like(h)
                         (hi, lo), = _seg_scan(
                             seg_flag, [(h, l)], ["df32"]
                         )
@@ -269,7 +281,7 @@ def make_window_kernel(
                 emit(cnt_run[peer_last], True)
                 continue
             if kind == "aggf":
-                _, fn_name, slot, fstart, fend = spec
+                _, fn_name, slot, fstart, fend, is_pair = spec
                 seg_last = get("seg_last")
                 lo = (
                     seg_first
@@ -305,10 +317,15 @@ def make_window_kernel(
                 if fn_name == "count":
                     emit(cnt, True)
                     continue
-                vm = jnp.where(avalid, val.astype(fdt), 0.0)
                 if mode == "x32":
+                    if is_pair:
+                        vh = jnp.where(avalid, val[0], 0.0)
+                        vl = jnp.where(avalid, val[1], 0.0)
+                    else:
+                        vh = jnp.where(avalid, val.astype(fdt), 0.0)
+                        vl = jnp.zeros_like(vh)
                     (ph, pl), = _seg_scan(
-                        seg_flag, [(vm, jnp.zeros_like(vm))], ["df32"]
+                        seg_flag, [(vh, vl)], ["df32"]
                     )
                     emit(ph[hi_g], False)
                     emit(pl[hi_g], False)
@@ -319,6 +336,7 @@ def make_window_kernel(
                         jnp.where(lo_open, pl[lom1_g], 0.0), False
                     )
                 else:
+                    vm = jnp.where(avalid, val.astype(fdt), 0.0)
                     p, = _seg_scan(seg_flag, [vm], ["sum"])
                     emit(p[hi_g], False)
                     emit(jnp.where(lo_open, p[lom1_g], 0.0), False)

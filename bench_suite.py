@@ -11,7 +11,7 @@ Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
   showcase (ranking + running sum + lag on TpuWindowExec)
 
 Each config emits one JSON line (same shape as bench.py) and everything
-is appended to BENCH_SUITE_r04.json so the results ship with the repo.
+is appended to BENCH_SUITE_r05.json so the results ship with the repo.
 
 Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|all]  (default all)
 """
@@ -26,7 +26,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 OUT_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r04.json"
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r05.json"
 )
 
 

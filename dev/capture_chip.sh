@@ -9,11 +9,29 @@
 #   bash dev/capture_chip.sh            # full capture (~1-2h)
 #   bash dev/capture_chip.sh quick      # bench.py + q6/q3 only
 #
-# Outputs: BENCH_r04_dev.json (bench.py line), BENCH_SUITE_r04.json,
-# KERNELBENCH_r04.json, AB_r04.log (A/B knob runs).
+# Outputs: BENCH_r05_dev.json (bench.py line), BENCH_SUITE_r05.json,
+# KERNELBENCH_r05.json, AB_r05.log (A/B knob runs).
+#
+# Exits nonzero if ANY step fails or times out, so the watch loop can
+# tell a real capture from a re-wedged tunnel and keep polling.
 
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
+
+fails=0
+step() {
+  # step <name> <timeout_s> <cmd...>  — never aborts the sequence, but
+  # records the failure so the script's exit code reflects it
+  local name="$1" t="$2"
+  shift 2
+  echo "== $name =="
+  timeout "$t" "$@"
+  local rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "!! step '$name' failed (rc=$rc)"
+    fails=$((fails + 1))
+  fi
+}
 
 probe() {
   timeout 200 python -c "
@@ -33,34 +51,34 @@ fi
 
 mode="${1:-full}"
 
-echo "== bench.py (q1 SF10) =="
-timeout 3600 python bench.py | tee BENCH_r04_dev.json
+step "bench.py (q1 SF10)" 3600 bash -c 'set -o pipefail; python bench.py | tee BENCH_r05_dev.json'
 
-echo "== suite: q6 =="
-timeout 3600 python bench_suite.py q6
-echo "== suite: q3 =="
-timeout 5400 python bench_suite.py q3
+step "suite: q6" 3600 python bench_suite.py q6
+step "suite: q3" 5400 python bench_suite.py q3
 
 if [ "$mode" = "full" ]; then
-  echo "== suite: starjoin =="
-  timeout 3600 python bench_suite.py starjoin
-  echo "== suite: full22 =="
-  timeout 5400 python bench_suite.py full22
-  echo "== suite: window =="
-  timeout 3600 python bench_suite.py window
-  echo "== suite: h2o =="
-  timeout 7200 python bench_suite.py h2o
+  step "suite: starjoin" 3600 python bench_suite.py starjoin
+  step "suite: full22" 5400 python bench_suite.py full22
+  step "suite: window" 3600 python bench_suite.py window
+  step "suite: h2o" 7200 python bench_suite.py h2o
 
-  echo "== A/B: q3 agg algorithm sort vs scatter ==" | tee AB_r04.log
-  BENCH_AGG_ALGO=sort timeout 5400 python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r04.log
-  BENCH_AGG_ALGO=scatter timeout 5400 python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r04.log
+  echo "== A/B: q3 agg algorithm sort vs scatter ==" | tee AB_r05.log
+  step "A/B q3 sort" 5400 bash -c \
+    'set -o pipefail; BENCH_AGG_ALGO=sort python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r05.log'
+  step "A/B q3 scatter" 5400 bash -c \
+    'set -o pipefail; BENCH_AGG_ALGO=scatter python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_r05.log'
 
-  echo "== A/B: h2o highcard routing cpu vs auto(keyed) ==" | tee -a AB_r04.log
+  echo "== A/B: h2o highcard routing cpu vs auto(keyed) ==" | tee -a AB_r05.log
   # highcard_mode=cpu reproduces the pre-keyed C++-hash-aggregate handoff
-  BENCH_HIGHCARD_MODE=cpu BENCH_H2O_N=1e8 timeout 7200 python bench_suite.py h2o 2>&1 | tail -1 | tee -a AB_r04.log
+  step "A/B h2o highcard=cpu" 7200 bash -c \
+    'set -o pipefail; BENCH_HIGHCARD_MODE=cpu BENCH_H2O_N=1e8 python bench_suite.py h2o 2>&1 | tail -1 | tee -a AB_r05.log'
 
-  echo "== kernel microbench grid =="
-  timeout 5400 python benchmarks/kernels.py --iters 3 --host-encode --out KERNELBENCH_r04.json
+  step "kernel microbench grid" 5400 \
+    python benchmarks/kernels.py --iters 3 --host-encode --out KERNELBENCH_r05.json
 fi
 
+if [ "$fails" -gt 0 ]; then
+  echo "== capture FINISHED WITH $fails FAILED STEP(S) =="
+  exit 1
+fi
 echo "== capture complete =="

@@ -251,3 +251,40 @@ def test_corr_degenerate_groups_null():
     assert got.column("r").to_pylist()[1] is None
     assert got.column("r").to_pylist()[2] == pytest.approx(1.0, rel=1e-9)
     _assert_close(want, got)
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_median_distinct_hi_word_collision(mode):
+    """Values whose f64 order-encodings collide on the TOP 32 bits
+    (relative spacing < ~1.2e-7) must still sort fully: the value LOW
+    word is a sort key, not payload.  Regression for the advisor repro
+    (median gathered 1.0 instead of 1.000000001; distinct counted a
+    duplicate twice when split by a same-hi neighbor)."""
+    vals = [
+        1.0,
+        1.000000001,
+        1.0,
+        1.000000001,
+        1.0000000005,
+        1.0,
+        1.000000002,
+    ]
+    k = [1] * len(vals) + [2, 2, 2]
+    v = vals + [5.0, 5.000000001, 5.0]
+    t = pa.table(
+        {
+            "k": pa.array(k, pa.int64()),
+            "v": pa.array(v, pa.float64()),
+        }
+    )
+    want, got, m = _both(
+        "select k, median(v) as md, count(distinct v) as dv "
+        "from t group by k",
+        t, mode,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    # exact: medians are gathers, distinct is a run count
+    assert got.column("md").to_pylist() == want.column("md").to_pylist()
+    assert got.column("dv").to_pylist() == want.column("dv").to_pylist()
+    assert got.column("dv").to_pylist() == [4, 2]

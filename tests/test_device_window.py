@@ -281,3 +281,38 @@ def _walk(plan):
         nd = stack.pop()
         yield nd
         stack.extend(nd.children())
+
+
+def test_x32_int_window_sums_above_2p24_exact():
+    """x32 integer window sums ship the argument as an exact f32
+    (hi, lo) pair (the aggregate path's column_pair discipline):
+    values above 2^24 must not lose low bits at a per-element f32
+    cast.  Regression for the advisor finding (running and ROWS-framed
+    sums silently diverged from the integer-exact CPU operator)."""
+    rng = np.random.default_rng(47)
+    n = 4096
+    g = rng.integers(0, 8, n)
+    # every value exceeds 2^24 and carries low bits an f32 cast drops
+    big = rng.integers(1 << 25, 1 << 27, n).astype(np.int64) * 2 + 1
+    t = pa.table(
+        {
+            "g": pa.array(g),
+            "iv": pa.array(np.arange(n, dtype=np.int64)),
+            "b": pa.array(big, pa.int64()),
+        }
+    )
+    sql = (
+        "select g, iv, "
+        "sum(b) over (partition by g order by iv) rs, "
+        "avg(b) over (partition by g order by iv) ra, "
+        "sum(b) over (partition by g order by iv "
+        "rows between 2 preceding and current row) fs "
+        "from t"
+    )
+    want, got, m = _both(sql, t, "x32", ["g", "iv"])
+    assert m.get("tpu_window", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    # integer sums: EXACT equality, not approx
+    assert got.column("rs").to_pylist() == want.column("rs").to_pylist()
+    assert got.column("fs").to_pylist() == want.column("fs").to_pylist()
+    _assert_close(want, got, rel=1e-9)

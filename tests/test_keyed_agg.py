@@ -282,3 +282,72 @@ def test_keyed_highcard_mode_cpu_preserves_hash_agg_handoff():
     assert m.get("highcard_fallback", 0) >= 1, m
     assert "keyed_path" not in m, m
     _assert_close(want, got)
+
+
+def test_merge_keyed_host_f64_minmax_sign_spanning():
+    """Cross-shard merge of an x32 ord-pair f64 extremum over a group
+    whose values span zero.  Regression: packing the biased (hi, lo)
+    pair into an int64 wrapped negative for every non-negative hi
+    (biased hi >= 2^31 shifted by 32), inverting the order —
+    min(-1.0, 2.0) decoded to 2.0."""
+    from arrow_ballista_tpu.ops.bridge import (
+        order_decode_f64,
+        split_u64_i32,
+        to_u64_order,
+    )
+
+    specs = [
+        K.KernelAggSpec(func="min", has_arg=True, ord_pair=True),
+        K.KernelAggSpec(func="max", has_arg=True, ord_pair=True),
+    ]
+
+    def shard(vals, keys):
+        u = to_u64_order(np.asarray(vals, np.float64))
+        hi, lo = split_u64_i32(u)
+        cnt = np.ones(len(vals), np.int64)
+        states = [
+            hi.astype(np.int64), lo.astype(np.int64), cnt,  # min
+            hi.astype(np.int64), lo.astype(np.int64), cnt,  # max
+            cnt,  # presence
+        ]
+        return states, [np.asarray(keys, np.int64)], len(vals)
+
+    per_dev = [
+        shard([-1.0, 3.5], [7, 8]),
+        shard([2.0, -0.25], [7, 8]),
+    ]
+    out, keys, n = K.merge_keyed_host(specs, "x32", per_dev)
+    assert n == 2 and keys[0].tolist() == [7, 8]
+    mins = order_decode_f64(out[0], out[1])
+    maxs = order_decode_f64(out[3], out[4])
+    assert mins.tolist() == [-1.0, -0.25]
+    assert maxs.tolist() == [2.0, 3.5]
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_keyed_multi_batch_ord_pair_minmax(mode):
+    """x32 f64 min/max rides an (hi, lo) ORDER-PAIR column through the
+    keyed buffer.  Regression: pair columns buffered as one tuple slot,
+    so the multi-batch concatenate at the final sort raised TypeError.
+    Multi-batch comes from MULTIPLE SOURCE PARTITIONS feeding the stage
+    (hash repartition yields one batch per upstream partition) — a
+    single-partition fixture never concatenates and hides the bug."""
+    t = _highcard_table(n=6000)
+    # median forces the SINGLE-PHASE keyed route after the hash
+    # repartition (it cannot partially aggregate), so the keyed stage
+    # sees one batch per upstream partition; two-phase min/max alone
+    # would run keyed on single-batch partial stages and miss the bug
+    want, got, m = _oracle_and_keyed(
+        "select k, min(v) as mn, max(v) as mx, sum(v) as s, "
+        "median(v) as md, count(*) as c from t group by k",
+        {"t": t},
+        mode,
+        partitions=2,
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    if mode == "x32":
+        # order-pair extrema are bit-exact
+        assert got.column("mn").to_pylist() == want.column("mn").to_pylist()
+        assert got.column("mx").to_pylist() == want.column("mx").to_pylist()
+    _assert_close(want, got)
