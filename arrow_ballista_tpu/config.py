@@ -32,6 +32,7 @@ TPU_DTYPE = "ballista.tpu.dtype"
 TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
 TPU_HIGHCARD_MODE = "ballista.tpu.highcard_mode"
+TPU_KEYED_BUFFER_MB = "ballista.tpu.keyed_buffer_mb"
 TPU_READAHEAD = "ballista.tpu.readahead"
 MESH_ENABLE = "ballista.mesh.enable"
 MESH_DEVICES = "ballista.mesh.devices"
@@ -153,6 +154,18 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "auto",
         ),
         ConfigEntry(
+            TPU_KEYED_BUFFER_MB,
+            "HBM budget (MiB) for the keyed path's buffered scan columns; "
+            "past it the buffered block reduces to [distinct]-sized keyed "
+            "states and a host merge combines blocks (median/corr cannot "
+            "chunk-merge and fall back to the CPU operator instead of "
+            "risking device OOM); 0 disables chunking",
+            int,
+            # v5e has 16 GiB HBM; the sort's working set runs ~2-3x the
+            # buffered bytes, so 2 GiB of buffer keeps peak well clear
+            "2048",
+        ),
+        ConfigEntry(
             TPU_READAHEAD,
             "background source-batch prefetch depth for device stages "
             "(overlaps scan/decode IO with device compute); 0 disables",
@@ -267,6 +280,10 @@ class BallistaConfig:
     @property
     def tpu_highcard_mode(self) -> str:
         return self._get(TPU_HIGHCARD_MODE)
+
+    @property
+    def tpu_keyed_buffer_mb(self) -> int:
+        return self._get(TPU_KEYED_BUFFER_MB)
 
     @property
     def tpu_readahead(self) -> int:

@@ -807,6 +807,7 @@ class TpuStageExec(ExecutionPlan):
         self.max_capacity = (
             config.tpu_max_capacity if fused.group_exprs else 1
         )
+        self.keyed_buffer_bytes = config.tpu_keyed_buffer_mb << 20
         self._filter_closure = filter_closure
         self._arg_closures = arg_closures
 
@@ -1403,9 +1404,6 @@ class TpuStageExec(ExecutionPlan):
         ``_CapacityExceeded`` (cardinality past tpu.max_capacity) for
         the caller's CPU fallback.
         """
-        import jax
-        import jax.numpy as jnp
-
         fused = self.fused
         build = None
         if fused.join is not None:
@@ -1415,9 +1413,37 @@ class TpuStageExec(ExecutionPlan):
         holder, prep = self._keyed_prep()
         n_keys = self._n_encoded_groups
         buf: list = []
+        chunks: list = []  # flushed (states, key_codes, n_groups) blocks
+        buffered = 0
         n_rows_in = 0
 
+        def flush():
+            # HBM budget reached: reduce the buffered block to its
+            # [distinct]-sized keyed states NOW and merge blocks on host
+            # at stream end (merge_keyed_host, the mesh cross-shard
+            # combine) instead of letting the buffer grow to the final
+            # sort — at SF100 a partition's buffered columns can exceed
+            # v5e HBM (16 GiB)
+            nonlocal buf, buffered
+            if not buf:
+                return
+            if self._median_cols or self._corr_pairs:
+                # medians/corr need every row in ONE sort; refuse the
+                # unbounded buffer and fall back before the device OOMs
+                raise ExecutionError(
+                    "keyed buffer budget exceeded with median/corr "
+                    "(order statistics cannot chunk-merge)"
+                )
+            states, key_codes, n_groups, _post = self._keyed_reduce(
+                buf, holder, n_keys
+            )
+            chunks.append((states, key_codes, n_groups))
+            self.metrics.add("keyed_chunks", 1)
+            buf = []
+            buffered = 0
+
         def feed(batch, codes):
+            nonlocal buffered
             n = batch.num_rows
             n_pad = K.bucket_rows(n)
             keys = tuple(
@@ -1428,7 +1454,11 @@ class TpuStageExec(ExecutionPlan):
             with self.metrics.timer("bridge_time_ns"):
                 args = self._kernel_args(batch, n, n_pad, build)
             with self.metrics.timer("device_time_ns"):
-                buf.append(prep(keys, valid, *args))
+                out = prep(keys, valid, *args)
+            buf.append(out)
+            buffered += sum(int(a.nbytes) for a in out)
+            if self.keyed_buffer_bytes and buffered >= self.keyed_buffer_bytes:
+                flush()
 
         with self.metrics.timer("tpu_stage_time_ns"):
             for batch, codes in first:
@@ -1442,45 +1472,27 @@ class TpuStageExec(ExecutionPlan):
                     codes = self._encode_codes(batch, key_encoders)
                 feed(batch, codes)
 
-            with self.metrics.timer("device_time_ns"):
-                parts = list(zip(*buf))
-                if len(buf) == 1:
-                    fields = [p[0] for p in parts]
-                else:
-                    fields = [jnp.concatenate(p) for p in parts]
-                total = int(fields[0].shape[0])
-                n2 = K.bucket_rows(total)
-                if n2 != total:
-                    # pad rows carry mask=False and sink past every
-                    # boundary in the sort — values never read
-                    fields = [
-                        jnp.pad(f, (0, n2 - total)) for f in fields
-                    ]
-                mask = fields[0]
-                per_corr = 3 if self._mode == "x32" else 2
-                n_extras = 3 * len(self._median_cols) + per_corr * len(
-                    self._corr_cols
+            if chunks:
+                flush()
+                with self.metrics.timer("keyed_merge_time_ns"):
+                    merged, merged_keys, n_groups = K.merge_keyed_host(
+                        self.specs, self._mode, chunks
+                    )
+                if n_groups > self.max_capacity:
+                    raise _CapacityExceeded()
+                return (
+                    merged,
+                    _KeyedGroups(merged_keys, n_groups),
+                    n_rows_in,
+                    {"median": [], "corr": []},
                 )
-                keys = fields[1:1 + n_keys]
-                flat_end = len(fields) - n_extras
-                flat_cols = fields[1 + n_keys:flat_end]
-                extras = fields[flat_end:]
-                out = K.keyed_sort_kernel(n_keys)(mask, *keys)
-                s2, perm = out[0], out[1]
-                sk = out[2:-1]
-                # the scalar fetch is the one host sync before capacity
-                # is known (~one tunnel roundtrip)
-                n_groups = int(np.asarray(out[-1]))
-            if n_groups > self.max_capacity:
-                raise _CapacityExceeded()
-            cap = max(64, 1 << (max(n_groups, 1) - 1).bit_length())
-            finish = K.keyed_finish_kernel(
-                holder["kinds"], holder["plan"], self.specs, n_keys, cap,
-                self._mode,
+
+            states, key_codes, n_groups, post = self._keyed_reduce(
+                buf, holder, n_keys
             )
+            mask, keys, extras, s2, perm, cap = post
+            per_corr = 3 if self._mode == "x32" else 2
             with self.metrics.timer("device_time_ns"):
-                packed = finish(s2, perm, tuple(sk), tuple(flat_cols))
-                host = np.asarray(packed)
                 med_results: list[np.ndarray] = []
                 for j in range(len(self._median_cols)):
                     med_fn = K.keyed_median_kernel(n_keys, cap)
@@ -1503,11 +1515,60 @@ class TpuStageExec(ExecutionPlan):
                         s2, perm, *corr_col(sx), *corr_col(sy)
                     )
                     corr_results.append(np.asarray(packed_c))
+        aux = {"median": med_results, "corr": corr_results}
+        return states, _KeyedGroups(key_codes, n_groups), n_rows_in, aux
+
+    def _keyed_reduce(self, buf: list, holder: dict, n_keys: int):
+        """ONE multi-key sort + segmented scan over the buffered blocks.
+
+        Returns ``(host_states, key_codes, n_groups, post)`` where
+        ``post = (mask, keys, extras, s2, perm, cap)`` keeps the sorted
+        arrays alive for the single-block median/corr passes.  Raises
+        ``_CapacityExceeded`` past tpu.max_capacity.
+        """
+        import jax.numpy as jnp
+
+        with self.metrics.timer("device_time_ns"):
+            parts = list(zip(*buf))
+            if len(buf) == 1:
+                fields = [p[0] for p in parts]
+            else:
+                fields = [jnp.concatenate(p) for p in parts]
+            total = int(fields[0].shape[0])
+            n2 = K.bucket_rows(total)
+            if n2 != total:
+                # pad rows carry mask=False and sink past every
+                # boundary in the sort — values never read
+                fields = [jnp.pad(f, (0, n2 - total)) for f in fields]
+            mask = fields[0]
+            per_corr = 3 if self._mode == "x32" else 2
+            n_extras = 3 * len(self._median_cols) + per_corr * len(
+                self._corr_cols
+            )
+            keys = fields[1:1 + n_keys]
+            flat_end = len(fields) - n_extras
+            flat_cols = fields[1 + n_keys:flat_end]
+            extras = fields[flat_end:]
+            out = K.keyed_sort_kernel(n_keys)(mask, *keys)
+            s2, perm = out[0], out[1]
+            sk = out[2:-1]
+            # the scalar fetch is the one host sync before capacity
+            # is known (~one tunnel roundtrip)
+            n_groups = int(np.asarray(out[-1]))
+        if n_groups > self.max_capacity:
+            raise _CapacityExceeded()
+        cap = max(64, 1 << (max(n_groups, 1) - 1).bit_length())
+        finish = K.keyed_finish_kernel(
+            holder["kinds"], holder["plan"], self.specs, n_keys, cap,
+            self._mode,
+        )
+        with self.metrics.timer("device_time_ns"):
+            packed = finish(s2, perm, tuple(sk), tuple(flat_cols))
+            host = np.asarray(packed)
         states, key_codes = K.unpack_keyed_host(
             self.specs, host, self._mode, n_keys
         )
-        aux = {"median": med_results, "corr": corr_results}
-        return states, _KeyedGroups(key_codes, n_groups), n_rows_in, aux
+        return states, key_codes, n_groups, (mask, keys, extras, s2, perm, cap)
 
     # ------------------------------------------------------- device join
     def _nojoin_stage(self) -> "TpuStageExec":
