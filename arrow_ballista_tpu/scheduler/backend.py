@@ -67,8 +67,13 @@ class StateBackend(ABC):
     def put(self, keyspace: Keyspace, key: str, value: bytes) -> None: ...
 
     @abstractmethod
-    def put_txn(self, ops: List[Tuple[Keyspace, str, bytes]]) -> None:
-        """Atomically apply several puts."""
+    def put_txn(
+        self, ops: List[Tuple[Keyspace, str, bytes]], fence=None
+    ) -> None:
+        """Atomically apply several puts.  ``fence`` (optional) is the
+        lock object guarding the write: remote lease backends reject the
+        transaction if the lease lapsed (fencing token); local backends
+        ignore it — in-process mutual exclusion is already total."""
 
     @abstractmethod
     def mv(
@@ -169,7 +174,7 @@ class MemoryBackend(_WatchMixin, _LockMixin, StateBackend):
             self._data[keyspace][key] = value
         self._notify(keyspace, WatchEvent(WatchEvent.PUT, key, value))
 
-    def put_txn(self, ops):
+    def put_txn(self, ops, fence=None):
         with self._guard:
             for ks, k, v in ops:
                 self._data[ks][k] = v
@@ -240,7 +245,7 @@ class SqliteBackend(_WatchMixin, _LockMixin, StateBackend):
             self._conn.commit()
         self._notify(keyspace, WatchEvent(WatchEvent.PUT, key, value))
 
-    def put_txn(self, ops):
+    def put_txn(self, ops, fence=None):
         with self._guard:
             for ks, k, v in ops:
                 self._conn.execute(

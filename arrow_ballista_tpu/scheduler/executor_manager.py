@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
 from ..errors import SchedulerError
+from .kvstore import LeaseFenced
 from ..proto import pb
 from ..serde.scheduler_types import ExecutorMetadata
 from .backend import Keyspace, StateBackend, WatchEvent
@@ -79,6 +80,18 @@ class ExecutorManager:
     def close(self) -> None:
         self._unsubscribe()
 
+    def _fenced_txn(self, lk, ops) -> None:
+        """Apply a Slots transaction under its lease's fencing token.
+
+        The reference's most carefully locked state is the slot accounting
+        (``executor_manager.rs:121-217``).  With a remote lease the write
+        carries the grant's token: if this holder's lease lapsed (stalled
+        refresher past TTL) and another scheduler re-acquired, the store
+        rejects the stale write (LeaseFenced) instead of letting it
+        corrupt the slot counts.  Local backends ignore the fence —
+        single-process mutual exclusion is already total."""
+        self.backend.put_txn(ops, fence=lk)
+
     # ------------------------------------------------------- registration
     def register_executor(
         self,
@@ -89,8 +102,10 @@ class ExecutorManager:
         reserve every slot for the offer cycle
         (reference: executor_manager.rs:308-417)."""
         slots = metadata.specification.task_slots
-        with self.backend.lock(Keyspace.Slots, "global"):
-            self.backend.put_txn(
+        lk = self.backend.lock(Keyspace.Slots, "global")
+        with lk:
+            self._fenced_txn(
+                lk,
                 [
                     (
                         Keyspace.Executors,
@@ -102,7 +117,7 @@ class ExecutorManager:
                         metadata.id,
                         _slots_bytes(0 if reserve else slots),
                     ),
-                ]
+                ],
             )
         self.save_heartbeat(
             ExecutorHeartbeat(metadata.id, time.time(), "active")
@@ -115,8 +130,11 @@ class ExecutorManager:
 
     def remove_executor(self, executor_id: str) -> None:
         """Mark dead and zero its slots."""
-        with self.backend.lock(Keyspace.Slots, "global"):
-            self.backend.put(Keyspace.Slots, executor_id, _slots_bytes(0))
+        lk = self.backend.lock(Keyspace.Slots, "global")
+        with lk:
+            self._fenced_txn(
+                lk, [(Keyspace.Slots, executor_id, _slots_bytes(0))]
+            )
         self.save_heartbeat(ExecutorHeartbeat(executor_id, time.time(), "dead"))
         with self._hb_lock:
             self._dead.add(executor_id)
@@ -189,24 +207,36 @@ class ExecutorManager:
         if n <= 0:
             return []
         alive = self.get_alive_executors()
-        reservations: List[ExecutorReservation] = []
-        with self.backend.lock(Keyspace.Slots, "global"):
-            txn = []
-            for eid, raw in self.backend.scan(Keyspace.Slots):
-                if eid not in alive:
-                    continue
-                avail = _slots_from(raw)
-                take = min(avail, n - len(reservations))
-                if take <= 0:
-                    continue
-                txn.append((Keyspace.Slots, eid, _slots_bytes(avail - take)))
-                reservations.extend(
-                    ExecutorReservation(eid, job_id) for _ in range(take)
-                )
-                if len(reservations) >= n:
-                    break
-            if txn:
-                self.backend.put_txn(txn)
+        # on LeaseFenced nothing was applied: re-scan and retry once
+        # under a fresh grant (the counts may have changed meanwhile)
+        for attempt in (0, 1):
+            reservations: List[ExecutorReservation] = []
+            lk = self.backend.lock(Keyspace.Slots, "global")
+            try:
+                with lk:
+                    txn = []
+                    for eid, raw in self.backend.scan(Keyspace.Slots):
+                        if eid not in alive:
+                            continue
+                        avail = _slots_from(raw)
+                        take = min(avail, n - len(reservations))
+                        if take <= 0:
+                            continue
+                        txn.append(
+                            (Keyspace.Slots, eid, _slots_bytes(avail - take))
+                        )
+                        reservations.extend(
+                            ExecutorReservation(eid, job_id)
+                            for _ in range(take)
+                        )
+                        if len(reservations) >= n:
+                            break
+                    if txn:
+                        self._fenced_txn(lk, txn)
+                return reservations
+            except LeaseFenced:
+                if attempt:
+                    raise
         return reservations
 
     def cancel_reservations(self, reservations: List[ExecutorReservation]) -> None:
@@ -216,13 +246,26 @@ class ExecutorManager:
         per: Dict[str, int] = {}
         for r in reservations:
             per[r.executor_id] = per.get(r.executor_id, 0) + 1
-        with self.backend.lock(Keyspace.Slots, "global"):
-            txn = []
-            for eid, k in per.items():
-                raw = self.backend.get(Keyspace.Slots, eid)
-                avail = _slots_from(raw) if raw is not None else 0
-                txn.append((Keyspace.Slots, eid, _slots_bytes(avail + k)))
-            self.backend.put_txn(txn)
+        # a fenced rejection must NOT leak the slots forever (the take
+        # was already applied by an earlier reserve): the give-back is a
+        # pure re-derive-and-add under whatever lease is current, so on
+        # LeaseFenced retry once with a fresh grant
+        for attempt in (0, 1):
+            lk = self.backend.lock(Keyspace.Slots, "global")
+            try:
+                with lk:
+                    txn = []
+                    for eid, k in per.items():
+                        raw = self.backend.get(Keyspace.Slots, eid)
+                        avail = _slots_from(raw) if raw is not None else 0
+                        txn.append(
+                            (Keyspace.Slots, eid, _slots_bytes(avail + k))
+                        )
+                    self._fenced_txn(lk, txn)
+                return
+            except LeaseFenced:
+                if attempt:
+                    raise
 
     def available_slots(self) -> int:
         alive = self.get_alive_executors()

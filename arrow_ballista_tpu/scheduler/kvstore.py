@@ -373,14 +373,16 @@ class RemoteBackend(StateBackend):
             )
         )
 
-    def put_txn(self, ops, fence: Optional[_RemoteLock] = None):
+    def put_txn(self, ops, fence=None):
         params = pb.KvTxnParams(
             ops=[
                 pb.KvTxnOp(keyspace=ks.value, key=self._k(k), value=v)
                 for ks, k, v in ops
             ]
         )
-        if fence is not None:
+        # callers pass whatever backend.lock() gave them; only remote
+        # leases carry a fencing token (a threading.Lock has none)
+        if fence is not None and hasattr(fence, "fence"):
             params.fence.CopyFrom(fence.fence())
         try:
             self._stub.PutTxn(params)

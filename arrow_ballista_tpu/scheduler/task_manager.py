@@ -115,7 +115,20 @@ class TaskManager:
         return entry.graph
 
     def _persist(self, graph: ExecutionGraph) -> None:
-        self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
+        try:
+            self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
+        except Exception:
+            # store unreachable (outage) or write refused: the in-memory
+            # graph now holds UNPERSISTED mutations — e.g. a task popped
+            # by fill_reservations that its caller will never deliver
+            # once this raises.  Drop the cached copy so the next load
+            # re-reads the last persisted state; otherwise the mutation
+            # strands (a "running" task no executor ever received).
+            with self._cache_lock:
+                e = self._cache.get(graph.job_id)
+            if e is not None:
+                e.graph = None
+            raise
 
     # ------------------------------------------------------------ recovery
     def recover_active_jobs(self) -> List[str]:
@@ -159,24 +172,19 @@ class TaskManager:
                         continue
                     graph.scheduler_id = self.scheduler_id
                     graph.revive()
-                    if hasattr(lk, "fence"):
-                        # remote lease: the adoption write carries the
-                        # grant's fencing token — if this sweeper's lease
-                        # lapsed (TTL outlived without a refresh), the
-                        # store rejects the write and a live sweeper wins
-                        try:
-                            self.backend.put_txn(
-                                [(
-                                    Keyspace.ActiveJobs, job_id,
-                                    graph.encode(),
-                                )],
-                                fence=lk,
-                            )
-                        except Exception:
-                            entry.graph = None  # store refused: reload
-                            raise
-                    else:
-                        self._persist(graph)
+                    # the adoption write carries the grant's fencing
+                    # token (remote lease) — if this sweeper's lease
+                    # lapsed (TTL outlived without a refresh), the store
+                    # rejects the write and a live sweeper wins; local
+                    # backends ignore the fence
+                    try:
+                        self.backend.put_txn(
+                            [(Keyspace.ActiveJobs, job_id, graph.encode())],
+                            fence=lk,
+                        )
+                    except Exception:
+                        entry.graph = None  # store refused: reload
+                        raise
                     out.append(job_id)
         return out
 
@@ -199,7 +207,15 @@ class TaskManager:
         entry = self._entry(job_id)
         with entry.lock:
             entry.graph = graph
-            self._persist(graph)
+            try:
+                self._persist(graph)
+            except Exception:
+                # nothing durable exists for this job: evict the cache
+                # entry too, or active_job_ids() would report a phantom
+                # job forever (KEDA's inflight metric never draining)
+                with self._cache_lock:
+                    self._cache.pop(job_id, None)
+                raise
         return graph
 
     def get_job_status(self, job_id: str) -> Optional[dict]:
@@ -332,6 +348,8 @@ class TaskManager:
                     continue
                 graph.revive()
                 changed = False
+                start = len(assignments)
+                free_before = list(free)
                 still_free = []
                 for r in free:
                     task = graph.pop_next_task(r.executor_id)
@@ -343,7 +361,24 @@ class TaskManager:
                 free = still_free
                 pending += graph.available_tasks()
                 if changed:
-                    self._persist(graph)
+                    try:
+                        self._persist(graph)
+                    except Exception:
+                        # this job's pops never became durable (_persist
+                        # dropped its cached graph, so it reloads the
+                        # last persisted state): withdraw ITS assignments
+                        # and give the reservations back, but keep and
+                        # deliver every assignment persisted for earlier
+                        # jobs — otherwise their tasks strand as Running
+                        # with no executor ever receiving them
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "persist failed filling reservations for %s; "
+                            "withdrawing its assignments", job_id,
+                        )
+                        del assignments[start:]
+                        free = free_before
         return assignments, free, pending
 
     def prepare_task_definition(self, task: Task) -> pb.TaskDefinition:

@@ -570,3 +570,59 @@ def test_scheduler_restart_resumes_job_over_sqlite(tmp_path):
         assert ran_after == baseline - done_before, (ran_after, baseline)
     finally:
         f3.stop()
+
+
+def test_fill_reservations_partial_persist_failure():
+    """A persist failure for ONE job mid fill_reservations must not
+    discard assignments already persisted for EARLIER jobs (they'd
+    strand as Running with no executor receiving them), and the failed
+    job's reservations return to the pool while its cached graph drops
+    back to the last persisted state."""
+    from arrow_ballista_tpu.scheduler.executor_manager import (
+        ExecutorReservation,
+    )
+
+    class FlakyBackend(MemoryBackend):
+        def __init__(self):
+            super().__init__()
+            self.fail_keys = set()
+
+        def put(self, keyspace, key, value):
+            if (keyspace, key) in self.fail_keys:
+                raise RuntimeError("store down for %s" % key)
+            super().put(keyspace, key, value)
+
+    backend = FlakyBackend()
+    fx = Fixture(backend=backend)
+    try:
+        fx.state.executor_manager.register_executor(EXEC1)
+        ctx = fx.make_session()
+        fx.submit(ctx, "select g, sum(v) as s from t group by g", "job-A")
+        fx.submit(ctx, "select g, count(v) as c from t group by g", "job-B")
+
+        # job-B's persist fails; job-A's succeeds
+        order = list(fx.state.task_manager._cache.keys())
+        assert order == ["job-A", "job-B"]
+        backend.fail_keys.add((Keyspace.ActiveJobs, "job-B"))
+
+        assignments, free, _ = fx.state.task_manager.fill_reservations(
+            [ExecutorReservation(EXEC1.id) for _ in range(4)]
+        )
+        # job-A's two stage-1 tasks are delivered; job-B's withdrawn
+        # pops gave their reservations back
+        jobs = {t.partition.job_id for _, t in assignments}
+        assert jobs == {"job-A"}, jobs
+        assert len(assignments) == 2
+        assert len(free) == 2
+
+        # store recovers: job-B reloads from its last persisted state
+        # and its tasks dispatch as if never popped
+        backend.fail_keys.clear()
+        assignments2, _, _ = fx.state.task_manager.fill_reservations(
+            [ExecutorReservation(EXEC1.id) for _ in range(4)]
+        )
+        jobs2 = {t.partition.job_id for _, t in assignments2}
+        assert jobs2 == {"job-B"}, jobs2
+        assert len(assignments2) == 2
+    finally:
+        fx.stop()
