@@ -339,12 +339,47 @@ def _running_minmax(spec: WindowSpec, vs, seg_id, seg_first):
     return np.where(cnt_mm > 0, cum, np.nan), cnt_mm
 
 
+def _np_range_extremum(v, lo, hi, fn, ident, max_len):
+    """Per-row extremum over [lo_i, hi_i]: numpy sparse table (doubling)
+    — level k holds the extremum of the size-2^k window starting at each
+    row; the query is two overlapping-window gathers.  ``max_len``
+    bounds the depth (finite frames need ceil(log2(frame_len)) levels).
+    Callers clip lo/hi to the row's segment, so both query windows stay
+    inside it even though levels span boundaries."""
+    n = len(v)
+    if n == 0:
+        return v
+    ext = np.minimum if fn == "min" else np.maximum
+    depth = max(1, int(max(max_len - 1, 1)).bit_length())
+    levels = [v]
+    cur = v
+    for k in range(1, depth + 1):
+        s = 1 << (k - 1)
+        shifted = np.full(n, ident, dtype=cur.dtype)
+        if s < n:
+            shifted[: n - s] = cur[s:]
+        cur = ext(cur, shifted)
+        levels.append(cur)
+    table = np.stack(levels)
+    length = np.maximum(hi - lo + 1, 1)
+    kq = np.zeros(n, dtype=np.int64)
+    for k in range(1, depth + 1):
+        kq += (length >= (1 << k)).astype(np.int64)
+    size = np.left_shift(np.ones(n, dtype=np.int64), kq)
+    aidx = np.clip(lo, 0, n - 1)
+    bidx = np.clip(hi - size + 1, 0, n - 1)
+    flat = table.reshape(-1)
+    return ext(flat[kq * n + aidx], flat[kq * n + bidx])
+
+
 def _rows_frame_aggregate(spec: WindowSpec, st: "_SortState", eval_col):
     """Explicit ROWS frames: row-exact sliding windows (no peer sharing).
 
     sum/avg/count reduce to two gathers on a segment-clamped prefix sum
-    — O(n) regardless of frame width; bounded min/max would need a
-    monotonic-deque pass and is not implemented."""
+    — O(n) regardless of frame width; bounded min/max query a sparse
+    table (``_np_range_extremum``) — O(n log frame) build, O(n) query,
+    with the running cummin/cummax fast path kept for UNBOUNDED
+    PRECEDING .. CURRENT ROW."""
     n = st.n
     seg_first = st.seg_first
     start, end = spec.frame
@@ -355,16 +390,49 @@ def _rows_frame_aggregate(spec: WindowSpec, st: "_SortState", eval_col):
     empty = hi < lo
 
     if spec.func in ("min", "max"):
-        if not (start is None and end == 0):
-            raise ExecutionError(
-                f"ROWS-framed {spec.func} supports only UNBOUNDED "
-                "PRECEDING AND CURRENT ROW"
-            )
         vs = _sorted_arg(st, eval_col, spec.arg)
-        cum, _ = _running_minmax(spec, vs, st.seg_id, seg_first)
-        if isinstance(cum, pa.Array):  # exact-int path
-            return pc.if_else(pa.array(~empty), cum, pa.scalar(None, cum.type))
-        return np.where(~empty, cum, np.nan)  # cum already NaN-gated
+        if start is None and end == 0:
+            # running fast path: grouped cummin/cummax
+            cum, _ = _running_minmax(spec, vs, st.seg_id, seg_first)
+            if isinstance(cum, pa.Array):  # exact-int path
+                return pc.if_else(
+                    pa.array(~empty), cum, pa.scalar(None, cum.type)
+                )
+            return np.where(~empty, cum, np.nan)  # cum already NaN-gated
+        # general ROWS frame: sparse-table range extremum (two gathers
+        # over log-depth doubled windows — the same decomposition the
+        # device kernel uses, ops/window_kernel._range_extremum)
+        _require_numeric(spec, vs.type)
+        max_len = (
+            end - start + 1 if start is not None and end is not None else n
+        )
+        if pa.types.is_integer(vs.type) and vs.null_count == 0:
+            v = vs.to_numpy(zero_copy_only=False).astype(np.int64)
+            ident = (
+                np.iinfo(np.int64).max
+                if spec.func == "min"
+                else np.iinfo(np.int64).min
+            )
+            res = _np_range_extremum(
+                v, lo, hi, spec.func, ident, max_len
+            )
+            return pa.array(res, pa.int64(), mask=empty)
+        fvals = pc.cast(vs, pa.float64(), safe=False).to_numpy(
+            zero_copy_only=False
+        )
+        miss = np.isnan(fvals)
+        ident = np.inf if spec.func == "min" else -np.inf
+        res = _np_range_extremum(
+            np.where(miss, ident, fvals), lo, hi, spec.func, ident, max_len
+        )
+        # frames holding only nulls (or clipped empty) are NULL: count
+        # the frame's valid rows via a segment-local prefix difference
+        vcum = _segmented_cumsum((~miss).astype(np.int64), seg_first)
+        hi_c = np.clip(hi, 0, max(n - 1, 0))
+        lom1_c = np.clip(lo - 1, 0, max(n - 1, 0))
+        base = np.where(lo > seg_first, vcum[lom1_c], 0)
+        vcnt = np.where(empty, 0, vcum[hi_c] - base)
+        return np.where(vcnt > 0, res, np.nan)
 
     if spec.arg is None:  # count(*)
         out = hi - lo + 1

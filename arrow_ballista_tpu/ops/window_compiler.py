@@ -7,8 +7,10 @@ program per window signature (``ops/window_kernel.py``): multi-key sort,
 boundary flags, segmented scans, gathers, packed fetch.
 
 Host responsibilities here:
-* eligibility (plan time): supported function set, default RANGE frames,
-  numeric/date ORDER BY, numeric arguments — anything else stays on the
+* eligibility (plan time): supported function set, default RANGE or
+  ROWS frames (incl. framed min/max via a sparse-table range extremum),
+  numeric/date/STRING ORDER BY (strings order-encode as ranks among the
+  sorted uniques), numeric arguments — anything else stays on the
   vectorized CPU path (``exec/window.py``), which remains the oracle;
 * ORDER-preserving integer key encoding: every ORDER BY key becomes a
   null-rank flag plus integer key(s) whose SIGNED order equals the SQL
@@ -38,6 +40,14 @@ from .bridge import arrow_to_numpy, make_key_encoder
 _AGG_FNS = {"sum", "avg", "min", "max", "count"}
 
 
+def _is_string_like(t: pa.DataType) -> bool:
+    return (
+        pa.types.is_string(t)
+        or pa.types.is_large_string(t)
+        or (pa.types.is_dictionary(t) and pa.types.is_string(t.value_type))
+    )
+
+
 # ------------------------------------------------------- key encoding
 from .bridge import split_u64_i32, to_u64_order  # noqa: E402
 
@@ -50,6 +60,38 @@ def _split_u64(u: np.ndarray, mode: str) -> list:
     if mode == "x64":
         return [(u ^ (np.uint64(1) << np.uint64(63))).view(np.int64)]
     return list(split_u64_i32(u))
+
+
+def _string_order_ranks(arr: pa.Array):
+    """(ranks int64, validity) — rank of each string among the SORTED
+    unique strings: an order-preserving integer key.  Rank equality is
+    string equality, so tie structure (rank/dense_rank peers) is exact.
+    ``pc.sort_indices`` does the ordering — the same collation the CPU
+    window operator sorts with, so the two paths cannot disagree."""
+    import pyarrow.compute as pc
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    denc = arr.dictionary_encode() if not pa.types.is_dictionary(
+        arr.type
+    ) else arr
+    d = denc.dictionary
+    codes = denc.indices
+    code_vals = np.asarray(codes.fill_null(0), dtype=np.int64)
+    validity = (
+        np.asarray(pc.is_valid(codes)) if codes.null_count else None
+    )
+    if d.null_count:
+        # pre-encoded dictionaries (e.g. from Parquet) may hold a null
+        # SLOT: a valid index pointing at it is still a NULL row
+        slot_valid = np.asarray(pc.is_valid(d))[code_vals]
+        validity = (
+            slot_valid if validity is None else validity & slot_valid
+        )
+    sort_idx = np.asarray(pc.sort_indices(d), dtype=np.int64)
+    rank_of = np.empty(len(d), dtype=np.int64)
+    rank_of[sort_idx] = np.arange(len(d), dtype=np.int64)
+    return rank_of[code_vals], validity
 
 
 def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],
@@ -65,6 +107,7 @@ def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],
         or pa.types.is_boolean(t)
         or pa.types.is_timestamp(t)
         or pa.types.is_decimal(t)
+        or _is_string_like(t)
     ):
         raise K.NotLowerable(f"window ORDER BY type {t}")
     if pa.types.is_decimal(t):
@@ -75,7 +118,10 @@ def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],
         import pyarrow.compute as pc
 
         arr = pc.cast(arr, pa.int32())
-    values, validity = arrow_to_numpy(arr)
+    if _is_string_like(t):
+        values, validity = _string_order_ranks(arr)
+    else:
+        values, validity = arrow_to_numpy(arr)
     u = _to_u64_order(values)
     if not asc:
         u = ~u
@@ -115,6 +161,7 @@ class TpuWindowExec(ExecutionPlan):
                     or pa.types.is_boolean(t)
                     or pa.types.is_timestamp(t)
                     or pa.types.is_decimal(t)
+                    or _is_string_like(t)
                 ):
                     raise K.NotLowerable(f"window ORDER BY type {t}")
             if spec.arg is not None:
@@ -135,9 +182,8 @@ class TpuWindowExec(ExecutionPlan):
 
     def _check_spec(self, spec: WindowSpec) -> None:
         if spec.frame is not None and spec.func not in (
-            "sum", "count", "avg",
+            "sum", "count", "avg", "min", "max",
         ):
-            # framed min/max need a monotonic deque — CPU handles those
             raise K.NotLowerable(f"window ROWS frame for {spec.func}")
         if spec.func in RANKING:
             return
@@ -423,6 +469,22 @@ class TpuWindowExec(ExecutionPlan):
                 fn = kspec[1]
                 if kspec[2] is None or fn == "count":
                     col = pa.array(int_row().astype(np.int64), pa.int64())
+                elif fn in ("min", "max"):
+                    if pa.types.is_integer(spec.out_type) or pa.types.is_date(
+                        spec.out_type
+                    ):
+                        v = int_row().astype(np.int64)
+                        empty = int_row() == 0
+                        col = pa.array(
+                            np.where(empty, 0, v), pa.int64(), mask=empty
+                        )
+                    else:
+                        v = float_row()
+                        empty = int_row() == 0
+                        col = pa.array(
+                            np.where(empty, 0.0, v), pa.float64(),
+                            mask=empty,
+                        )
                 else:
                     if mode == "x32":
                         hi_v = float_row() + float_row()

@@ -125,6 +125,43 @@ def _seg_scan(flag, elems: list, kinds: list):
     return outs
 
 
+def _range_extremum(v, lo, hi, fn, ident, n, max_len):
+    """Per-row extremum over [lo_i, hi_i] via a SPARSE TABLE (doubling):
+    level k holds the extremum of the size-2^k window starting at each
+    row, built with log-depth shifted minimum/maximum folds; the query
+    is two gathers (the classic overlapping-windows RMQ decomposition).
+    A monotonic deque is inherently sequential — this is the
+    gather-friendly device form.  Segment safety: both query windows lie
+    inside [lo, hi], which callers clip to the row's segment, so levels
+    may freely span segment boundaries without contaminating results.
+    ``max_len`` bounds the table depth: finite frames need only
+    ceil(log2(frame_len)) levels."""
+    ext = jnp.minimum if fn == "min" else jnp.maximum
+    levels = [v]
+    depth = max(1, int(max_len - 1).bit_length())
+    cur = v
+    for k in range(1, depth + 1):
+        s = 1 << (k - 1)
+        if s < n:
+            shifted = jnp.concatenate(
+                [cur[s:], jnp.full((s,), ident, cur.dtype)]
+            )
+        else:
+            shifted = jnp.full((n,), ident, cur.dtype)
+        cur = ext(cur, shifted)
+        levels.append(cur)
+    table = jnp.stack(levels)  # [depth+1, n]
+    length = jnp.maximum(hi - lo + 1, 1)
+    kq = jnp.zeros_like(length)
+    for k in range(1, depth + 1):
+        kq = kq + (length >= (1 << k)).astype(length.dtype)
+    size = jnp.left_shift(jnp.ones_like(kq), kq)
+    aidx = jnp.clip(lo, 0, n - 1)
+    bidx = jnp.clip(hi - size + 1, 0, n - 1)
+    flat = table.reshape(-1)
+    return ext(flat[kq * n + aidx], flat[kq * n + bidx])
+
+
 def make_window_kernel(
     specs: tuple,
     n_part_keys: int,
@@ -315,6 +352,28 @@ def make_window_kernel(
                     cp[hi_g] - jnp.where(lo_open, cp[lom1_g], 0),
                 )
                 if fn_name == "count":
+                    emit(cnt, True)
+                    continue
+                if fn_name in ("min", "max"):
+                    if jnp.issubdtype(val.dtype, jnp.integer):
+                        info = jnp.iinfo(idt)
+                        ident = info.max if fn_name == "min" else info.min
+                        vv = jnp.where(avalid, val.astype(idt), ident)
+                        out_int = True
+                    else:
+                        ident = jnp.inf if fn_name == "min" else -jnp.inf
+                        vv = jnp.where(avalid, val.astype(fdt), ident)
+                        out_int = False
+                    # finite frames bound the sparse table's depth
+                    max_len = (
+                        fend - fstart + 1
+                        if fstart is not None and fend is not None
+                        else n
+                    )
+                    res = _range_extremum(
+                        vv, lo, hi, fn_name, ident, n, max_len
+                    )
+                    emit(jnp.where(empty, ident, res), out_int)
                     emit(cnt, True)
                     continue
                 if mode == "x32":

@@ -236,43 +236,75 @@ def test_rows_framed_sum_mixed_magnitude_partitions():
         _assert_close(want, got, rel=1e-6)
 
 
-def test_rows_framed_minmax_stays_on_cpu():
-    """Framed min/max need a monotonic deque: the plan must keep the CPU
-    operator (correctness preserved, no device attempt)."""
-    t = _data(n=2000)
-    ctx = _ctx(t, True)
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_rows_framed_minmax_on_device(mode):
+    """ROWS-framed min/max lower as a sparse-table range extremum (two
+    gathers over log-depth doubled windows — a monotonic deque is
+    sequential; this is the gather-friendly device form).  Finite,
+    unbounded-preceding, forward and backward frames, int and float
+    args, vs the CPU operator oracle."""
+    t = _data()
     sql = (
-        "select g, iv, min(w) over (partition by g order by iv "
-        "rows between unbounded preceding and current row) mm from t"
+        "select g, iv, w, "
+        "min(w) over (partition by g order by iv, w "
+        "rows between unbounded preceding and current row) rm, "
+        "max(w) over (partition by g order by iv, w "
+        "rows between 2 preceding and current row) fm, "
+        "min(iv) over (partition by g order by iv, w "
+        "rows between 1 preceding and 3 following) im, "
+        "max(v) over (partition by g order by iv, w "
+        "rows between 3 following and 6 following) nm, "
+        "min(w) over (partition by g order by iv, w "
+        "rows between 6 preceding and 2 preceding) pm "
+        "from t"
     )
-    plan = ctx.sql(sql).physical_plan()
-    names = []
-    stack = [plan]
-    while stack:
-        nd = stack.pop()
-        names.append(type(nd).__name__)
-        stack.extend(nd.children())
-    assert "TpuWindowExec" not in names, names
-    assert "WindowExec" in names, names
-    K.set_precision(None)
-    want = _ctx(t, False).sql(sql).collect()
-    got = ctx.execute(plan)
-    key = [("g", "ascending"), ("iv", "ascending"), ("mm", "ascending")]
-    _assert_close(want.sort_by(key), got.sort_by(key))
+    want, got, m = _both(sql, t, mode, ["g", "iv", "w"])
+    assert m.get("tpu_window", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got, rel=1e-6)
 
 
-def test_string_order_by_falls_back():
-    t = _data(n=2000)
-    ctx = _ctx(t, True)
-    sql = "select g, s, rank() over (partition by g order by s) rk from t"
-    plan = ctx.sql(sql).physical_plan()
-    names = [type(n).__name__ for n in _walk(plan)]
-    assert "TpuWindowExec" not in names, names
-    K.set_precision(None)
-    want = _ctx(t, False).sql(sql).collect()
-    got = ctx.execute(plan)
-    key = [("g", "ascending"), ("s", "ascending"), ("rk", "ascending")]
-    _assert_close(want.sort_by(key), got.sort_by(key))
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_string_order_by_on_device(mode):
+    """String ORDER BY keys order-encode as ranks among the SORTED
+    unique strings (pc.sort_indices collation — identical to the CPU
+    operator's sort), so ranking/agg/value functions all lower."""
+    t = _data()
+    sql = (
+        "select g, s, rank() over (partition by g order by s) rk, "
+        "dense_rank() over (partition by g order by s) dr, "
+        "sum(w) over (partition by g order by s) rs, "
+        "first_value(w) over (partition by g order by s) fv "
+        "from t"
+    )
+    want, got, m = _both(sql, t, mode, ["g", "s", "rk"])
+    assert m.get("tpu_window", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got, rel=1e-6)
+
+
+def test_string_order_desc_nulls_and_ties():
+    """DESC string order + NULL strings keep exact tie structure."""
+    rng = np.random.default_rng(9)
+    n = 3000
+    words = np.array(["apple", "pear", "Zebra", "zebra", "fig", ""])
+    sv = words[rng.integers(0, len(words), n)]
+    smask = rng.uniform(size=n) < 0.08
+    t = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 10, n)),
+            "s": pa.array(sv.tolist(), pa.string(), mask=smask),
+            "w": pa.array(rng.uniform(0, 50, n)),
+        }
+    )
+    sql = (
+        "select g, s, rank() over (partition by g order by s desc) rk, "
+        "count(*) over (partition by g order by s desc) rc from t"
+    )
+    want, got, m = _both(sql, t, "x32", ["g", "rk", "rc"])
+    assert m.get("tpu_window", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got)
 
 
 def _walk(plan):
@@ -316,3 +348,25 @@ def test_x32_int_window_sums_above_2p24_exact():
     assert got.column("rs").to_pylist() == want.column("rs").to_pylist()
     assert got.column("fs").to_pylist() == want.column("fs").to_pylist()
     _assert_close(want, got, rel=1e-9)
+
+
+def test_dictionary_order_key_with_null_slot():
+    """A pre-encoded dictionary column (e.g. from Parquet) can hold a
+    NULL dictionary slot: a valid index pointing at it is still a NULL
+    row and must take the null_rank path, not a string rank.  (Unit
+    test: the CPU operator cannot sort dictionary keys at all, so the
+    encoder is the only thing standing between this shape and a wrong
+    device answer.)"""
+    from arrow_ballista_tpu.ops.window_compiler import _string_order_ranks
+
+    d = pa.DictionaryArray.from_arrays(
+        pa.array([0, 1, 2, 0, None, 1], pa.int32()),
+        pa.array(["b", None, "a"]),
+    )
+    ranks, validity = _string_order_ranks(d)
+    assert validity is not None
+    # rows 1 and 5 point at the null SLOT; row 4 has a null INDEX
+    assert validity.tolist() == [True, False, True, True, False, False]
+    # among valid rows: "a" < "b"
+    assert ranks[2] < ranks[0]
+    assert ranks[0] == ranks[3]
