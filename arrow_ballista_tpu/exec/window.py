@@ -403,9 +403,15 @@ def _rows_frame_aggregate(spec: WindowSpec, st: "_SortState", eval_col):
         # over log-depth doubled windows — the same decomposition the
         # device kernel uses, ops/window_kernel._range_extremum)
         _require_numeric(spec, vs.type)
-        max_len = (
-            end - start + 1 if start is not None and end is not None else n
-        )
+        if start is not None and end is not None:
+            max_len = end - start + 1
+        else:
+            # half-unbounded frames never exceed the largest segment:
+            # bound the table depth by it, not n (the device kernel has
+            # to use its static padded n — this host path need not)
+            max_len = (
+                int((seg_last - seg_first + 1).max()) if n else 1
+            )
         if pa.types.is_integer(vs.type) and vs.null_count == 0:
             v = vs.to_numpy(zero_copy_only=False).astype(np.int64)
             ident = (

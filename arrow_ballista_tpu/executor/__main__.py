@@ -31,11 +31,14 @@ CONFIG_KEYS = {
     "concurrent_tasks": (int, 4, "task slots"),
     "task_scheduling_policy": (str, "pull-staged", "pull-staged | push-staged"),
     "task_isolation": (
-        str, "thread",
-        "thread | process: 'process' runs file-shuffle tasks in pooled "
+        str, "process",
+        "process | thread: 'process' (default) runs shuffle tasks — file "
+        "AND memory data plane (mem:// partitions spool through the "
+        "shared work_dir and the executor absorbs them) — in pooled "
         "worker subprocesses so plan execution (e.g. a GIL-pegging UDF) "
         "cannot starve Flight serving/CancelTasks/heartbeats (reference "
-        "DedicatedExecutor); device stages always stay in-process",
+        "DedicatedExecutor); device stages stay in-process on a real "
+        "accelerator (the XLA client is per-process)",
     ),
     "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
@@ -105,6 +108,17 @@ class ShuffleJanitor(threading.Thread):
             return
         for job in entries:
             path = os.path.join(self.work_dir, job)
+            if job == ".memspool" and os.path.isdir(path):
+                # orphaned worker spool files (a failed/cancelled task's
+                # mem:// partitions were never absorbed): age per file
+                for f in os.listdir(path):
+                    fp = os.path.join(path, f)
+                    try:
+                        if now - os.path.getmtime(fp) > ttl_s:
+                            os.unlink(fp)
+                    except OSError:
+                        pass
+                continue
             if not os.path.isdir(path):
                 continue
             newest = 0.0

@@ -13,6 +13,7 @@ reference's catch_unwind (``execution_loop.rs:120-130``).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -216,14 +217,13 @@ class Executor:
     # ---------------------------------------------------- process isolation
     def _worker_eligible(self, task: pb.TaskDefinition) -> bool:
         """Process isolation runs tasks whose outputs OUTLIVE the worker:
-        file shuffle (shared work_dir).  Memory-shuffle tasks publish into
-        this process's ``mem://`` store and device stages need this
-        process's XLA client — both keep the thread path."""
+        file shuffle (shared work_dir) and memory shuffle (the worker
+        SPOOLS mem:// partitions to the shared work_dir and this process
+        absorbs them into its store on completion).  Device stages need
+        this process's XLA client and keep the thread path on a real
+        accelerator — the measured residual risk
+        (tests/test_executor_isolation.py device-stage latency test)."""
         props = dict(task.props)
-        if props.get("ballista.shuffle.to_memory", "false").lower() in (
-            "true", "1", "yes",
-        ):
-            return False
         if props.get("ballista.tpu.enable", "true").lower() in (
             "true", "1", "yes",
         ):
@@ -265,7 +265,23 @@ class Executor:
             self._idle_workers.append(worker)
         status = pb.TaskStatus()
         status.ParseFromString(out)
+        self._absorb_spooled(status)
         return status
+
+    def _absorb_spooled(self, status: pb.TaskStatus) -> None:
+        """Move a worker's spooled mem:// partitions into THIS process's
+        memory store (the Flight service serves from here)."""
+        if status.WhichOneof("status") != "completed":
+            return
+        from ..shuffle import memory_store
+
+        spool = os.path.join(self.work_dir, ".memspool")
+        for part in status.completed.partitions:
+            if part.path.startswith(memory_store.SCHEME):
+                if not memory_store.absorb_spooled(spool, part.path):
+                    log.warning(
+                        "spooled memory partition missing: %s", part.path
+                    )
 
     def shutdown_workers(self) -> None:
         with self._worker_lock:

@@ -53,8 +53,15 @@ def main() -> None:
 
     from ..proto import pb
     from ..serde.scheduler_types import ExecutorMetadata, ExecutorSpecification
+    from ..shuffle import memory_store
     from ..udf import load_udf_plugins
     from .executor import Executor
+
+    # mem:// puts in this process spool to the shared work_dir; the
+    # parent absorbs them into its store when the task completes
+    import os
+
+    memory_store.set_spool_dir(os.path.join(args.work_dir, ".memspool"))
 
     if args.plugin_dir:
         load_udf_plugins(args.plugin_dir)

@@ -77,6 +77,11 @@ def _string_order_ranks(arr: pa.Array):
     ) else arr
     d = denc.dictionary
     codes = denc.indices
+    if len(d) == 0:  # every row is NULL: one rank, all rows invalid
+        return (
+            np.zeros(len(arr), dtype=np.int64),
+            np.zeros(len(arr), dtype=bool),
+        )
     code_vals = np.asarray(codes.fill_null(0), dtype=np.int64)
     validity = (
         np.asarray(pc.is_valid(codes)) if codes.null_count else None

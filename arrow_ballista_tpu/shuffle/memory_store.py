@@ -26,6 +26,16 @@ import pyarrow as pa
 
 SCHEME = "mem://"
 
+# Spool mode (process-isolated task workers): the worker process cannot
+# publish into the PARENT executor's in-memory store, so with a spool
+# dir set its puts write compact IPC buffers to files under the shared
+# work_dir instead (tmpfs-speed when work_dir is tmpfs) and the parent
+# absorbs them into its own store when the task completes — mem://
+# partitions stay served from executor memory while plan execution
+# stays out of the executor's GIL (reference DedicatedExecutor,
+# cpu_bound_executor.rs:37-131).
+_spool_dir: Optional[str] = None
+
 _lock = threading.Lock()
 # values are compact Arrow IPC stream buffers, NOT RecordBatch lists: a
 # stored batch slice would pin its parent batch's entire allocation (and
@@ -48,6 +58,53 @@ def parse_path(path: str) -> Optional[Tuple[str, int, int, int]]:
     return parts[0], int(parts[1]), int(parts[2]), int(parts[3])
 
 
+def set_spool_dir(path: Optional[str]) -> None:
+    """Divert puts in THIS process to spool files (task workers)."""
+    global _spool_dir
+    if path is not None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+    _spool_dir = path
+
+
+def spool_file(spool_dir: str, path: str) -> Optional[str]:
+    key = parse_path(path)
+    if key is None:
+        return None
+    import os
+
+    return os.path.join(
+        spool_dir, f"{key[0]}__{key[1]}__{key[2]}__{key[3]}.arrow"
+    )
+
+
+def absorb_spooled(spool_dir: str, path: str) -> bool:
+    """Parent side: move a worker's spooled partition into this
+    process's store (memory-map, copy into an owned buffer, unlink)."""
+    import time
+
+    key = parse_path(path)
+    f = spool_file(spool_dir, path)
+    if key is None or f is None:
+        return False
+    import os
+
+    try:
+        # no exists() pre-check: a janitor sweep or a duplicate task
+        # completion can unlink between check and open (TOCTOU) — treat
+        # any filesystem race as "not spooled" and let the caller warn
+        with open(f, "rb") as fh:
+            buf = pa.py_buffer(fh.read())
+        os.unlink(f)
+    except OSError:
+        return False
+    with _lock:
+        _store[key] = buf
+        _job_touched[key[0]] = time.time()
+    return True
+
+
 def put(
     job_id: str,
     stage_id: int,
@@ -65,13 +122,29 @@ def put(
     buf = sink.getvalue()
 
     key = (job_id, stage_id, out_part, in_part)
+    path = make_path(*key)
+    if _spool_dir is not None:
+        import os
+
+        f = spool_file(_spool_dir, path)
+        tmp = f + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as fh:
+            fh.write(buf)
+        os.replace(tmp, f)  # atomic: a retried task never sees half a file
+        return path
     with _lock:
         _store[key] = buf
         _job_touched[job_id] = time.time()
-    return make_path(*key)
+    return path
 
 
 def put_size(path: str) -> int:
+    if _spool_dir is not None:
+        import os
+
+        f = spool_file(_spool_dir, path)
+        if f is not None and os.path.exists(f):
+            return os.path.getsize(f)
     key = parse_path(path)
     with _lock:
         buf = _store.get(key) if key else None

