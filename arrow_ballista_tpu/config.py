@@ -57,8 +57,10 @@ def _parse_bool(v: str) -> bool:
 
 def _parse_highcard_mode(v: str) -> str:
     mode = v.lower()
-    if mode not in ("auto", "device", "cpu"):
-        raise ValueError(f"highcard_mode must be auto|cpu|device, got {v!r}")
+    if mode not in ("auto", "device", "cpu", "gid"):
+        raise ValueError(
+            f"highcard_mode must be auto|cpu|device|gid, got {v!r}"
+        )
     return mode
 
 
@@ -147,9 +149,13 @@ _ENTRIES: dict[str, ConfigEntry] = {
         ConfigEntry(
             TPU_HIGHCARD_MODE,
             "aggregate routing when the first batch shows groups ~ rows: "
-            "'auto'/'device' run the device-KEYED aggregation (group ids "
-            "assigned by the device sort, no host hash encode); 'cpu' "
-            "hands the stage to the C++ hash aggregate (A/B baseline)",
+            "'auto' resolves by platform — accelerator backends run the "
+            "device-KEYED aggregation (group ids assigned by the device "
+            "sort, no host hash encode), the cpu backend hands to the "
+            "C++ hash aggregate (measured winner there: h2o q10 4x); "
+            "'device' pins the keyed path anywhere, 'cpu' pins the hash "
+            "handoff (A/B baseline), 'gid' pins the gid-table device "
+            "path even at high cardinality (A/B: capacity must fit)",
             _parse_highcard_mode,
             "auto",
         ),

@@ -115,10 +115,29 @@ class _KeyedGroups:
 # High-cardinality routing: below either bound the gid-table device path
 # wins outright (measured q1 SF10: 38x).  Above both, the host group-id
 # encode used to dominate (q3 SF10: 44% of wall was key_encode) — the
-# keyed path moves that to the device sort, so 'auto' now stays on
-# device; 'cpu' preserves the old C++-hash-aggregate handoff for A/B.
+# keyed path moves that to the device sort; 'cpu' preserves the old
+# C++-hash-aggregate handoff for A/B.  'auto' resolves BY PLATFORM:
+# measured on the CPU platform (KERNELBENCH smoke, 1e5 rows: scatter
+# 166M rows/s vs keyed sort 2.6M; h2o G1_1e6 A/B: q10 9.9s keyed vs
+# 2.4s hash handoff), the sort-based keyed path loses ~4x there, so a
+# cpu backend routes groups~rows to the C++ hash aggregate; on an
+# accelerator (scatter serializes, host encode pays the tunnel) auto
+# stays keyed.  'device' pins keyed anywhere (tests, chip A/B).
 _HIGHCARD_MIN_GROUPS = 1 << 16
 _HIGHCARD_RATIO = 0.05
+
+
+def keyed_route_wanted(config) -> bool:
+    """Does groups~rows route to the device-KEYED path in this config
+    on this platform?  (See the routing comment above.)"""
+    mode = config.tpu_highcard_mode
+    if mode == "cpu":
+        return False
+    if mode == "device":
+        return True
+    import jax
+
+    return jax.default_backend() != "cpu"
 
 
 def _highcard_detect(n_groups: int, n_rows: int) -> bool:
@@ -126,15 +145,6 @@ def _highcard_detect(n_groups: int, n_rows: int) -> bool:
     return (
         n_groups > _HIGHCARD_MIN_GROUPS
         and n_groups > _HIGHCARD_RATIO * n_rows
-    )
-
-
-def should_highcard_fallback(config, n_groups: int, n_rows: int) -> bool:
-    """Mesh-gang predicate: the gang has no keyed path, so groups~rows
-    hands the stage to the sequential fallback unless
-    ``ballista.tpu.highcard_mode=device`` pins the sort-based gid path."""
-    return config.tpu_highcard_mode != "device" and _highcard_detect(
-        n_groups, n_rows
     )
 
 
@@ -1233,14 +1243,16 @@ class TpuStageExec(ExecutionPlan):
                         if first_groups is None or _highcard_detect(
                             first_groups, n
                         ):
-                            if (
-                                self.config.tpu_highcard_mode != "cpu"
-                                and keyed_ok
-                            ):
+                            if keyed_route_wanted(self.config) and keyed_ok:
                                 raise _KeyedRoute(
                                     [(batch, codes)], src, key_encoders, ra
                                 )
-                            if fused.join is None:
+                            if (
+                                self.config.tpu_highcard_mode == "gid"
+                                and first_groups is not None
+                            ):
+                                pass  # pinned gid-table path (A/B)
+                            elif fused.join is None:
                                 raise _HighCardinality([batch], src)
                             # fused device join at high cardinality with
                             # the keyed path unavailable (cpu mode or

@@ -191,24 +191,27 @@ class MeshGangExec(ExecutionPlan):
                             )
                         if n_rows == 0:
                             from ..ops.stage_compiler import (
-                                should_highcard_fallback,
+                                _highcard_detect,
+                                keyed_route_wanted,
                             )
 
-                            if should_highcard_fallback(
-                                tpu.config, group_table.n_groups, n
-                            ):
-                                if tpu.config.tpu_highcard_mode != "cpu":
+                            if _highcard_detect(group_table.n_groups, n):
+                                if keyed_route_wanted(tpu.config):
                                     # groups ~ rows: per-shard KEYED
                                     # reduction keeps the whole mesh busy
                                     raise _MeshKeyedRoute(n_dev)
-                                # highcard_mode=cpu: the sequential
-                                # fallback routes each partition to the
-                                # C++ hash aggregate
-                                from ..errors import ExecutionError
+                                if tpu.config.tpu_highcard_mode != "gid":
+                                    # cpu platform / highcard_mode=cpu:
+                                    # the sequential fallback routes each
+                                    # partition to the C++ hash aggregate
+                                    # (the measured winner off-
+                                    # accelerator); 'gid' pins the gid-
+                                    # table gang path (capacity must fit)
+                                    from ..errors import ExecutionError
 
-                                raise ExecutionError(
-                                    "high-cardinality gang stage"
-                                )
+                                    raise ExecutionError(
+                                        "high-cardinality gang stage"
+                                    )
                     else:
                         seg = np.zeros(n, dtype=np.int32)
                     with self.metrics.timer("bridge_time_ns"):

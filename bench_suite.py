@@ -25,7 +25,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-OUT_PATH = os.path.join(
+OUT_PATH = os.environ.get("BENCH_SUITE_OUT") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_SUITE_r05.json"
 )
 
@@ -139,7 +139,8 @@ def _run_both(make_ctx, sql: str, n_rows: int, iters: int = 5):
 
 
 def bench_q6_parquet() -> None:
-    """Config #1: q6 SF1 from Parquet — exercises the scan bridge."""
+    """Config #1: q6 SF1 from Parquet — exercises the scan bridge.
+    BENCH_Q6_SF shrinks the scale for CI smoke runs."""
     import tempfile
 
     import pyarrow.parquet as pq
@@ -148,7 +149,8 @@ def bench_q6_parquet() -> None:
     from benchmarks.tpch.datagen import gen_lineitem
     from benchmarks.tpch.queries import QUERIES
 
-    li = gen_lineitem(1.0)
+    sf = float(os.environ.get("BENCH_Q6_SF", "1"))
+    li = gen_lineitem(sf)
     n = li.num_rows
     tmp = tempfile.mkdtemp(prefix="bench_q6_")
     path = os.path.join(tmp, "lineitem.parquet")
@@ -174,7 +176,7 @@ def bench_q6_parquet() -> None:
     cpu_s, tpu_s, m, ok = _run_both(make_ctx, QUERIES[6], n)
     _emit(
         {
-            "metric": "tpch_q6_sf1_parquet_tpu_rows_per_sec",
+            "metric": "tpch_q6_sf%g_parquet_tpu_rows_per_sec" % sf,
             "value": round(n / tpu_s),
             "unit": "rows/s",
             "vs_baseline": round(cpu_s / tpu_s, 3),
@@ -488,9 +490,12 @@ def bench_h2o() -> None:
     n = int(float(os.environ.get("BENCH_H2O_N", "1e8")))
     k = int(os.environ.get("BENCH_H2O_K", "100"))
     iters = int(os.environ.get("BENCH_H2O_ITERS", "2"))
+    # A/B hygiene: BENCH_HIGHCARD_MODE only affects the tpu leg, so a
+    # mode sweep can skip re-running the identical CPU-engine oracle
+    skip_cpu = bool(os.environ.get("BENCH_H2O_SKIP_CPU"))
     per_engine = {}
     questions = {}
-    for tpu in (False, True):
+    for tpu in ((True,) if skip_cpu else (False, True)):
         buf = io.StringIO()
         summary = run_groupby(
             n=n, k=k, partitions=2, tpu=tpu, iters=iters, out=buf
@@ -503,16 +508,21 @@ def bench_h2o() -> None:
                 questions.setdefault(qid, {})[
                     "tpu" if tpu else "cpu"
                 ] = rec["time_sec"]
-    total_cpu = per_engine[False]["total_sec"]
+    total_cpu = per_engine[False]["total_sec"] if not skip_cpu else None
     total_tpu = per_engine[True]["total_sec"]
     _emit(
         {
             "metric": "h2o_groupby_G1_%.0e_total_sec_tpu" % n,
             "value": total_tpu,
             "unit": "s",
-            "vs_baseline": round(total_cpu / total_tpu, 3),
+            "vs_baseline": (
+                round(total_cpu / total_tpu, 3) if total_cpu else None
+            ),
             "rows": n,
             "k": k,
+            # the record must say WHICH route produced it: the A/B legs
+            # would otherwise be indistinguishable in the artifact
+            "highcard_mode": os.environ.get("BENCH_HIGHCARD_MODE", "auto"),
             "cpu_total_sec": total_cpu,
             "per_question_sec": questions,
         }

@@ -278,9 +278,11 @@ def test_mesh_gang_with_sort_algorithm():
     _assert_tables_close(got.sort_by(key), want.sort_by(key), rel=1e-6)
 
 
-def test_mesh_gang_highcard_device_mode():
-    """highcard_mode=device must keep a groups~rows aggregate on the gang
-    (no mesh_fallback) with the sort strategy, matching the CPU oracle."""
+def test_mesh_gang_highcard_gid_mode():
+    """highcard_mode=gid pins a groups~rows aggregate on the gang's
+    GID-TABLE path (no mesh_fallback, no keyed route) with the sort
+    strategy, matching the CPU oracle — the capacity ceiling is raised
+    to fit every group."""
     import numpy as np
 
     from arrow_ballista_tpu.ops import kernels as K
@@ -306,7 +308,7 @@ def test_mesh_gang_highcard_device_mode():
         ctx = SessionContext(
             _cfg(
                 **{
-                    "ballista.tpu.highcard_mode": "device",
+                    "ballista.tpu.highcard_mode": "gid",
                     "ballista.tpu.max_capacity": str(1 << 19),
                 }
             )
@@ -318,6 +320,7 @@ def test_mesh_gang_highcard_device_mode():
         assert gangs
         m = gangs[0].metrics.to_dict()
         assert "mesh_fallback" not in m, m
+        assert "mesh_keyed" not in m, m  # gid path, not the keyed gang
     finally:
         K.set_agg_algorithm(None)
 
@@ -325,11 +328,12 @@ def test_mesh_gang_highcard_device_mode():
 
 
 def test_mesh_gang_highcard_keyed_across_shards(monkeypatch):
-    """Default (auto) routing: a groups~rows gang runs the KEYED
-    reduction per shard — every device concurrently — with a
-    [distinct]-sized host merge (mesh_keyed metric), matching the CPU
-    oracle.  Groups straddle shard boundaries, so the merge must
-    combine cross-shard states by key."""
+    """Keyed gang routing (highcard_mode=device — 'auto' resolves to
+    the C++ hash handoff on the CPU platform these tests run on): a
+    groups~rows gang runs the KEYED reduction per shard — every device
+    concurrently — with a [distinct]-sized host merge (mesh_keyed
+    metric), matching the CPU oracle.  Groups straddle shard
+    boundaries, so the merge must combine cross-shard states by key."""
     import numpy as np
 
     from arrow_ballista_tpu.ops import stage_compiler as SC
@@ -360,7 +364,10 @@ def test_mesh_gang_highcard_keyed_across_shards(monkeypatch):
     off.register_arrow_table("t", tbl, partitions=4)
     want = off.sql(sql).collect().sort_by([("g", "ascending")])
 
-    ctx = SessionContext(_cfg(**{"ballista.tpu.max_capacity": str(1 << 19)}))
+    ctx = SessionContext(_cfg(**{
+        "ballista.tpu.max_capacity": str(1 << 19),
+        "ballista.tpu.highcard_mode": "device",
+    }))
     ctx.register_arrow_table("t", tbl, partitions=4)
     plan = ctx.sql(sql).physical_plan()
     got = ctx.execute(plan)
@@ -370,4 +377,43 @@ def test_mesh_gang_highcard_keyed_across_shards(monkeypatch):
     assert m.get("mesh_keyed", 0) >= 1, m
     assert "mesh_fallback" not in m, m
     assert m.get("mesh_devices") == 8, m
+    _assert_tables_close(got.sort_by([("g", "ascending")]), want, rel=1e-6)
+
+
+def test_mesh_gang_highcard_auto_cpu_sequential_fallback(monkeypatch):
+    """Platform default on the CPU backend: 'auto' routes a groups~rows
+    gang to the sequential fallback (each partition on the C++ hash
+    aggregate — the measured winner off-accelerator), NOT the keyed
+    gang, and results still match the oracle."""
+    import numpy as np
+
+    from arrow_ballista_tpu.ops import stage_compiler as SC
+
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 1024)
+    rng = np.random.default_rng(37)
+    n = 1 << 15
+    g = np.arange(n) % (n // 8)
+    tbl = pa.table(
+        {
+            "g": pa.array(g.astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    sql = "select g, sum(v) as s, count(*) as c from t group by g"
+
+    off = SessionContext(
+        _cfg(**{"ballista.mesh.enable": "false", "ballista.tpu.enable": "false"})
+    )
+    off.register_arrow_table("t", tbl, partitions=4)
+    want = off.sql(sql).collect().sort_by([("g", "ascending")])
+
+    ctx = SessionContext(_cfg())  # highcard_mode defaults to auto
+    ctx.register_arrow_table("t", tbl, partitions=4)
+    plan = ctx.sql(sql).physical_plan()
+    got = ctx.execute(plan)
+    gangs = _find(plan, MeshGangExec)
+    assert gangs
+    m = gangs[0].metrics.to_dict()
+    assert m.get("mesh_fallback", 0) >= 1, m
+    assert "mesh_keyed" not in m, m
     _assert_tables_close(got.sort_by([("g", "ascending")]), want, rel=1e-6)

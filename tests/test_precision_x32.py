@@ -22,15 +22,13 @@ def _x32_mode():
     K.set_precision(None)
 
 
-def _ctx(tpu: bool) -> SessionContext:
-    return SessionContext(
-        BallistaConfig(
-            {
-                "ballista.tpu.enable": "true" if tpu else "false",
-                "ballista.tpu.min_rows": "0",
-            }
-        )
-    )
+def _ctx(tpu: bool, **extra) -> SessionContext:
+    settings = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.tpu.min_rows": "0",
+    }
+    settings.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(settings))
 
 
 def _register_tpch(ctx, sf=0.01):
@@ -294,7 +292,9 @@ def test_x32_minmax_f64_bit_exact_keyed():
     old = SC._HIGHCARD_MIN_GROUPS
     SC._HIGHCARD_MIN_GROUPS = 16
     try:
-        dev = _ctx(True)
+        # pin the keyed route: platform-aware 'auto' resolves to the
+        # C++ hash handoff on the CPU platform this test runs on
+        dev = _ctx(True, **{"ballista.tpu.highcard_mode": "device"})
         dev.register_table("t", MemoryTable.from_table(t, 1))
         plan = dev.sql(sql).physical_plan()
         got = dev.execute(plan)

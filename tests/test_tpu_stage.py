@@ -290,6 +290,10 @@ def test_max_capacity_falls_back_to_cpu():
         **{
             "ballista.tpu.segment_capacity": 64,
             "ballista.tpu.max_capacity": 1024,
+            # pin the device route: platform-aware 'auto' would hand
+            # this groups~rows stage to the C++ hash aggregate on the
+            # CPU platform before the capacity ceiling is ever hit
+            "ballista.tpu.highcard_mode": "device",
         },
     )
     ctx.register_arrow_table("t", tbl, partitions=1)
@@ -499,6 +503,7 @@ def test_capacity_fallback_closes_prefetcher():
             "ballista.tpu.max_capacity": "256",  # forces _CapacityExceeded
             "ballista.batch.size": "512",
             "ballista.tpu.readahead": "2",
+            "ballista.tpu.highcard_mode": "device",  # see above
         },
     )
     ctx.register_arrow_table("t", tbl, partitions=1)
