@@ -303,12 +303,16 @@ def EtcdBackend(endpoints: str, namespace: str = "ballista"):
     """
     from .kvstore import RemoteBackend
 
-    # comma lists accepted for etcd-flag compatibility; the store is a
-    # single service, so extra endpoints are failover spares (unused yet)
-    first = endpoints.split(",")[0].strip()
-    host, _, port = first.partition(":")
+    # comma lists are live failover spares: the client rotates to the
+    # next endpoint on UNAVAILABLE (a backup kvstore refuses to serve
+    # until it promotes, so rotation settles on the current primary)
+    from .kvstore import parse_endpoint
+
+    eps = [e.strip() for e in endpoints.split(",") if e.strip()]
+    host, port = parse_endpoint(eps[0] if eps else "")
     return RemoteBackend(
-        host or "127.0.0.1", int(port or 50060), namespace=namespace
+        host, port, namespace=namespace,
+        endpoints=eps if len(eps) > 1 else None,
     )
 
 

@@ -557,3 +557,217 @@ def test_extended_store_outage_converges(tmp_path):
             pass
         back.close()
         b_extra.close()
+
+
+def test_replica_refuses_service_and_replicates(tmp_path):
+    """A backup store (replica_of) refuses every RPC with UNAVAILABLE
+    while its primary lives, and asynchronously mirrors the primary's
+    state (full sync + watch follow)."""
+    import grpc
+
+    primary = KvStoreHandle(
+        SqliteBackend(str(tmp_path / "p.db")), "127.0.0.1", 0
+    ).start()
+    backup_backend = SqliteBackend(str(tmp_path / "b.db"))
+    backup = KvStoreHandle(
+        backup_backend, "127.0.0.1", 0,
+        replica_of=("127.0.0.1", primary.port), promote_after_s=1.0,
+    ).start()
+    try:
+        assert backup.replicator.synced.wait(10.0)
+        b = _remote(primary)
+        b.put(Keyspace.Sessions, "r1", b"v1")
+        b.put_txn([(Keyspace.Slots, "e1", b"4"), (Keyspace.Slots, "e2", b"2")])
+        b.delete(Keyspace.Slots, "e2")
+        # replication is async: poll the backup's LOCAL backend
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (
+                backup_backend.get(Keyspace.Sessions, "r1") == b"v1"
+                and backup_backend.get(Keyspace.Slots, "e1") == b"4"
+                and backup_backend.get(Keyspace.Slots, "e2") is None
+            ):
+                break
+            time.sleep(0.1)
+        assert backup_backend.get(Keyspace.Sessions, "r1") == b"v1"
+        assert backup_backend.get(Keyspace.Slots, "e1") == b"4"
+        assert backup_backend.get(Keyspace.Slots, "e2") is None
+
+        # direct client of the REPLICA endpoint: refused
+        direct = RemoteBackend("127.0.0.1", backup.port)
+        with pytest.raises(grpc.RpcError) as ei:
+            direct.get(Keyspace.Sessions, "r1")
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        direct.close()
+        b.close()
+    finally:
+        backup.stop()
+        primary.stop()
+
+
+def test_replicated_store_failover_completes_job(tmp_path):
+    """The full raft-replication slot, end to end: scheduler runs
+    against [primary, backup] endpoints; the primary dies mid-job; the
+    backup self-promotes; the client rotates on UNAVAILABLE; a stale
+    pre-failover fence is rejected (empty lease table = conservative);
+    and the job completes against the promoted store."""
+    from arrow_ballista_tpu.scheduler.kvstore import LeaseFenced
+
+    primary = KvStoreHandle(
+        SqliteBackend(str(tmp_path / "p.db")), "127.0.0.1", 0
+    ).start()
+    backup = KvStoreHandle(
+        SqliteBackend(str(tmp_path / "b.db")), "127.0.0.1", 0,
+        replica_of=("127.0.0.1", primary.port), promote_after_s=1.0,
+    ).start()
+    from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
+
+    eps = [f"127.0.0.1:{primary.port}", f"127.0.0.1:{backup.port}"]
+    back = RemoteBackend("127.0.0.1", primary.port, endpoints=eps)
+    sched = SchedulerServer(
+        "sched-REP",
+        back,
+        TaskSchedulingPolicy.PULL_STAGED,
+        launcher=NoopLauncher(),
+        work_dir="/tmp/abt-ha-test",
+        reaper_interval_s=3600.0,
+    )
+    sched.init()
+    l_stale = back.lock(Keyspace.Slots, "rep-cs", ttl_s=30.0)
+    try:
+        assert backup.replicator.synced.wait(10.0)
+        sched.state.executor_manager.register_executor(EXEC)
+        ctx = sched.state.session_manager.create_session(
+            {"ballista.shuffle.partitions": "2", "ballista.tpu.enable": "false"}
+        )
+        ctx.register_arrow_table(
+            "t",
+            pa.table({"g": pa.array(["a", "b", "a"]), "v": pa.array([1.0, 2.0, 3.0])}),
+            partitions=2,
+        )
+        plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
+        sched.submit_job("rep-job", ctx.session_id, plan)
+        assert sched.drain(5.0)
+        ran, _ = _run_one_task(sched)
+        assert ran == 1
+        assert l_stale.acquire(timeout=2.0)  # lease on the PRIMARY
+
+        # give replication a beat to mirror the committed stage state,
+        # then kill the primary
+        time.sleep(1.0)
+        primary.stop()
+
+        # backup promotes within ~promote_after_s + poll; afterwards the
+        # rotating client reaches it transparently
+        deadline = time.time() + 20
+        while backup.service.role != "primary" and time.time() < deadline:
+            time.sleep(0.2)
+        assert backup.service.role == "primary"
+
+        # the pre-failover lease did not replicate: its fenced write is
+        # rejected by the promoted store
+        with pytest.raises(LeaseFenced):
+            back.put_txn(
+                [(Keyspace.Slots, "stale-rep", b"x")], fence=l_stale
+            )
+        assert back.get(Keyspace.Slots, "stale-rep") is None
+
+        done = False
+        for _ in range(40):
+            try:
+                ran, pending = _run_one_task(sched)
+            except Exception:
+                time.sleep(0.3)  # rotation/connection settling
+                continue
+            if ran == 0 and pending == 0:
+                done = True
+                break
+        assert done
+        status = sched.state.task_manager.get_job_status("rep-job")
+        assert status["state"] == "completed", status
+    finally:
+        try:
+            sched.stop()
+        except Exception:
+            pass
+        back.close()
+        backup.stop()
+        try:
+            primary.stop()
+        except Exception:
+            pass
+
+
+def test_unsynced_replica_refuses_promotion(tmp_path):
+    """A backup that never completed a sync (primary down at boot) must
+    NOT promote — serving an empty store as the new truth is worse than
+    unavailability."""
+    # point at a port nothing listens on
+    backup = KvStoreHandle(
+        SqliteBackend(str(tmp_path / "b.db")), "127.0.0.1", 0,
+        replica_of=("127.0.0.1", 1), promote_after_s=0.3,
+    ).start()
+    try:
+        time.sleep(1.5)  # several promote windows elapse
+        assert backup.service.role == "replica"
+    finally:
+        backup.stop()
+
+
+def test_restarted_old_primary_demotes_to_promoted_backup(tmp_path):
+    """Split-brain closure: after the backup promotes, a supervisor-
+    restarted old primary (started with peer=backup) probes the peer,
+    sees it serving, and comes up as the peer's REPLICA — one primary
+    at a time, and the demoted store resyncs the promoted one's state."""
+    pdb = str(tmp_path / "p.db")
+    primary = KvStoreHandle(SqliteBackend(pdb), "127.0.0.1", 0).start()
+    p_port = primary.port
+    backup = KvStoreHandle(
+        SqliteBackend(str(tmp_path / "b.db")), "127.0.0.1", 0,
+        replica_of=("127.0.0.1", p_port), promote_after_s=0.5,
+    ).start()
+    try:
+        assert backup.replicator.synced.wait(10.0)
+        b = _remote(primary)
+        b.put(Keyspace.Sessions, "before", b"1")
+        time.sleep(0.8)  # let it replicate
+        primary.stop()
+        deadline = time.time() + 15
+        while backup.service.role != "primary" and time.time() < deadline:
+            time.sleep(0.2)
+        assert backup.service.role == "primary"
+        b.close()
+
+        # a write lands on the promoted backup only
+        b2 = RemoteBackend("127.0.0.1", backup.port)
+        b2.put(Keyspace.Sessions, "after", b"2")
+
+        # supervisor restarts the old primary on its old port, peer set
+        old_backend = SqliteBackend(pdb)
+        restarted = None
+        deadline = time.time() + 10
+        while restarted is None and time.time() < deadline:
+            try:
+                restarted = KvStoreHandle(
+                    old_backend, "127.0.0.1", p_port,
+                    peer=("127.0.0.1", backup.port),
+                ).start()
+            except Exception:
+                time.sleep(0.2)
+        assert restarted is not None
+        assert restarted.service.role == "replica"
+        # and it resyncs the promoted store's newer state
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if old_backend.get(Keyspace.Sessions, "after") == b"2":
+                break
+            time.sleep(0.1)
+        assert old_backend.get(Keyspace.Sessions, "after") == b"2"
+        b2.close()
+        restarted.stop()
+    finally:
+        backup.stop()
+        try:
+            primary.stop()
+        except Exception:
+            pass
