@@ -106,12 +106,20 @@ def split_u64_i32(u: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return hi, lo
 
 
-def order_decode_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
-    """Inverse of ``to_u64_order`` + ``split_u64_i32`` for f64 values."""
-    u = (
+def join_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Inverse of ``split_u64_i32``: biased (hi, lo) i32 pair → u64
+    whose unsigned order equals the pair's lexicographic signed order.
+    MUST stay in uint64 — packing in int64 wraps negative for every
+    biased hi >= 2^31 (all non-negative values), inverting the order."""
+    return (
         ((hi.astype(np.int64) + (1 << 31)).astype(np.uint64) << np.uint64(32))
         | (lo.astype(np.int64) + (1 << 31)).astype(np.uint64)
     )
+
+
+def order_decode_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Inverse of ``to_u64_order`` + ``split_u64_i32`` for f64 values."""
+    u = join_u64(hi, lo)
     neg = (u >> np.uint64(63)) == 0  # sign bit was flipped on encode
     mask = np.where(
         neg, np.uint64(0xFFFFFFFFFFFFFFFF), np.uint64(1) << np.uint64(63)

@@ -1869,14 +1869,12 @@ def merge_keyed_host(
         return np.maximum.reduceat(a, starts)
 
     def _lex_reduceat(hi, lo, how):
-        # lexicographic (hi, lo) i32 extremum via one biased u64 key;
-        # packing in i64 would wrap negative whenever biased hi >= 2^31
-        # (every non-negative f64 extremum), inverting the order
-        v = (
-            ((hi.astype(np.int64) + (1 << 31)).astype(np.uint64) << np.uint64(32))
-            | (lo.astype(np.int64) + (1 << 31)).astype(np.uint64)
-        )
-        m = _reduceat(v, how)
+        # lexicographic (hi, lo) i32 extremum via ONE biased u64 key —
+        # bridge.join_u64 owns the bias/pack convention (and its
+        # docstring owns the i64-wrap warning)
+        from .bridge import join_u64
+
+        m = _reduceat(join_u64(hi, lo), how)
         return (
             (m >> np.uint64(32)).astype(np.int64) - (1 << 31),
             (m & np.uint64(0xFFFFFFFF)).astype(np.int64) - (1 << 31),

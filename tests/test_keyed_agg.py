@@ -460,3 +460,52 @@ def test_auto_mode_routes_to_hash_aggregate_on_cpu_platform():
     assert m.get("keyed_path", 0) == 0, m
     assert m.get("highcard_fallback", 0) >= 1, m
     _assert_close(want, got)
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_keyed_hbm_budget_with_device_join(mode):
+    """Budget chunking composes with the fused device join: each
+    buffered block ran filter+join+scan-prep on device; the chunk
+    states merge by key across blocks, matching the CPU oracle."""
+    rng = np.random.default_rng(41)
+    m_dim = 500
+    n = 24_000
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(1, m_dim + 1).astype(np.int64)),
+            "dv": pa.array(rng.uniform(0.5, 1.5, m_dim)),
+        }
+    )
+    fact_tbl = pa.table(
+        {
+            "fk": pa.array(
+                rng.integers(1, int(m_dim * 1.2), n).astype(np.int64)
+            ),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    fact_batches = fact_tbl.to_batches(max_chunksize=3000)
+    sql = (
+        "select fk, sum(v * dv) as s, min(v) as mn, count(*) as c "
+        "from dim, fact where dk = fk group by fk"
+    )
+
+    K.set_precision(None)
+    cpu = _ctx(False)
+    cpu.register_table("dim", MemoryTable.from_table(dim, 1))
+    cpu.register_table("fact", MemoryTable([fact_batches], fact_tbl.schema))
+    want = cpu.sql(sql).collect().sort_by([("fk", "ascending")])
+
+    K.set_precision(mode)
+    dev = _ctx(True)
+    dev.register_table("dim", MemoryTable.from_table(dim, 1))
+    dev.register_table("fact", MemoryTable([fact_batches], fact_tbl.schema))
+    plan = dev.sql(sql).physical_plan()
+    _set_keyed_budget(plan, 128 * 1024)
+    got = dev.execute(plan).sort_by([("fk", "ascending")])
+    m = _metrics(plan)
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("keyed_chunks", 0) >= 2, m
+    assert m.get("join_fallback", 0) == 0, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    _assert_close(want, got)
