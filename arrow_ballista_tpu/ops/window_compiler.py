@@ -48,6 +48,30 @@ def _is_string_like(t: pa.DataType) -> bool:
     )
 
 
+def _orderable_type(t: pa.DataType) -> bool:
+    """Types the device window can ORDER BY (order-encodable)."""
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_date(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_timestamp(t)
+        or pa.types.is_decimal(t)
+        or _is_string_like(t)
+    )
+
+
+def _arg_type_ok(t: pa.DataType) -> bool:
+    """Types a window function argument can ship to the device."""
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_date(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_decimal(t)
+    )
+
+
 # ------------------------------------------------------- key encoding
 from .bridge import split_u64_i32, to_u64_order  # noqa: E402
 
@@ -105,15 +129,7 @@ def _order_keys(arr: pa.Array, asc: bool, nulls_first: Optional[bool],
     if nulls_first is None:
         nulls_first = not asc  # SQL default: NULLS LAST for ASC
     t = arr.type
-    if not (
-        pa.types.is_integer(t)
-        or pa.types.is_floating(t)
-        or pa.types.is_date(t)
-        or pa.types.is_boolean(t)
-        or pa.types.is_timestamp(t)
-        or pa.types.is_decimal(t)
-        or _is_string_like(t)
-    ):
+    if not _orderable_type(t):
         raise K.NotLowerable(f"window ORDER BY type {t}")
     if pa.types.is_decimal(t):
         import pyarrow.compute as pc
@@ -159,25 +175,11 @@ class TpuWindowExec(ExecutionPlan):
             self._check_spec(spec)
             for e, _a, _nf in spec.order_by:
                 t = K._infer_pa_type(e, schema)
-                if not (
-                    pa.types.is_integer(t)
-                    or pa.types.is_floating(t)
-                    or pa.types.is_date(t)
-                    or pa.types.is_boolean(t)
-                    or pa.types.is_timestamp(t)
-                    or pa.types.is_decimal(t)
-                    or _is_string_like(t)
-                ):
+                if not _orderable_type(t):
                     raise K.NotLowerable(f"window ORDER BY type {t}")
             if spec.arg is not None:
                 t = K._infer_pa_type(spec.arg, schema)
-                if not (
-                    pa.types.is_integer(t)
-                    or pa.types.is_floating(t)
-                    or pa.types.is_date(t)
-                    or pa.types.is_boolean(t)
-                    or pa.types.is_decimal(t)
-                ):
+                if not _arg_type_ok(t):
                     raise K.NotLowerable(f"window argument type {t}")
             sig = (
                 tuple(str(p) for p in spec.partition_by),
@@ -339,13 +341,7 @@ class TpuWindowExec(ExecutionPlan):
         def checked_arr():
             arr = eval_col(spec.arg)
             t = arr.type
-            if not (
-                pa.types.is_integer(t)
-                or pa.types.is_floating(t)
-                or pa.types.is_date(t)
-                or pa.types.is_boolean(t)
-                or pa.types.is_decimal(t)
-            ):
+            if not _arg_type_ok(t):
                 raise K.NotLowerable(f"window argument type {t}")
             if pa.types.is_decimal(t) or pa.types.is_boolean(t):
                 import pyarrow.compute as pc
