@@ -1,0 +1,162 @@
+"""Randomized device-vs-oracle property sweep.
+
+A handful of seeded random schemas/queries per family (aggregate, keyed
+aggregate, window) executed on BOTH engines and compared — the shapes
+are randomized where the targeted tests are hand-picked, so structural
+assumptions (null placement, tie structure, dtype mixes, partition
+counts) get shaken out.  Seeds are fixed: failures reproduce.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops import kernels as K
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    K.set_precision(None)
+
+
+def _table(rng, n):
+    cols = {
+        "k": pa.array(rng.integers(0, rng.integers(2, 60), n).astype(np.int64)),
+        "s": pa.array(
+            np.char.add("g", rng.integers(0, 9, n).astype("U1")).tolist()
+        ),
+    }
+    fmask = rng.uniform(size=n) < rng.uniform(0, 0.2)
+    # positive float values: x32 ships f32 INPUTS, so cancelling sums
+    # (values spanning zero summing to ~0) amplify the per-element
+    # quantization past any fixed relative bar — the same convention the
+    # targeted fixtures use; sign-spanning extrema are covered by the
+    # dedicated bit-exact min/max tests
+    cols["f"] = pa.array(rng.uniform(0, 1e3, n), pa.float64(), mask=fmask)
+    imask = rng.uniform(size=n) < rng.uniform(0, 0.2)
+    cols["i"] = pa.array(
+        rng.integers(-10_000, 10_000, n).astype(np.int64), pa.int64(),
+        mask=imask,
+    )
+    return pa.table(cols)
+
+
+def _run(sql, t, tpu, mode, partitions, extra=None):
+    K.set_precision(None)
+    if tpu:
+        K.set_precision(mode)
+    settings = {
+        "ballista.tpu.enable": str(tpu).lower(),
+        "ballista.tpu.min_rows": "0",
+    }
+    settings.update(extra or {})
+    ctx = SessionContext(BallistaConfig(settings))
+    ctx.register_table("t", MemoryTable.from_table(t, partitions))
+    return ctx.sql(sql).collect()
+
+
+def _compare(want, got, rel=1e-6):
+    assert want.num_rows == got.num_rows, (want.num_rows, got.num_rows)
+    keys = [
+        (c, "ascending")
+        for c in want.column_names
+        if not pa.types.is_floating(want.schema.field(c).type)
+    ]
+    want, got = want.sort_by(keys), got.sort_by(keys)
+    for name in want.column_names:
+        for x, y in zip(
+            want.column(name).to_pylist(), got.column(name).to_pylist()
+        ):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=rel, abs=1e-9), name
+            else:
+                assert x == y, (name, x, y)
+
+
+_AGGS = [
+    "sum(f)", "avg(f)", "min(f)", "max(f)", "count(f)", "count(*)",
+    "sum(i)", "min(i)", "max(i)", "avg(i)", "count(distinct i)",
+    "median(f)", "stddev(f)", "variance(f)",
+]
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_random_aggregates(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2_000, 12_000))
+    t = _table(rng, n)
+    picks = rng.choice(len(_AGGS), size=4, replace=False)
+    sel = ", ".join(f"{_AGGS[p]} as a{j}" for j, p in enumerate(picks))
+    keys = ["k", "k, s"][int(rng.integers(0, 2))]
+    sql = f"select {keys}, {sel} from t group by {keys}"
+    parts = int(rng.integers(1, 4))
+    mode = ["x32", "x64"][int(rng.integers(0, 2))]
+    want = _run(sql, t, False, None, parts)
+    got = _run(sql, t, True, mode, parts)
+    _compare(want, got, rel=3e-6 if mode == "x32" else 1e-9)
+
+
+@pytest.mark.parametrize("seed", [404, 505, 606])
+def test_random_keyed_aggregates(seed):
+    """High-cardinality shapes forced onto the keyed route."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(4_000, 10_000))
+    t = _table(rng, n).set_column(
+        0, "k",
+        pa.array(rng.integers(0, n // 3, n).astype(np.int64)),
+    )
+    picks = rng.choice(10, size=3, replace=False)  # plain agg family
+    sel = ", ".join(f"{_AGGS[p]} as a{j}" for j, p in enumerate(picks))
+    sql = f"select k, {sel} from t group by k"
+    parts = int(rng.integers(1, 4))
+    mode = ["x32", "x64"][int(rng.integers(0, 2))]
+    want = _run(sql, t, False, None, parts)
+    import arrow_ballista_tpu.ops.stage_compiler as SC
+
+    old = SC._HIGHCARD_MIN_GROUPS
+    SC._HIGHCARD_MIN_GROUPS = 16
+    try:
+        got = _run(
+            sql, t, True, mode, parts,
+            extra={"ballista.tpu.highcard_mode": "device"},
+        )
+    finally:
+        SC._HIGHCARD_MIN_GROUPS = old
+    _compare(want, got, rel=3e-6 if mode == "x32" else 1e-9)
+
+
+_WINDOWS = [
+    "row_number() over (partition by {p} order by {o}, i)",
+    "rank() over (partition by {p} order by {o})",
+    "dense_rank() over (partition by {p} order by {o})",
+    "sum(f) over (partition by {p} order by {o})",
+    "count(*) over (partition by {p} order by {o})",
+    "min(i) over (partition by {p} order by {o})",
+    "lag(f, 2) over (partition by {p} order by {o}, i)",
+    "sum(i) over (partition by {p} order by {o}, i "
+    "rows between 2 preceding and 1 following)",
+    "max(f) over (partition by {p} order by {o}, i "
+    "rows between 3 preceding and current row)",
+]
+
+
+@pytest.mark.parametrize("seed", [707, 808])
+def test_random_windows(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2_000, 8_000))
+    t = _table(rng, n)
+    p = ["k", "s"][int(rng.integers(0, 2))]
+    o = ["i", "f", "s"][int(rng.integers(0, 3))]
+    picks = rng.choice(len(_WINDOWS), size=3, replace=False)
+    sel = ", ".join(
+        _WINDOWS[w].format(p=p, o=o) + f" as w{j}"
+        for j, w in enumerate(picks)
+    )
+    sql = f"select k, s, i, f, {sel} from t"
+    mode = ["x32", "x64"][int(rng.integers(0, 2))]
+    want = _run(sql, t, False, None, 2)
+    got = _run(sql, t, True, mode, 2)
+    _compare(want, got, rel=3e-6 if mode == "x32" else 1e-9)
