@@ -160,3 +160,60 @@ def test_random_windows(seed):
     want = _run(sql, t, False, None, 2)
     got = _run(sql, t, True, mode, 2)
     _compare(want, got, rel=3e-6 if mode == "x32" else 1e-9)
+
+
+@pytest.mark.parametrize("seed", [909, 1010])
+def test_random_join_aggregates(seed):
+    """PK-FK join folded into the device stage, randomized dim size /
+    selectivity / aggregate mix."""
+    rng = np.random.default_rng(seed)
+    m_dim = int(rng.integers(50, 800))
+    n = int(rng.integers(3_000, 9_000))
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(1, m_dim + 1).astype(np.int64)),
+            "dv": pa.array(rng.uniform(0.5, 1.5, m_dim)),
+            "dtag": pa.array(rng.integers(0, 4, m_dim).astype(np.int64)),
+        }
+    )
+    fact = pa.table(
+        {
+            "fk": pa.array(
+                rng.integers(
+                    1, int(m_dim * rng.uniform(1.0, 1.5)), n
+                ).astype(np.int64)
+            ),
+            "g": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    tag = int(rng.integers(1, 4))
+    sel = rng.choice(
+        ["sum(v * dv)", "sum(v)", "min(v)", "max(dv)", "avg(v)"],
+        size=2, replace=False,
+    )
+    sql = (
+        f"select g, {sel[0]} as a0, {sel[1]} as a1, count(*) as c "
+        f"from dim, fact where dk = fk and dtag < {tag} group by g"
+    )
+    parts = int(rng.integers(1, 3))
+    mode = ["x32", "x64"][int(rng.integers(0, 2))]
+
+    def run(tpu):
+        K.set_precision(None)
+        if tpu:
+            K.set_precision(mode)
+        ctx = SessionContext(
+            BallistaConfig(
+                {
+                    "ballista.tpu.enable": str(tpu).lower(),
+                    "ballista.tpu.min_rows": "0",
+                }
+            )
+        )
+        ctx.register_table("dim", MemoryTable.from_table(dim, 1))
+        ctx.register_table("fact", MemoryTable.from_table(fact, parts))
+        return ctx.sql(sql).collect()
+
+    want, got = run(False), run(True)
+    _compare(want, got, rel=3e-6 if mode == "x32" else 1e-9)
