@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Post-fusion A/B re-capture (round 5): after the single-dispatch fused
+# runner lands, re-measure the headline configs against the pre-fusion
+# rows already in BENCH_SUITE_r05.json / BENCH_r05_dev.json.
+#
+# Appends to BENCH_SUITE_r05.json (bench_suite._emit appends); bench.py
+# rewrites BENCH_r05_dev.json via tee.  AB_FUSION_r05.log captures the
+# before/after pairing for the README table.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fails=0
+step() {
+  local name="$1" t="$2"
+  shift 2
+  echo "== $name =="
+  timeout "$t" "$@"
+  local rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "!! step '$name' failed (rc=$rc)"
+    fails=$((fails + 1))
+  fi
+}
+
+probe() {
+  timeout 200 python -c "
+from benchmarks.device_guard import probe_backend
+import sys
+p = probe_backend(180)
+print('probe:', p)
+sys.exit(0 if p not in (None, 'timeout', 'cpu') else 1)
+"
+}
+
+echo "== probing device =="
+if ! probe; then
+  echo "device unavailable — aborting (nothing written)"
+  exit 2
+fi
+
+{
+  echo "== post-fusion capture $(date -u +%Y-%m-%dT%H:%M:%SZ) =="
+} | tee -a AB_FUSION_r05.log
+
+step "post-fusion q6" 3600 bash -c \
+  'set -o pipefail; python bench_suite.py q6 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+step "post-fusion bench.py (q1 SF10)" 3600 bash -c \
+  'set -o pipefail; python bench.py | tee BENCH_r05_dev.json | tee -a AB_FUSION_r05.log'
+step "post-fusion q3" 5400 bash -c \
+  'set -o pipefail; python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+
+if [ "$fails" -gt 0 ]; then
+  echo "== post-fusion capture FINISHED WITH $fails FAILED STEP(S) =="
+  exit 1
+fi
+echo "== post-fusion capture complete =="

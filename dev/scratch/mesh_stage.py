@@ -1,0 +1,650 @@
+"""Mesh gang stages: whole-stage SPMD execution over the device mesh.
+
+This is the engine integration of :mod:`.mesh` (VERDICT.md round-1 item 3):
+the reference routes EVERY cross-stage exchange through the disk+Flight
+shuffle (``shuffle_writer.rs:142-292`` → ``flight_service.rs:80-118``); on
+a TPU host, partitions of a mesh-resident stage are SHARDS, and the
+partial-aggregate exchange collapses into ``psum``/``pmin``/``pmax`` over
+ICI inside one jit-compiled ``shard_map`` program.
+
+Mechanically: the distributed planner wraps an eligible stage subtree
+(filter→project→partial-aggregate, the same shapes ``maybe_accelerate``
+fuses) in a :class:`MeshGangExec` whose output partitioning is 1 — so the
+scheduler naturally creates ONE task for the stage, and the executor that
+receives it runs every input partition as a shard of a single mesh
+program.  Nothing else in the graph/task machinery changes: recovery,
+retries and stats see an ordinary one-task stage.  The reduced
+[capacity]-sized states are the only thing that leaves the device.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..exec.operators import ExecutionPlan, Partitioning, TaskContext
+
+# jitted shard_map step per (kernel signature, n_devices): reused across
+# plan instances exactly like stage_compiler._KERNEL_CACHE
+_MESH_STEP_CACHE: dict = {}
+
+
+class _MeshKeyedRoute(Exception):
+    """Control flow: the gang's first batch showed groups ~ rows — run
+    the KEYED reduction per shard (every device concurrently) and merge
+    the [distinct]-sized results on host, instead of abandoning the
+    mesh for the sequential fallback."""
+
+    def __init__(self, n_dev: int):
+        super().__init__("mesh keyed high-cardinality")
+        self.n_dev = n_dev
+
+
+def gang_eligible(plan: ExecutionPlan) -> bool:
+    """Structural check (no kernel build, no device touch — safe on the
+    scheduler): does this stage subtree fuse into a partial-aggregate
+    kernel whose states reduce with mesh collectives?"""
+    from ..exec.aggregates import PARTIAL, HashAggregateExec
+    from ..ops.stage_compiler import _flatten
+
+    if not isinstance(plan, HashAggregateExec) or plan.mode != PARTIAL:
+        return False
+    if any(
+        a.func == "count_distinct" or a.func.startswith("udaf:")
+        for a in plan.aggs
+    ):
+        return False
+    fused = _flatten(plan)
+    # device-join stages run sequentially for now: the gang path would
+    # need the build side replicated across shards
+    return fused is not None and fused.join is None
+
+
+class MeshGangExec(ExecutionPlan):
+    """Runs a whole stage as one shard_map program over the mesh.
+
+    Output partitioning is always 1: the scheduler sees a one-task stage.
+    Execution accelerates the subtree (``maybe_accelerate``) and, when it
+    fused, shards ALL input partitions over the mesh's data axis, reduces
+    the per-device states over ICI and materializes the combined partial
+    result.  Any fusion/capacity failure falls back to executing the input
+    partitions sequentially inside the same task — still correct, just
+    without the collective.
+    """
+
+    def __init__(self, input: ExecutionPlan, n_devices: int = 0):
+        super().__init__()
+        self.input = input
+        self.n_devices = n_devices
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self.input.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return MeshGangExec(children[0], self.n_devices)
+
+    def __str__(self) -> str:
+        n = self.n_devices or "auto"
+        return f"MeshGangExec: devices={n}"
+
+    # ------------------------------------------------------------ execute
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        assert partition == 0, "gang stages are single-task"
+        from ..ops.stage_compiler import TpuStageExec, maybe_accelerate
+
+        from ..errors import ExecutionError
+        from ..ops.stage_compiler import _CapacityExceeded
+
+        inner = self.input
+        if not isinstance(inner, TpuStageExec):
+            inner = maybe_accelerate(inner, ctx.config)
+        if (
+            isinstance(inner, TpuStageExec)
+            and ctx.config.tpu_enable
+            and inner.fused.join is None
+        ):
+            try:
+                # fully materialized before yielding: a capacity fallback
+                # must never follow already-emitted rows with a re-run
+                batches = list(self._execute_mesh(inner, ctx))
+                yield from batches
+                return
+            except _MeshKeyedRoute as route:
+                try:
+                    batches = list(
+                        self._execute_mesh_keyed(inner, ctx, route.n_dev)
+                    )
+                    yield from batches
+                    return
+                except (_CapacityExceeded, ExecutionError):
+                    self.metrics.add("mesh_fallback", 1)
+            except (_CapacityExceeded, ExecutionError):
+                # group capacity overflow or a type that slipped past
+                # plan-time lowering: re-run sequentially (Cancelled and
+                # real bugs propagate — they are not fusion failures)
+                self.metrics.add("mesh_fallback", 1)
+        yield from self._execute_sequential(inner, ctx)
+
+    def _execute_sequential(
+        self, inner: ExecutionPlan, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        for p in range(self.input.output_partitioning().n):
+            yield from inner.execute(p, ctx)
+
+    def _execute_mesh(self, tpu, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        """All input partitions → one sharded fused kernel + ICI reduce."""
+        import jax
+
+        from ..ops import kernels as K
+        from . import mesh as M
+
+        fused = tpu.fused
+        n_dev = self.n_devices or ctx.config.mesh_devices or len(jax.devices())
+        n_dev = max(1, min(n_dev, len(jax.devices())))
+
+        from ..ops.groups import GroupTable
+
+        from ..ops.bridge import make_key_encoder
+
+        key_encoders = [
+            make_key_encoder(tpu._schema.field(i).type)
+            for i in range(len(fused.group_exprs))
+        ]
+        group_table = GroupTable(len(fused.group_exprs))
+        n_rows = 0
+        n_parts = fused.source.output_partitioning().n
+        # Partitions ARE the shards: each partition's arrays transfer to
+        # its device (round-robin) as soon as the partition is scanned, so
+        # peak host memory is ONE partition and source I/O overlaps device
+        # transfer (round-2 weakness #6: the old path np.concatenate'd the
+        # whole stage input on host first).  Column order per device chunk:
+        # [seg, valid, *flat_names].
+        names = ["__seg", "__valid"] + list(tpu._flat_names)
+        n_dev_chunks: list[list[list]] = []  # [device][chunk][column]
+        with self.metrics.timer("mesh_stage_time_ns"):
+            import jax as _jax
+
+            mesh = M.make_mesh(n_dev)
+            devices = list(mesh.devices.flatten())
+            n_dev_chunks = [[] for _ in devices]
+            for p in range(n_parts):
+                for batch in fused.source.execute(p, ctx):
+                    ctx.check_cancelled()
+                    if batch.num_rows == 0:
+                        continue
+                    n = batch.num_rows
+                    if fused.group_exprs:
+                        with self.metrics.timer("key_encode_time_ns"):
+                            seg = tpu._encode_groups(
+                                batch, key_encoders, group_table
+                            )
+                        if n_rows == 0:
+                            from ..ops.stage_compiler import (
+                                _highcard_detect,
+                                keyed_route_wanted,
+                            )
+
+                            if _highcard_detect(group_table.n_groups, n):
+                                if keyed_route_wanted(tpu.config):
+                                    # groups ~ rows: per-shard KEYED
+                                    # reduction keeps the whole mesh busy
+                                    raise _MeshKeyedRoute(n_dev)
+                                if tpu.config.tpu_highcard_mode != "gid":
+                                    # cpu platform / highcard_mode=cpu:
+                                    # the sequential fallback routes each
+                                    # partition to the C++ hash aggregate
+                                    # (the measured winner off-
+                                    # accelerator); 'gid' pins the gid-
+                                    # table gang path (capacity must fit)
+                                    from ..errors import ExecutionError
+
+                                    raise ExecutionError(
+                                        "high-cardinality gang stage"
+                                    )
+                    else:
+                        seg = np.zeros(n, dtype=np.int32)
+                    with self.metrics.timer("bridge_time_ns"):
+                        env = K.build_env(batch, tpu.leaves, n)
+                        cols = [seg, np.ones(n, dtype=bool)] + [
+                            env[nm] for nm in tpu._flat_names
+                        ]
+                        dev = devices[p % n_dev]
+                        n_dev_chunks[p % n_dev].append(
+                            [_jax.device_put(c, dev) for c in cols]
+                        )
+                    n_rows += n
+                    # host copies die with `env`/`cols` at next iteration
+
+            if n_rows == 0:
+                yield from tpu._materialize(
+                    None, key_encoders, group_table, 0, ctx, 0
+                )
+                return
+
+            # same 4x capacity bucketing as the sequential device path —
+            # segment ids beyond the table would be dropped silently
+            cap = tpu.capacity
+            while cap < group_table.n_groups:
+                cap *= 4
+            cap = min(cap, tpu.max_capacity)
+            if cap > tpu.capacity:
+                self.metrics.add("capacity_growths", 1)
+
+            step_key = (tpu._sig, n_dev, cap) + K.algo_cache_token()
+            step = _MESH_STEP_CACHE.get(step_key)
+            if step is None:
+                raw_kernel, _ = tpu._kernel_for(cap)
+                step = M.make_distributed_agg_step(
+                    raw_kernel, tpu.specs, mesh, cap, tpu._mode
+                )
+                _MESH_STEP_CACHE[step_key] = step
+            with self.metrics.timer("device_time_ns"):
+                sharded = M.assemble_shards(mesh, n_dev_chunks, len(names))
+                out = step(*sharded)
+                # packed fetch = the only reliable sync on the tunnel TPU
+                # (block_until_ready is a no-op there); one roundtrip,
+                # sliced to the assigned groups (pow2 bucket)
+                host_states = tpu._fetch_states(
+                    tuple(out),
+                    group_table.n_groups if tpu.fused.group_exprs else None,
+                )
+        self.metrics.add("mesh_rows_in", n_rows)
+        self.metrics.add("mesh_devices", n_dev)
+        yield from tpu._materialize(
+            host_states, key_encoders, group_table, n_rows, ctx, 0
+        )
+
+
+    def _execute_mesh_keyed(
+        self, tpu, ctx: TaskContext, n_dev: int
+    ) -> Iterator[pa.RecordBatch]:
+        """High-cardinality gang: per-shard KEYED reduction on every
+        device CONCURRENTLY (async dispatch of the single-chip keyed
+        kernels — sort by raw key codes, gids from key-change
+        boundaries), then a [distinct]-sized vectorized host merge by
+        key.  The O(rows) sort/scan work stays on the shards; only the
+        per-shard (unique keys, states) cross to host.  An ICI
+        tree-merge is the future optimization; the host merge is already
+        orders of magnitude below row scale."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..errors import ExecutionError
+        from ..ops import kernels as K
+        from ..ops.bridge import make_key_encoder
+        from ..ops.stage_compiler import _CapacityExceeded, _KeyedGroups
+        from . import mesh as M
+
+        fused = tpu.fused
+        holder, prep = tpu._keyed_prep()
+        key_encoders = [
+            make_key_encoder(tpu._schema.field(pos).type)
+            for pos, (kind, _s) in enumerate(tpu._group_plan)
+            if kind == "enc"
+        ]
+        n_keys = tpu._n_encoded_groups
+        mesh = M.make_mesh(n_dev)
+        devices = list(mesh.devices.flatten())
+        per_dev_buf: list[list] = [[] for _ in devices]
+        n_rows = 0
+        with self.metrics.timer("mesh_stage_time_ns"):
+            n_parts = fused.source.output_partitioning().n
+            for p in range(n_parts):
+                for batch in fused.source.execute(p, ctx):
+                    ctx.check_cancelled()
+                    n = batch.num_rows
+                    if n == 0:
+                        continue
+                    with self.metrics.timer("key_encode_time_ns"):
+                        codes = tpu._encode_codes(batch, key_encoders)
+                    if tpu._mode == "x32":
+                        for c in codes:
+                            if len(c) and (
+                                c.min() < -(1 << 31)
+                                or c.max() >= (1 << 31)
+                            ):
+                                raise ExecutionError(
+                                    "gang keys exceed i32"
+                                )
+                    n_pad = K.bucket_rows(n)
+                    keys = tuple(
+                        K._pad(K.coerce_host_values(c), n_pad)
+                        for c in codes
+                    )
+                    valid = np.zeros(n_pad, dtype=bool)
+                    valid[:n] = True
+                    with self.metrics.timer("bridge_time_ns"):
+                        # trivial-validity substitution is skipped here:
+                        # the gang pins arrays to explicit mesh devices,
+                        # and a default-device iota mask would break that
+                        # placement
+                        args, _ = tpu._kernel_args(batch, n, n_pad, None)
+                    dev = devices[p % n_dev]
+                    with self.metrics.timer("device_time_ns"):
+                        keys_d = tuple(
+                            jax.device_put(k, dev) for k in keys
+                        )
+                        valid_d = jax.device_put(valid, dev)
+                        args_d = [jax.device_put(a, dev) for a in args]
+                        per_dev_buf[p % n_dev].append(
+                            prep(keys_d, valid_d, *args_d)
+                        )
+                    n_rows += n
+
+            if n_rows == 0:
+                yield from tpu._materialize(
+                    None, key_encoders, _KeyedGroups([], 0), 0, ctx, 0
+                )
+                return
+
+            with self.metrics.timer("device_time_ns"):
+                # per-device concat + phase-1 sort (dispatches overlap
+                # across devices; only the scalar fetches serialize)
+                sort_out: list = []
+                for buf in per_dev_buf:
+                    if not buf:
+                        sort_out.append(None)
+                        continue
+                    parts = list(zip(*buf))
+                    if len(buf) == 1:
+                        fields = [q[0] for q in parts]
+                    else:
+                        fields = [jnp.concatenate(q) for q in parts]
+                    total = int(fields[0].shape[0])
+                    n2 = K.bucket_rows(total)
+                    if n2 != total:
+                        fields = [
+                            jnp.pad(f, (0, n2 - total)) for f in fields
+                        ]
+                    mask = fields[0]
+                    keys_f = fields[1:1 + n_keys]
+                    flat = fields[1 + n_keys:]
+                    out = K.keyed_sort_kernel(n_keys)(mask, *keys_f)
+                    sort_out.append((out, flat))
+                counts = [
+                    int(np.asarray(so[0][-1])) if so is not None else 0
+                    for so in sort_out
+                ]
+                if max(counts, default=0) > tpu.max_capacity:
+                    raise _CapacityExceeded()
+                cap = max(64, 1 << (max(max(counts), 1) - 1).bit_length())
+                fetches = []
+                for so, ng in zip(sort_out, counts):
+                    if so is None:
+                        continue
+                    out, flat = so
+                    s2, perm, sk = out[0], out[1], out[2:-1]
+                    finish = K.keyed_finish_kernel(
+                        holder["kinds"], holder["plan"], tpu.specs,
+                        n_keys, cap, tpu._mode,
+                    )
+                    fetches.append(
+                        (finish(s2, perm, tuple(sk), tuple(flat)), ng)
+                    )
+                per_dev = []
+                for packed, ng in fetches:
+                    host = np.asarray(packed)
+                    states, kc = K.unpack_keyed_host(
+                        tpu.specs, host, tpu._mode, n_keys
+                    )
+                    per_dev.append((states, kc, ng))
+            merged_states, merged_keys, n_groups = K.merge_keyed_host(
+                tpu.specs, tpu._mode, per_dev
+            )
+        self.metrics.add("mesh_rows_in", n_rows)
+        self.metrics.add("mesh_devices", n_dev)
+        self.metrics.add("mesh_keyed", 1)
+        yield from tpu._materialize(
+            merged_states, key_encoders,
+            _KeyedGroups(merged_keys, n_groups), n_rows, ctx, 0,
+        )
+
+
+class MeshExchangeError(Exception):
+    """Exchange-specific failure (capacity ceiling, untransferable column):
+    the owning writer falls back to the classic hash-split.  Deliberately
+    NOT an ExecutionError so inner-plan execution errors propagate to the
+    normal stage-retry machinery instead of being silently re-run."""
+
+
+def exchange_supported(schema: pa.Schema) -> bool:
+    """Can every field of this schema cross the ICI batch exchange?
+    (numeric/bool/date/timestamp directly, strings as dictionary codes,
+    i64 as lo/hi pairs — mesh.BatchExchanger's layout rules)."""
+    from ..ops.bridge import _is_device_friendly
+
+    for f in schema:
+        t = f.type
+        if not (
+            pa.types.is_string(t)
+            or pa.types.is_large_string(t)
+            or _is_device_friendly(t)
+        ):
+            return False
+    return True
+
+
+class MeshRepartitionExec(ExecutionPlan):
+    """Gang-form hash repartition: the stage's shuffle IS an ICI collective.
+
+    The reference hash-splits every batch per input partition and writes
+    n_in x n_out shuffle files (``shuffle_writer.rs:201-285``); when the
+    stage's partitions are mesh-resident, this node runs ONE task that
+    shards every input partition over the mesh, routes rows to their
+    destination output partition with a single ``all_to_all``
+    (:class:`..parallel.mesh.BatchExchanger`), and hands the owning
+    :class:`ShuffleWriterExec` already-partitioned output batches — zero
+    hash-split files, one memory write per output partition.
+
+    ``output_partitioning()`` is 1 so the scheduler sees an ordinary
+    one-task stage (same trick as :class:`MeshGangExec`); recovery and
+    stats machinery are untouched.  Capacity follows the documented
+    n_dropped contract: computed exactly from the shard layout, doubled
+    and retried if the exchange still reports drops, ExecutionError (→
+    writer fallback) past the ceiling.
+    """
+
+    _CAP_CEILING = 1 << 24
+    # process-wide observability: completed exchanges / writer fallbacks
+    # (executor-side metrics are not reachable from cluster tests)
+    exchanges_completed = 0
+
+    def __init__(
+        self, input: ExecutionPlan, partitioning: Partitioning,
+        n_devices: int = 0,
+    ):
+        super().__init__()
+        assert partitioning.kind == "hash"
+        self.input = input
+        self.partitioning = partitioning
+        self.n_devices = n_devices
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self.input.schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> list[ExecutionPlan]:
+        return [self.input]
+
+    def with_new_children(self, children):
+        return MeshRepartitionExec(
+            children[0], self.partitioning, self.n_devices
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"MeshRepartitionExec: hash({self.partitioning.n}) "
+            f"devices={self.n_devices or 'auto'}"
+        )
+
+    def execute(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        # direct execution (no writer): repartition does not change row
+        # content, so pass every input partition through unchanged
+        for p in range(self.input.output_partitioning().n):
+            yield from self.input.execute(p, ctx)
+
+    # -------------------------------------------------------- exchanged
+    def execute_exchanged(
+        self, ctx: TaskContext
+    ) -> Iterator[tuple[int, pa.RecordBatch]]:
+        """Yield (output_partition, batch) pairs after the mesh exchange."""
+        import jax
+
+        from ..errors import ExecutionError
+        from ..shuffle.execution_plans import partition_indices
+        from . import mesh as M
+
+        n_out = self.partitioning.n
+        exprs = list(self.partitioning.exprs)
+        n_dev = self.n_devices or ctx.config.mesh_devices or len(jax.devices())
+        n_dev = max(1, min(n_dev, len(jax.devices())))
+
+        # the exchange buffers the stage input in host memory (~2x resident
+        # plus device staging): a row ceiling keeps huge shuffles on the
+        # streaming hash-split path instead of OOMing this task
+        max_rows = ctx.config.mesh_exchange_max_rows
+        with self.metrics.timer("mesh_stage_time_ns"):
+            batches: list[pa.RecordBatch] = []
+            dest_parts: list[np.ndarray] = []
+            rows_seen = 0
+            for p in range(self.input.output_partitioning().n):
+                for b in self.input.execute(p, ctx):
+                    ctx.check_cancelled()
+                    if b.num_rows == 0:
+                        continue
+                    rows_seen += b.num_rows
+                    if rows_seen > max_rows:
+                        raise MeshExchangeError(
+                            f"stage exceeds mesh.exchange_max_rows "
+                            f"({rows_seen} > {max_rows})"
+                        )
+                    with self.metrics.timer("repart_time_ns"):
+                        idx = partition_indices(b, exprs, n_out)
+                    batches.append(b)
+                    dest_parts.append(idx.astype(np.int32))
+            if not batches:
+                return
+
+            # destination column rides the exchange so one device can
+            # carry several output partitions (n_out != n_dev)
+            ext_schema = pa.schema(
+                list(self.input.schema) + [pa.field("__part", pa.int32())]
+            )
+            ext_batches = [
+                pa.RecordBatch.from_arrays(
+                    list(b.columns) + [pa.array(d)], schema=ext_schema
+                )
+                for b, d in zip(batches, dest_parts)
+            ]
+            dest_dev = np.concatenate(dest_parts) % n_dev
+            dest_dev = dest_dev.astype(np.int32)
+            total = len(dest_dev)
+            valid = np.ones(total, dtype=bool)
+
+            # exact per-(source shard, destination) bucket need from the
+            # known contiguous shard layout (shard_batch pads evenly)
+            per_shard = -(-total // n_dev)
+            shard_id = np.arange(total, dtype=np.int64) // per_shard
+            need = int(
+                np.bincount(
+                    shard_id * n_dev + dest_dev, minlength=n_dev * n_dev
+                ).max()
+            )
+            cap = 1 << max(need - 1, 0).bit_length()
+
+            mesh = M.make_mesh(n_dev)
+            try:
+                base_ex = None
+                cols = None
+                while True:
+                    ex = M.BatchExchanger(
+                        mesh, ext_schema, cap, share_from=base_ex
+                    )
+                    if cols is None:  # encoding is capacity-independent
+                        base_ex = ex
+                        cols_per_batch = [
+                            ex.to_columns(b) for b in ext_batches
+                        ]
+                        cols = [
+                            np.concatenate(parts)
+                            for parts in zip(*cols_per_batch)
+                        ]
+                    with self.metrics.timer("device_time_ns"):
+                        recv_cols, recv_valid, n_dropped = ex.exchange(
+                            dest_dev, valid, cols
+                        )
+                    if n_dropped == 0:
+                        break
+                    cap *= 2  # grow-or-fallback contract (mesh.py docstring)
+                    if cap > self._CAP_CEILING:
+                        raise MeshExchangeError(
+                            "mesh exchange capacity ceiling exceeded"
+                        )
+                    self.metrics.add("capacity_growths", 1)
+            except ExecutionError as e:
+                # column didn't cross the bridge (dtype slipped past the
+                # plan-time check): an exchange failure, not a plan failure
+                raise MeshExchangeError(str(e)) from e
+
+            self.metrics.add("mesh_exchange_rows", total)
+            self.metrics.add("mesh_devices", n_dev)
+            MeshRepartitionExec.exchanges_completed += 1
+
+            part_col = len(ext_schema) - 1
+            for recv in ex.to_batches(recv_cols, recv_valid):
+                if recv.num_rows == 0:
+                    continue
+                parts = np.asarray(recv.column(part_col))
+                core = recv.select(range(part_col))
+                order = np.argsort(parts, kind="stable")
+                sorted_parts = parts[order]
+                shuffled = core.take(pa.array(order))
+                bounds = np.searchsorted(
+                    sorted_parts, np.arange(n_out + 1)
+                )
+                for out_p in range(n_out):
+                    lo, hi = int(bounds[out_p]), int(bounds[out_p + 1])
+                    if hi > lo:
+                        yield out_p, shuffled.slice(lo, hi - lo)
+
+
+def maybe_mesh(plan: ExecutionPlan, config) -> ExecutionPlan:
+    """Physical-optimizer rule for the LOCAL engine (SessionContext): run
+    an accelerated partial-aggregate under Repartition/Coalesce as one
+    mesh gang so the local path exercises the same collectives as the
+    distributed gang stages."""
+    from ..exec.operators import CoalescePartitionsExec, RepartitionExec
+    from ..ops.stage_compiler import TpuStageExec
+
+    if not (config.mesh_enable and config.tpu_enable):
+        return plan
+    kids = plan.children()
+    if kids:
+        plan = plan.with_new_children([maybe_mesh(c, config) for c in kids])
+    if isinstance(plan, (RepartitionExec, CoalescePartitionsExec)):
+        child = plan.children()[0]
+        if (
+            isinstance(child, TpuStageExec)
+            and child.fused.mode == "partial"
+            and child.fused.source.output_partitioning().n > 1
+        ):
+            return plan.with_new_children(
+                [MeshGangExec(child, config.mesh_devices)]
+            )
+    return plan
