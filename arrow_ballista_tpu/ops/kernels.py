@@ -513,14 +513,19 @@ def _infer_pa_type(e: pe.PhysicalExpr, schema: pa.Schema) -> pa.DataType:
 
 # ---------------------------------------------------------------- env build
 def build_env(
-    batch: pa.RecordBatch, leaves: dict[str, LeafSpec], n_padded: int
+    batch: pa.RecordBatch, leaves: dict[str, LeafSpec], n_padded: int,
+    trivial_valid: Optional[set] = None,
 ) -> dict[str, np.ndarray]:
     """Evaluate/extract all leaf arrays for one batch, padded to n_padded.
 
     Every leaf ALWAYS ships a validity companion (all-true when the batch
     has no nulls) so the fused kernel's positional signature is identical
     across batches — nulls appearing mid-stream must not trigger an XLA
-    recompile.
+    recompile.  Names of companions that are trivially the row tail mask
+    (all-true over live rows, False over padding) are added to
+    ``trivial_valid`` when given: the executor substitutes ONE shared
+    device-built iota mask for them instead of shipping n_padded host
+    bytes per leaf over the tunnel.
     """
     import pyarrow.compute as pc
 
@@ -540,16 +545,19 @@ def build_env(
             # count(col): ONLY the validity mask crosses — the values are
             # never read, so any column type (strings, decimals, wide
             # i64) counts on device
-            validity = (
-                np.asarray(pc.is_valid(arr))
-                if arr.null_count
-                else np.ones(len(arr), dtype=bool)
-            )
+            if arr.null_count:
+                validity = np.asarray(pc.is_valid(arr))
+            else:
+                validity = np.ones(len(arr), dtype=bool)
+                if trivial_valid is not None:
+                    trivial_valid.add(f"{name}__valid")
             env[f"{name}__valid"] = _pad(validity, n_padded)
             continue
         values, validity = arrow_to_numpy(arr)
         if validity is None:
             validity = np.ones(len(values), dtype=bool)
+            if trivial_valid is not None:
+                trivial_valid.add(f"{name}__valid")
         env[f"{name}__valid"] = _pad(validity, n_padded)
         if spec.kind == "column_pair":
             v = values.astype(np.float64)
@@ -626,7 +634,8 @@ def flat_arg_names(leaves: dict[str, LeafSpec]) -> list[str]:
 
 
 def make_join_kernel(
-    inner_fn, flat_names: list[str], join_slots: dict[str, int], n_build: int
+    inner_fn, flat_names: list[str], join_slots: dict[str, int],
+    n_build: int, dense: bool = False,
 ):
     """Wrap a fused aggregate kernel with an on-device PK-FK probe join.
 
@@ -634,27 +643,47 @@ def make_join_kernel(
     their index in the build-column arrays.  The wrapped signature is::
 
         fn(seg, valid, *probe_args, pkey, pkey_valid,
-           bkeys, *bvals, *bvalids)
+           bkeys, *bvals, *bvalids)               # sorted-probe form
+        fn(seg, valid, *probe_args, pkey, pkey_valid,
+           table, kmin, *bvals, *bvalids)         # dense form
 
     where ``probe_args`` are the per-batch arrays for NON-join flat names
-    (in order), ``pkey`` is this batch's probe join key, and the build
-    arrays are [m]-sized, SORTED by key (unique keys).  The join itself is
-    a searchsorted + gather; non-matching probe rows fold into the global
-    row mask (inner join), so shapes stay static and the joined relation
-    is never materialized.
+    (in order) and ``pkey`` is this batch's probe join key.  Sorted form:
+    build arrays are [m]-sized, SORTED by key (unique keys), probed by
+    searchsorted + gather.  Dense form (key span fits the slot cap):
+    ``table`` is a [span] array holding row_index+1 at slot key-kmin
+    (0 = no such key), probed with ONE gather — searchsorted's log2(m)
+    sequential gather passes dominated device time on the chip
+    (BENCH_SUITE_r05 starjoin row).  Either way non-matching probe rows
+    fold into the global row mask (inner join), so shapes stay static
+    and the joined relation is never materialized.
     """
     n_probe = sum(1 for n in flat_names if n not in join_slots)
 
     def fn(seg_ids, valid, *args):
         probe_args = args[:n_probe]
-        pkey, pkey_valid, bkeys = args[n_probe:n_probe + 3]
-        bvals = args[n_probe + 3:n_probe + 3 + n_build]
-        bvalids = args[n_probe + 3 + n_build:]
-        m = bkeys.shape[0]
-        idx = jnp.clip(
-            jnp.searchsorted(bkeys, pkey), 0, max(m - 1, 0)
-        ).astype(jnp.int32)
-        match = jnp.logical_and(bkeys[idx] == pkey, pkey_valid)
+        if dense:
+            pkey, pkey_valid, tbl, kmin = args[n_probe:n_probe + 4]
+            bvals = args[n_probe + 4:n_probe + 4 + n_build]
+            bvalids = args[n_probe + 4 + n_build:]
+            span = tbl.shape[0]
+            # i64 probe arithmetic: i32 pkey - i32 kmin can overflow
+            rel = pkey.astype(jnp.int64) - kmin.astype(jnp.int64)
+            inb = jnp.logical_and(rel >= 0, rel < span)
+            slot = tbl[jnp.clip(rel, 0, span - 1).astype(jnp.int32)]
+            match = jnp.logical_and(
+                jnp.logical_and(inb, slot > 0), pkey_valid
+            )
+            idx = jnp.maximum(slot - 1, 0).astype(jnp.int32)
+        else:
+            pkey, pkey_valid, bkeys = args[n_probe:n_probe + 3]
+            bvals = args[n_probe + 3:n_probe + 3 + n_build]
+            bvalids = args[n_probe + 3 + n_build:]
+            m = bkeys.shape[0]
+            idx = jnp.clip(
+                jnp.searchsorted(bkeys, pkey), 0, max(m - 1, 0)
+            ).astype(jnp.int32)
+            match = jnp.logical_and(bkeys[idx] == pkey, pkey_valid)
         full = []
         it = iter(probe_args)
         for name in flat_names:
@@ -2035,6 +2064,31 @@ def state_is_int(spec: KernelAggSpec, mode: str) -> tuple[bool, ...]:
 _PACK_CACHE: dict = {}
 
 
+def pack_states(
+    specs: list[KernelAggSpec], states: tuple, mode: str,
+    keep: Optional[int] = None,
+):
+    """Traceable body of :func:`pack_for_fetch`: stack every state field
+    (floats bitcast to the integer domain) into one [n_fields, keep]
+    array.  Usable inside a larger jit (the fused single-dispatch runner
+    packs in the same trace as the kernels) or via the jitted wrapper."""
+    cap = states[0].shape[-1]
+    if keep is None or keep > cap:
+        keep = cap
+    flags = [
+        f for spec in specs for f in state_is_int(spec, mode)
+    ] + [True]  # presence
+    fdt = jnp.float64 if mode == "x64" else jnp.float32
+    idt = jnp.int64 if mode == "x64" else jnp.int32
+    rows = [
+        a[:keep].astype(idt)
+        if is_int
+        else jax.lax.bitcast_convert_type(a[:keep].astype(fdt), idt)
+        for a, is_int in zip(states, flags)
+    ]
+    return jnp.stack(rows, axis=0)
+
+
 def pack_for_fetch(
     specs: list[KernelAggSpec], acc: tuple, mode: str,
     keep: Optional[int] = None,
@@ -2052,22 +2106,9 @@ def pack_for_fetch(
     key = (tuple(specs), mode, cap, keep)
     fn = _PACK_CACHE.get(key)
     if fn is None:
-        flags = [
-            f for spec in specs for f in state_is_int(spec, mode)
-        ] + [True]  # presence
-
-        def _pack(states):
-            fdt = jnp.float64 if mode == "x64" else jnp.float32
-            idt = jnp.int64 if mode == "x64" else jnp.int32
-            rows = [
-                a[:keep].astype(idt)
-                if is_int
-                else jax.lax.bitcast_convert_type(a[:keep].astype(fdt), idt)
-                for a, is_int in zip(states, flags)
-            ]
-            return jnp.stack(rows, axis=0)
-
-        fn = jax.jit(_pack)
+        fn = jax.jit(
+            lambda states: pack_states(specs, states, mode, keep)
+        )
         _PACK_CACHE[key] = fn
     return fn(acc)
 

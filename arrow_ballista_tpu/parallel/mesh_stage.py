@@ -104,7 +104,7 @@ class MeshGangExec(ExecutionPlan):
         from ..ops.stage_compiler import TpuStageExec, maybe_accelerate
 
         from ..errors import ExecutionError
-        from ..ops.stage_compiler import _CapacityExceeded
+        from ..ops.stage_compiler import _CapacityExceeded, _JaxRuntimeError
 
         inner = self.input
         if not isinstance(inner, TpuStageExec):
@@ -127,12 +127,18 @@ class MeshGangExec(ExecutionPlan):
                     )
                     yield from batches
                     return
-                except (_CapacityExceeded, ExecutionError):
+                except (_CapacityExceeded, ExecutionError, _JaxRuntimeError):
                     self.metrics.add("mesh_fallback", 1)
-            except (_CapacityExceeded, ExecutionError):
-                # group capacity overflow or a type that slipped past
-                # plan-time lowering: re-run sequentially (Cancelled and
-                # real bugs propagate — they are not fusion failures)
+            except (_CapacityExceeded, ExecutionError, _JaxRuntimeError):
+                # group capacity overflow, a type that slipped past
+                # plan-time lowering, or a DEVICE/COMPILE failure
+                # (BENCH_SUITE_r05 h2o: the gang's shard_map compile got
+                # its tpu_compile_helper SIGKILLed and the uncaught
+                # JaxRuntimeError killed the whole query — a gang stage
+                # must degrade to the sequential path, never crash): re-run
+                # sequentially.  Only jax's runtime error is caught
+                # (blanket RuntimeError would hide real bugs); Cancelled
+                # is a BallistaError sibling and still propagates.
                 self.metrics.add("mesh_fallback", 1)
         yield from self._execute_sequential(inner, ctx)
 
@@ -325,7 +331,11 @@ class MeshGangExec(ExecutionPlan):
                     valid = np.zeros(n_pad, dtype=bool)
                     valid[:n] = True
                     with self.metrics.timer("bridge_time_ns"):
-                        args = tpu._kernel_args(batch, n, n_pad, None)
+                        # trivial-validity substitution is skipped here:
+                        # the gang pins arrays to explicit mesh devices,
+                        # and a default-device iota mask would break that
+                        # placement
+                        args, _ = tpu._kernel_args(batch, n, n_pad, None)
                     dev = devices[p % n_dev]
                     with self.metrics.timer("device_time_ns"):
                         keys_d = tuple(

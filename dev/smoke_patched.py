@@ -1,0 +1,180 @@
+"""Smoke-test the patched package copy (dev/pkgcopy) on the CPU backend
+before overlaying the live package: fused single-dispatch runner, device
+tail masks, dense-probe join, keyed pin, routing flip, cache hits."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "pkgcopy"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext  # noqa: E402
+from arrow_ballista_tpu.catalog import MemoryTable  # noqa: E402
+from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec  # noqa: E402
+
+assert "pkgcopy" in sys.modules["arrow_ballista_tpu"].__file__, (
+    "smoke must import the PATCHED copy, got %s"
+    % sys.modules["arrow_ballista_tpu"].__file__
+)
+
+
+def ctx(tpu, **extra):
+    s = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.partitions": "1",
+    }
+    s.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(s))
+
+
+def metrics(plan):
+    agg = {}
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TpuStageExec):
+            for k, v in n.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(n.children())
+    return agg
+
+
+def run(c, sql):
+    df = c.sql(sql)
+    plan = df.physical_plan()
+    return c.execute(plan), metrics(plan)
+
+
+def check(name, sql, tables, expect_metric=None, absent_metric=None,
+          **extra):
+    cc, ct = ctx(False), ctx(True, **extra)
+    for nm, t in tables.items():
+        cc.register_table(nm, MemoryTable.from_table(t, 1))
+        ct.register_table(nm, MemoryTable.from_table(t, 1))
+    want, _ = run(cc, sql)
+    got, m = run(ct, sql)
+    key = [(c0, "ascending") for c0 in want.column_names
+           if not pa.types.is_floating(want.schema.field(c0).type)]
+    want, got = want.sort_by(key), got.sort_by(key)
+    assert want.num_rows == got.num_rows, (name, want.num_rows, got.num_rows)
+    for col in want.column_names:
+        for x, y in zip(want.column(col).to_pylist(),
+                        got.column(col).to_pylist()):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert abs(x - y) <= 1e-9 * max(abs(x), abs(y), 1.0), (
+                    name, col, x, y)
+            else:
+                assert x == y, (name, col, x, y)
+    if expect_metric:
+        for em in ([expect_metric] if isinstance(expect_metric, str)
+                   else expect_metric):
+            assert m.get(em, 0) >= 1, (name, em, m)
+    if absent_metric:
+        assert m.get(absent_metric, 0) == 0, (name, absent_metric, m)
+    print("ok:", name, {k: v for k, v in m.items() if not k.endswith("_ns")})
+    return m
+
+
+rng = np.random.default_rng(0)
+n = 6000
+t = pa.table({
+    "k": pa.array(rng.integers(0, 7, n), pa.int64()),
+    "v": pa.array(rng.uniform(-100, 100, n)),
+    "q": pa.array(rng.integers(1, 50, n).astype(np.float64)),
+})
+tn = pa.table({
+    "k": t.column("k"),
+    "v": pa.array([None if x > 80 else x
+                   for x in t.column("v").to_pylist()], pa.float64()),
+    "q": t.column("q"),
+})
+
+check("grouped fused", "select k, sum(v), count(v), min(q), max(v) "
+      "from t group by k", {"t": t}, expect_metric="fused_dispatches")
+check("scalar fused", "select sum(v), count(*), min(v) from t where q < 25",
+      {"t": t}, expect_metric="fused_dispatches")
+check("nulls fused", "select k, sum(v), count(v) from t group by k",
+      {"t": tn}, expect_metric="fused_dispatches")
+
+# multi-batch + capacity growth
+big = pa.table({
+    "k": pa.array(rng.integers(0, 3000, 30000), pa.int64()),
+    "v": pa.array(rng.uniform(-10, 10, 30000)),
+    "q": pa.array(rng.integers(1, 50, 30000).astype(np.float64)),
+})
+check("growth fused", "select k, sum(v), count(v) from big group by k",
+      {"big": big}, expect_metric="fused_dispatches",
+      **{"ballista.batch.size": 4096})
+
+# cache hit second run
+cthit = ctx(True)
+cthit.register_table("t", MemoryTable.from_table(t, 1))
+r1, _ = run(cthit, "select k, sum(v) from t group by k")
+r2, m2 = run(cthit, "select k, sum(v) from t group by k")
+assert m2.get("cache_hits", 0) >= 1 and m2.get("fused_dispatches", 0) >= 1, m2
+assert r1.sort_by([("k", "ascending")]).equals(
+    r2.sort_by([("k", "ascending")]))
+print("ok: cache hit fused", m2.get("cache_hits"))
+
+# dense join (contiguous, offset, gappy) + wide-span sorted fallback
+m_dim = 500
+dim = pa.table({
+    "pk": pa.array(np.arange(100, 100 + m_dim), pa.int64()),
+    "dv": pa.array(rng.uniform(0.5, 1.5, m_dim)),
+    "dg": pa.array((np.arange(m_dim) % 5).astype(np.int64)),
+})
+fact = pa.table({
+    "fk": pa.array(rng.integers(0, 800, 5000), pa.int64()),
+    "g": pa.array(rng.integers(0, 5, 5000), pa.int64()),
+    "x": pa.array(rng.uniform(0, 1, 5000)),
+})
+jm = check("dense join",
+           "select g, sum(x * dv), count(*) from dim, fact where pk = fk "
+           "group by g", {"dim": dim, "fact": fact},
+           expect_metric="dense_join", absent_metric="tpu_fallback")
+assert jm.get("join_fallback", 0) == 0, jm
+
+wide = pa.table({
+    "pk": pa.array((np.arange(1024) << 18).astype(np.int64)),
+    "dv": pa.array(rng.uniform(0.5, 1.5, 1024)),
+    "dg": pa.array((np.arange(1024) % 5).astype(np.int64)),
+})
+wfact = pa.table({
+    # half the probes hit real keys, half are uniform misses
+    "fk": pa.array(np.concatenate([
+        (rng.integers(0, 1024, 2500) << 18),
+        rng.integers(0, 1 << 28, 2500),
+    ]).astype(np.int64)),
+    "g": pa.array(rng.integers(0, 5, 5000), pa.int64()),
+    "x": pa.array(rng.uniform(0, 1, 5000)),
+})
+wm = check("wide-span sorted join",
+      "select g, sum(x * dv), count(*) from wide, wfact where pk = fk "
+      "group by g", {"wide": wide, "wfact": wfact},
+      absent_metric="tpu_fallback")
+assert wm.get("dense_join", 0) == 0, wm
+
+# keyed path still works when PINNED
+hk = pa.table({
+    "k": pa.array(rng.integers(0, 400000, 300000), pa.int64()),
+    "v": pa.array(rng.uniform(-10, 10, 300000)),
+})
+mk = check("keyed pinned", "select k, sum(v), count(*) from hk group by k",
+           {"hk": hk}, expect_metric="keyed_path",
+           **{"ballista.tpu.highcard_mode": "device"})
+
+# auto no longer routes keyed: same shape without the pin must take the
+# C++ hash handoff (highcard_fallback), not the keyed path
+ma = check("auto highcard -> hash handoff",
+           "select k, sum(v), count(*) from hk group by k", {"hk": hk})
+assert ma.get("keyed_path", 0) == 0, ma
+assert ma.get("highcard_fallback", 0) >= 1, ma
+
+print("SMOKE PASSED")
