@@ -330,8 +330,24 @@ def bench_full22() -> None:
     # default 300s ceiling just because XLA is compiling 22 queries'
     # worth of kernels on a busy host
     os.environ.setdefault("BALLISTA_JOB_TIMEOUT_S", "1800")
-    data = {name: gen_table(name, sf) for name in ALL_TABLES}
-    n_lineitem = data["lineitem"].num_rows
+    # register PARQUET paths, not in-memory tables: inline MemoryTable
+    # data rides the ExecuteQuery proto, and at SF1 the serialized plan
+    # (1.5 GB) blows the 256 MiB gRPC message cap (BENCH_SUITE_r05
+    # full22 failure) — the reference harness registers parquet dirs for
+    # the same reason (tpch.rs: register_tables); executors scan the
+    # files themselves and only shuffle/result bytes cross the wire
+    import tempfile
+
+    import pyarrow.parquet as _pq
+
+    pq_dir = tempfile.mkdtemp(prefix="bench_full22_")
+    n_lineitem = 0
+    for name in ALL_TABLES:
+        tbl = gen_table(name, sf)
+        if name == "lineitem":
+            n_lineitem = tbl.num_rows
+        _pq.write_table(tbl, os.path.join(pq_dir, f"{name}.parquet"))
+        del tbl
 
     def run(tpu: bool):
         cfg = BallistaConfig(
@@ -348,8 +364,10 @@ def bench_full22() -> None:
         times = {}
         outputs = {}
         try:
-            for name, tbl in data.items():
-                bctx.register_table(name, MemoryTable.from_table(tbl, 2))
+            for name in ALL_TABLES:
+                bctx.register_parquet(
+                    name, os.path.join(pq_dir, f"{name}.parquet")
+                )
             for qno in sorted(QUERIES):
                 t0 = time.perf_counter()
                 out = bctx.sql(QUERIES[qno]).collect()

@@ -47,8 +47,19 @@ step "post-fusion q6" 3600 bash -c \
   'set -o pipefail; python bench_suite.py q6 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
 step "post-fusion bench.py (q1 SF10)" 3600 bash -c \
   'set -o pipefail; python bench.py | tee BENCH_r05_dev.json | tee -a AB_FUSION_r05.log'
-step "post-fusion q3" 5400 bash -c \
+step "post-fusion starjoin (dense probe)" 3600 bash -c \
+  'set -o pipefail; python bench_suite.py starjoin 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+step "post-fusion full22 SF1 (parquet register)" 5400 bash -c \
+  'set -o pipefail; python bench_suite.py full22 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+step "post-fusion q3 (gid route + dense join)" 5400 bash -c \
   'set -o pipefail; python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+# window at reduced scale first: the full 2e7 config blocked the chip for
+# 55 min in the main capture — prove the device path at 2e6 before
+# risking the big shape again
+step "post-fusion window 2e6" 1800 bash -c \
+  'set -o pipefail; BENCH_WINDOW_N=2e6 BENCH_WINDOW_PARTS=5e3 python bench_suite.py window 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+step "kernel microbench grid" 5400 \
+  python benchmarks/kernels.py --iters 3 --host-encode --out KERNELBENCH_r05.json
 
 if [ "$fails" -gt 0 ]; then
   echo "== post-fusion capture FINISHED WITH $fails FAILED STEP(S) =="

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Round-5 post-fix trip-wire: wait for the main capture_chip.sh run to
+# drain (one job at a time on this box), then poll the device probe and
+# fire capture_post_fusion.sh on first recovery.
+#
+#   nohup bash dev/watch_post_fusion.sh > dev/watch_post_fusion.log 2>&1 &
+
+set -u
+cd "$(dirname "$0")/.."
+
+STATUS=dev/watch_post_fusion.status
+INTERVAL="${WATCH_INTERVAL_S:-480}"
+
+while pgrep -f "capture_chip.sh" > /dev/null 2>&1; do
+  echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) main capture still running" >> "$STATUS"
+  sleep 120
+done
+
+probe_once() {
+  timeout 200 python -c "
+from benchmarks.device_guard import probe_backend
+import sys
+p = probe_backend(180)
+print('probe:', p)
+sys.exit(0 if p not in (None, 'timeout', 'cpu') else 1)
+"
+}
+
+n=0
+while true; do
+  n=$((n + 1))
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if out=$(probe_once 2>&1); then
+    echo "$ts probe#$n OK — starting post-fusion capture" | tee -a "$STATUS"
+    bash dev/capture_post_fusion.sh >> dev/capture_post_fusion.log 2>&1
+    rc=$?
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) post-fusion capture rc=$rc" | tee -a "$STATUS"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) DONE" | tee -a "$STATUS"
+      exit 0
+    fi
+    # failed steps: keep watching so a later window can rerun
+  else
+    echo "$ts probe#$n unavailable: $out" >> "$STATUS"
+  fi
+  sleep "$INTERVAL"
+done
