@@ -34,6 +34,16 @@ DASHBOARD_HTML = """<!doctype html>
  th { background: #f0f0f0; }
  .ok { color: #0a7d2c; } .dead { color: #b00020; }
  #meta { color: #666; font-size: .8rem; }
+ .bar { background: #e4e4e4; width: 7rem; height: .6rem; display: inline-block; }
+ .bar > i { background: #2b6cb0; height: 100%; display: block; }
+ svg .stage rect { fill: #f7f7f7; stroke: #888; }
+ svg .stage.Running rect { fill: #dbeafe; stroke: #2b6cb0; }
+ svg .stage.Successful rect { fill: #dcfce7; stroke: #0a7d2c; }
+ svg .stage.Failed rect { fill: #fee2e2; stroke: #b00020; }
+ svg text { font: .7rem ui-monospace, Menlo, monospace; }
+ svg line { stroke: #999; marker-end: url(#arr); }
+ pre.plan { background: #f7f7f7; border: 1px solid #ddd; padding: .5rem;
+            font-size: .75rem; overflow-x: auto; }
 </style></head><body>
 <h1>Ballista-TPU Scheduler</h1>
 <div id="meta">loading…</div>
@@ -61,21 +71,90 @@ async function showDetail(jobId) {
   let html = `<h2>Job ${esc(jobId)} — ${esc(d.state)}` +
     ` <a href="/api/job/${encodeURIComponent(jobId)}/dot">[dot]</a></h2>`;
   if (d.error) html += `<p class="dead">${esc(d.error)}</p>`;
+  html += dagSvg(d.stages);
   html += '<table><thead><tr><th>stage</th><th>state</th><th>tasks</th>' +
-          '<th>metrics</th></tr></thead><tbody>';
+          '<th>progress</th><th>metrics</th></tr></thead><tbody>';
   for (const s of d.stages) {
     const done = s.completed_tasks === undefined ? '—'
       : `${s.completed_tasks}/${s.partitions}`;
+    const pct = s.completed_tasks === undefined ? 0
+      : Math.round(100 * s.completed_tasks / Math.max(1, s.partitions));
     const mets = s.metrics
       ? esc(Object.entries(s.metrics).map(([op, m]) =>
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
         ).join(' · '))
       : '—';
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
-            `<td>${done}</td><td>${mets}</td></tr>`;
+            `<td>${done}</td>` +
+            `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +
+            `<td>${mets}</td></tr>`;
+    if (s.plan) {
+      html += `<tr><td colspan="5"><details><summary>stage ${s.stage_id} ` +
+              `plan</summary><pre class="plan">${esc(s.plan)}</pre>` +
+              `</details></td></tr>`;
+    }
   }
   html += '</tbody></table>';
   document.getElementById('detail').innerHTML = html;
+}
+function dagSvg(stages) {
+  // layered DAG layout: producers left of consumers (output_links are
+  // stage -> consumer edges); the reference UI renders this graph via
+  // react-flow — here a dependency-free SVG suffices
+  if (!stages || !stages.length) return '';
+  const byId = {}, preds = {};
+  for (const s of stages) { byId[s.stage_id] = s; preds[s.stage_id] = []; }
+  for (const s of stages)
+    for (const c of (s.output_links || []))
+      if (preds[c] !== undefined) preds[c].push(s.stage_id);
+  const layer = {};
+  const depth = (id, seen) => {
+    if (layer[id] !== undefined) return layer[id];
+    if (seen.has(id)) return 0;  // cycle guard (never expected)
+    seen.add(id);
+    const ps = preds[id];
+    layer[id] = ps.length ? 1 + Math.max(...ps.map(p => depth(p, seen))) : 0;
+    return layer[id];
+  };
+  for (const s of stages) depth(s.stage_id, new Set());
+  const cols = {};
+  for (const s of stages) (cols[layer[s.stage_id]] ||= []).push(s);
+  const W = 120, H = 46, GX = 60, GY = 18;
+  const pos = {};
+  let maxRow = 0;
+  for (const [l, ss] of Object.entries(cols)) {
+    ss.sort((a, b) => a.stage_id - b.stage_id);
+    ss.forEach((s, i) => { pos[s.stage_id] = [l * (W + GX), i * (H + GY)]; });
+    maxRow = Math.max(maxRow, ss.length);
+  }
+  const width = (Object.keys(cols).length) * (W + GX);
+  const height = maxRow * (H + GY);
+  let svg = `<svg width="${width}" height="${height}" ` +
+    `style="margin:.5rem 0;display:block">` +
+    '<defs><marker id="arr" viewBox="0 0 6 6" refX="6" refY="3" ' +
+    'markerWidth="5" markerHeight="5" orient="auto">' +
+    '<path d="M0,0 L6,3 L0,6 z" fill="#999"/></marker></defs>';
+  for (const s of stages)
+    for (const c of (s.output_links || [])) {
+      if (!pos[c]) continue;
+      const [x1, y1] = pos[s.stage_id], [x2, y2] = pos[c];
+      svg += `<line x1="${x1 + W}" y1="${y1 + H / 2}" ` +
+             `x2="${x2}" y2="${y2 + H / 2}"/>`;
+    }
+  for (const s of stages) {
+    const [x, y] = pos[s.stage_id];
+    const pct = s.completed_tasks === undefined ? 0
+      : (s.completed_tasks / Math.max(1, s.partitions));
+    svg += `<g class="stage ${esc(s.state)}" transform="translate(${x},${y})">` +
+      `<rect width="${W}" height="${H}" rx="5"/>` +
+      `<title>${esc(s.plan || '')}</title>` +
+      `<text x="8" y="17">stage ${s.stage_id}</text>` +
+      `<text x="8" y="31" fill="#555">${esc(s.state)}</text>` +
+      `<rect x="8" y="36" width="${W - 16}" height="4" fill="#e4e4e4" stroke="none"/>` +
+      `<rect x="8" y="36" width="${(W - 16) * pct}" height="4" fill="#2b6cb0" stroke="none"/>` +
+      `</g>`;
+  }
+  return svg + '</svg>';
 }
 async function refresh() {
   try {

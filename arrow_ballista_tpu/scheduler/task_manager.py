@@ -73,6 +73,16 @@ class GrpcLauncher(TaskLauncher):
         )
 
 
+def _plan_tree_text(plan: ExecutionPlan, depth: int = 0, limit: int = 40) -> str:
+    """Indented one-line-per-operator tree for the dashboard plan view
+    (the reference UI's query-plan panel; rendered as <pre> client-side)."""
+    lines = ["  " * depth + str(plan)]
+    if depth < limit:
+        for child in plan.children():
+            lines.append(_plan_tree_text(child, depth + 1, limit))
+    return "\n".join(lines)
+
+
 @dataclass
 class JobEntry:
     lock: threading.RLock = field(default_factory=threading.RLock)
@@ -287,6 +297,10 @@ class TaskManager:
             err = getattr(stage, "error", "")
             if err:
                 row["error"] = err
+            # DAG edges + operator tree for the dashboard's SVG plan view
+            # (the reference UI renders the stage graph; QueriesList.tsx)
+            row["output_links"] = list(getattr(stage, "output_links", []))
+            row["plan"] = _plan_tree_text(stage.plan)
             stages.append(row)
         detail["stages"] = stages
         return detail

@@ -136,8 +136,43 @@ def test_dashboard_served():
             html = resp.read().decode()
         assert "Ballista-TPU Scheduler" in html
         assert "/api/state" in html  # dashboard polls the JSON API
+        assert "dagSvg" in html  # SVG stage-DAG plan view is embedded
     finally:
         api.stop()
+        ctx.close()
+
+
+def test_job_detail_carries_dag_and_plan():
+    """The dashboard's SVG DAG needs output_links edges and an operator
+    tree per stage (reference UI: QueriesList row expansion + plan
+    panel); run a real distributed query and read its drill-down."""
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.catalog import MemoryTable
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.standalone(num_executors=1)
+    try:
+        t = pa.table({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+        ctx.register_table("t", MemoryTable.from_table(t, 2))
+        out = ctx.sql("select k, sum(v) from t group by k").collect()
+        assert out.num_rows == 2
+        tm = ctx._standalone_handles[0].server.state.task_manager
+        jobs = tm.list_jobs()
+        assert jobs, "job table empty after a completed query"
+        detail = tm.get_job_detail(jobs[-1]["job_id"])
+        stages = detail["stages"]
+        assert len(stages) >= 2  # shuffle-split plan: at least two stages
+        # every stage carries DAG edges + a plan tree; at least one edge
+        # exists and every link targets a real stage id
+        ids = {s["stage_id"] for s in stages}
+        links = [c for s in stages for c in s["output_links"]]
+        assert links and all(c in ids for c in links)
+        for s in stages:
+            assert s["plan"].strip(), s
+        # the final stage consumes some producer
+        assert any(s["output_links"] for s in stages)
+    finally:
         ctx.close()
 
 
