@@ -1107,11 +1107,25 @@ class TpuStageExec(ExecutionPlan):
                     )
                 ]
             )
-        except (_CapacityExceeded, ExecutionError, _JaxRuntimeError):
-            # group cardinality exceeded the device segment table, a
-            # column type slipped past plan-time lowering checks, or the
-            # device/compiler failed mid-stage (BENCH_SUITE_r05 h2o: a
-            # SIGKILLed tpu_compile_helper surfaced as JaxRuntimeError
+        except _CapacityExceeded:
+            self.metrics.add("tpu_fallback", 1)
+            if self.fused.join is not None:
+                # a join-fused stage's gid table holds every distinct
+                # PROBE key, pre-filter — q3 SF10 has 15M orderkeys
+                # against the 2M ceiling even though only 1.26M groups
+                # survive the join.  The round-2 shape (join on CPU,
+                # aggregate on device over POST-join rows) keys the gid
+                # table on surviving groups instead, which is how r03
+                # captured q3 at 1.13x; its own execute() still falls to
+                # full CPU if even that overflows.
+                self.metrics.add("join_fallback", 1)
+                yield from self._nojoin_stage().execute(partition, ctx)
+                return
+            cpu_plan = self.original
+        except (ExecutionError, _JaxRuntimeError):
+            # a column type slipped past plan-time lowering checks, or
+            # the device/compiler failed mid-stage (BENCH_SUITE_r05 h2o:
+            # a SIGKILLed tpu_compile_helper surfaced as JaxRuntimeError
             # and killed the query instead of degrading) — re-run this
             # partition on the CPU operator path.  Only jax's runtime
             # error is caught (a blanket RuntimeError would silently
@@ -1284,11 +1298,18 @@ class TpuStageExec(ExecutionPlan):
                                 pass  # pinned gid-table path (A/B)
                             elif fused.join is None:
                                 raise _HighCardinality([batch], src)
-                            # fused device join at high cardinality with
-                            # the keyed path unavailable (cpu mode or
-                            # unshippable keys): the CPU alternative pays
-                            # the join too — stay on the gid-table path
-                            if first_groups is None:
+                            # fused device join at high cardinality:
+                            # stay on the gid-table path while it can
+                            # fit — but the table keys on every distinct
+                            # PROBE key pre-filter, so when batch 1
+                            # alone fills half the ceiling the stream
+                            # total will overflow it after the host has
+                            # paid the encode (q3 SF10: 15M orderkeys vs
+                            # the 2M cap, overflow discovered mid-stream)
+                            # — bail to the round-2 shape NOW
+                            if first_groups is None or (
+                                first_groups > self.max_capacity // 2
+                            ):
                                 raise _CapacityExceeded()
                         # first batch: shrink the segment table to the
                         # OBSERVED cardinality (2x headroom) — matmul-path

@@ -104,6 +104,50 @@ def test_dense_negative_probe_range():
     assert _join_fallbacks(plan) == 0
 
 
+def test_probe_key_overflow_degrades_to_cpu_join_device_agg():
+    # The gid table of a join-fused stage holds every distinct PROBE key
+    # pre-filter (q3 SF10: 15M orderkeys vs the 2M ceiling, only 1.26M
+    # surviving groups).  On _CapacityExceeded the stage must retry the
+    # round-2 shape — join on CPU, aggregate on device over POST-join
+    # rows — not fall to full CPU.
+    rng = np.random.default_rng(7)
+    n = 5000
+    dim = pa.table({
+        "pk": pa.array(np.arange(100), pa.int64()),
+        "dv": pa.array(rng.uniform(0.5, 1.5, 100)),
+    })
+    fact = pa.table({
+        # 5000 distinct probe keys, only 100 join; group by the probe key
+        "fk": pa.array(rng.permutation(5000), pa.int64()),
+        "v": pa.array(rng.uniform(0, 100, n)),
+    })
+    sql = ("select fk, sum(v * dv) as s from dim, fact where pk = fk "
+           "group by fk")
+    out = []
+    for tpu in (False, True):
+        ctx = _ctx(tpu, **{"ballista.tpu.max_capacity": 1024,
+                           "ballista.tpu.segment_capacity": 64})
+        ctx.register_table("dim", MemoryTable.from_table(dim, 1))
+        ctx.register_table("fact", MemoryTable.from_table(fact, 1))
+        df = ctx.sql(sql)
+        plan = df.physical_plan()
+        out.append((ctx.execute(plan), plan))
+    (cpu, _), (tpu_t, plan) = out
+    _assert_equal(cpu, tpu_t)
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+    m = {}
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, TpuStageExec):
+            for k, v in node.metrics.values.items():
+                m[k] = m.get(k, 0) + v
+        stack.extend(node.children())
+    assert m.get("join_fallback", 0) >= 1, m   # degraded to round-2 shape
+    assert m.get("device_time_ns", 0) > 0, m   # the aggregate still ran on device
+
+
 def test_wide_span_falls_back_to_sorted_probe():
     # span beyond the slot cap: sorted searchsorted probe, same results
     keys = np.arange(0, 1 << 28, 1 << 18)  # span 2^28 > cap, m = 1024
