@@ -141,6 +141,77 @@ def bench_host_encode(rows: int, capacity: int, iters: int, strings: bool):
     return best
 
 
+def bench_sort_operands(rows: int, n_operands: int, iters: int, u64: bool):
+    """Pure lax.sort cost vs operand count — the r05 chip capture showed
+    stream-wide multi-operand sorts losing 10-100x (q3 keyed 0.036x, the
+    2e7 window sort never returning), and every sort-based path
+    (keyed/window/median) carries 2+n_keys operands through each bitonic
+    pass.  This family answers whether BYTES MOVED or per-pass overhead
+    dominates, i.e. whether packing keys+iota into one u64 operand is
+    worth building.  ``u64=True`` benches that packed candidate: one
+    u64 key operand (num_keys=1) vs the same total key bits as i32s."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    if u64:
+        ops = (rng.integers(0, 1 << 62, rows, dtype=np.uint64),)
+        num_keys = 1
+    else:
+        ops = tuple(
+            rng.integers(0, 1 << 30, rows).astype(np.int32)
+            for _ in range(n_operands - 1)
+        ) + (np.arange(rows, dtype=np.int32),)  # iota payload
+        num_keys = n_operands - 1
+    ops_d = tuple(jax.device_put(o) for o in ops)
+    fn = jax.jit(lambda *a: jax.lax.sort(a, num_keys=num_keys))
+
+    def run():
+        out = fn(*ops_d)
+        return np.asarray(out[0][:64])  # tiny fetch: sync without volume
+
+    run()  # compile + warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_tunnel_latency(iters: int):
+    """Dispatch + fetch round-trip floors (the q6 latency story): time a
+    near-no-op jitted call synced by a 1-element fetch, and a chain of K
+    dependent dispatches before one fetch — separates per-dispatch from
+    per-fetch cost.  Returns (one_dispatch_fetch_s, chained8_fetch_s)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(np.arange(1024, dtype=np.float32))
+    one = jax.jit(lambda v: (v * 2.0).sum())
+    step = jax.jit(lambda v: v * 1.000001)
+
+    def run_one():
+        return float(np.asarray(one(x)))
+
+    def run_chain():
+        v = x
+        for _ in range(8):
+            v = step(v)
+        return float(np.asarray(v[0]))
+
+    run_one(), run_chain()  # compile + warm
+    best1 = best8 = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_one()
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_chain()
+        best8 = min(best8, time.perf_counter() - t0)
+    return best1, best8
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", default="1e6,8e6")
@@ -221,6 +292,51 @@ def main() -> None:
                         ),
                         args.out,
                     )
+
+    # sort-cost vs operand count + the packed-u64 candidate (r05: every
+    # sort-based device path is suspect on the tunnel-attached chip)
+    for rows in rows_list:
+        for n_ops, u64 in [(2, False), (3, False), (5, False), (1, True)]:
+            try:
+                s = bench_sort_operands(rows, n_ops, args.iters, u64)
+                _emit(
+                    dict(
+                        base,
+                        bench="sort_operands",
+                        operands=("u64x1" if u64 else f"i32x{n_ops}"),
+                        rows=rows,
+                        sec=round(s, 6),
+                        rows_per_sec=round(rows / s),
+                    ),
+                    args.out,
+                )
+            except Exception as e:
+                _emit(
+                    dict(
+                        base,
+                        bench="sort_operands",
+                        operands=("u64x1" if u64 else f"i32x{n_ops}"),
+                        rows=rows,
+                        error=str(e)[:200],
+                    ),
+                    args.out,
+                )
+
+    # dispatch/fetch round-trip floors (the q6 latency story, versioned)
+    try:
+        one_s, chain8_s = bench_tunnel_latency(max(args.iters, 5))
+        _emit(
+            dict(base, bench="tunnel_latency", metric="dispatch_plus_fetch",
+                 sec=round(one_s, 6)),
+            args.out,
+        )
+        _emit(
+            dict(base, bench="tunnel_latency", metric="chained8_plus_fetch",
+                 sec=round(chain8_s, 6)),
+            args.out,
+        )
+    except Exception as e:
+        _emit(dict(base, bench="tunnel_latency", error=str(e)[:200]), args.out)
 
 
 if __name__ == "__main__":
