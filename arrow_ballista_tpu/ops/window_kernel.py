@@ -199,12 +199,20 @@ def make_window_kernel(
         n = part_keys[0].shape[0]
         iota = jnp.arange(n, dtype=jnp.int32)
         all_keys = tuple(part_keys) + tuple(order_keys)
-        sorted_ = jax.lax.sort(
-            all_keys + (iota,), num_keys=len(all_keys)
-        )
-        perm = sorted_[-1]
-        s_part = sorted_[: len(part_keys)]
-        s_all = sorted_[:-1]
+        packed = K.packed_multikey_sort(all_keys, iota)
+        if packed is not None:
+            # pairwise-u64-packed operands: ~half the bytes per bitonic
+            # pass (the r05 chip capture's window sort never returned;
+            # see kernels.packed_multikey_sort)
+            perm, s_all = packed
+            s_part = s_all[: len(part_keys)]
+        else:
+            sorted_ = jax.lax.sort(
+                all_keys + (iota,), num_keys=len(all_keys)
+            )
+            perm = sorted_[-1]
+            s_part = sorted_[: len(part_keys)]
+            s_all = sorted_[:-1]
         # inverse permutation as a SORT (gather-friendly), not a scatter
         _, inv = jax.lax.sort_key_val(perm, iota)
 
