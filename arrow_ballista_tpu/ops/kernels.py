@@ -1732,10 +1732,20 @@ def keyed_sort_kernel(n_keys: int):
             sk = (k0,)
             diff = k0[1:] != k0[:-1]
         else:
-            sorted_ = jax.lax.sort((inv, *keys, iota), num_keys=1 + n_keys)
-            sk = sorted_[1:1 + n_keys]
-            perm = sorted_[-1]
-            valid = sorted_[0] == 0
+            packed2 = packed_multikey_sort((inv,) + tuple(keys), iota)
+            if packed2 is not None:
+                # multi-key form: pairwise-u64 words (see
+                # packed_multikey_sort) — 2 words vs 3-5 operands
+                perm, skeys = packed2
+                sk = skeys[1:]
+                valid = skeys[0] == 0
+            else:
+                sorted_ = jax.lax.sort(
+                    (inv, *keys, iota), num_keys=1 + n_keys
+                )
+                sk = sorted_[1:1 + n_keys]
+                perm = sorted_[-1]
+                valid = sorted_[0] == 0
             diff = sk[0][1:] != sk[0][:-1]
             for k in sk[1:]:
                 diff = jnp.logical_or(diff, k[1:] != k[:-1])
