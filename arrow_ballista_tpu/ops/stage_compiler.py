@@ -119,16 +119,22 @@ class _KeyedGroups:
 
 
 # High-cardinality routing: below either bound the gid-table device path
-# wins outright (measured q1 SF10: 38x).  Above both, the host group-id
-# encode used to dominate (q3 SF10: 44% of wall was key_encode) — the
-# keyed path moves that to the device sort; 'cpu' preserves the old
-# C++-hash-aggregate handoff for A/B.  'auto' resolves BY PLATFORM:
-# measured on the CPU platform (KERNELBENCH smoke, 1e5 rows: scatter
-# 166M rows/s vs keyed sort 2.6M; h2o G1_1e6 A/B: q10 9.9s keyed vs
-# 2.4s hash handoff), the sort-based keyed path loses ~4x there, so a
-# cpu backend routes groups~rows to the C++ hash aggregate; on an
-# accelerator (scatter serializes, host encode pays the tunnel) auto
-# stays keyed.  'device' pins keyed anywhere (tests, chip A/B).
+# wins outright (measured on chip, BENCH_r05_dev.json q1 SF10: 35-40x).
+# Above both, 'auto' routes to the C++ hash aggregate on EVERY platform
+# (join-free shapes) or stays on the gid table (fused joins, which pay
+# the join either way).  The measurements behind that:
+#   - chip (BENCH_SUITE_r05.json): q3 SF10 keyed = 0.036x — ~130s/iter
+#     of stream-wide device sort vs the hash aggregate's 14s; the r03
+#     gid/hash route ran the same query at 1.13x;
+#   - CPU platform (KERNELBENCH smoke, 1e5 rows: scatter 166M rows/s vs
+#     keyed sort 2.6M; h2o G1_1e6 A/B: q10 9.9s keyed vs 2.4s hash).
+# 'cpu' pins the hash handoff explicitly; 'device' pins the keyed path
+# (tests, chip A/B, and the r05 packed-sort rework whose chip numbers
+# are still pending — KERNELBENCH sort_operands will say whether the
+# 4.6-9x single-operand speedup moves the routing again).
+# The detector bounds themselves remain heuristic: the chip kernel grid
+# (capacity x rows x algo) is the tuning artifact for them once a
+# tunnel window allows a full capture.
 _HIGHCARD_MIN_GROUPS = 1 << 16
 _HIGHCARD_RATIO = 0.05
 # Build-key spans up to this many slots use the dense direct-probe join

@@ -207,15 +207,17 @@ def bench_q3_sf10() -> None:
     n = li.num_rows
 
     def make_ctx(tpu: bool):
-        ctx = SessionContext(
-            BallistaConfig(
-                {
-                    "ballista.tpu.enable": str(tpu).lower(),
-                    "ballista.batch.size": str(1 << 22),
-                    "ballista.shuffle.partitions": "1",
-                }
-            )
-        )
+        settings = {
+            "ballista.tpu.enable": str(tpu).lower(),
+            "ballista.batch.size": str(1 << 22),
+            "ballista.shuffle.partitions": "1",
+        }
+        # A/B hook (tpu leg only — the CPU oracle is mode-independent):
+        # 'device' pins the keyed path, 'gid'/'cpu' pin the alternatives
+        mode = os.environ.get("BENCH_HIGHCARD_MODE")
+        if tpu and mode:
+            settings["ballista.tpu.highcard_mode"] = mode
+        ctx = SessionContext(BallistaConfig(settings))
         ctx.register_table("lineitem", MemoryTable.from_table(li, 1))
         ctx.register_table("orders", MemoryTable.from_table(od, 1))
         ctx.register_table("customer", MemoryTable.from_table(cu, 1))
@@ -225,6 +227,7 @@ def bench_q3_sf10() -> None:
     _emit(
         {
             "metric": "tpch_q3_sf%g_tpu_rows_per_sec" % sf,
+            "highcard_mode": os.environ.get("BENCH_HIGHCARD_MODE", "auto"),
             "value": round(n / tpu_s),
             "unit": "rows/s",
             "vs_baseline": round(cpu_s / tpu_s, 3),

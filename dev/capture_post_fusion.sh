@@ -51,8 +51,12 @@ step "post-fusion starjoin (dense probe)" 3600 bash -c \
   'set -o pipefail; python bench_suite.py starjoin 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
 step "post-fusion full22 SF1 (parquet register)" 5400 bash -c \
   'set -o pipefail; python bench_suite.py full22 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
-step "post-fusion q3 (gid route + dense join)" 5400 bash -c \
+step "post-fusion q3 (auto route: cpu-join + device agg)" 5400 bash -c \
   'set -o pipefail; python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
+# keyed pinned: q3's keyed sort is single-key and now rides the packed
+# u64 form — this A/B says whether packing moved the 0.036x chip number
+step "A/B q3 keyed (packed sort)" 3600 bash -c \
+  'set -o pipefail; BENCH_HIGHCARD_MODE=device BENCH_Q3_SF=1 python bench_suite.py q3 2>&1 | tail -1 | tee -a AB_FUSION_r05.log'
 # window at reduced scale first: the full 2e7 config blocked the chip for
 # 55 min in the main capture — prove the device path at 2e6 before
 # risking the big shape again
