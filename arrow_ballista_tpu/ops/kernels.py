@@ -1661,8 +1661,12 @@ def packed_multikey_sort(keys: tuple, iota):
     n = iota.shape[0]
     if n >= (1 << 31) or any(k.dtype != jnp.int32 for k in keys):
         return None
-    bias = jnp.uint64(1 << 31)
-    fields = [k.astype(jnp.int64).astype(jnp.uint64) + bias for k in keys]
+    fields = [
+        # bias in SIGNED i64 first (no uint wraparound subtleties), then
+        # reinterpret: result is always in [0, 2^32)
+        (k.astype(jnp.int64) + jnp.int64(1 << 31)).astype(jnp.uint64)
+        for k in keys
+    ]
     fields.append(iota.astype(jnp.uint64))  # non-negative: bias-free
     if len(fields) % 2:
         # a constant low half never affects order
@@ -1713,7 +1717,7 @@ def keyed_sort_kernel(n_keys: int):
             # rows on the CPU backend — and every sort-based device
             # path was the r05 chip capture's loss center.
             biased = (
-                jnp.asarray(keys[0], jnp.int64) + jnp.int64(1 << 31)
+                keys[0].astype(jnp.int64) + jnp.int64(1 << 31)
             ).astype(jnp.uint64)
             packed = (
                 (inv.astype(jnp.uint64) << jnp.uint64(63))
@@ -1730,7 +1734,6 @@ def keyed_sort_kernel(n_keys: int):
             ).astype(jnp.int32)
             valid = (sp >> jnp.uint64(63)) == jnp.uint64(0)
             sk = (k0,)
-            diff = k0[1:] != k0[:-1]
         else:
             packed2 = packed_multikey_sort((inv,) + tuple(keys), iota)
             if packed2 is not None:
@@ -1746,9 +1749,9 @@ def keyed_sort_kernel(n_keys: int):
                 sk = sorted_[1:1 + n_keys]
                 perm = sorted_[-1]
                 valid = sorted_[0] == 0
-            diff = sk[0][1:] != sk[0][:-1]
-            for k in sk[1:]:
-                diff = jnp.logical_or(diff, k[1:] != k[:-1])
+        diff = sk[0][1:] != sk[0][:-1]
+        for k in sk[1:]:
+            diff = jnp.logical_or(diff, k[1:] != k[:-1])
         first = jnp.concatenate([jnp.ones((1,), jnp.bool_), diff])
         flag = jnp.logical_and(first, valid)
         gid = jnp.cumsum(flag.astype(jnp.int32)) - 1

@@ -148,6 +148,12 @@ _DENSE_JOIN_SPAN_CAP = 1 << 26
 _FUSED_MAX_ENTRIES = 32
 
 
+def _keep_bucket(n_groups: int) -> int:
+    """Pow2 bucket of assigned-group slots a packed fetch moves (shared
+    by the streamed and fused fetch paths so their trace keys agree)."""
+    return 1 << max(6, (max(n_groups, 1) - 1).bit_length())
+
+
 def keyed_route_wanted(config) -> bool:
     """Does groups~rows route to the device-KEYED path in this config
     on this platform?  (See the routing comment above.)
@@ -1245,6 +1251,9 @@ class TpuStageExec(ExecutionPlan):
         group_table = GroupTable(max(self._n_encoded_groups, 1))
         entries = []
 
+        import jax
+        import jax.numpy as jnp
+
         acc = None
         n_rows_in = 0
         cap = self.capacity
@@ -1355,9 +1364,6 @@ class TpuStageExec(ExecutionPlan):
                         batch, n, n_pad, build
                     )
                 with self.metrics.timer("device_time_ns"):
-                    import jax
-                    import jax.numpy as jnp
-
                     # device-built row tail mask, shared by the global
                     # valid slot and every all-true leaf companion: two
                     # eager ops replace n_pad*(1+n_trivial) host→HBM
@@ -1566,6 +1572,8 @@ class TpuStageExec(ExecutionPlan):
             buf = []
             buffered = 0
 
+        import jax.numpy as jnp
+
         def feed(batch, codes):
             nonlocal buffered
             n = batch.num_rows
@@ -1578,8 +1586,6 @@ class TpuStageExec(ExecutionPlan):
                     batch, n, n_pad, build
                 )
             with self.metrics.timer("device_time_ns"):
-                import jax.numpy as jnp
-
                 # device-built tail mask replaces the host validity ship,
                 # shared with every all-true leaf companion (see the
                 # gid-path device section)
@@ -1818,9 +1824,7 @@ class TpuStageExec(ExecutionPlan):
         bytes at high cardinality)."""
         if acc is None:
             return None
-        keep = None
-        if n_groups is not None:
-            keep = 1 << max(6, (max(n_groups, 1) - 1).bit_length())
+        keep = None if n_groups is None else _keep_bucket(n_groups)
         packed = K.pack_for_fetch(self.specs, acc, self._mode, keep=keep)
         return K.unpack_host(self.specs, np.asarray(packed), self._mode)
 
@@ -1852,9 +1856,7 @@ class TpuStageExec(ExecutionPlan):
                 out = kernel(seg, valid, *args)
                 acc = K.combine_states(self.specs, acc, out, self._mode)
             return self._fetch_states(acc, n_groups)
-        keep = None
-        if n_groups is not None:
-            keep = 1 << max(6, (max(n_groups, 1) - 1).bit_length())
+        keep = None if n_groups is None else _keep_bucket(n_groups)
         shapes = tuple(int(e[1].shape[0]) for e in entries)
         n_args = len(entries[0][2])
         fn = self._fused_for(cap, shapes, n_args, keep)

@@ -10,6 +10,10 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+# persistent XLA compile cache: each step is a fresh process, and chip
+# windows are scarce — don't spend them recompiling identical kernels
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+
 fails=0
 step() {
   local name="$1" t="$2"
