@@ -1,0 +1,111 @@
+"""Turn KERNELBENCH grid rows into routing-threshold recommendations.
+
+The r04/r05 verdict discipline: routing constants must cite a measured
+artifact, not a guess.  This reads one or more KERNELBENCH_*.json files
+and prints, per platform found in the rows:
+
+* the matmul->sort capacity crossover per row count (tunes
+  kernels._MATMUL_MAX_CAP / _MATMUL_MAX_ELEMS);
+* the scatter/sort/keyed winner per (rows, capacity) cell (tunes
+  segment_algo and the highcard route);
+* sort cost vs operand count + the packed-u64 ratio (validates the
+  packed-sort rework);
+* dispatch/fetch latency floors (the q6 economics).
+
+Usage: python dev/analyze_grid.py KERNELBENCH_r05.json [more.json ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+
+def load(paths):
+    rows = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def main() -> None:
+    paths = sys.argv[1:] or ["KERNELBENCH_r05.json"]
+    rows = load(paths)
+    by_platform = defaultdict(list)
+    for r in rows:
+        by_platform[r.get("device_platform", "?")].append(r)
+
+    for platform, rs in by_platform.items():
+        print(f"\n=== platform: {platform} "
+              f"({'FALLBACK — not chip data' if any('error' in r for r in rs) else 'clean'}) ===")
+
+        cells = defaultdict(dict)  # (rows, cap) -> algo -> rows/s
+        for r in rs:
+            if r.get("bench") == "segment_reduce" and "rows_per_sec" in r:
+                cells[(r["rows"], r["capacity"])][r["algo"]] = r["rows_per_sec"]
+
+        if cells:
+            print("segment_reduce winner per (rows, capacity):")
+            crossover = {}
+            for (n, cap), algos in sorted(cells.items()):
+                win = max(algos, key=algos.get)
+                line = "  ".join(
+                    f"{a}={v / 1e6:.1f}M" for a, v in sorted(algos.items())
+                )
+                print(f"  rows={n:>9} cap={cap:>8}: winner={win:<8} {line}")
+                if "matmul" in algos and "sort" in algos:
+                    better = algos["matmul"] > algos["sort"]
+                    cur = crossover.get(n)
+                    if better and (cur is None or cap > cur):
+                        crossover[n] = cap
+            for n, cap in sorted(crossover.items()):
+                print(f"  -> matmul still wins at cap={cap} for rows={n}: "
+                      f"set _MATMUL_MAX_CAP >= {cap} "
+                      f"(_MATMUL_MAX_ELEMS >= {n * cap:.0e})")
+
+        sorts = [r for r in rs if r.get("bench") == "sort_operands"
+                 and "rows_per_sec" in r]
+        if sorts:
+            print("sort cost vs operands:")
+            base = {}
+            for r in sorted(sorts, key=lambda r: (r["rows"], r["operands"])):
+                key = (r["rows"], "u64x1")
+                if r["operands"] == "u64x1":
+                    base[r["rows"]] = r["rows_per_sec"]
+            for r in sorted(sorts, key=lambda r: (r["rows"], r["operands"])):
+                rel = (
+                    f"  ({base[r['rows']] / r['rows_per_sec']:.1f}x slower "
+                    f"than u64x1)" if r["operands"] != "u64x1"
+                    and r["rows"] in base else ""
+                )
+                print(f"  rows={r['rows']:>9} {r['operands']:>6}: "
+                      f"{r['rows_per_sec'] / 1e6:6.1f}M rows/s{rel}")
+
+        lat = [r for r in rs if r.get("bench") == "tunnel_latency"
+               and "sec" in r]
+        for r in lat:
+            print(f"latency {r['metric']}: {r['sec'] * 1000:.2f} ms")
+        if lat:
+            one = next((r["sec"] for r in lat
+                        if r["metric"] == "dispatch_plus_fetch"), None)
+            if one:
+                print(f"  -> per-query floor ~{one * 1000:.0f} ms: a query "
+                      f"must beat the CPU by more than this to win; the "
+                      f"fused runner exists to pay it exactly once")
+
+        enc = [r for r in rs if r.get("bench") == "host_encode"
+               and "rows_per_sec" in r]
+        if enc:
+            print("host encode:")
+            for r in sorted(enc, key=lambda r: (r["rows"], r["algo"])):
+                print(f"  rows={r['rows']:>9} {r['algo']:>12}: "
+                      f"{r['rows_per_sec'] / 1e6:6.1f}M rows/s")
+
+
+if __name__ == "__main__":
+    main()
