@@ -245,6 +245,25 @@ class SessionContext:
         if isinstance(stmt, ast.Explain):
             builder = PlanBuilder(self.catalog, self.udfs)
             df = DataFrame(self, builder.build_query(stmt.query))
+            if stmt.analyze:
+                # EXPLAIN ANALYZE (reference: DataFusion's analyze plan):
+                # execute the physical plan, then render it annotated
+                # with every operator's runtime metrics
+                import time as _time
+
+                phys = df.physical_plan()
+                t0 = _time.perf_counter()
+                self.execute(phys)
+                elapsed = _time.perf_counter() - t0
+                text = (
+                    phys.display(with_metrics=True)
+                    + f"\nelapsed: {elapsed:.6f}s"
+                )
+                return self._values_df(
+                    pa.table(
+                        {"plan_type": ["explain analyze"], "plan": [text]}
+                    )
+                )
             text = df.explain()
             return self._values_df(
                 pa.table({"plan_type": ["explain"], "plan": [text]})

@@ -258,6 +258,7 @@ class SetVariable:
 class Explain:
     query: Query
     verbose: bool = False
+    analyze: bool = False  # EXPLAIN ANALYZE: execute + runtime metrics
 
 
 @dataclass

@@ -103,7 +103,8 @@ class Parser:
         if self.at_kw("EXPLAIN"):
             self.next()
             verbose = self.eat_kw("VERBOSE")
-            return ast.Explain(self.parse_query(), verbose)
+            analyze = self.eat_kw("ANALYZE")
+            return ast.Explain(self.parse_query(), verbose, analyze)
         if self.at_kw("DROP"):
             self.next()
             self.expect_kw("TABLE")

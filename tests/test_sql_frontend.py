@@ -111,3 +111,34 @@ def test_builder_unknown_column_errors(tpch_ctx):
 
     with pytest.raises(PlanError):
         tpch_ctx.sql("select nope from lineitem").collect()
+
+
+def test_explain_analyze_annotates_runtime_metrics():
+    """EXPLAIN ANALYZE executes the plan and renders per-operator
+    runtime metrics (reference: DataFusion's analyze plan)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import BallistaConfig, SessionContext
+    from arrow_ballista_tpu.catalog import MemoryTable
+
+    ctx = SessionContext(BallistaConfig({
+        "ballista.tpu.enable": "true",
+        "ballista.tpu.min_rows": "0",
+    }))
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 5, 4000), pa.int64()),
+        "v": pa.array(rng.uniform(0, 1, 4000)),
+    })
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    out = ctx.sql(
+        "explain analyze select k, sum(v) from t group by k"
+    ).collect()
+    assert out.column("plan_type").to_pylist() == ["explain analyze"]
+    text = out.column("plan").to_pylist()[0]
+    assert "metrics=" in text and "elapsed:" in text
+    assert "output_rows" in text
+    # plain EXPLAIN must stay metric-free and not execute
+    plain = ctx.sql("explain select k from t").collect()
+    assert "metrics=" not in plain.column("plan").to_pylist()[0]
