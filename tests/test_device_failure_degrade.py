@@ -1,0 +1,124 @@
+"""Device/compiler failures must degrade, never kill a query.
+
+BENCH_SUITE_r05 h2o: the mesh gang's shard_map compile got its
+tpu_compile_helper SIGKILLed and the uncaught JaxRuntimeError destroyed
+the whole run.  These tests inject JaxRuntimeError into the device
+stage and the mesh gang and assert the query still returns the CPU
+oracle's answer, with the fallback recorded in metrics — while
+non-jax RuntimeErrors (genuine bugs) still propagate.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops import stage_compiler as SC
+
+
+def _table(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 6, n), pa.int64()),
+        "v": pa.array(rng.uniform(-10, 10, n)),
+    })
+
+
+def _ctx(tpu=True, **extra):
+    s = {
+        "ballista.tpu.enable": str(tpu).lower(),
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.partitions": "1",
+    }
+    s.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(s))
+
+
+SQL = "select k, sum(v), count(*) from t group by k"
+
+
+def _metrics(plan):
+    agg = {}
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, SC.TpuStageExec):
+            for k, v in n.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(n.children())
+    return agg
+
+
+def _oracle(t):
+    c = _ctx(False)
+    c.register_table("t", MemoryTable.from_table(t, 1))
+    return c.sql(SQL).collect().sort_by([("k", "ascending")])
+
+
+def test_stage_jax_runtime_error_degrades_to_cpu(monkeypatch):
+    t = _table()
+    want = _oracle(t)
+
+    def boom(self, entries, cap, group_table):
+        raise SC._JaxRuntimeError("INTERNAL: tpu_compile_helper SIGKILL")
+
+    monkeypatch.setattr(SC.TpuStageExec, "_run_fused", boom)
+    ctx = _ctx(True)
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    plan = ctx.sql(SQL).physical_plan()
+    got = ctx.execute(plan).sort_by([("k", "ascending")])
+    assert got.equals(want)
+    assert _metrics(plan).get("tpu_fallback", 0) >= 1
+
+
+def test_stage_plain_runtime_error_propagates(monkeypatch):
+    # a non-jax RuntimeError is a genuine bug: it must NOT silently
+    # become a fallback
+    t = _table()
+
+    def boom(self, entries, cap, group_table):
+        raise RuntimeError("logic bug, not a device failure")
+
+    monkeypatch.setattr(SC.TpuStageExec, "_run_fused", boom)
+    ctx = _ctx(True)
+    ctx.register_table("t", MemoryTable.from_table(t, 1))
+    with pytest.raises(RuntimeError, match="logic bug"):
+        ctx.sql(SQL).collect()
+
+
+def test_mesh_gang_jax_runtime_error_degrades(monkeypatch):
+    from arrow_ballista_tpu.parallel import mesh_stage as MS
+
+    t = _table(n=60000, seed=1)
+    want = _oracle(t)
+
+    def boom(self, inner, ctx):
+        raise SC._JaxRuntimeError("INTERNAL: remote_compile HTTP 500")
+        yield  # pragma: no cover - generator shape
+
+    monkeypatch.setattr(MS.MeshGangExec, "_execute_mesh", boom)
+    ctx = _ctx(True, **{"ballista.mesh.enable": "true",
+                        "ballista.shuffle.partitions": "2"})
+    ctx.register_table("t", MemoryTable.from_table(t, 2))
+    plan = ctx.sql(SQL).physical_plan()
+    gangs = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, MS.MeshGangExec):
+            gangs.append(n)
+        stack.extend(n.children())
+    assert gangs, "plan did not gang-wrap the partial aggregate"
+    got = ctx.execute(plan).sort_by([("k", "ascending")])
+    # sequential fallback sums in a different order: approx floats
+    assert got.column("k").to_pylist() == want.column("k").to_pylist()
+    assert got.column("count(*)").to_pylist() == (
+        want.column("count(*)").to_pylist()
+    )
+    for x, y in zip(got.column("sum(v)").to_pylist(),
+                    want.column("sum(v)").to_pylist()):
+        assert y == pytest.approx(x, rel=1e-9)
+    assert sum(
+        g.metrics.values.get("mesh_fallback", 0) for g in gangs
+    ) >= 1
