@@ -1,0 +1,84 @@
+"""Randomized window-function sweep: device path vs the CPU operator.
+
+The device window kernel now rides the packed-u64 multikey sort; this
+sweep drives random combinations of window functions, partition key
+cardinalities, order-key distributions (ties included), nulls, and ROWS
+frames through SQL on both paths and requires equal results.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+
+FNS = [
+    "row_number() over (partition by g order by o)",
+    "rank() over (partition by g order by o)",
+    "dense_rank() over (partition by g order by o)",
+    "sum(v) over (partition by g order by o)",
+    "avg(v) over (partition by g order by o)",
+    "count(v) over (partition by g order by o)",
+    "min(v) over (partition by g order by o)",
+    "max(v) over (partition by g order by o)",
+    "lag(v) over (partition by g order by o)",
+    "lead(v) over (partition by g order by o)",
+    "first_value(v) over (partition by g order by o)",
+    "sum(v) over (partition by g order by o "
+    "rows between 3 preceding and current row)",
+    "max(v) over (partition by g order by o "
+    "rows between 2 preceding and 1 following)",
+]
+
+
+def _ctx(tpu: bool) -> SessionContext:
+    return SessionContext(BallistaConfig({
+        "ballista.tpu.enable": str(tpu).lower(),
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.partitions": "1",
+    }))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_window_sweep(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(500, 4000))
+    n_parts = int(rng.choice([1, 3, 40, n // 3 + 1]))
+    # order keys WITH ties so peer semantics (rank vs row_number) differ
+    o_card = int(rng.choice([max(4, n // 10), n * 10]))
+    vals = rng.uniform(-100, 100, n)
+    if rng.uniform() < 0.5:
+        vals = np.where(rng.uniform(size=n) < 0.1, np.nan, vals)
+    v = pa.array([None if np.isnan(x) else float(x) for x in vals],
+                 pa.float64())
+    t = pa.table({
+        "g": pa.array(rng.integers(0, n_parts, n), pa.int64()),
+        "o": pa.array(rng.integers(0, o_card, n), pa.int64()),
+        "v": v,
+    })
+    picks = list(rng.choice(len(FNS), size=3, replace=False))
+    sel = ", ".join(f"{FNS[i]} w{j}" for j, i in enumerate(picks))
+    sql = f"select g, o, v, {sel} from t"
+    res = {}
+    for tpu in (False, True):
+        c = _ctx(tpu)
+        c.register_table("t", MemoryTable.from_table(t, 1))
+        res[tpu] = c.sql(sql).collect()
+    a, b = res[False], res[True]
+    assert a.num_rows == b.num_rows == n
+    # align rows on (g, o, v) — ties among full peers make per-row
+    # comparison of rank-like outputs stable only when the window fns
+    # themselves are deterministic per peer group, which rank/dense_rank
+    # sum/min/max/count are; row_number/lag/lead within EXACT ties can
+    # legitimately differ, so sort including the outputs
+    keys = [(c0, "ascending") for c0 in a.column_names]
+    a, b = a.sort_by(keys), b.sort_by(keys)
+    for col in a.column_names:
+        av, bv = a.column(col).to_pylist(), b.column(col).to_pylist()
+        for x, y in zip(av, bv):
+            if isinstance(x, float) and x is not None and y is not None:
+                assert y == pytest.approx(x, rel=1e-6, abs=1e-9), (
+                    seed, col, x, y)
+            else:
+                assert x == y, (seed, col, x, y)
