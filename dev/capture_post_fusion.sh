@@ -69,6 +69,11 @@ step "post-fusion window 2e6" 1800 bash -c \
 step "kernel microbench grid" 5400 \
   python benchmarks/kernels.py --iters 3 --host-encode --out KERNELBENCH_r05.json
 
+# LAST (longest, and the crash-fixed path): BASELINE config #5 has no
+# chip row at all — highcard questions take the C++ hash handoff, the
+# low-card gang now degrades instead of dying on a compile-helper loss
+step "post-fusion h2o G1_1e8" 7200 python bench_suite.py h2o
+
 if [ "$fails" -gt 0 ]; then
   echo "== post-fusion capture FINISHED WITH $fails FAILED STEP(S) =="
   exit 1
