@@ -38,6 +38,11 @@ MESH_ENABLE = "ballista.mesh.enable"
 MESH_DEVICES = "ballista.mesh.devices"
 MESH_EXCHANGE_MAX_ROWS = "ballista.mesh.exchange_max_rows"
 SHUFFLE_TO_MEMORY = "ballista.shuffle.to_memory"
+SHUFFLE_FETCH_CONCURRENCY = "ballista.shuffle.fetch_concurrency"
+SHUFFLE_PREFETCH_BYTES = "ballista.shuffle.prefetch_bytes"
+SHUFFLE_FETCH_RETRIES = "ballista.shuffle.fetch_retries"
+SHUFFLE_FETCH_BACKOFF_MS = "ballista.shuffle.fetch_backoff_ms"
+SHUFFLE_COALESCE_ROWS = "ballista.shuffle.coalesce_rows"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -206,6 +211,45 @@ _ENTRIES: dict[str, ConfigEntry] = {
             _parse_bool,
             "false",
         ),
+        ConfigEntry(
+            SHUFFLE_FETCH_CONCURRENCY,
+            "map-side locations each shuffle reader fetches concurrently "
+            "(local file, memory store and Flight sources alike); 1 runs a "
+            "single fetch worker that walks locations in order",
+            int,
+            "8",
+        ),
+        ConfigEntry(
+            SHUFFLE_PREFETCH_BYTES,
+            "byte budget of fetched-but-unconsumed shuffle batches per "
+            "reader partition; fetch workers block (backpressure) once the "
+            "queue holds this much",
+            int,
+            str(64 << 20),
+        ),
+        ConfigEntry(
+            SHUFFLE_FETCH_RETRIES,
+            "per-location fetch retries before the stage fails; each failed "
+            "attempt drops the cached Flight connection so the retry "
+            "reconnects",
+            int,
+            "3",
+        ),
+        ConfigEntry(
+            SHUFFLE_FETCH_BACKOFF_MS,
+            "base backoff between fetch retries (doubles per attempt)",
+            int,
+            "50",
+        ),
+        ConfigEntry(
+            SHUFFLE_COALESCE_ROWS,
+            "target row count for host-side coalescing of fetched shuffle "
+            "batches before device transfer (small map fragments combine "
+            "into one device dispatch); 0 follows ballista.batch.size, "
+            "negative disables coalescing",
+            int,
+            "0",
+        ),
     ]
 }
 
@@ -314,6 +358,26 @@ class BallistaConfig:
     @property
     def shuffle_to_memory(self) -> bool:
         return self._get(SHUFFLE_TO_MEMORY)
+
+    @property
+    def shuffle_fetch_concurrency(self) -> int:
+        return self._get(SHUFFLE_FETCH_CONCURRENCY)
+
+    @property
+    def shuffle_prefetch_bytes(self) -> int:
+        return self._get(SHUFFLE_PREFETCH_BYTES)
+
+    @property
+    def shuffle_fetch_retries(self) -> int:
+        return self._get(SHUFFLE_FETCH_RETRIES)
+
+    @property
+    def shuffle_fetch_backoff_ms(self) -> int:
+        return self._get(SHUFFLE_FETCH_BACKOFF_MS)
+
+    @property
+    def shuffle_coalesce_rows(self) -> int:
+        return self._get(SHUFFLE_COALESCE_ROWS)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -52,6 +52,12 @@ class PollLoop:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # abort THIS executor's in-flight shuffle fetch pipelines (scoped
+        # by work_dir): a fetch worker blocked on a dead peer would
+        # otherwise pin its task thread past shutdown
+        from ..shuffle.fetcher import shutdown_active_fetchers
+
+        shutdown_active_fetchers(owner=self.executor.work_dir)
         if self._thread is not None:
             self._thread.join(timeout)
 

@@ -189,6 +189,11 @@ class ExecutorServer:
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self.heartbeater.stop()
+        # abort this executor's in-flight shuffle fetch pipelines (the
+        # push-mode analogue of PollLoop.stop's cleanup)
+        from ..shuffle.fetcher import shutdown_active_fetchers
+
+        shutdown_active_fetchers(owner=self.executor.work_dir)
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1)
 

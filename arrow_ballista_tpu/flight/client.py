@@ -50,6 +50,27 @@ class BallistaClient:
                     pass
             cls._cache.clear()
 
+    @classmethod
+    def invalidate(
+        cls, host: str, port: int, instance: "BallistaClient" = None
+    ) -> None:
+        """Drop the cached connection for one endpoint.
+
+        Called on every FlightError so a retry reconnects instead of
+        reusing a dead channel.  With ``instance`` given, the entry is
+        only dropped while it still IS that instance — a worker erroring
+        on an old dead channel must not evict the healthy replacement a
+        faster worker already cached.  The old object is NOT closed here:
+        concurrent fetch workers may still be streaming healthy
+        partitions over it (closing would burn their retry budgets on a
+        self-inflicted teardown); it drains and is collected when the
+        last holder drops it.
+        """
+        with cls._lock:
+            c = cls._cache.get((host, port))
+            if c is not None and (instance is None or c is instance):
+                del cls._cache[(host, port)]
+
     def fetch_partition(
         self, job_id: str, stage_id: int, partition_id: int, path: str
     ) -> Iterator[pa.RecordBatch]:
@@ -74,6 +95,7 @@ class BallistaClient:
             reader = self._client.do_get(ticket)
             schema = reader.schema
         except flight.FlightError as e:
+            type(self).invalidate(self.host, self.port, self)
             raise ExecutionError(
                 f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
                 f"{self.host}:{self.port} failed: {e}"
@@ -84,6 +106,7 @@ class BallistaClient:
                 for chunk in reader:
                     yield chunk.data
             except flight.FlightError as e:
+                type(self).invalidate(self.host, self.port, self)
                 raise ExecutionError(
                     f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
                     f"{self.host}:{self.port} failed: {e}"

@@ -48,11 +48,29 @@ class ShuffleFlightService(flight.FlightServerBase):
             raise flight.FlightServerError(f"path {path!r} outside work dir")
         if not os.path.exists(path):
             raise flight.FlightServerError(f"no such partition file {path!r}")
-        reader = pa.ipc.open_file(path)
+        # memory-map so served batches are zero-copy views of the page
+        # cache (Zerrow property: the Arrow data plane never copies on the
+        # serving side); OSFile fallback for filesystems without mmap
+        try:
+            source = pa.memory_map(path, "rb")
+        except Exception:
+            source = pa.OSFile(path, "rb")
+        try:
+            reader = pa.ipc.open_file(source)
+        except Exception as e:
+            # truncated/corrupt partition file: close the handle before
+            # raising, or every reduce-side retry leaks an mmap/fd here
+            source.close()
+            raise flight.FlightServerError(
+                f"unreadable partition file {path!r}: {e}"
+            )
 
         def gen():
-            for i in range(reader.num_record_batches):
-                yield reader.get_batch(i)
+            try:
+                for i in range(reader.num_record_batches):
+                    yield reader.get_batch(i)
+            finally:
+                source.close()
 
         return flight.GeneratorStream(reader.schema, gen())
 

@@ -264,3 +264,58 @@ def make_key_encoder(t: pa.DataType):
         return IdentityKeyEncoder()
     return DictEncoder()
 
+
+def coalesce_batches(source, target_rows: int, metrics=None):
+    """Host-side batch coalescer feeding the device bridge.
+
+    Shuffle readers yield one fragment per map task — with 16 map tasks an
+    8192-row batch arrives as ~512-row slivers, and each sliver would pay
+    a full key-encode + host→HBM dispatch.  Combine consecutive fragments
+    up to ``target_rows`` before they cross the bridge; batches already at
+    or above the target pass through untouched (no re-copy of big data).
+    Row content and order of the combined stream are unchanged.
+    """
+    buf: list[pa.RecordBatch] = []
+    rows = 0
+    for b in source:
+        if b.num_rows == 0:
+            continue
+        if b.num_rows >= target_rows:
+            # big batch: flush pending fragments, then pass it through
+            # untouched — never fold big data into a concat just to
+            # prepend a sliver
+            if buf:
+                if metrics is not None:
+                    metrics.add("coalesced_source_batches", len(buf))
+                yield _concat_batches(buf)
+                buf, rows = [], 0
+            yield b
+            continue
+        if buf and rows + b.num_rows > target_rows:
+            # flush BEFORE appending: an emitted batch never exceeds the
+            # target, or it would land in a larger device padding bucket
+            # than batch_size and trigger a fresh XLA compile
+            if metrics is not None:
+                metrics.add("coalesced_source_batches", len(buf))
+            yield _concat_batches(buf)
+            buf, rows = [], 0
+        buf.append(b)
+        rows += b.num_rows
+        if rows >= target_rows:
+            if metrics is not None:
+                metrics.add("coalesced_source_batches", len(buf))
+            yield _concat_batches(buf)
+            buf, rows = [], 0
+    if buf:
+        if metrics is not None:
+            metrics.add("coalesced_source_batches", len(buf))
+        yield _concat_batches(buf)
+
+
+def _concat_batches(parts: list) -> pa.RecordBatch:
+    if len(parts) == 1:
+        return parts[0]
+    tbl = pa.Table.from_batches(parts).combine_chunks()
+    batches = tbl.to_batches()
+    return batches[0] if batches else parts[0]
+

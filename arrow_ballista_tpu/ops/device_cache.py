@@ -27,6 +27,16 @@ _total_bytes = 0
 _budget = DEFAULT_BUDGET_BYTES
 
 
+def staging_bytes() -> int:
+    """Bytes sitting in shuffle prefetch queues (fetched but not yet
+    consumed / transferred).  Tracked jax-free in ``shuffle.fetcher``;
+    surfaced here so stats() shows BOTH memory pressures of the data
+    plane — pinned HBM and in-flight host staging — in one place."""
+    from ..shuffle.fetcher import staging_bytes as _fetch_staging
+
+    return _fetch_staging()
+
+
 def set_budget(n_bytes: int) -> None:
     global _budget
     _budget = n_bytes
@@ -97,4 +107,9 @@ def clear() -> None:
 
 
 def stats() -> dict:
-    return {"entries": len(_CACHE), "bytes": _total_bytes, "budget": _budget}
+    return {
+        "entries": len(_CACHE),
+        "bytes": _total_bytes,
+        "budget": _budget,
+        "staging_bytes": staging_bytes(),
+    }

@@ -270,6 +270,26 @@ class _ReadAhead:
             self._thread.join(0.05)
 
 
+def _shuffle_coalesce_rows(config) -> int:
+    """Resolved host-coalescing target for shuffle-fed device stages:
+    ``ballista.shuffle.coalesce_rows`` (0 → follow ``ballista.batch.size``,
+    negative → disabled)."""
+    n = config.shuffle_coalesce_rows
+    if n < 0:
+        return 0
+    return n or config.batch_size
+
+
+def _reads_shuffle(plan) -> bool:
+    """Does this stage source pull from a shuffle reader (whose batches
+    arrive as per-map-task fragments worth coalescing)?"""
+    from ..shuffle.execution_plans import ShuffleReaderExec
+
+    if isinstance(plan, ShuffleReaderExec):
+        return True
+    return any(_reads_shuffle(c) for c in plan.children())
+
+
 @contextlib.contextmanager
 def _closing_on_error(ra: Optional[_ReadAhead]):
     """Stop the prefetch pump when the device stage aborts into a CPU
@@ -1213,6 +1233,15 @@ class TpuStageExec(ExecutionPlan):
                 return
 
         src = fused.source.execute(partition, ctx)
+        coalesce = _shuffle_coalesce_rows(self.config)
+        if coalesce > 0 and _reads_shuffle(fused.source):
+            # shuffle readers yield one fragment per map task; combine
+            # them to the target batch size on host so each device
+            # dispatch moves a full batch (fetch + coalesce then overlap
+            # device compute through the _ReadAhead pump below)
+            from .bridge import coalesce_batches
+
+            src = coalesce_batches(src, coalesce, self.metrics)
         min_rows = self.config.tpu_min_rows
         if min_rows > 0:
             # peek: kernel-launch/compile latency dominates tiny inputs, so

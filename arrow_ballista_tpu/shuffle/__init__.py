@@ -5,11 +5,15 @@ from .execution_plans import (
     UnresolvedShuffleExec,
     partition_indices,
 )
+from .fetcher import FetchPolicy, ShuffleFetcher, fetch_location
 
 __all__ = [
+    "FetchPolicy",
+    "ShuffleFetcher",
     "ShuffleReaderExec",
     "ShuffleWriterExec",
     "UnresolvedShuffleExec",
     "WRITE_STATS_SCHEMA",
+    "fetch_location",
     "partition_indices",
 ]

@@ -21,7 +21,7 @@ implementation; both produce identical assignments by construction.
 from __future__ import annotations
 
 import os
-from typing import Iterator, Optional
+from typing import TYPE_CHECKING, Iterator, Optional
 
 import pyarrow as pa
 
@@ -33,7 +33,12 @@ from ..exec.operators import (
     TaskContext,
     hash_partition_indices,
 )
-from ..serde.scheduler_types import PartitionLocation, ShuffleWritePartition
+
+if TYPE_CHECKING:  # runtime import is lazy: serde.physical_plan imports
+    # THIS module back, and an eager import here made the package cycle
+    # unenterable from the shuffle side (ImportError when
+    # arrow_ballista_tpu.shuffle was the first package imported)
+    from ..serde.scheduler_types import PartitionLocation, ShuffleWritePartition
 
 try:  # native partitioner (C++); optional
     from ..native import native_hash_partition_indices
@@ -184,6 +189,8 @@ class ShuffleWriterExec(ExecutionPlan):
     ) -> list[ShuffleWritePartition]:
         """Run the stage subplan for ``input_partition`` and persist its
         output (reference: shuffle_writer.rs:142-292)."""
+        from ..serde.scheduler_types import ShuffleWritePartition
+
         stage_dir = os.path.join(self.work_dir, self.job_id, str(self.stage_id))
         part = self.shuffle_output_partitioning
         to_mem = self._use_memory(ctx)
@@ -271,6 +278,8 @@ class ShuffleWriterExec(ExecutionPlan):
     ) -> list[ShuffleWritePartition]:
         """Close every partition sink (creating empty ones so readers need
         no existence probe) and assemble the write stats."""
+        from ..serde.scheduler_types import ShuffleWritePartition
+
         out = []
         with self.metrics.timer("write_time_ns"):
             for p in range(len(sinks)):
@@ -372,39 +381,31 @@ class ShuffleReaderExec(ExecutionPlan):
         return Partitioning.unknown(len(self.partition))
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        """Stream the merged batches of every map-side location.
+
+        EVERY read routes through :class:`ShuffleFetcher` — with
+        ``fetch_concurrency=1`` (or a single location) it runs one worker
+        that walks locations in order, so "sequential" keeps the same
+        retry/backoff, streaming memory profile, cancel wake-up and
+        shutdown-abort registration as the pipelined path instead of
+        being a second, less robust code path."""
+        from .fetcher import FetchPolicy, ShuffleFetcher
+
         locations = self.partition[partition]
-        for loc in locations:
-            with self.metrics.timer("fetch_time_ns"):
-                batches = list(self._fetch(loc))
-            for b in batches:
-                self.metrics.add("output_rows", b.num_rows)
-                yield b
-
-    def _fetch(self, loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
-        from . import memory_store
-
-        if loc.path and loc.path.startswith(memory_store.SCHEME):
-            # memory data plane: same-process fast path, Flight otherwise
-            hit = memory_store.get(loc.path)
-            if hit is not None:
-                yield from hit[1]
-                return
-        # local fast path: the file is on this machine's filesystem
-        elif loc.path and os.path.exists(loc.path):
-            with pa.OSFile(loc.path, "rb") as f:
-                reader = pa.ipc.open_file(f)
-                for i in range(reader.num_record_batches):
-                    yield reader.get_batch(i)
+        if not locations:
             return
-        from ..flight.client import BallistaClient
-
-        client = BallistaClient.get(loc.executor_meta.host, loc.executor_meta.flight_port)
-        yield from client.fetch_partition(
-            loc.partition_id.job_id,
-            loc.partition_id.stage_id,
-            loc.partition_id.partition_id,
-            loc.path,
+        policy = FetchPolicy.from_config(ctx.config)
+        fetcher = ShuffleFetcher(
+            locations,
+            policy,
+            self.metrics,
+            cancel_event=ctx.cancel_event,
+            owner=ctx.work_dir,
         )
+        for b in fetcher:
+            ctx.check_cancelled()
+            self.metrics.add("output_rows", b.num_rows)
+            yield b
 
     def with_new_children(self, children):
         assert not children

@@ -1,0 +1,466 @@
+"""Concurrent pipelined shuffle fetch.
+
+The reduce side of every multi-stage query reads N map-side
+``PartitionLocation``s.  The original ``ShuffleReaderExec`` walked them one
+at a time and fully materialized each location before yielding — a 64-map
+stage paid 64 serial round trips with the device idle during every one.
+This module rebuilds that data plane as a pipeline (PAPERS.md
+"Benchmarking Apache Arrow Flight": wire speed needs multiple concurrent
+DoGet streams):
+
+* a per-reader pool of daemon threads fans out over the locations,
+  claiming them from a shared cursor — local-file, memory-store and
+  Flight sources stream through the same :func:`fetch_location` path;
+* batches flow into a :class:`_PrefetchQueue` bounded by BYTES (not batch
+  count — map fragments vary from KBs to tens of MBs), so a fast producer
+  backpressures instead of buffering the whole stage in host memory;
+* the consumer yields batches as they arrive, in whatever order the
+  locations complete — merged-multiset semantics, same rows;
+* each location gets retry with exponential backoff; a failed attempt
+  drops the cached Flight connection (``BallistaClient.invalidate``) so
+  the retry reconnects instead of reusing a dead channel, and a retry
+  after a mid-stream failure skips the batches already delivered (per
+  location the serving order is deterministic: IPC file order).
+
+Metrics (into the owning operator's registry): ``bytes_fetched``,
+``fetch_time_ns`` (summed per-location latency), ``locations_fetched``,
+``fetch_retries``, ``fetch_queue_full_ns`` (producer backpressure time),
+``fetch_wait_time_ns`` (consumer starvation time) and
+``peak_locations_in_flight`` (peak concurrency per execute; sums across
+executes of the same operator).
+
+Queued-but-unconsumed bytes are tracked by this module's jax-free
+staging counters; ``ops.device_cache.stats()`` surfaces them as
+``staging_bytes`` next to pinned HBM.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+import pyarrow as pa
+
+log = logging.getLogger(__name__)
+
+# Host-side staging accounting: bytes sitting in prefetch queues (fetched
+# but not yet consumed).  Lives HERE, jax-free — ops.device_cache.stats()
+# surfaces it next to pinned HBM, but a CPU-only executor must not pay
+# the ops-package jax import just to count queue bytes.
+_staging_lock = threading.Lock()
+_staging_bytes = 0
+
+
+def staging_add(n_bytes: int) -> None:
+    global _staging_bytes
+    with _staging_lock:
+        _staging_bytes += n_bytes
+
+
+def staging_sub(n_bytes: int) -> None:
+    global _staging_bytes
+    with _staging_lock:
+        _staging_bytes -= n_bytes
+        if _staging_bytes < 0:  # defensive: never report negative pressure
+            _staging_bytes = 0
+
+
+def staging_bytes() -> int:
+    with _staging_lock:
+        return _staging_bytes
+
+
+@dataclass(frozen=True)
+class FetchPolicy:
+    """Reader-side fetch knobs (see ``ballista.shuffle.fetch_*``)."""
+
+    concurrency: int = 8
+    prefetch_bytes: int = 64 << 20
+    retries: int = 3
+    backoff_s: float = 0.05
+
+    @staticmethod
+    def from_config(config) -> "FetchPolicy":
+        return FetchPolicy(
+            concurrency=config.shuffle_fetch_concurrency,
+            prefetch_bytes=config.shuffle_prefetch_bytes,
+            retries=config.shuffle_fetch_retries,
+            backoff_s=config.shuffle_fetch_backoff_ms / 1000.0,
+        )
+
+
+def fetch_location(loc) -> Iterator[pa.RecordBatch]:
+    """Stream one map-side partition: memory-store fast path, local IPC
+    file, Arrow Flight otherwise — the single source-dispatch behind
+    every shuffle read."""
+    from . import memory_store
+
+    if loc.path and loc.path.startswith(memory_store.SCHEME):
+        hit = memory_store.get(loc.path)
+        if hit is not None:
+            yield from hit[1]
+            return
+        # A miss here is either janitor eviction or a partition produced
+        # by ANOTHER executor (whose Flight service serves mem:// paths
+        # from its own store).  Never silent: recovery from a genuinely
+        # lost partition starts from this line.
+        log.warning(
+            "memory shuffle partition %s not in the local store (evicted "
+            "or remote); falling back to Flight from %s:%s",
+            loc.path,
+            loc.executor_meta.host,
+            loc.executor_meta.flight_port,
+        )
+    elif loc.path and os.path.exists(loc.path):
+        with pa.OSFile(loc.path, "rb") as f:
+            reader = pa.ipc.open_file(f)
+            for i in range(reader.num_record_batches):
+                yield reader.get_batch(i)
+        return
+    from ..flight.client import BallistaClient
+
+    client = BallistaClient.get(
+        loc.executor_meta.host, loc.executor_meta.flight_port
+    )
+    yield from client.fetch_partition(
+        loc.partition_id.job_id,
+        loc.partition_id.stage_id,
+        loc.partition_id.partition_id,
+        loc.path,
+    )
+
+
+def retrying_fetch(
+    loc,
+    policy: FetchPolicy,
+    metrics,
+    fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
+    stop_event: Optional[threading.Event] = None,
+) -> Iterator[pa.RecordBatch]:
+    """Stream one location with retry + exponential backoff.
+
+    A retry after a mid-stream failure skips the batches already
+    delivered (per location the serving order is deterministic: IPC file
+    order), so failures never duplicate rows.  Every fetch worker routes
+    through this — ``fetch_retries`` applies at any concurrency.
+    ``stop_event`` cuts a backoff wait short (the original error
+    re-raises).
+    """
+    fetch = fetch_fn or fetch_location
+    attempt = 0
+    delivered = 0
+    while True:
+        try:
+            skip = delivered
+            for batch in fetch(loc):
+                if skip > 0:
+                    skip -= 1
+                    continue
+                yield batch
+                delivered += 1
+            return
+        except Exception as e:
+            attempt += 1
+            if attempt > policy.retries:
+                raise
+            metrics.add("fetch_retries", 1)
+            delay = policy.backoff_s * (2 ** (attempt - 1))
+            log.warning(
+                "shuffle fetch of %s failed (attempt %d/%d): %s; "
+                "retrying in %.0fms",
+                getattr(loc, "path", loc),
+                attempt,
+                policy.retries,
+                e,
+                delay * 1e3,
+            )
+            if stop_event is not None:
+                if stop_event.wait(delay):
+                    raise
+            else:
+                time.sleep(delay)
+
+
+class _Closed(Exception):
+    """Internal: the pipeline was torn down (consumer gone or error)."""
+
+
+class _PrefetchQueue:
+    """Bounded-by-bytes handoff between fetch workers and the consumer.
+
+    ``put`` blocks while the byte budget is exhausted — but always admits
+    a batch when the queue is EMPTY, so a single batch larger than the
+    whole budget cannot deadlock the pipeline.
+    """
+
+    def __init__(self, max_bytes: int, metrics) -> None:
+        self._max = max(1, max_bytes)
+        self._metrics = metrics
+        self._dq: deque = deque()
+        self._bytes = 0
+        self._cv = threading.Condition()
+        self._producers = 0
+        self._closed = False
+
+    def add_producer(self) -> None:
+        with self._cv:
+            self._producers += 1
+
+    def producer_done(self) -> None:
+        with self._cv:
+            self._producers -= 1
+            self._cv.notify_all()
+
+    def put(self, batch: pa.RecordBatch, nbytes: int) -> None:
+        with self._cv:
+            t0 = None
+            while self._bytes >= self._max and self._dq and not self._closed:
+                if t0 is None:
+                    t0 = time.monotonic_ns()
+                self._cv.wait()
+            if t0 is not None:
+                self._metrics.add(
+                    "fetch_queue_full_ns", time.monotonic_ns() - t0
+                )
+            if self._closed:
+                raise _Closed()
+            self._dq.append((batch, nbytes))
+            self._bytes += nbytes
+            staging_add(nbytes)
+            self._cv.notify_all()
+
+    def get(
+        self, abort_event: Optional[threading.Event] = None
+    ) -> Optional[pa.RecordBatch]:
+        """Next batch, or None when every producer has finished, the
+        queue was closed on error, or ``abort_event`` is set (nothing
+        else can wake a consumer whose workers are all stuck inside a
+        hung remote read — the caller re-checks the event on None)."""
+        with self._cv:
+            t0 = None
+            while not self._dq and self._producers > 0 and not self._closed:
+                if abort_event is not None and abort_event.is_set():
+                    break
+                if t0 is None:
+                    t0 = time.monotonic_ns()
+                self._cv.wait(0.25 if abort_event is not None else None)
+            if t0 is not None:
+                self._metrics.add(
+                    "fetch_wait_time_ns", time.monotonic_ns() - t0
+                )
+            if not self._dq:
+                return None
+            batch, nbytes = self._dq.popleft()
+            self._bytes -= nbytes
+            staging_sub(nbytes)
+            self._cv.notify_all()
+            return batch
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            if self._bytes:
+                staging_sub(self._bytes)
+            self._dq.clear()
+            self._bytes = 0
+            self._cv.notify_all()
+
+
+# Executor shutdown must be able to abort in-flight fetch pipelines (a
+# worker blocked on a dead peer would otherwise pin its task thread):
+# every live fetcher registers here with its owner token (the executing
+# task's work_dir — unique per executor unless explicitly shared), so
+# stopping ONE executor in a multi-executor process does not abort the
+# others' fetches.
+_active: "weakref.WeakSet[ShuffleFetcher]" = weakref.WeakSet()
+_active_lock = threading.Lock()
+
+
+def shutdown_active_fetchers(owner: Optional[str] = None) -> int:
+    """Close in-flight fetch pipelines: those registered under ``owner``
+    (an executor's work_dir), or every one in the process when None.
+    Returns how many were closed (executor shutdown path)."""
+    with _active_lock:
+        fetchers = [
+            f for f in _active if owner is None or f.owner == owner
+        ]
+    for f in fetchers:
+        f.close(error=_aborted())
+    return len(fetchers)
+
+
+def _aborted():
+    from ..errors import ExecutionError
+
+    return ExecutionError("shuffle fetch aborted: executor shutting down")
+
+
+class ShuffleFetcher:
+    """One reader partition's fetch pipeline over its locations.
+
+    ``fetch_fn`` is the per-location stream factory — injectable so tests
+    can add deterministic latency or faults without a network.
+    """
+
+    def __init__(
+        self,
+        locations: list,
+        policy: FetchPolicy,
+        metrics,
+        cancel_event: Optional[threading.Event] = None,
+        fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
+        owner: Optional[str] = None,
+    ) -> None:
+        self.owner = owner
+        self._locations = list(locations)
+        self._policy = policy
+        self._metrics = metrics
+        self._cancel = cancel_event
+        self._fetch_fn = fetch_fn or fetch_location
+        self._q = _PrefetchQueue(policy.prefetch_bytes, metrics)
+        self._cursor = 0
+        self._cursor_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._in_flight = 0
+        self._peak_in_flight = 0
+        self._peak_reported = False
+        self._consumed = False
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self) -> Iterator[pa.RecordBatch]:
+        # single-use: the location cursor is spent after one pass, so a
+        # second iteration would silently yield nothing instead of rows
+        if self._consumed:
+            raise RuntimeError(
+                "ShuffleFetcher is single-use; construct a new one to re-read"
+            )
+        self._consumed = True
+        return self._iterate()
+
+    def _iterate(self) -> Iterator[pa.RecordBatch]:
+        n_workers = max(1, min(self._policy.concurrency, len(self._locations)))
+        with _active_lock:
+            _active.add(self)
+        try:
+            for i in range(n_workers):
+                self._q.add_producer()
+                try:
+                    t = threading.Thread(
+                        target=self._worker,
+                        name=f"shuffle-fetch-{i}",
+                        daemon=True,
+                    )
+                    t.start()
+                except BaseException:
+                    # the slot was counted but its worker never ran
+                    self._q.producer_done()
+                    raise
+        except BaseException:
+            # a failed spawn (e.g. thread exhaustion) must not leak the
+            # already-started workers into a queue nobody will drain
+            self.close()
+            raise
+        try:
+            while True:
+                batch = self._q.get(abort_event=self._cancel)
+                if batch is None:
+                    if self._cancel is not None and self._cancel.is_set():
+                        raise _cancelled()
+                    break
+                yield batch
+            if self._error is not None:
+                raise self._error
+        finally:
+            self.close()
+            self._report_peak()
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        """Tear the pipeline down.  ``error`` (external aborts) surfaces
+        to the consumer instead of silently truncating the stream; the
+        consumer's own finally-close passes None and raises nothing."""
+        if error is not None and self._error is None:
+            self._error = error
+        self._stop.set()
+        self._q.close()
+
+    # ------------------------------------------------------------ producers
+    def _next_index(self) -> Optional[int]:
+        with self._cursor_lock:
+            if self._cursor >= len(self._locations):
+                return None
+            i = self._cursor
+            self._cursor += 1
+            return i
+
+    def _worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                idx = self._next_index()
+                if idx is None:
+                    break
+                self._fetch_one(self._locations[idx])
+        except _Closed:
+            pass
+        except BaseException as e:  # first error wins; tears the pipe down
+            if self._error is None:
+                self._error = e
+            self.close()
+        finally:
+            self._q.producer_done()
+
+    def _enter_location(self) -> None:
+        with self._cursor_lock:
+            self._in_flight += 1
+            self._peak_in_flight = max(self._peak_in_flight, self._in_flight)
+
+    def _exit_location(self) -> None:
+        with self._cursor_lock:
+            self._in_flight -= 1
+
+    def _report_peak(self) -> None:
+        """Record peak concurrency once per pipeline — in the consumer's
+        finally, so failed or aborted runs (where concurrency data
+        matters most) still report it."""
+        with self._cursor_lock:
+            if self._peak_reported or self._peak_in_flight == 0:
+                return
+            self._peak_reported = True
+            peak = self._peak_in_flight
+        self._metrics.add("peak_locations_in_flight", peak)
+
+    def _fetch_one(self, loc) -> None:
+        """Stream one location into the queue via :func:`retrying_fetch`
+        (retry/backoff + mid-stream resume shared with the sequential
+        reader)."""
+        t0 = time.monotonic_ns()
+        self._enter_location()
+        try:
+            if self._cancel is not None and self._cancel.is_set():
+                raise _cancelled()
+            for batch in retrying_fetch(
+                loc,
+                self._policy,
+                self._metrics,
+                fetch_fn=self._fetch_fn,
+                stop_event=self._stop,
+            ):
+                nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                self._q.put(batch, nbytes)
+                self._metrics.add("bytes_fetched", nbytes)
+            self._metrics.add("fetch_time_ns", time.monotonic_ns() - t0)
+            self._metrics.add("locations_fetched", 1)
+        finally:
+            self._exit_location()
+
+
+def _cancelled():
+    from ..errors import Cancelled
+
+    return Cancelled("task cancelled")

@@ -13,7 +13,11 @@ Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
 Each config emits one JSON line (same shape as bench.py) and everything
 is appended to BENCH_SUITE_r05.json so the results ship with the repo.
 
-Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|all]  (default all)
+  plus a shuffle-fetch data-plane micro-bench (shuffle_fetch_mb_per_sec,
+  pipelined vs sequential reduce-side read)
+
+Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|shuffle|all]
+(default all)
 """
 
 from __future__ import annotations
@@ -550,6 +554,31 @@ def bench_h2o() -> None:
     )
 
 
+def bench_shuffle_fetch() -> None:
+    """Config #6: shuffle fetch data plane — MB/s through the concurrent
+    pipelined reader vs the sequential location-by-location path, over
+    real IPC partition files (no query plan in the way)."""
+    from benchmarks.shuffle_fetch import run_fetch_bench
+
+    n_loc = int(os.environ.get("BENCH_SHUFFLE_LOCATIONS", "16"))
+    mb = float(os.environ.get("BENCH_SHUFFLE_MB_PER_LOC", "4"))
+    conc = int(os.environ.get("BENCH_SHUFFLE_CONCURRENCY", "8"))
+    rec = run_fetch_bench(
+        n_locations=n_loc, mb_per_location=mb, concurrency=conc
+    )
+    _emit(
+        {
+            "metric": "shuffle_fetch_mb_per_sec",
+            "value": rec["pipelined_mb_per_sec"],
+            "unit": "MB/s",
+            "vs_baseline": round(
+                rec["sequential_s"] / rec["pipelined_s"], 3
+            ),
+            **rec,
+        }
+    )
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
@@ -572,6 +601,8 @@ def main() -> None:
         bench_window()
     if which in ("h2o", "all"):
         bench_h2o()
+    if which in ("shuffle", "all"):
+        bench_shuffle_fetch()
 
 
 if __name__ == "__main__":

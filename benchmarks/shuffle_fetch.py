@@ -1,0 +1,119 @@
+"""Shuffle fetch data-plane micro-benchmark.
+
+Measures MB/s through the reduce-side read path — sequential
+(location-by-location) vs the concurrent pipelined fetcher — over real
+Arrow IPC partition files, no query plan in the way.  Reported by
+``bench_suite.py shuffle`` as ``shuffle_fetch_mb_per_sec`` and exercised
+tier-2 by ``tests/test_shuffle_fetch_bench.py`` (marked ``slow``).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pyarrow as pa
+
+
+def _make_partition_files(
+    work_dir: str, n_locations: int, mb_per_location: float, batch_rows: int
+):
+    """One IPC file per map-side location, ~mb_per_location each."""
+    from arrow_ballista_tpu.serde.scheduler_types import (
+        ExecutorMetadata,
+        PartitionId,
+        PartitionLocation,
+        PartitionStats,
+    )
+
+    rng = np.random.default_rng(11)
+    schema = pa.schema(
+        [
+            pa.field("k", pa.int64()),
+            pa.field("a", pa.float64()),
+            pa.field("b", pa.float64()),
+        ]
+    )
+    bytes_per_row = 24
+    rows = max(batch_rows, int(mb_per_location * (1 << 20)) // bytes_per_row)
+    meta = ExecutorMetadata("bench", "127.0.0.1", 1)
+    locs = []
+    total_bytes = 0
+    for i in range(n_locations):
+        path = os.path.join(work_dir, f"bench-loc-{i}.arrow")
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_file(f, schema) as w:
+                for lo in range(0, rows, batch_rows):
+                    n = min(batch_rows, rows - lo)
+                    w.write_batch(
+                        pa.record_batch(
+                            {
+                                "k": pa.array(
+                                    rng.integers(0, 1 << 30, n), pa.int64()
+                                ),
+                                "a": pa.array(rng.normal(size=n)),
+                                "b": pa.array(rng.normal(size=n)),
+                            },
+                            schema=schema,
+                        )
+                    )
+        total_bytes += os.path.getsize(path)
+        locs.append(
+            PartitionLocation(
+                PartitionId("bench", 1, 0), meta, PartitionStats(rows, 1, 0), path
+            )
+        )
+    return schema, locs, total_bytes
+
+
+def run_fetch_bench(
+    n_locations: int = 16,
+    mb_per_location: float = 4.0,
+    batch_rows: int = 65536,
+    concurrency: int = 8,
+    work_dir: str | None = None,
+) -> dict:
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.exec.operators import TaskContext
+    from arrow_ballista_tpu.shuffle import ShuffleReaderExec
+
+    own_dir = None
+    if work_dir is None:
+        own_dir = tempfile.TemporaryDirectory(prefix="shuffle-fetch-bench-")
+        work_dir = own_dir.name
+    try:
+        schema, locs, total_bytes = _make_partition_files(
+            work_dir, n_locations, mb_per_location, batch_rows
+        )
+
+        def run(n_conc: int) -> float:
+            ctx = TaskContext(
+                config=BallistaConfig(
+                    {"ballista.shuffle.fetch_concurrency": str(n_conc)}
+                )
+            )
+            reader = ShuffleReaderExec(1, schema, [locs])
+            t0 = time.perf_counter()
+            rows = sum(b.num_rows for b in reader.execute(0, ctx))
+            elapsed = time.perf_counter() - t0
+            assert rows > 0
+            return elapsed
+
+        run(1)  # warm the page cache so both legs read warm files
+        seq_s = run(1)
+        conc_s = run(concurrency)
+        total_mb = total_bytes / (1 << 20)
+        return {
+            "total_mb": round(total_mb, 2),
+            "n_locations": n_locations,
+            "concurrency": concurrency,
+            "sequential_s": round(seq_s, 4),
+            "pipelined_s": round(conc_s, 4),
+            "sequential_mb_per_sec": round(total_mb / seq_s, 2),
+            "pipelined_mb_per_sec": round(total_mb / conc_s, 2),
+        }
+    finally:
+        if own_dir is not None:
+            own_dir.cleanup()

@@ -1,0 +1,26 @@
+"""Tier-2 micro-bench for the shuffle fetch data plane (marked ``slow``,
+excluded from tier-1 by ``-m 'not slow'``): BENCH runs report
+``shuffle_fetch_mb_per_sec`` alongside the TPC-H metrics."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_fetch_bench_reports_throughput(tmp_path, capsys):
+    from benchmarks.shuffle_fetch import run_fetch_bench
+
+    rec = run_fetch_bench(
+        n_locations=8,
+        mb_per_location=1.0,
+        batch_rows=8192,
+        concurrency=4,
+        work_dir=str(tmp_path),
+    )
+    print(json.dumps({"metric": "shuffle_fetch_mb_per_sec", **rec}))
+    assert rec["n_locations"] == 8
+    assert rec["total_mb"] >= 8
+    assert rec["sequential_mb_per_sec"] > 0
+    assert rec["pipelined_mb_per_sec"] > 0

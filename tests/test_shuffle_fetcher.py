@@ -1,0 +1,422 @@
+"""Concurrent pipelined shuffle fetch tests.
+
+Correctness of the pipelined reader against the sequential reader
+(merged-multiset semantics under randomized per-location delays), the
+3x-speedup acceptance bar with deterministic injected latency, retry /
+backoff with fault injection, dead-connection eviction in BallistaClient,
+and the memory-store miss → Flight fallback path.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig
+from arrow_ballista_tpu.errors import ExecutionError
+from arrow_ballista_tpu.exec.operators import TaskContext
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    PartitionId,
+    PartitionLocation,
+    PartitionStats,
+)
+from arrow_ballista_tpu.shuffle import (
+    FetchPolicy,
+    ShuffleFetcher,
+    ShuffleReaderExec,
+)
+from arrow_ballista_tpu.shuffle import fetcher as fetcher_mod
+from arrow_ballista_tpu.shuffle import memory_store
+
+SCHEMA = pa.schema([pa.field("k", pa.int64()), pa.field("v", pa.float64())])
+META = ExecutorMetadata("e1", "127.0.0.1", 1)
+
+
+def _make_locations(job, n_locs, rows_per_loc=64, batches_per_loc=1):
+    """n_locs memory-store partitions, all feeding output partition 0."""
+    rng = np.random.default_rng(7)
+    locs = []
+    for i in range(n_locs):
+        batches = [
+            pa.record_batch(
+                {
+                    "k": pa.array(
+                        np.full(rows_per_loc, i * 1000 + b), pa.int64()
+                    ),
+                    "v": pa.array(rng.normal(size=rows_per_loc), pa.float64()),
+                },
+                schema=SCHEMA,
+            )
+            for b in range(batches_per_loc)
+        ]
+        path = memory_store.put(job, 1, 0, i, SCHEMA, batches)
+        locs.append(
+            PartitionLocation(
+                PartitionId(job, 1, 0),
+                META,
+                PartitionStats(rows_per_loc * batches_per_loc, batches_per_loc, 0),
+                path,
+            )
+        )
+    return locs
+
+
+def _ctx(**settings):
+    return TaskContext(
+        config=BallistaConfig({k: str(v) for k, v in settings.items()})
+    )
+
+
+def _row_multiset(batches):
+    tbl = pa.Table.from_batches(list(batches), schema=SCHEMA)
+    return sorted(zip(tbl.column("k").to_pylist(), tbl.column("v").to_pylist()))
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    yield
+    memory_store.clear()
+
+
+def test_concurrent_matches_sequential_random_delays(monkeypatch):
+    """Merged batch multiset of the pipelined reader == sequential reader
+    output, under randomized per-location fetch delays."""
+    locs = _make_locations("jobC", 12, batches_per_loc=3)
+    reader = ShuffleReaderExec(1, SCHEMA, [locs])
+
+    rng = np.random.default_rng(3)
+    delays = {loc.path: float(d) for loc, d in zip(locs, rng.uniform(0, 0.01, 12))}
+    real_fetch = fetcher_mod.fetch_location
+
+    def delayed_fetch(loc):
+        time.sleep(delays[loc.path])
+        return real_fetch(loc)
+
+    seq = list(reader.execute(0, _ctx(**{"ballista.shuffle.fetch_concurrency": 1})))
+
+    monkeypatch.setattr(fetcher_mod, "fetch_location", delayed_fetch)
+    conc = list(
+        ShuffleReaderExec(1, SCHEMA, [locs]).execute(
+            0, _ctx(**{"ballista.shuffle.fetch_concurrency": 6})
+        )
+    )
+    assert _row_multiset(conc) == _row_multiset(seq)
+
+
+def test_pipelined_3x_faster_than_sequential(monkeypatch):
+    """Acceptance: 16 locations x 10ms injected latency — pipelined wall
+    time >= 3x faster than sequential, identical batch content.
+
+    Tiny batches keep GIL-bound decode out of the measurement (the fake
+    latency IS the workload), and each leg takes its best of 3 runs so a
+    CI scheduler hiccup in one run cannot flip the deterministic ratio
+    (sequential floor: 16 serial sleeps = 160ms; pipelined floor: one
+    sleep + thread spawn, ~15-30ms on 2 cores)."""
+    # warm the staging-accounting import (jax via the ops package) so the
+    # first pipelined leg doesn't pay it inside the timed region
+    import arrow_ballista_tpu.ops.device_cache  # noqa: F401
+
+    locs = _make_locations("jobS", 16, rows_per_loc=4)
+    real_fetch = fetcher_mod.fetch_location
+
+    def slow_fetch(loc):
+        time.sleep(0.010)
+        return real_fetch(loc)
+
+    monkeypatch.setattr(fetcher_mod, "fetch_location", slow_fetch)
+
+    def run(concurrency):
+        reader = ShuffleReaderExec(1, SCHEMA, [locs])
+        t0 = time.perf_counter()
+        out = list(
+            reader.execute(
+                0,
+                _ctx(**{"ballista.shuffle.fetch_concurrency": concurrency}),
+            )
+        )
+        return time.perf_counter() - t0, out, reader
+
+    seq_s, seq, _ = min((run(1) for _ in range(3)), key=lambda r: r[0])
+    conc_s, conc, conc_reader = min(
+        (run(16) for _ in range(3)), key=lambda r: r[0]
+    )
+
+    assert _row_multiset(conc) == _row_multiset(seq)
+    assert seq_s >= 3 * conc_s, f"sequential {seq_s:.3f}s vs pipelined {conc_s:.3f}s"
+    m = conc_reader.metrics.to_dict()
+    assert m["locations_fetched"] == 16
+    assert m["bytes_fetched"] > 0
+    assert m["peak_locations_in_flight"] >= 2
+
+
+def test_retry_backoff_fault_injection():
+    """One location errors twice then succeeds: rows complete, two
+    retries recorded, backoff honored."""
+    locs = _make_locations("jobR", 6)
+    flaky_path = locs[2].path
+    attempts = {}
+    real_fetch = fetcher_mod.fetch_location
+
+    def flaky_fetch(loc):
+        n = attempts.get(loc.path, 0)
+        attempts[loc.path] = n + 1
+        if loc.path == flaky_path and n < 2:
+            raise ExecutionError(f"injected failure #{n + 1}")
+        return real_fetch(loc)
+
+    reader = ShuffleReaderExec(1, SCHEMA, [locs])
+    policy = FetchPolicy(concurrency=4, retries=3, backoff_s=0.001)
+    fetcher = ShuffleFetcher(locs, policy, reader.metrics, fetch_fn=flaky_fetch)
+    out = list(fetcher)
+
+    assert attempts[flaky_path] == 3
+    assert reader.metrics.to_dict()["fetch_retries"] == 2
+    seq = list(
+        ShuffleReaderExec(1, SCHEMA, [locs]).execute(
+            0, _ctx(**{"ballista.shuffle.fetch_concurrency": 1})
+        )
+    )
+    assert _row_multiset(out) == _row_multiset(seq)
+
+
+def test_sequential_single_location_retries(monkeypatch):
+    """fetch_retries applies on the sequential path too: a partition with
+    ONE location survives a transient failure instead of failing the
+    stage on the first error."""
+    locs = _make_locations("jobQ", 1, batches_per_loc=2)
+    attempts = {"n": 0}
+    real_fetch = fetcher_mod.fetch_location
+
+    def flaky_fetch(loc):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise ExecutionError("transient: executor restarting")
+        return real_fetch(loc)
+
+    monkeypatch.setattr(fetcher_mod, "fetch_location", flaky_fetch)
+    reader = ShuffleReaderExec(1, SCHEMA, [locs])
+    out = list(
+        reader.execute(
+            0,
+            _ctx(
+                **{
+                    "ballista.shuffle.fetch_concurrency": 8,
+                    "ballista.shuffle.fetch_backoff_ms": 1,
+                }
+            ),
+        )
+    )
+    assert attempts["n"] == 2
+    assert reader.metrics.to_dict()["fetch_retries"] == 1
+    assert sum(b.num_rows for b in out) == 128
+
+
+def test_retry_exhaustion_raises():
+    locs = _make_locations("jobX", 3)
+
+    def always_fails(loc):
+        raise ExecutionError("dead executor")
+        yield  # pragma: no cover - marks this as a generator factory
+
+    policy = FetchPolicy(concurrency=2, retries=2, backoff_s=0.001)
+    fetcher = ShuffleFetcher(
+        locs, policy, ShuffleReaderExec(1, SCHEMA, [locs]).metrics,
+        fetch_fn=always_fails,
+    )
+    with pytest.raises(ExecutionError, match="dead executor"):
+        list(fetcher)
+
+
+def test_mid_stream_failure_retry_never_duplicates():
+    """A stream that dies after delivering some batches resumes on retry
+    by skipping the already-delivered prefix — no duplicate rows."""
+    locs = _make_locations("jobM", 1, batches_per_loc=4)
+    state = {"attempt": 0}
+    real_fetch = fetcher_mod.fetch_location
+
+    def dies_mid_stream(loc):
+        state["attempt"] += 1
+        first = state["attempt"] == 1
+        for i, b in enumerate(real_fetch(loc)):
+            if first and i == 2:
+                raise ExecutionError("connection reset mid-stream")
+            yield b
+
+    metrics = ShuffleReaderExec(1, SCHEMA, [locs]).metrics
+    policy = FetchPolicy(concurrency=2, retries=2, backoff_s=0.001)
+    out = list(
+        ShuffleFetcher(locs, policy, metrics, fetch_fn=dies_mid_stream)
+    )
+    seq = list(
+        ShuffleReaderExec(1, SCHEMA, [locs]).execute(
+            0, _ctx(**{"ballista.shuffle.fetch_concurrency": 1})
+        )
+    )
+    assert state["attempt"] == 2
+    assert _row_multiset(out) == _row_multiset(seq)
+
+
+def test_tiny_prefetch_budget_backpressures_not_deadlocks():
+    """prefetch_bytes smaller than a single batch: the queue admits one
+    batch at a time (never deadlocks) and content is complete."""
+    locs = _make_locations("jobB", 8, batches_per_loc=2)
+    metrics = ShuffleReaderExec(1, SCHEMA, [locs]).metrics
+    policy = FetchPolicy(concurrency=4, prefetch_bytes=1)
+    out = list(ShuffleFetcher(locs, policy, metrics))
+    seq = list(
+        ShuffleReaderExec(1, SCHEMA, [locs]).execute(
+            0, _ctx(**{"ballista.shuffle.fetch_concurrency": 1})
+        )
+    )
+    assert _row_multiset(out) == _row_multiset(seq)
+
+
+def test_consumer_abandon_stops_workers():
+    """Breaking out of the batch stream tears the pipeline down: fetch
+    worker threads exit instead of blocking on the full queue forever."""
+    locs = _make_locations("jobA", 8, batches_per_loc=4)
+    metrics = ShuffleReaderExec(1, SCHEMA, [locs]).metrics
+    policy = FetchPolicy(concurrency=4, prefetch_bytes=1)
+    fetcher = ShuffleFetcher(locs, policy, metrics)
+    it = iter(fetcher)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    alive = True
+    while alive and time.time() < deadline:
+        alive = any(
+            t.name.startswith("shuffle-fetch") and t.is_alive()
+            for t in threading.enumerate()
+        )
+        time.sleep(0.01)
+    assert not alive
+
+
+def test_shutdown_active_fetchers_surfaces_error():
+    """An external abort (executor shutdown) raises at the consumer
+    instead of silently truncating the stream."""
+    locs = _make_locations("jobD", 4)
+
+    def slow_fetch(loc):
+        time.sleep(0.05)
+        return fetcher_mod.fetch_location(loc)
+
+    metrics = ShuffleReaderExec(1, SCHEMA, [locs]).metrics
+    fetcher = ShuffleFetcher(
+        locs, FetchPolicy(concurrency=2), metrics, fetch_fn=slow_fetch
+    )
+    it = iter(fetcher)
+    t = threading.Timer(0.01, fetcher_mod.shutdown_active_fetchers)
+    t.start()
+    try:
+        with pytest.raises(ExecutionError, match="aborted"):
+            list(it)
+    finally:
+        t.cancel()
+
+
+def test_client_cache_evicts_on_flight_error():
+    """A FlightError drops the cached (host, port) client so the next
+    get() reconnects instead of reusing the dead channel."""
+    import pyarrow.flight as flight
+
+    from arrow_ballista_tpu.flight.client import BallistaClient
+
+    class _DeadChannel:
+        def do_get(self, ticket):
+            raise flight.FlightUnavailableError("executor gone")
+
+        def close(self):
+            pass
+
+    try:
+        client = BallistaClient.get("127.0.0.1", 59998)
+        client._client.close()
+        client._client = _DeadChannel()
+        assert ("127.0.0.1", 59998) in BallistaClient._cache
+        with pytest.raises(ExecutionError, match="failed"):
+            client.fetch_partition_with_schema("j", 1, 0, "p")
+        assert ("127.0.0.1", 59998) not in BallistaClient._cache
+        fresh = BallistaClient.get("127.0.0.1", 59998)
+        assert fresh is not client
+    finally:
+        BallistaClient.clear_cache()
+
+
+def test_memory_miss_falls_back_to_flight_with_log(monkeypatch, caplog):
+    """A mem:// location missing from the local store logs the evicted
+    key and fetches via Flight instead of failing silently."""
+    import logging
+
+    from arrow_ballista_tpu.flight import client as client_mod
+
+    missing = memory_store.make_path("jobZ", 1, 0, 0)
+    loc = PartitionLocation(
+        PartitionId("jobZ", 1, 0), META, PartitionStats(2, 1, 0), missing
+    )
+    served = pa.record_batch(
+        {"k": pa.array([1, 2], pa.int64()), "v": pa.array([0.5, 1.5])},
+        schema=SCHEMA,
+    )
+
+    class _StubClient:
+        def fetch_partition(self, job_id, stage_id, partition_id, path):
+            assert path == missing
+            return iter([served])
+
+    monkeypatch.setattr(
+        client_mod.BallistaClient, "get", classmethod(lambda *a: _StubClient())
+    )
+    with caplog.at_level(logging.WARNING, logger=fetcher_mod.log.name):
+        out = list(fetcher_mod.fetch_location(loc))
+    assert out == [served]
+    assert any(missing in r.message for r in caplog.records)
+
+
+def test_coalesce_batches_combines_small_fragments():
+    from arrow_ballista_tpu.ops.bridge import coalesce_batches
+
+    frags = [
+        pa.record_batch({"x": pa.array(range(i * 10, i * 10 + 10))})
+        for i in range(10)
+    ]
+    out = list(coalesce_batches(iter(frags), 32))
+    # flush happens BEFORE an append would overshoot: batches never
+    # exceed the target (a larger device padding bucket would recompile)
+    assert [b.num_rows for b in out] == [30, 30, 30, 10]
+    assert all(b.num_rows <= 32 for b in out)
+    assert pa.Table.from_batches(out).column("x").to_pylist() == list(range(100))
+    # batches already at/above target pass through untouched
+    big = pa.record_batch({"x": pa.array(range(100))})
+    out = list(coalesce_batches(iter([big]), 32))
+    assert len(out) == 1 and out[0] is big
+    # ... even when a small fragment is already buffered: the buffer
+    # flushes first and the big batch is never re-copied
+    sliver = pa.record_batch({"x": pa.array(range(5))})
+    out = list(coalesce_batches(iter([sliver, big]), 32))
+    assert [b.num_rows for b in out] == [5, 100]
+    assert out[1] is big
+
+
+def test_fetcher_is_single_use():
+    locs = _make_locations("jobU", 2)
+    fetcher = ShuffleFetcher(
+        locs, FetchPolicy(concurrency=2),
+        ShuffleReaderExec(1, SCHEMA, [locs]).metrics,
+    )
+    assert len(list(fetcher)) == 2
+    with pytest.raises(RuntimeError, match="single-use"):
+        iter(fetcher)
+
+
+def test_staging_bytes_returns_to_zero():
+    from arrow_ballista_tpu.ops import device_cache
+
+    locs = _make_locations("jobT", 6, batches_per_loc=2)
+    metrics = ShuffleReaderExec(1, SCHEMA, [locs]).metrics
+    base = device_cache.staging_bytes()
+    list(ShuffleFetcher(locs, FetchPolicy(concurrency=3), metrics))
+    assert device_cache.staging_bytes() == base
