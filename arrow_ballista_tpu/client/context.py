@@ -203,8 +203,14 @@ class BallistaContext:
         self._job_ids.add(job_id)
         # cold XLA compiles on a slow host can push a legitimate job past
         # the default 300s (observed: full-TPC-H sweeps on a 1-core box);
-        # benchmarks/operators raise it via env without touching the API
-        timeout_s = float(os.environ.get("BALLISTA_JOB_TIMEOUT_S", "300"))
+        # benchmarks/operators raise it via env without touching the API,
+        # sessions via SET ballista.client.job_timeout_seconds
+        timeout_s = float(
+            os.environ.get(
+                "BALLISTA_JOB_TIMEOUT_S",
+                self.config.client_job_timeout_seconds,
+            )
+        )
         status = self.wait_for_job(job_id, timeout_s=timeout_s)
         return self.fetch_job_output(status)
 

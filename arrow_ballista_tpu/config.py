@@ -43,6 +43,13 @@ SHUFFLE_PREFETCH_BYTES = "ballista.shuffle.prefetch_bytes"
 SHUFFLE_FETCH_RETRIES = "ballista.shuffle.fetch_retries"
 SHUFFLE_FETCH_BACKOFF_MS = "ballista.shuffle.fetch_backoff_ms"
 SHUFFLE_COALESCE_ROWS = "ballista.shuffle.coalesce_rows"
+# Fault tolerance (see docs/user-guide/fault-tolerance.md)
+TASK_MAX_ATTEMPTS = "ballista.task.max_attempts"
+STAGE_MAX_ATTEMPTS = "ballista.stage.max_attempts"
+EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
+EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
+EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
+CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -250,6 +257,48 @@ _ENTRIES: dict[str, ConfigEntry] = {
             int,
             "0",
         ),
+        ConfigEntry(
+            TASK_MAX_ATTEMPTS,
+            "total attempts per task (first run + retries of transient "
+            "failures) before the job fails with the accumulated error "
+            "history; 1 disables retries",
+            int,
+            "4",
+        ),
+        ConfigEntry(
+            STAGE_MAX_ATTEMPTS,
+            "executor-loss rollbacks per stage before the job fails "
+            "instead of looping against a flapping executor",
+            int,
+            "4",
+        ),
+        ConfigEntry(
+            EXECUTOR_QUARANTINE_THRESHOLD,
+            "task/launch failures inside the sliding window that exclude "
+            "an executor from new reservations; 0 disables quarantine",
+            int,
+            "5",
+        ),
+        ConfigEntry(
+            EXECUTOR_QUARANTINE_WINDOW_S,
+            "sliding-window length (seconds) for the per-executor "
+            "failure count",
+            float,
+            "60",
+        ),
+        ConfigEntry(
+            EXECUTOR_QUARANTINE_BACKOFF_S,
+            "how long (seconds) a quarantined executor is excluded from "
+            "slot reservations",
+            float,
+            "30",
+        ),
+        ConfigEntry(
+            CLIENT_JOB_TIMEOUT_S,
+            "FlightSQL front-end poll deadline (seconds) per statement",
+            float,
+            "300",
+        ),
     ]
 }
 
@@ -378,6 +427,30 @@ class BallistaConfig:
     @property
     def shuffle_coalesce_rows(self) -> int:
         return self._get(SHUFFLE_COALESCE_ROWS)
+
+    @property
+    def task_max_attempts(self) -> int:
+        return self._get(TASK_MAX_ATTEMPTS)
+
+    @property
+    def stage_max_attempts(self) -> int:
+        return self._get(STAGE_MAX_ATTEMPTS)
+
+    @property
+    def executor_quarantine_threshold(self) -> int:
+        return self._get(EXECUTOR_QUARANTINE_THRESHOLD)
+
+    @property
+    def executor_quarantine_window_s(self) -> float:
+        return self._get(EXECUTOR_QUARANTINE_WINDOW_S)
+
+    @property
+    def executor_quarantine_backoff_s(self) -> float:
+        return self._get(EXECUTOR_QUARANTINE_BACKOFF_S)
+
+    @property
+    def client_job_timeout_seconds(self) -> float:
+        return self._get(CLIENT_JOB_TIMEOUT_S)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

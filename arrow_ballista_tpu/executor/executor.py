@@ -29,6 +29,12 @@ from ..shuffle.execution_plans import ShuffleWriterExec
 log = logging.getLogger(__name__)
 
 
+def _sum_metric(metrics, key: str) -> int:
+    """Total one named counter across the per-operator metric sets (used
+    to lift shuffle ``fetch_retries`` into TaskStatus for the scheduler)."""
+    return sum(int(values.get(key, 0)) for _, values in metrics)
+
+
 class LoggingMetricsCollector:
     """Prints the per-partition stage plan with metrics (reference:
     executor/src/metrics/mod.rs:28-60)."""
@@ -115,12 +121,16 @@ class _ProcessWorker:
 
 class _WorkerAbort:
     """Duck-types threading.Event.set() for the abort-handle table: a
-    cancelled process-isolated task dies by worker kill."""
+    cancelled process-isolated task dies by worker kill.  ``cancelled``
+    records that the kill was deliberate — the scheduler must see
+    Cancelled (fatal, no retry), not a transient worker crash."""
 
     def __init__(self, worker: _ProcessWorker):
         self._worker = worker
+        self.cancelled = False
 
     def set(self) -> None:
+        self.cancelled = True
         self._worker.kill()
 
 
@@ -155,11 +165,21 @@ class Executor:
         error becomes a Failed TaskStatus."""
         if self.task_isolation == "process" and self._worker_eligible(task):
             return self._execute_in_worker(task)
+        from ..testing.faults import fault_point
+
         pid = PartitionId.from_proto(task.task_id)
         cancel_event = threading.Event()
         with self._abort_lock:
             self._abort_handles[pid] = cancel_event
         try:
+            fault_point(
+                "executor.execute_task",
+                executor_id=self.id,
+                job_id=pid.job_id,
+                stage_id=pid.stage_id,
+                partition_id=pid.partition_id,
+                attempt=task.attempt,
+            )
             plan = BallistaCodec.decode_physical(task.plan, self.work_dir)
             config = BallistaConfig(dict(task.props))
             writer = self._new_shuffle_writer(pid, plan, task, config)
@@ -182,10 +202,18 @@ class Executor:
                 executor_id=self.id,
                 partitions=partitions,
                 metrics=metrics,
+                attempt=task.attempt,
+                fetch_retries=_sum_metric(metrics, "fetch_retries"),
             )
         except Exception as e:  # noqa: BLE001 - every failure must report
             log.warning("task %s failed: %s", pid, e, exc_info=True)
-            info = TaskInfo(pid, "failed", error=f"{type(e).__name__}: {e}")
+            info = TaskInfo(
+                pid,
+                "failed",
+                executor_id=self.id,
+                error=f"{type(e).__name__}: {e}",
+                attempt=task.attempt,
+            )
         finally:
             with self._abort_lock:
                 self._abort_handles.pop(pid, None)
@@ -246,8 +274,9 @@ class Executor:
             )
         if worker is None or not worker.alive():
             worker = _ProcessWorker(self.id, self.work_dir, self.plugin_dir)
+        abort = _WorkerAbort(worker)
         with self._abort_lock:
-            self._abort_handles[pid] = _WorkerAbort(worker)
+            self._abort_handles[pid] = abort
         try:
             out = worker.run(task.SerializeToString())
         finally:
@@ -255,10 +284,18 @@ class Executor:
                 self._abort_handles.pop(pid, None)
         if out is None:
             worker.kill()
+            # a deliberate cancel is fatal (no retry); an unexplained
+            # worker death is a transient infrastructure failure
+            error = (
+                "Cancelled: task cancelled (worker killed)"
+                if abort.cancelled
+                else "ExecutionError: task worker terminated (crashed)"
+            )
             info = TaskInfo(
                 pid, "failed",
-                error="ExecutionError: task worker terminated "
-                      "(cancelled or crashed)",
+                executor_id=self.id,
+                error=error,
+                attempt=task.attempt,
             )
             return task_info_to_proto(info)
         with self._worker_lock:

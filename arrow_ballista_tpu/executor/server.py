@@ -97,13 +97,19 @@ class Heartbeater:
             self._send()
 
     def _send(self) -> None:
+        from ..testing.faults import FaultInjected, fault_point
+
         try:
+            fault_point("executor.heartbeat", executor_id=self.executor_id)
             status = pb.ExecutorStatus()
             status.active = ""
             self.scheduler.HeartBeatFromExecutor(
                 pb.HeartBeatParams(executor_id=self.executor_id, status=status),
                 timeout=10,
             )
+        except FaultInjected as e:
+            # injected dropped beat: skip this interval, next one retries
+            log.warning("heartbeat suppressed by fault injection: %s", e)
         except grpc.RpcError as e:
             log.warning("heartbeat failed: %s", e.code())
 

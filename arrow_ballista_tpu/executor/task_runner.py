@@ -84,6 +84,17 @@ def main() -> None:
             return
         task = pb.TaskDefinition()
         task.ParseFromString(payload)
+        # worker-crash injection (BALLISTA_FAULTS is inherited through the
+        # environment): "exit" hard-kills this process mid-task, "raise"
+        # propagates out of main() — either way the parent sees EOF and
+        # reports a transient "task worker terminated" failure
+        from ..testing.faults import fault_point
+
+        fault_point(
+            "executor.task_runner",
+            executor_id=args.executor_id,
+            attempt=task.attempt,
+        )
         status = ex.execute_task(task)  # never raises
         out = status.SerializeToString()
         stdout.write(struct.pack(">I", len(out)))

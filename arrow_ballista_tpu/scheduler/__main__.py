@@ -36,6 +36,9 @@ CONFIG_KEYS = {
     "work_dir": (str, "/tmp/ballista-tpu", "scratch dir for plans"),
     "plugin_dir": (str, "", "directory of UDF plugin .py modules"),
     "executor_timeout_seconds": (int, 180, "expire executors after this"),
+    "quarantine_threshold": (int, 5, "failures in-window that quarantine an executor; 0 disables"),
+    "quarantine_window_seconds": (float, 60.0, "sliding window for the per-executor failure count"),
+    "quarantine_backoff_seconds": (float, 30.0, "reservation exclusion period for quarantined executors"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "scheduler", "log file prefix"),
@@ -137,6 +140,9 @@ def main(argv=None) -> None:
         policy,
         work_dir=cfg["work_dir"],
         executor_timeout_s=cfg["executor_timeout_seconds"],
+        quarantine_threshold=cfg["quarantine_threshold"],
+        quarantine_window_s=cfg["quarantine_window_seconds"],
+        quarantine_backoff_s=cfg["quarantine_backoff_seconds"],
     ).init()
     # the curator address executors dial back: must be reachable, never
     # the 0.0.0.0 wildcard

@@ -51,7 +51,7 @@ DASHBOARD_HTML = """<!doctype html>
  <th>id</th><th>host</th><th>flight</th><th>grpc</th><th>alive</th><th>last seen</th>
 </tr></thead><tbody></tbody></table>
 <h2>Jobs</h2><table id="jobs"><thead><tr>
- <th>job</th><th>state</th><th></th></tr></thead><tbody></tbody></table>
+ <th>job</th><th>state</th><th>retries</th><th></th></tr></thead><tbody></tbody></table>
 <div id="detail"></div>
 <script>
 let openJob = null;
@@ -72,13 +72,19 @@ async function showDetail(jobId) {
     ` <a href="/api/job/${encodeURIComponent(jobId)}/dot">[dot]</a></h2>`;
   if (d.error) html += `<p class="dead">${esc(d.error)}</p>`;
   html += dagSvg(d.stages);
+  const hist = d.attempt_histogram || {};
+  const retried = Object.entries(hist).filter(([a]) => a > 0)
+    .map(([a, n]) => `${n} task(s) @ ${a} retr${a > 1 ? 'ies' : 'y'}`).join(', ');
+  if (retried) html += `<p>attempt histogram: ${esc(retried)}</p>`;
   html += '<table><thead><tr><th>stage</th><th>state</th><th>tasks</th>' +
-          '<th>progress</th><th>metrics</th></tr></thead><tbody>';
+          '<th>progress</th><th>retries</th><th>metrics</th></tr></thead><tbody>';
   for (const s of d.stages) {
     const done = s.completed_tasks === undefined ? '—'
       : `${s.completed_tasks}/${s.partitions}`;
     const pct = s.completed_tasks === undefined ? 0
       : Math.round(100 * s.completed_tasks / Math.max(1, s.partitions));
+    const retr = (s.task_retries || s.fetch_retries)
+      ? `task ${s.task_retries || 0} · fetch ${s.fetch_retries || 0}` : '—';
     const mets = s.metrics
       ? esc(Object.entries(s.metrics).map(([op, m]) =>
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
@@ -87,9 +93,10 @@ async function showDetail(jobId) {
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
             `<td>${done}</td>` +
             `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +
+            `<td>${esc(retr)}</td>` +
             `<td>${mets}</td></tr>`;
     if (s.plan) {
-      html += `<tr><td colspan="5"><details><summary>stage ${s.stage_id} ` +
+      html += `<tr><td colspan="6"><details><summary>stage ${s.stage_id} ` +
               `plan</summary><pre class="plan">${esc(s.plan)}</pre>` +
               `</details></td></tr>`;
     }
@@ -166,7 +173,9 @@ async function refresh() {
     document.getElementById('meta').textContent =
       `version ${state.version} · uptime ${state.uptime_seconds}s · ` +
       `${metrics.alive_executors} executor(s) · ${metrics.available_slots} slot(s) · ` +
-      `${metrics.active_jobs} active job(s)`;
+      `${metrics.active_jobs} active job(s) · ` +
+      `${metrics.task_retries || 0} task retr${metrics.task_retries === 1 ? 'y' : 'ies'} · ` +
+      `${metrics.executors_quarantined || 0} quarantined`;
     const etb = document.querySelector('#executors tbody');
     etb.innerHTML = '';
     for (const e of state.executors) {
@@ -184,6 +193,7 @@ async function refresh() {
       // via dataset, so escaping concerns stay purely textual)
       jtb.insertAdjacentHTML('beforeend',
         `<tr><td>${esc(j.job_id)}</td><td>${esc(j.state)}</td>` +
+        `<td>${j.task_retries || 0}</td>` +
         `<td><a href="#" class="detail-link" data-job="${esc(j.job_id)}">detail</a></td></tr>`);
     }
     for (const a of jtb.querySelectorAll('a.detail-link')) {
@@ -276,11 +286,15 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             return
         if path == "/api/metrics":
             em = srv.state.executor_manager
+            tm = srv.state.task_manager
             self._json(
                 {
                     "available_slots": em.available_slots(),
                     "alive_executors": len(em.get_alive_executors()),
-                    "active_jobs": len(srv.state.task_manager.active_job_ids()),
+                    "active_jobs": len(tm.active_job_ids()),
+                    "task_retries": tm.task_retries_total,
+                    "executors_quarantined": len(em.quarantined_executors()),
+                    "quarantines_total": em.quarantines_total,
                 }
             )
             return

@@ -48,6 +48,11 @@ class Task:
     partition: PartitionId
     plan: ShuffleWriterExec
     output_partitioning: Optional[object]  # Partitioning of the shuffle write
+    attempt: int = 0  # 0-based attempt counter, shipped in TaskDefinition
+
+
+DEFAULT_TASK_MAX_ATTEMPTS = 4
+DEFAULT_STAGE_MAX_ATTEMPTS = 4
 
 
 # Job status values
@@ -74,6 +79,16 @@ class ExecutionGraph:
         self.error: str = ""
         self.stages: Dict[int, Stage] = {}
         self.output_locations: List[PartitionLocation] = []
+        self.task_max_attempts = (
+            config.task_max_attempts if config is not None
+            else DEFAULT_TASK_MAX_ATTEMPTS
+        )
+        self.stage_max_attempts = (
+            config.stage_max_attempts if config is not None
+            else DEFAULT_STAGE_MAX_ATTEMPTS
+        )
+        self.task_retries = 0  # transient-failure re-queues over job lifetime
+        self.stage_reset_counts: Dict[int, int] = {}  # executor-loss resets
 
         planner = DistributedPlanner(work_dir, config)
         stage_plans = planner.plan_query_stages(job_id, plan)
@@ -121,34 +136,81 @@ class ExecutionGraph:
         return changed
 
     # ----------------------------------------------------------- dispatch
-    def pop_next_task(self, executor_id: str) -> Optional[Task]:
+    def pop_next_task(
+        self, executor_id: str, allow_excluded: bool = False
+    ) -> Optional[Task]:
         """Find a Running stage with an unclaimed partition, mark it
         running on ``executor_id`` and return it
-        (reference: execution_graph.rs:418-471)."""
+        (reference: execution_graph.rs:418-471).
+
+        A partition whose last transient failure happened on
+        ``executor_id`` is skipped (the retry must land elsewhere) unless
+        ``allow_excluded`` — the liveness escape hatch when no other
+        executor exists (``task_manager.fill_reservations``)."""
         for sid in sorted(self.stages):
             stage = self.stages[sid]
             if not isinstance(stage, RunningStage):
                 continue
             for p, t in enumerate(stage.task_statuses):
-                if t is None:
-                    pid = PartitionId(self.job_id, sid, p)
-                    stage.task_statuses[p] = TaskInfo(pid, "running", executor_id)
-                    return Task(
-                        self.session_id,
-                        pid,
-                        stage.plan,
-                        stage.plan.shuffle_output_partitioning,
-                    )
+                if t is not None:
+                    continue
+                if (
+                    not allow_excluded
+                    and stage.task_exclusions.get(p) == executor_id
+                ):
+                    continue
+                attempt = stage.task_attempts.get(p, 0)
+                pid = PartitionId(self.job_id, sid, p)
+                stage.task_statuses[p] = TaskInfo(
+                    pid, "running", executor_id, attempt=attempt
+                )
+                return Task(
+                    self.session_id,
+                    pid,
+                    stage.plan,
+                    stage.plan.shuffle_output_partitioning,
+                    attempt,
+                )
         return None
 
-    def reset_task_status(self, partition: PartitionId) -> None:
+    def reset_task_status(
+        self, partition: PartitionId, exclude_executor: str = ""
+    ) -> None:
         """Return a handed-out task to the pool (launch failed / reservation
-        cancelled)."""
+        cancelled).  ``exclude_executor`` keeps the re-dispatch off the
+        executor the launch just failed against."""
         stage = self.stages.get(partition.stage_id)
         if isinstance(stage, RunningStage):
             t = stage.task_statuses[partition.partition_id]
             if t is not None and t.state == "running":
                 stage.task_statuses[partition.partition_id] = None
+                if exclude_executor:
+                    stage.task_exclusions[partition.partition_id] = (
+                        exclude_executor
+                    )
+
+    def reset_running_tasks(self, executor_id: str) -> int:
+        """Re-queue every task currently running on ``executor_id`` with
+        the executor excluded (quarantine: the host is sick but its past
+        shuffle output is still servable, so no stage rollback).  Returns
+        the number of tasks reset.
+
+        The attempt counter is bumped: the quarantined executor was never
+        told to stop, so its late status for the superseded attempt must
+        fail the stale-attempt guards instead of double-completing or
+        double-failing the partition."""
+        n = 0
+        for stage in self.stages.values():
+            if not isinstance(stage, RunningStage):
+                continue
+            for p, t in enumerate(stage.task_statuses):
+                if t is not None and t.state == "running" and t.executor_id == executor_id:
+                    stage.task_statuses[p] = None
+                    stage.task_exclusions[p] = executor_id
+                    stage.task_attempts[p] = stage.task_attempts.get(p, 0) + 1
+                    self.task_retries += 1
+                    n += 1
+        return n
 
     # ------------------------------------------------------ status updates
     def update_task_status(
@@ -170,16 +232,19 @@ class ExecutionGraph:
 
         events: List[str] = []
         if info.state == "failed":
-            self.stages[info.partition_id.stage_id] = stage.to_failed(info.error)
-            self.status = FAILED
-            self.error = (
-                f"stage {info.partition_id.stage_id} task "
-                f"{info.partition_id.partition_id} failed: {info.error}"
-            )
-            return ["job_failed"]
+            return self._on_task_failed(stage, info)
 
+        p = info.partition_id.partition_id
+        if info.attempt < stage.task_attempts.get(p, 0):
+            # late status from a superseded attempt (the task was reset by
+            # quarantine and re-dispatched): accepting it would overwrite
+            # the live attempt's status — and a stale completion would
+            # propagate the same partition's output twice
+            return []
         stage.update_task_status(info)
         if info.state == "completed":
+            if info.fetch_retries:
+                stage.task_fetch_retries[p] = info.fetch_retries
             stage.update_task_metrics(info)
             if executor is not None:
                 self._propagate_output(stage, info, executor)
@@ -206,6 +271,51 @@ class ExecutionGraph:
             else:
                 events.append("job_updated")
         return events
+
+    def _on_task_failed(self, stage: RunningStage, info: TaskInfo) -> List[str]:
+        """Bounded retry with failure classification (the reference fails
+        the whole job on the first failed task; production cannot):
+        transient failures re-queue the partition — excluded from the
+        executor that just failed it — until ``ballista.task.max_attempts``
+        is spent, then the job fails with the accumulated error history.
+        Fatal (plan/serde/SQL) errors fail fast on attempt 1."""
+        from .failure import FATAL, classify_failure
+
+        sid = info.partition_id.stage_id
+        p = info.partition_id.partition_id
+        current = stage.task_attempts.get(p, 0)
+        if info.attempt < current:
+            # late report from an attempt already superseded (e.g. the
+            # task was reset by quarantine and re-ran elsewhere)
+            return []
+        if info.fetch_retries:
+            stage.task_fetch_retries[p] = info.fetch_retries
+        error = info.error or "task failed"
+        history = stage.task_failures.setdefault(p, [])
+        history.append(
+            f"attempt {current} on {info.executor_id or '<unknown>'}: {error}"
+        )
+        kind = classify_failure(error)
+        if kind != FATAL and current + 1 < self.task_max_attempts:
+            stage.task_attempts[p] = current + 1
+            if info.executor_id:
+                stage.task_exclusions[p] = info.executor_id
+            stage.task_statuses[p] = None
+            self.task_retries += 1
+            return ["task_retried"]
+
+        detail = "; ".join(history)
+        reason = (
+            "fatal error"
+            if kind == FATAL
+            else f"exhausted {self.task_max_attempts} attempts"
+        )
+        self.stages[sid] = stage.to_failed(detail)
+        self.status = FAILED
+        self.error = (
+            f"stage {sid} task {p} failed ({reason}): {detail}"
+        )
+        return ["job_failed"]
 
     def _propagate_output(
         self, stage: RunningStage, info: TaskInfo, executor: ExecutorMetadata
@@ -313,8 +423,23 @@ class ExecutionGraph:
                 self.stages[sid] = running
                 affected.add(sid)
 
-        # 5) also re-run completed stages whose own output files lived on
-        #    the lost executor and feed a still-unresolved consumer
+        # 5) bound the rollback: a stage reset more than
+        #    ballista.stage.max_attempts times means the cluster is
+        #    flapping faster than the job can make progress — fail it
+        #    with the reset ledger instead of looping forever
+        for sid in affected:
+            count = self.stage_reset_counts.get(sid, 0) + 1
+            self.stage_reset_counts[sid] = count
+            if count >= self.stage_max_attempts and self.status != FAILED:
+                self.status = FAILED
+                self.error = (
+                    f"stage {sid} reset {count} times after executor loss "
+                    f"(last: {executor_id}); exceeded "
+                    f"ballista.stage.max_attempts={self.stage_max_attempts}"
+                )
+        if self.status == FAILED:
+            return len(affected)
+
         if affected and self.status == COMPLETED:
             self.status = RUNNING
         self.revive()
@@ -329,6 +454,12 @@ class ExecutionGraph:
         g.session_id = self.session_id
         g.scheduler_id = self.scheduler_id
         g.output_partitions = self.output_partitions
+        g.task_max_attempts = self.task_max_attempts
+        g.stage_max_attempts = self.stage_max_attempts
+        g.task_retries = self.task_retries
+        for sid in sorted(self.stage_reset_counts):
+            g.stage_reset_ids.append(sid)
+            g.stage_reset_counts.append(self.stage_reset_counts[sid])
         if self.status == QUEUED:
             g.status.queued.SetInParent()
         elif self.status == RUNNING:
@@ -365,6 +496,12 @@ class ExecutionGraph:
                         continue
                     ts = sp.completed.task_statuses.add()
                     ts.task_id.CopyFrom(t.partition_id.to_proto())
+                    ts.attempt = stage.task_attempts.get(
+                        t.partition_id.partition_id, t.attempt
+                    )
+                    ts.fetch_retries = stage.task_fetch_retries.get(
+                        t.partition_id.partition_id, t.fetch_retries
+                    )
                     ts.completed.executor_id = t.executor_id
                     for p in t.partitions:
                         ts.completed.partitions.add().CopyFrom(p.to_proto())
@@ -388,6 +525,14 @@ class ExecutionGraph:
         self.output_partitions = g.output_partitions
         self.output_locations = []
         self.error = ""
+        # restart/HA adoption must keep the session's bounds and the spent
+        # budgets — a fresh budget per failover would unbound the loops
+        self.task_max_attempts = g.task_max_attempts or DEFAULT_TASK_MAX_ATTEMPTS
+        self.stage_max_attempts = g.stage_max_attempts or DEFAULT_STAGE_MAX_ATTEMPTS
+        self.task_retries = g.task_retries
+        self.stage_reset_counts = dict(
+            zip(g.stage_reset_ids, g.stage_reset_counts)
+        )
         which = g.status.WhichOneof("status")
         if which == "queued":
             self.status = QUEUED
@@ -425,6 +570,8 @@ class ExecutionGraph:
             elif which == "completed":
                 s = sp.completed
                 statuses: List[Optional[TaskInfo]] = [None] * s.partitions
+                attempts: Dict[int, int] = {}
+                fetch_retries: Dict[int, int] = {}
                 for ts in s.task_statuses:
                     pid = PartitionId.from_proto(ts.task_id)
                     statuses[pid.partition_id] = TaskInfo(
@@ -435,13 +582,21 @@ class ExecutionGraph:
                             ShuffleWritePartition.from_proto(p)
                             for p in ts.completed.partitions
                         ],
+                        attempt=ts.attempt,
+                        fetch_retries=ts.fetch_retries,
                     )
+                    if ts.attempt:
+                        attempts[pid.partition_id] = ts.attempt
+                    if ts.fetch_retries:
+                        fetch_retries[pid.partition_id] = ts.fetch_retries
                 stage = CompletedStage(
                     s.stage_id,
                     BallistaCodec.decode_physical(s.plan, work_dir),
                     list(s.output_links),
                     _decode_inputs(s.inputs),
                     statuses,
+                    task_attempts=attempts,
+                    task_fetch_retries=fetch_retries,
                 )
             else:
                 s = sp.failed

@@ -46,6 +46,8 @@ class TaskInfo:
     error: str = ""
     partitions: List[ShuffleWritePartition] = field(default_factory=list)
     metrics: List[tuple] = field(default_factory=list)  # (operator, {k: v})
+    attempt: int = 0  # which attempt this status describes (0-based)
+    fetch_retries: int = 0  # shuffle-fetch retries this attempt paid
 
 
 @dataclass
@@ -174,6 +176,14 @@ class RunningStage:
     inputs: Dict[int, StageInput]
     task_statuses: List[Optional[TaskInfo]]
     stage_metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # fault tolerance (partition -> value); sparse so stage transitions
+    # constructed positionally keep working
+    task_attempts: Dict[int, int] = field(default_factory=dict)
+    task_failures: Dict[int, List[str]] = field(default_factory=dict)
+    # the executor that last failed the partition: its retry never goes
+    # back there while another live executor exists
+    task_exclusions: Dict[int, str] = field(default_factory=dict)
+    task_fetch_retries: Dict[int, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -223,6 +233,8 @@ class RunningStage:
             dict(self.inputs),
             list(self.task_statuses),
             dict(self.stage_metrics),
+            dict(self.task_attempts),
+            dict(self.task_fetch_retries),
         )
 
     def to_failed(self, error: str) -> "FailedStage":
@@ -249,6 +261,8 @@ class CompletedStage:
     inputs: Dict[int, StageInput]
     task_statuses: List[Optional[TaskInfo]]
     stage_metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    task_attempts: Dict[int, int] = field(default_factory=dict)
+    task_fetch_retries: Dict[int, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -268,6 +282,10 @@ class CompletedStage:
             dict(self.inputs),
             list(self.task_statuses),
             dict(self.stage_metrics),
+            dict(self.task_attempts),
+            {},
+            {},
+            dict(self.task_fetch_retries),
         )
 
     def reset_tasks(self, executor_id: str) -> int:

@@ -15,8 +15,10 @@ Counterpart of the reference's ``scheduler/src/state/executor_manager.rs``:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
@@ -26,8 +28,16 @@ from ..proto import pb
 from ..serde.scheduler_types import ExecutorMetadata
 from .backend import Keyspace, StateBackend, WatchEvent
 
+log = logging.getLogger(__name__)
+
 DEFAULT_LIVENESS_WINDOW_S = 60.0
 DEFAULT_EXECUTOR_TIMEOUT_S = 180.0
+# Quarantine defaults (ballista.executor.quarantine_* knobs)
+DEFAULT_QUARANTINE_THRESHOLD = 5
+DEFAULT_QUARANTINE_WINDOW_S = 60.0
+DEFAULT_QUARANTINE_BACKOFF_S = 30.0
+# consecutive LaunchTask failures before an executor is declared lost
+DEFAULT_LAUNCH_FAILURE_THRESHOLD = 3
 
 
 @dataclass
@@ -69,12 +79,27 @@ class ExecutorManager:
         self,
         backend: StateBackend,
         liveness_window_s: float = DEFAULT_LIVENESS_WINDOW_S,
+        quarantine_threshold: int = DEFAULT_QUARANTINE_THRESHOLD,
+        quarantine_window_s: float = DEFAULT_QUARANTINE_WINDOW_S,
+        quarantine_backoff_s: float = DEFAULT_QUARANTINE_BACKOFF_S,
+        launch_failure_threshold: int = DEFAULT_LAUNCH_FAILURE_THRESHOLD,
     ):
         self.backend = backend
         self.liveness_window_s = liveness_window_s
         self._heartbeats: Dict[str, ExecutorHeartbeat] = {}
         self._dead: Set[str] = set()
         self._hb_lock = threading.Lock()
+        # ---- quarantine: sliding-window failure accounting per executor
+        self.quarantine_threshold = quarantine_threshold
+        self.quarantine_window_s = quarantine_window_s
+        self.quarantine_backoff_s = quarantine_backoff_s
+        self.launch_failure_threshold = launch_failure_threshold
+        self._q_lock = threading.Lock()
+        self._failure_times: Dict[str, deque] = {}
+        self._quarantined_until: Dict[str, float] = {}
+        self._launch_failures: Dict[str, int] = {}  # consecutive
+        self._pending_expulsions: Set[str] = set()
+        self.quarantines_total = 0
         self._unsubscribe = backend.watch(Keyspace.Heartbeats, "", self._on_hb_event)
 
     def close(self) -> None:
@@ -124,6 +149,12 @@ class ExecutorManager:
         )
         with self._hb_lock:
             self._dead.discard(metadata.id)
+        with self._q_lock:
+            # a (re-)registering executor starts with a clean record
+            self._failure_times.pop(metadata.id, None)
+            self._quarantined_until.pop(metadata.id, None)
+            self._launch_failures.pop(metadata.id, None)
+            self._pending_expulsions.discard(metadata.id)
         if reserve:
             return [ExecutorReservation(metadata.id) for _ in range(slots)]
         return []
@@ -138,6 +169,11 @@ class ExecutorManager:
         self.save_heartbeat(ExecutorHeartbeat(executor_id, time.time(), "dead"))
         with self._hb_lock:
             self._dead.add(executor_id)
+        with self._q_lock:
+            self._failure_times.pop(executor_id, None)
+            self._quarantined_until.pop(executor_id, None)
+            self._launch_failures.pop(executor_id, None)
+            self._pending_expulsions.discard(executor_id)
 
     def get_executor_metadata(self, executor_id: str) -> ExecutorMetadata:
         raw = self.backend.get(Keyspace.Executors, executor_id)
@@ -198,6 +234,105 @@ class ExecutorManager:
             hb = self._heartbeats.get(executor_id)
         return hb.timestamp if hb else None
 
+    # ---------------------------------------------------------- quarantine
+    def record_task_failure(self, executor_id: str, now: Optional[float] = None) -> bool:
+        """Count one failure into the executor's sliding window.  Returns
+        True when this failure NEWLY quarantines the executor (the caller
+        then resets its in-flight tasks)."""
+        if self.quarantine_threshold <= 0 or not executor_id:
+            return False
+        now = time.time() if now is None else now
+        with self._q_lock:
+            dq = self._failure_times.setdefault(executor_id, deque())
+            dq.append(now)
+            cutoff = now - self.quarantine_window_s
+            while dq and dq[0] < cutoff:
+                dq.popleft()
+            already = self._quarantined_until.get(executor_id, 0.0) > now
+            if len(dq) < self.quarantine_threshold or already:
+                return False
+            quarantined = {
+                eid
+                for eid, until in self._quarantined_until.items()
+                if until > now
+            }
+        # sidelining the ONLY live executor turns a sick cluster into a
+        # dead one — keep it serving (its failures stay bounded by the
+        # per-task attempt budget); checked outside _q_lock since
+        # get_alive_executors takes its own lock
+        others = self.get_alive_executors(now) - quarantined - {executor_id}
+        if not others:
+            log.warning(
+                "executor %s crossed the quarantine threshold but is the "
+                "only live executor; not quarantining",
+                executor_id,
+            )
+            return False
+        with self._q_lock:
+            if self._quarantined_until.get(executor_id, 0.0) > now:
+                return False  # raced: someone else quarantined it
+            dq = self._failure_times.setdefault(executor_id, deque())
+            self._quarantined_until[executor_id] = now + self.quarantine_backoff_s
+            self.quarantines_total += 1
+            dq.clear()  # the window restarts after the backoff
+        log.warning(
+            "executor %s quarantined for %.0fs (%d failures in %.0fs window)",
+            executor_id,
+            self.quarantine_backoff_s,
+            self.quarantine_threshold,
+            self.quarantine_window_s,
+        )
+        return True
+
+    def record_launch_failure(self, executor_id: str) -> bool:
+        """Launch failures feed the quarantine window AND an escalation
+        counter: after ``launch_failure_threshold`` CONSECUTIVE launch
+        failures the executor is queued for expulsion (ExecutorLost) —
+        the scheduler cannot even deliver tasks to it, so silently
+        re-dispatching would black-hole the job.  Returns True when the
+        expulsion threshold was just crossed."""
+        self.record_task_failure(executor_id)
+        with self._q_lock:
+            n = self._launch_failures.get(executor_id, 0) + 1
+            self._launch_failures[executor_id] = n
+            if n < self.launch_failure_threshold:
+                return False
+            if executor_id in self._pending_expulsions:
+                return False
+            self._pending_expulsions.add(executor_id)
+        log.warning(
+            "executor %s failed %d consecutive launches; queueing expulsion",
+            executor_id,
+            n,
+        )
+        return True
+
+    def record_launch_success(self, executor_id: str) -> None:
+        with self._q_lock:
+            self._launch_failures.pop(executor_id, None)
+
+    def take_pending_expulsions(self) -> List[str]:
+        """Drain executors whose repeated launch failures crossed the
+        threshold; the caller posts ExecutorLost for each."""
+        with self._q_lock:
+            out = sorted(self._pending_expulsions)
+            self._pending_expulsions.clear()
+        return out
+
+    def is_quarantined(self, executor_id: str, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        with self._q_lock:
+            return self._quarantined_until.get(executor_id, 0.0) > now
+
+    def quarantined_executors(self, now: Optional[float] = None) -> List[str]:
+        now = time.time() if now is None else now
+        with self._q_lock:
+            return sorted(
+                eid
+                for eid, until in self._quarantined_until.items()
+                if until > now
+            )
+
     # -------------------------------------------------------------- slots
     def reserve_slots(
         self, n: int, job_id: Optional[str] = None
@@ -207,6 +342,9 @@ class ExecutorManager:
         if n <= 0:
             return []
         alive = self.get_alive_executors()
+        # quarantined executors take no new work until their backoff ends
+        for eid in self.quarantined_executors():
+            alive.discard(eid)
         # on LeaseFenced nothing was applied: re-scan and retry once
         # under a fresh grant (the counts may have changed meanwhile)
         for attempt in (0, 1):

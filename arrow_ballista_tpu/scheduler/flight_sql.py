@@ -37,6 +37,8 @@ from ..serde.scheduler_types import PartitionLocation
 log = logging.getLogger(__name__)
 
 JOB_POLL_INTERVAL_S = 0.1
+# fallback when the session config is unavailable; the live value comes
+# from ballista.client.job_timeout_seconds (SET-able per session)
 JOB_TIMEOUT_S = 300.0
 
 
@@ -82,9 +84,17 @@ class FlightSqlService(flight.FlightServerBase):
         self.scheduler.submit_job(job_id, self.session_ctx.session_id, plan)
         return job_id
 
+    def _job_timeout_s(self) -> float:
+        """The ballista.client.job_timeout_seconds knob, read per call so
+        ``SET`` in the shared session takes effect immediately."""
+        try:
+            return self.session_ctx.config.client_job_timeout_seconds
+        except Exception:  # noqa: BLE001 - a broken setting must not hang DoGet
+            return JOB_TIMEOUT_S
+
     def _check_job(self, job_id: str) -> list[PartitionLocation]:
         """Poll until terminal (reference: check_job flight_sql.rs:99-139)."""
-        deadline = time.time() + JOB_TIMEOUT_S
+        deadline = time.time() + self._job_timeout_s()
         tm = self.scheduler.state.task_manager
         while True:
             status = tm.get_job_status(job_id)

@@ -161,8 +161,21 @@ class QueryStageScheduler(EventAction):
             event.executor, event.statuses
         )
         post_job_events(self.state, sender, events)
+        if self.state.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            # a retried/requeued task must land on a DIFFERENT executor
+            # than the slot freed by its failure — reserve across the
+            # cluster (quarantine-reset tasks mint nothing otherwise)
+            retried = sum(
+                1 for _, ev in events if ev in ("task_retried", "task_requeued")
+            )
+            if retried:
+                reservations = list(reservations)
+                reservations.extend(
+                    self.state.executor_manager.reserve_slots(retried)
+                )
         if reservations:
             sender.post(ReservationOffering(reservations))
+        self._drain_expulsions(sender)
 
     def _on_reservation_offering(
         self, event: ReservationOffering, sender: EventSender
@@ -173,13 +186,31 @@ class QueryStageScheduler(EventAction):
             # give the slots back — the next TaskUpdating re-mints them.
             # Re-posting here would spin the loop.
             self.state.executor_manager.cancel_reservations(leftover)
+        self._drain_expulsions(sender)
+
+    def _drain_expulsions(self, sender: EventSender) -> None:
+        """Executors whose repeated launch failures crossed the threshold
+        become ExecutorLost — the standard rollback path — instead of the
+        scheduler silently re-dispatching into a black hole."""
+        for eid in self.state.executor_manager.take_pending_expulsions():
+            sender.post(ExecutorLost(eid, "repeated launch failures"))
 
     def _on_executor_lost(self, event: ExecutorLost, sender: EventSender) -> None:
         log.warning("executor %s lost: %s", event.executor_id, event.reason)
         self.state.executor_manager.remove_executor(event.executor_id)
         affected = self.state.task_manager.executor_lost(event.executor_id)
         for job_id in affected:
-            sender.post(JobUpdated(job_id))
+            # bounded rollback: a stage reset past ballista.stage.max_attempts
+            # failed the graph instead of resetting again
+            status = self.state.task_manager.get_job_status(job_id) or {}
+            if status.get("state") == "failed":
+                sender.post(
+                    JobRunningFailed(
+                        job_id, status.get("error", "stage reset limit")
+                    )
+                )
+            else:
+                sender.post(JobUpdated(job_id))
         if affected and self.state.policy == TaskSchedulingPolicy.PUSH_STAGED:
             total = 0
             for job_id in affected:

@@ -60,6 +60,9 @@ class SchedulerServer:
         liveness_window_s: float = 60.0,
         executor_timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S,
         reaper_interval_s: Optional[float] = None,
+        quarantine_threshold: Optional[int] = None,
+        quarantine_window_s: Optional[float] = None,
+        quarantine_backoff_s: Optional[float] = None,
     ):
         self.scheduler_id = scheduler_id
         self.policy = policy
@@ -71,6 +74,9 @@ class SchedulerServer:
             launcher,
             work_dir,
             liveness_window_s,
+            quarantine_threshold=quarantine_threshold,
+            quarantine_window_s=quarantine_window_s,
+            quarantine_backoff_s=quarantine_backoff_s,
         )
         self.event_loop = EventLoop(
             "query_stage", EVENT_LOOP_BUFFER, QueryStageScheduler(self.state)

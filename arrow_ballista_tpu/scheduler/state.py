@@ -39,11 +39,38 @@ class SchedulerState:
         launcher: Optional[TaskLauncher] = None,
         work_dir: str = "/tmp/ballista-tpu",
         liveness_window_s: float = 60.0,
+        quarantine_threshold: Optional[int] = None,
+        quarantine_window_s: Optional[float] = None,
+        quarantine_backoff_s: Optional[float] = None,
     ):
+        from .executor_manager import (
+            DEFAULT_QUARANTINE_BACKOFF_S,
+            DEFAULT_QUARANTINE_THRESHOLD,
+            DEFAULT_QUARANTINE_WINDOW_S,
+        )
+
         self.backend = backend
         self.scheduler_id = scheduler_id
         self.policy = policy
-        self.executor_manager = ExecutorManager(backend, liveness_window_s)
+        self.executor_manager = ExecutorManager(
+            backend,
+            liveness_window_s,
+            quarantine_threshold=(
+                DEFAULT_QUARANTINE_THRESHOLD
+                if quarantine_threshold is None
+                else quarantine_threshold
+            ),
+            quarantine_window_s=(
+                DEFAULT_QUARANTINE_WINDOW_S
+                if quarantine_window_s is None
+                else quarantine_window_s
+            ),
+            quarantine_backoff_s=(
+                DEFAULT_QUARANTINE_BACKOFF_S
+                if quarantine_backoff_s is None
+                else quarantine_backoff_s
+            ),
+        )
         self.task_manager = TaskManager(
             backend, self.executor_manager, scheduler_id, launcher, work_dir
         )
@@ -77,7 +104,10 @@ class SchedulerState:
         (reference: state/mod.rs:128-150)."""
         events = self.task_manager.update_task_statuses(executor, statuses)
         reservations = []
-        if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+        if (
+            self.policy == TaskSchedulingPolicy.PUSH_STAGED
+            and not self.executor_manager.is_quarantined(executor.id)
+        ):
             finished = sum(1 for s in statuses if s.state in ("completed", "failed"))
             reservations = [
                 ExecutorReservation(executor.id) for _ in range(finished)

@@ -105,6 +105,9 @@ class TaskManager:
         self.work_dir = work_dir
         self._cache: Dict[str, JobEntry] = {}
         self._cache_lock = threading.Lock()
+        # scheduler-lifetime counter of transient-failure re-queues
+        # (surfaced as `task_retries` on /api/metrics)
+        self.task_retries_total = 0
 
     # ------------------------------------------------------------ helpers
     def _entry(self, job_id: str) -> JobEntry:
@@ -277,6 +280,11 @@ class TaskManager:
 
     def _detail_of(self, graph: ExecutionGraph) -> dict:
         detail = self._status_of(graph)
+        detail["task_retries"] = graph.task_retries
+        detail["stage_resets"] = dict(graph.stage_reset_counts)
+        # per-job attempt histogram: {attempts_consumed: n_tasks}; tasks
+        # that never failed land in bucket 0
+        histogram: Dict[int, int] = {}
         stages = []
         for sid in sorted(graph.stages):
             stage = graph.stages[sid]
@@ -289,6 +297,20 @@ class TaskManager:
             count = getattr(stage, "completed_tasks", None)
             if count is not None:
                 row["completed_tasks"] = count()
+            attempts = getattr(stage, "task_attempts", None)
+            if attempts is not None:
+                for p in range(stage.partitions):
+                    a = attempts.get(p, 0)
+                    histogram[a] = histogram.get(a, 0) + 1
+                if attempts:
+                    row["task_attempts"] = dict(attempts)
+                row["task_retries"] = sum(attempts.values())
+            fetch_retries = getattr(stage, "task_fetch_retries", None)
+            if fetch_retries:
+                row["fetch_retries"] = sum(fetch_retries.values())
+            failures = getattr(stage, "task_failures", None)
+            if failures:
+                row["failures"] = {p: list(h) for p, h in failures.items()}
             metrics = getattr(stage, "stage_metrics", None)
             if metrics:
                 row["metrics"] = {
@@ -303,6 +325,11 @@ class TaskManager:
             row["plan"] = _plan_tree_text(stage.plan)
             stages.append(row)
         detail["stages"] = stages
+        detail["attempt_histogram"] = histogram
+        # decoded (persisted) graphs lose the live counter but keep the
+        # per-task attempts; derive so completed jobs still report retries
+        attempts_total = sum(a * n for a, n in histogram.items())
+        detail["task_retries"] = max(detail["task_retries"], attempts_total)
         return detail
 
     def get_job_dot(self, job_id: str) -> Optional[str]:
@@ -319,13 +346,23 @@ class TaskManager:
         statuses: List[TaskInfo],
     ) -> List[Tuple[str, str]]:
         """Group statuses per job, apply to graphs; returns
-        [(job_id, event)] with event in job_updated/job_completed/job_failed
-        (reference: task_manager.rs:132-170)."""
+        [(job_id, event)] with event in
+        job_updated/job_completed/job_failed/task_retried
+        (reference: task_manager.rs:132-170).
+
+        Failed statuses feed the executor quarantine window; an executor
+        quarantined by this batch gets its in-flight tasks reset so they
+        re-dispatch elsewhere immediately instead of timing out."""
         per_job: Dict[str, List[TaskInfo]] = {}
         for s in statuses:
+            # FailedTask carries no executor id on the wire; the reporting
+            # executor ran it — stamp it for exclusion/quarantine tracking
+            if s.state == "failed" and not s.executor_id:
+                s.executor_id = executor.id
             per_job.setdefault(s.partition_id.job_id, []).append(s)
 
         events: List[Tuple[str, str]] = []
+        newly_quarantined: List[str] = []
         for job_id, infos in per_job.items():
             entry = self._entry(job_id)
             with entry.lock:
@@ -333,10 +370,55 @@ class TaskManager:
                 if graph is None:
                     continue
                 for info in infos:
-                    for ev in graph.update_task_status(info, executor):
+                    evs = graph.update_task_status(info, executor)
+                    for ev in evs:
+                        if ev == "task_retried":
+                            self.task_retries_total += 1
                         events.append((job_id, ev))
+                    if info.state == "failed" and evs:
+                        from .failure import is_transient
+
+                        # only infrastructure (transient) failures that the
+                        # graph actually PROCESSED indict the host: a fatal
+                        # plan/serde error is the job's fault, and a stale
+                        # duplicate of a superseded attempt (evs == [])
+                        # must not re-count one real failure into the
+                        # quarantine window
+                        if is_transient(info.error) and (
+                            self.executor_manager.record_task_failure(
+                                info.executor_id
+                            )
+                        ):
+                            newly_quarantined.append(info.executor_id)
                 self._persist(graph)
+        for eid in newly_quarantined:
+            for job_id, n in self.reset_executor_running_tasks(eid).items():
+                # one task_requeued per reset task: the event loop mints a
+                # replacement reservation for each in push mode (the
+                # quarantined executor's own slots are sidelined)
+                self.task_retries_total += n
+                events.extend([(job_id, "task_requeued")] * n)
         return events
+
+    def reset_executor_running_tasks(self, executor_id: str) -> Dict[str, int]:
+        """Re-queue (with exclusion) every in-flight task on a quarantined
+        executor across cached jobs; returns {job_id: tasks reset}.  Unlike
+        ``executor_lost`` this does NOT roll back completed shuffle output
+        — the host is sick, not gone, and its files are still servable."""
+        with self._cache_lock:
+            job_ids = list(self._cache.keys())
+        affected: Dict[str, int] = {}
+        for job_id in job_ids:
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                n = graph.reset_running_tasks(executor_id)
+                if n:
+                    affected[job_id] = n
+                    self._persist(graph)
+        return affected
 
     # ------------------------------------------------------------ dispatch
     def fill_reservations(
@@ -345,9 +427,22 @@ class TaskManager:
         """Assign tasks to reserved slots.  Returns (assignments as
         (executor_id, task), unassigned reservations, pending tasks count)
         (reference: task_manager.rs:184-221)."""
-        free = list(reservations)
+        em = self.executor_manager
+        quarantined = set(em.quarantined_executors())
+        # a quarantined executor's slots sit out this cycle entirely —
+        # returned unfilled so the caller cancels them back to the pool
+        free = [r for r in reservations if r.executor_id not in quarantined]
+        sidelined = [r for r in reservations if r.executor_id in quarantined]
         assignments: List[Tuple[str, Task]] = []
         pending = 0
+
+        # exclusion escape hatch: a task is never retried on the executor
+        # that just failed it UNLESS that executor is the only live
+        # candidate (otherwise a 1-executor cluster could never retry)
+        alive = em.get_alive_executors() - quarantined
+
+        def _allow_excluded(executor_id: str) -> bool:
+            return not (alive - {executor_id})
 
         with self._cache_lock:
             job_ids = list(self._cache.keys())
@@ -366,7 +461,10 @@ class TaskManager:
                 free_before = list(free)
                 still_free = []
                 for r in free:
-                    task = graph.pop_next_task(r.executor_id)
+                    task = graph.pop_next_task(
+                        r.executor_id,
+                        allow_excluded=_allow_excluded(r.executor_id),
+                    )
                     if task is None:
                         still_free.append(r)
                         continue
@@ -393,7 +491,7 @@ class TaskManager:
                         )
                         del assignments[start:]
                         free = free_before
-        return assignments, free, pending
+        return assignments, free + sidelined, pending
 
     def prepare_task_definition(self, task: Task) -> pb.TaskDefinition:
         td = pb.TaskDefinition()
@@ -406,6 +504,7 @@ class TaskManager:
             td.has_output_partitioning = True
         td.session_id = task.session_id
         td.curator_scheduler_id = self.scheduler_id
+        td.attempt = task.attempt
         # ship the session settings so the executor's TaskContext + TPU
         # acceleration pass see the client's config (reference: grpc.rs
         # poll_work/launch builds TaskDefinition.props from session props)
@@ -423,23 +522,33 @@ class TaskManager:
     def launch_tasks(
         self, executor: ExecutorMetadata, tasks: List[Task]
     ) -> None:
+        from ..testing.faults import fault_point
+
         defs = [self.prepare_task_definition(t) for t in tasks]
         try:
+            fault_point("scheduler.launch_task", executor_id=executor.id)
             self.launcher.launch(executor, defs, self.scheduler_id)
         except Exception as e:
-            # hand the tasks back so they can re-dispatch elsewhere
+            # hand the tasks back — excluded from this executor so the
+            # re-dispatch goes elsewhere — and feed the quarantine window;
+            # repeated launch failures queue the executor for expulsion
+            # (drained into ExecutorLost by the query-stage scheduler)
             for t in tasks:
-                self.reset_task(t.partition)
+                self.reset_task(t.partition, exclude_executor=executor.id)
+            self.executor_manager.record_launch_failure(executor.id)
             raise SchedulerError(
                 f"launching {len(tasks)} task(s) on {executor.id} failed: {e}"
             ) from e
+        self.executor_manager.record_launch_success(executor.id)
 
-    def reset_task(self, partition: PartitionId) -> None:
+    def reset_task(
+        self, partition: PartitionId, exclude_executor: str = ""
+    ) -> None:
         entry = self._entry(partition.job_id)
         with entry.lock:
             graph = self._load(partition.job_id, entry)
             if graph is not None:
-                graph.reset_task_status(partition)
+                graph.reset_task_status(partition, exclude_executor)
                 self._persist(graph)
 
     # --------------------------------------------------------- transitions
@@ -543,7 +652,14 @@ class TaskManager:
         for job_id in self.active_job_ids():
             st = self.get_job_status(job_id)
             if st is not None:
-                out.append({"job_id": job_id, "state": st["state"]})
+                retries = self._with_graph(job_id, lambda g: g.task_retries)
+                out.append(
+                    {
+                        "job_id": job_id,
+                        "state": st["state"],
+                        "task_retries": retries or 0,
+                    }
+                )
                 seen.add(job_id)
         for ks, state in (
             (Keyspace.CompletedJobs, "completed"),

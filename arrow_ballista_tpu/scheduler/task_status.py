@@ -17,6 +17,8 @@ from .execution_stage import TaskInfo
 def task_info_to_proto(info: TaskInfo) -> pb.TaskStatus:
     msg = pb.TaskStatus()
     msg.task_id.CopyFrom(info.partition_id.to_proto())
+    msg.attempt = info.attempt
+    msg.fetch_retries = info.fetch_retries
     if info.state == "running":
         msg.running.executor_id = info.executor_id
     elif info.state == "failed":
@@ -40,9 +42,23 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
     which = msg.WhichOneof("status")
     metrics = [(m.operator_name, dict(m.values)) for m in msg.metrics]
     if which == "running":
-        return TaskInfo(pid, "running", msg.running.executor_id, metrics=metrics)
+        return TaskInfo(
+            pid,
+            "running",
+            msg.running.executor_id,
+            metrics=metrics,
+            attempt=msg.attempt,
+            fetch_retries=msg.fetch_retries,
+        )
     if which == "failed":
-        return TaskInfo(pid, "failed", error=msg.failed.error, metrics=metrics)
+        return TaskInfo(
+            pid,
+            "failed",
+            error=msg.failed.error,
+            metrics=metrics,
+            attempt=msg.attempt,
+            fetch_retries=msg.fetch_retries,
+        )
     if which == "completed":
         parts = [
             ShuffleWritePartition.from_proto(p) for p in msg.completed.partitions
@@ -53,6 +69,8 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             msg.completed.executor_id,
             partitions=parts,
             metrics=metrics,
+            attempt=msg.attempt,
+            fetch_retries=msg.fetch_retries,
         )
     raise ValueError(f"TaskStatus with no status set for {pid}")
 

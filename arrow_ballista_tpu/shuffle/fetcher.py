@@ -152,11 +152,18 @@ def retrying_fetch(
     ``stop_event`` cuts a backoff wait short (the original error
     re-raises).
     """
+    from ..testing.faults import fault_point
+
     fetch = fetch_fn or fetch_location
     attempt = 0
     delivered = 0
     while True:
         try:
+            fault_point(
+                "shuffle.fetch",
+                path=getattr(loc, "path", ""),
+                attempt=attempt,
+            )
             skip = delivered
             for batch in fetch(loc):
                 if skip > 0:

@@ -205,16 +205,106 @@ def test_task_ordering_respects_dependencies():
     assert t3 is not None and t3.partition.stage_id == final_sid
 
 
-def test_failed_task_fails_job():
+def test_fatal_failed_task_fails_job():
+    # fatal (plan/serde-class) errors fail fast on attempt 1 — no retry
     graph = make_graph("select g, sum(v) as s from t group by g")
     graph.revive()
     task = graph.pop_next_task("exec-1")
     events = graph.update_task_status(
-        TaskInfo(task.partition, "failed", "exec-1", error="boom"), EXEC1
+        TaskInfo(task.partition, "failed", "exec-1", error="PlanError: boom"),
+        EXEC1,
     )
     assert events == ["job_failed"]
     assert graph.status == FAILED
     assert "boom" in graph.error
+    assert graph.task_retries == 0
+
+
+def test_transient_failed_task_retries_then_fails():
+    # transient failures re-queue the partition (excluded from the failing
+    # executor) until ballista.task.max_attempts is exhausted, then fail
+    # with the accumulated error history
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    for attempt in range(graph.task_max_attempts):
+        executor = ("exec-1", "exec-2")[attempt % 2]
+        task = graph.pop_next_task(executor)
+        assert task is not None, f"attempt {attempt} not re-queued"
+        assert task.attempt == attempt
+        events = graph.update_task_status(
+            TaskInfo(
+                task.partition,
+                "failed",
+                executor,
+                error=f"OSError: disk on fire #{attempt}",
+                attempt=task.attempt,
+            ),
+            EXEC1,
+        )
+        if attempt < graph.task_max_attempts - 1:
+            assert events == ["task_retried"]
+            # the retry is excluded from the executor that just failed it
+            stage = graph.stages[task.partition.stage_id]
+            assert stage.task_exclusions[task.partition.partition_id] == executor
+        else:
+            assert events == ["job_failed"]
+    assert graph.status == FAILED
+    assert graph.task_retries == graph.task_max_attempts - 1
+    # the accumulated history names every attempt
+    for attempt in range(graph.task_max_attempts):
+        assert f"disk on fire #{attempt}" in graph.error
+
+
+def test_retry_not_placed_on_failing_executor():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    task = graph.pop_next_task("exec-1")
+    map_sid = task.partition.stage_id
+    events = graph.update_task_status(
+        TaskInfo(
+            task.partition, "failed", "exec-1",
+            error="OSError: boom", attempt=0,
+        ),
+        EXEC1,
+    )
+    assert events == ["task_retried"]
+    # exec-1 cannot take the retried partition back...
+    seen = set()
+    while True:
+        t = graph.pop_next_task("exec-1")
+        if t is None:
+            break
+        seen.add(t.partition.partition_id)
+    assert task.partition.partition_id not in seen
+    # ...but exec-2 can, and the liveness escape hatch lets exec-1 too
+    t2 = graph.pop_next_task("exec-2")
+    assert t2 is not None and t2.partition.partition_id == task.partition.partition_id
+    graph.reset_task_status(t2.partition)
+    t3 = graph.pop_next_task("exec-1", allow_excluded=True)
+    assert t3 is not None and t3.partition.partition_id == task.partition.partition_id
+
+
+def test_stale_attempt_failure_ignored():
+    # a failure report from a superseded attempt must not burn the retry
+    # budget or fail the job
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    task = graph.pop_next_task("exec-1")
+    graph.update_task_status(
+        TaskInfo(task.partition, "failed", "exec-1",
+                 error="OSError: t0", attempt=0),
+        EXEC1,
+    )
+    retry = graph.pop_next_task("exec-2")
+    assert retry.attempt == 1
+    # late duplicate of attempt 0 arrives after the retry dispatched
+    events = graph.update_task_status(
+        TaskInfo(task.partition, "failed", "exec-1",
+                 error="OSError: t0 again", attempt=0),
+        EXEC1,
+    )
+    assert events == []
+    assert graph.status == RUNNING
 
 
 def test_reset_task_status_returns_task_to_pool():
@@ -269,6 +359,91 @@ def test_reset_stages_on_executor_loss():
     # drain on exec-2 completes the job
     drain(graph, EXEC2)
     assert graph.status == COMPLETED, graph.error
+
+
+def test_reset_stages_rolls_back_completed_map_stage():
+    """A completed map stage whose output lived on the lost executor must
+    roll back (its lost tasks to Unresolved/re-run) while the consumer
+    stage returns to Unresolved — then the job completes elsewhere."""
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    map_sid = min(graph.stages)
+    final_sid = graph.final_stage_id
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-2")
+    complete_task(graph, t1, EXEC1)
+    complete_task(graph, t2, EXEC2)
+    # the whole map stage is Completed, the final stage Running
+    assert isinstance(graph.stages[map_sid], CompletedStage)
+    assert isinstance(graph.stages[final_sid], RunningStage)
+
+    affected = graph.reset_stages("exec-1")
+    assert affected >= 2
+    # map stage re-runs ONLY the lost task; final stage rolled back
+    map_stage = graph.stages[map_sid]
+    assert isinstance(map_stage, RunningStage)
+    assert map_stage.available_tasks() == 1
+    assert isinstance(graph.stages[final_sid], UnresolvedStage)
+    # exec-2's surviving map output is still registered
+    final_inputs = graph.stages[final_sid].inputs[map_sid]
+    survivors = {
+        l.executor_meta.id
+        for locs in final_inputs.partition_locations.values()
+        for l in locs
+    }
+    assert survivors == {"exec-2"}
+
+    drain(graph, EXEC2)
+    assert graph.status == COMPLETED, graph.error
+
+
+def test_second_executor_lost_during_rollback_does_not_double_reset():
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.revive()
+    map_sid = min(graph.stages)
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-2")
+    complete_task(graph, t1, EXEC1)
+    complete_task(graph, t2, EXEC2)
+    graph.reset_stages("exec-1")
+    map_stage = graph.stages[map_sid]
+    available = map_stage.available_tasks()
+    resets = dict(graph.stage_reset_counts)
+    # the same loss reported again mid-rollback: nothing left to strip,
+    # so no stage is affected and no reset budget is burned
+    assert graph.reset_stages("exec-1") == 0
+    assert graph.stages[map_sid] is map_stage
+    assert map_stage.available_tasks() == available
+    assert graph.stage_reset_counts == resets
+    drain(graph, EXEC2)
+    assert graph.status == COMPLETED, graph.error
+
+
+def test_stage_resets_bounded_by_max_attempts():
+    """A flapping cluster cannot loop the rollback forever: past
+    ballista.stage.max_attempts the job fails with the reset ledger."""
+    graph = make_graph("select g, sum(v) as s from t group by g")
+    graph.stage_max_attempts = 2
+    graph.revive()
+    map_sid = min(graph.stages)
+
+    # round 1: exec-1 completes the map stage, then dies
+    for _ in range(2):
+        t = graph.pop_next_task("exec-1")
+        complete_task(graph, t, EXEC1)
+    assert graph.reset_stages("exec-1") >= 1
+    assert graph.status == RUNNING
+    assert graph.stage_reset_counts[map_sid] == 1
+
+    # round 2: exec-2 re-runs it and also dies -> budget exhausted
+    for _ in range(2):
+        t = graph.pop_next_task("exec-2")
+        if t is None:
+            break
+        complete_task(graph, t, EXEC2)
+    graph.reset_stages("exec-2")
+    assert graph.status == FAILED
+    assert "ballista.stage.max_attempts" in graph.error
 
 
 def test_graph_persistence_roundtrip():

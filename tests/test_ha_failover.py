@@ -172,7 +172,7 @@ def test_jobs_survive_store_bounce(tmp_path):
         )
         plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
         sched.submit_job("bounce-job", ctx.session_id, plan)
-        assert sched.drain(5.0)
+        assert sched.drain(20.0)
         ran, _ = _run_one_task(sched)
         assert ran == 1
 
@@ -252,7 +252,7 @@ def _run_one_task(server, executor_id=EXEC.id):
         executor_id,
         [TaskInfo(task.partition, "completed", executor_id, partitions=partitions)],
     )
-    assert server.drain(5.0)
+    assert server.drain(20.0)
     return 1, pending
 
 
@@ -279,7 +279,7 @@ def test_two_scheduler_failover_completes_job(store):
         plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
         job_id = "ha-job-1"
         sched_a.submit_job(job_id, ctx.session_id, plan)
-        assert sched_a.drain(5.0)
+        assert sched_a.drain(20.0)
 
         # A publishes liveness, completes stage 1 (both tasks), then dies
         sched_a.heartbeat_self()
@@ -342,7 +342,7 @@ def test_takeover_is_single_winner(store):
         )
         plan = ctx.sql("select sum(x) as s from t").logical_plan()
         sched_b.submit_job("dead-job", ctx.session_id, plan)
-        assert sched_b.drain(5.0)
+        assert sched_b.drain(20.0)
         # rewrite curator to the dead peer
         tm = sched_b.state.task_manager
         entry = tm._entry("dead-job")
@@ -477,7 +477,7 @@ def test_extended_store_outage_converges(tmp_path):
         )
         plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
         sched.submit_job("outage-job", ctx.session_id, plan)
-        assert sched.drain(5.0)
+        assert sched.drain(20.0)
         ran, _ = _run_one_task(sched)
         assert ran == 1
 
@@ -647,7 +647,7 @@ def test_replicated_store_failover_completes_job(tmp_path):
         )
         plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
         sched.submit_job("rep-job", ctx.session_id, plan)
-        assert sched.drain(5.0)
+        assert sched.drain(20.0)
         ran, _ = _run_one_task(sched)
         assert ran == 1
         assert l_stale.acquire(timeout=2.0)  # lease on the PRIMARY

@@ -335,7 +335,9 @@ def test_planning_failure_fails_job():
         f.stop()
 
 
-def test_task_failure_fails_job():
+def test_fatal_task_failure_fails_job():
+    # fatal-classified errors fail the job on attempt 1 (transient ones
+    # retry — covered by tests/test_fault_tolerance.py)
     f = Fixture()
     try:
         f.state.executor_manager.register_executor(EXEC1)
@@ -349,13 +351,22 @@ def test_task_failure_fails_job():
         _, task = assignments[0]
         f.sender.post(
             TaskUpdating(
-                EXEC1, [TaskInfo(task.partition, "failed", "exec-1", error="boom")]
+                EXEC1,
+                [
+                    TaskInfo(
+                        task.partition,
+                        "failed",
+                        "exec-1",
+                        error="PlanError: boom",
+                    )
+                ],
             )
         )
         assert f.loop.drain(5.0)
         status = f.state.task_manager.get_job_status(job_id)
         assert status["state"] == "failed"
         assert "boom" in status["error"]
+        assert f.state.task_manager.task_retries_total == 0
     finally:
         f.stop()
 
