@@ -237,7 +237,8 @@ class BallistaContext:
         distributed_query.rs:232-309)."""
         from ..scheduler.task_status import job_status_from_proto
 
-        deadline = time.time() + timeout_s
+        # monotonic deadline: immune to wall-clock jumps mid-poll
+        deadline = time.monotonic() + timeout_s
         while True:
             result = self.stub.GetJobStatus(
                 pb.GetJobStatusParams(job_id=job_id), timeout=20
@@ -250,7 +251,7 @@ class BallistaContext:
                 raise ExecutionError(
                     f"job {job_id} failed: {status.get('error', 'unknown error')}"
                 )
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise ExecutionError(f"job {job_id} timed out after {timeout_s}s")
             time.sleep(JOB_POLL_INTERVAL_S)
 

@@ -50,6 +50,10 @@ EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
 EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
 EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
 CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
+# Observability (see docs/user-guide/observability.md)
+OBS_ENABLED = "ballista.obs.enabled"
+OBS_SAMPLE_RATE = "ballista.obs.sample_rate"
+OBS_BUFFER_SPANS = "ballista.obs.buffer_spans"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -299,6 +303,28 @@ _ENTRIES: dict[str, ConfigEntry] = {
             float,
             "300",
         ),
+        ConfigEntry(
+            OBS_ENABLED,
+            "distributed tracing + span recording for this session's jobs "
+            "(scheduler, executors and shuffle fetch stitch under one "
+            "trace id); off = the span API is a near-zero-cost no-op",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            OBS_SAMPLE_RATE,
+            "fraction of jobs that get a trace when obs is enabled "
+            "(sampling decided once per job at submit)",
+            float,
+            "1.0",
+        ),
+        ConfigEntry(
+            OBS_BUFFER_SPANS,
+            "per-process finished-span ring-buffer capacity; overflow "
+            "drops the oldest spans (observability never grows unbounded)",
+            int,
+            "4096",
+        ),
     ]
 }
 
@@ -451,6 +477,18 @@ class BallistaConfig:
     @property
     def client_job_timeout_seconds(self) -> float:
         return self._get(CLIENT_JOB_TIMEOUT_S)
+
+    @property
+    def obs_enabled(self) -> bool:
+        return self._get(OBS_ENABLED)
+
+    @property
+    def obs_sample_rate(self) -> float:
+        return self._get(OBS_SAMPLE_RATE)
+
+    @property
+    def obs_buffer_spans(self) -> int:
+        return self._get(OBS_BUFFER_SPANS)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional
 
 from ..config import BallistaConfig
 from ..exec.operators import TaskContext
+from ..obs import trace
+from ..obs.recorder import get_recorder
 from ..proto import pb
 from ..scheduler.execution_stage import TaskInfo
 from ..scheduler.task_status import collect_plan_metrics, task_info_to_proto
@@ -167,44 +169,63 @@ class Executor:
             return self._execute_in_worker(task)
         from ..testing.faults import fault_point
 
+        # observability ratchets on with the first traced task and the
+        # task's trace context (minted at the scheduler) adopts on this
+        # thread so every child span stitches under the job's trace
+        trace.enable_from_props(task.props, process=f"executor:{self.id}")
         pid = PartitionId.from_proto(task.task_id)
         cancel_event = threading.Event()
         with self._abort_lock:
             self._abort_handles[pid] = cancel_event
         try:
-            fault_point(
-                "executor.execute_task",
-                executor_id=self.id,
-                job_id=pid.job_id,
-                stage_id=pid.stage_id,
-                partition_id=pid.partition_id,
+            with trace.activate(task.trace_id, task.parent_span_id), trace.span(
+                "task.execute",
+                job=pid.job_id,
+                stage=pid.stage_id,
+                partition=pid.partition_id,
                 attempt=task.attempt,
-            )
-            plan = BallistaCodec.decode_physical(task.plan, self.work_dir)
-            config = BallistaConfig(dict(task.props))
-            writer = self._new_shuffle_writer(pid, plan, task, config)
-            ctx = TaskContext(
-                session_id=task.session_id or "default",
-                config=config,
-                work_dir=self.work_dir,
-                job_id=pid.job_id,
-                stage_id=pid.stage_id,
-                cancel_event=cancel_event,
-            )
-            partitions = writer.execute_shuffle_write(pid.partition_id, ctx)
-            metrics = collect_plan_metrics(writer)
-            self.metrics_collector.record_stage(
-                pid.job_id, pid.stage_id, pid.partition_id, writer, metrics
-            )
-            info = TaskInfo(
-                pid,
-                "completed",
-                executor_id=self.id,
-                partitions=partitions,
-                metrics=metrics,
-                attempt=task.attempt,
-                fetch_retries=_sum_metric(metrics, "fetch_retries"),
-            )
+                executor=self.id,
+            ):
+                fault_point(
+                    "executor.execute_task",
+                    executor_id=self.id,
+                    job_id=pid.job_id,
+                    stage_id=pid.stage_id,
+                    partition_id=pid.partition_id,
+                    attempt=task.attempt,
+                )
+                with trace.span("task.prepare"):
+                    plan = BallistaCodec.decode_physical(task.plan, self.work_dir)
+                    config = BallistaConfig(dict(task.props))
+                    writer = self._new_shuffle_writer(pid, plan, task, config)
+                ctx = TaskContext(
+                    session_id=task.session_id or "default",
+                    config=config,
+                    work_dir=self.work_dir,
+                    job_id=pid.job_id,
+                    stage_id=pid.stage_id,
+                    cancel_event=cancel_event,
+                )
+                with trace.span("shuffle.write") as wspan:
+                    partitions = writer.execute_shuffle_write(
+                        pid.partition_id, ctx
+                    )
+                    wspan.set_attr(
+                        "bytes", sum(p.num_bytes for p in partitions)
+                    )
+                metrics = collect_plan_metrics(writer)
+                self.metrics_collector.record_stage(
+                    pid.job_id, pid.stage_id, pid.partition_id, writer, metrics
+                )
+                info = TaskInfo(
+                    pid,
+                    "completed",
+                    executor_id=self.id,
+                    partitions=partitions,
+                    metrics=metrics,
+                    attempt=task.attempt,
+                    fetch_retries=_sum_metric(metrics, "fetch_retries"),
+                )
         except Exception as e:  # noqa: BLE001 - every failure must report
             log.warning("task %s failed: %s", pid, e, exc_info=True)
             info = TaskInfo(
@@ -217,6 +238,10 @@ class Executor:
         finally:
             with self._abort_lock:
                 self._abort_handles.pop(pid, None)
+        if trace.is_enabled():
+            # piggyback every span finished in this process (this task's
+            # and any stragglers) onto the status report
+            info.spans = get_recorder().drain()
         return task_info_to_proto(info)
 
     def _new_shuffle_writer(
@@ -268,6 +293,10 @@ class Executor:
         DedicatedExecutor property: plan execution cannot starve Flight
         serving / CancelTasks / heartbeats in this process)."""
         pid = PartitionId.from_proto(task.task_id)
+        # the worker records its own spans (they ride back inside the
+        # TaskStatus bytes); the parent still ratchets obs on so ITS
+        # heartbeat piggyback and Flight-serving spans flow too
+        trace.enable_from_props(task.props, process=f"executor:{self.id}")
         with self._worker_lock:
             worker = (
                 self._idle_workers.pop() if self._idle_workers else None

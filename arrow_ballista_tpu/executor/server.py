@@ -97,20 +97,36 @@ class Heartbeater:
             self._send()
 
     def _send(self) -> None:
+        from ..obs import trace
+        from ..obs.recorder import get_recorder
         from ..testing.faults import FaultInjected, fault_point
 
+        drained = None
         try:
             fault_point("executor.heartbeat", executor_id=self.executor_id)
             status = pb.ExecutorStatus()
             status.active = ""
-            self.scheduler.HeartBeatFromExecutor(
-                pb.HeartBeatParams(executor_id=self.executor_id, status=status),
-                timeout=10,
+            params = pb.HeartBeatParams(
+                executor_id=self.executor_id, status=status
             )
+            if trace.is_enabled():
+                # spans finished between task reports (Flight serving,
+                # cache activity) ride the heartbeat to the trace store
+                drained = get_recorder().drain()
+                if drained:
+                    import json as _json
+
+                    params.spans_json = _json.dumps(drained).encode()
+            self.scheduler.HeartBeatFromExecutor(params, timeout=10)
         except FaultInjected as e:
             # injected dropped beat: skip this interval, next one retries
             log.warning("heartbeat suppressed by fault injection: %s", e)
         except grpc.RpcError as e:
+            # the beat (and its span payload) never arrived: give the
+            # spans back so the next beat re-ships them instead of
+            # leaving silent trace gaps exactly when the system limps
+            if drained:
+                get_recorder().requeue(drained)
             log.warning("heartbeat failed: %s", e.code())
 
 

@@ -72,18 +72,31 @@ class BallistaClient:
                 del cls._cache[(host, port)]
 
     def fetch_partition(
-        self, job_id: str, stage_id: int, partition_id: int, path: str
+        self,
+        job_id: str,
+        stage_id: int,
+        partition_id: int,
+        path: str,
+        headers: list = None,
     ) -> Iterator[pa.RecordBatch]:
         _schema, batches = self.fetch_partition_with_schema(
-            job_id, stage_id, partition_id, path
+            job_id, stage_id, partition_id, path, headers=headers
         )
         return batches
 
     def fetch_partition_with_schema(
-        self, job_id: str, stage_id: int, partition_id: int, path: str
+        self,
+        job_id: str,
+        stage_id: int,
+        partition_id: int,
+        path: str,
+        headers: list = None,
     ) -> tuple[pa.Schema, Iterator[pa.RecordBatch]]:
         """Returns the partition schema up front (available even when the
-        partition holds zero batches) plus a lazy batch stream."""
+        partition holds zero batches) plus a lazy batch stream.
+
+        ``headers`` (list of (bytes, bytes) pairs) ride the DoGet as gRPC
+        metadata — the trace-context hop for stitched shuffle traces."""
         ticket_proto = pb.FetchPartitionTicket(
             job_id=job_id,
             stage_id=stage_id,
@@ -92,7 +105,14 @@ class BallistaClient:
         )
         ticket = flight.Ticket(ticket_proto.SerializeToString())
         try:
-            reader = self._client.do_get(ticket)
+            # positional options only when headers ride along: test/client
+            # doubles with a plain do_get(ticket) signature keep working
+            if headers:
+                reader = self._client.do_get(
+                    ticket, flight.FlightCallOptions(headers=headers)
+                )
+            else:
+                reader = self._client.do_get(ticket)
             schema = reader.schema
         except flight.FlightError as e:
             type(self).invalidate(self.host, self.port, self)

@@ -11,19 +11,87 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 import pyarrow as pa
 import pyarrow.flight as flight
 
+from ..obs import trace
 from ..proto import pb
+
+
+class _TraceMiddleware(flight.ServerMiddleware):
+    """Carries the caller's trace context for the duration of one call."""
+
+    def __init__(self, trace_id: str, parent_span_id: str):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+
+
+def _header_value(headers, key: str) -> str:
+    vals = headers.get(key) or headers.get(key.encode(), ())
+    for v in vals:
+        return v.decode() if isinstance(v, bytes) else v
+    return ""
+
+
+class _TraceMiddlewareFactory(flight.ServerMiddlewareFactory):
+    def start_call(self, info, headers):
+        tid = _header_value(headers, trace.TRACE_HEADER.decode())
+        if not tid:
+            return None
+        return _TraceMiddleware(
+            tid, _header_value(headers, trace.PARENT_HEADER.decode())
+        )
 
 
 class ShuffleFlightService(flight.FlightServerBase):
     def __init__(self, work_dir: str, host: str = "0.0.0.0", port: int = 0):
         location = f"grpc://{host}:{port}"
-        super().__init__(location)
+        super().__init__(
+            location, middleware={"trace": _TraceMiddlewareFactory()}
+        )
         self.work_dir = os.path.abspath(work_dir)
+
+    @staticmethod
+    def _trace_ctx(context) -> tuple:
+        """(trace_id, parent_span_id) from call metadata, or ("", "")."""
+        try:
+            mw = context.get_middleware("trace")
+        except Exception:  # noqa: BLE001 - tracing never fails a fetch
+            mw = None
+        if mw is None:
+            return "", ""
+        return mw.trace_id, mw.parent_span_id
+
+    @staticmethod
+    def _traced_stream(batches, trace_id: str, parent: str, path: str):
+        """Wrap a batch stream so the serving window is one span in the
+        CALLER's trace (closed when the stream drains or breaks)."""
+        t0_unix, t0_mono = time.time_ns(), time.monotonic_ns()
+        nbytes = 0
+        error = ""
+        try:
+            for b in batches:
+                nbytes += int(getattr(b, "nbytes", 0) or 0)
+                yield b
+        except BaseException as e:
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            attrs = {"path": path, "bytes": nbytes}
+            if error:
+                attrs["error"] = error
+            trace.record_raw(
+                "flight.do_get",
+                trace_id,
+                trace.new_id(),
+                parent or trace_id,
+                t0_unix,
+                time.monotonic_ns() - t0_mono,
+                **attrs,
+            )
 
     def do_get(self, context, ticket: flight.Ticket):
         msg = pb.FetchPartitionTicket()
@@ -31,6 +99,7 @@ class ShuffleFlightService(flight.FlightServerBase):
             msg.ParseFromString(ticket.ticket)
         except Exception as e:
             raise flight.FlightServerError(f"invalid ticket: {e}")
+        trace_id, parent = self._trace_ctx(context)
         from ..shuffle import memory_store
 
         if msg.path.startswith(memory_store.SCHEME):
@@ -40,7 +109,12 @@ class ShuffleFlightService(flight.FlightServerBase):
                     f"no such memory partition {msg.path!r}"
                 )
             schema, batches = hit
-            return flight.GeneratorStream(schema, iter(batches))
+            stream = iter(batches)
+            if trace_id and trace.is_enabled():
+                stream = self._traced_stream(
+                    stream, trace_id, parent, msg.path
+                )
+            return flight.GeneratorStream(schema, stream)
         path = os.path.abspath(msg.path)
         # only serve files inside the work dir (the ticket's path originates
         # from this executor's own shuffle-write stats, but never trust it)
@@ -72,7 +146,10 @@ class ShuffleFlightService(flight.FlightServerBase):
             finally:
                 source.close()
 
-        return flight.GeneratorStream(reader.schema, gen())
+        stream = gen()
+        if trace_id and trace.is_enabled():
+            stream = self._traced_stream(stream, trace_id, parent, msg.path)
+        return flight.GeneratorStream(reader.schema, stream)
 
 
 class FlightServerHandle:

@@ -1,0 +1,166 @@
+"""Trace and profile exports.
+
+* :func:`chrome_trace` — spans → Chrome Trace Event JSON (the "JSON array
+  format with metadata"), loadable in Perfetto / chrome://tracing.  Each
+  distinct recording process becomes a pid row with a process_name
+  metadata event, so one job renders scheduler and executor lanes on a
+  single wall-clock timeline.
+* :func:`job_profile` — EXPLAIN-ANALYZE-style per-stage rollup joining
+  the scheduler's job detail (stage states, attempts, merged operator
+  metrics) with the job's spans: queue wait, attempt count, shuffle
+  bytes/retries, TPU compile-vs-execute split and compile-cache
+  hit/miss from ``ops/stage_compiler.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def chrome_trace(spans: List[dict], job_id: str = "") -> dict:
+    """Spans (recorder dicts) → Chrome trace JSON object."""
+    pids: Dict[str, int] = {}
+    events: List[dict] = []
+    for s in spans:
+        proc = s.get("proc", "proc")
+        pid = pids.get(proc)
+        if pid is None:
+            pid = pids[proc] = len(pids) + 1
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": proc},
+                }
+            )
+        args = dict(s.get("attrs") or {})
+        args["span_id"] = s.get("span", "")
+        if s.get("parent"):
+            args["parent_span_id"] = s["parent"]
+        events.append(
+            {
+                "name": s.get("name", "span"),
+                "cat": s.get("trace", ""),
+                "ph": "X",
+                "pid": pid,
+                "tid": s.get("tid", 0),
+                # Chrome trace timestamps are MICROseconds
+                "ts": s.get("ts", 0) / 1000.0,
+                "dur": max(s.get("dur", 0), 1) / 1000.0,
+                "args": args,
+            }
+        )
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if job_id:
+        out["otherData"] = {"job_id": job_id}
+    return out
+
+
+def _stage_of(span: dict) -> Optional[int]:
+    st = (span.get("attrs") or {}).get("stage")
+    try:
+        return int(st)
+    except (TypeError, ValueError):
+        return None
+
+
+_NS_PER_MS = 1e6
+
+
+def job_profile(detail: dict, spans: List[dict]) -> dict:
+    """Join the scheduler's job detail with the job's spans into a
+    per-stage profile.  ``detail`` is ``TaskManager.get_job_detail``
+    output; missing spans degrade the timing columns to null, never the
+    whole profile."""
+    task_spans: Dict[int, List[dict]] = {}
+    root_ts: Optional[int] = None
+    for s in spans:
+        if s.get("name") == "job" or s.get("span") == s.get("trace"):
+            root_ts = s.get("ts") if root_ts is None else min(root_ts, s["ts"])
+        if s.get("name") in ("task.execute", "task.run"):
+            sid = _stage_of(s)
+            if sid is not None:
+                task_spans.setdefault(sid, []).append(s)
+    if root_ts is None and spans:
+        root_ts = min(s.get("ts", 0) for s in spans)
+
+    stages_detail = detail.get("stages", [])
+    preds: Dict[int, List[int]] = {int(r["stage_id"]): [] for r in stages_detail}
+    for r in stages_detail:
+        for consumer in r.get("output_links", []):
+            if int(consumer) in preds:
+                preds[int(consumer)].append(int(r["stage_id"]))
+
+    def _stage_end(sid: int) -> Optional[int]:
+        ss = task_spans.get(sid)
+        if not ss:
+            return None
+        return max(s["ts"] + s.get("dur", 0) for s in ss)
+
+    stages = []
+    for r in stages_detail:
+        sid = int(r["stage_id"])
+        metrics = r.get("metrics") or {}
+        tpu = {}
+        shuffle_bytes = 0
+        for op, vals in metrics.items():
+            if op.startswith("TpuStage") or op.startswith("TpuWindow"):
+                for k, v in vals.items():
+                    tpu[k] = tpu.get(k, 0) + v
+            shuffle_bytes += vals.get("bytes_fetched", 0)
+
+        row = {
+            "stage_id": sid,
+            "state": r.get("state"),
+            "partitions": r.get("partitions"),
+            "attempts": sum((r.get("task_attempts") or {}).values())
+            + (r.get("partitions") or 0),
+            "task_retries": r.get("task_retries", 0),
+            "fetch_retries": r.get("fetch_retries", 0),
+            "shuffle_bytes_fetched": shuffle_bytes,
+        }
+
+        ss = task_spans.get(sid)
+        if ss:
+            first = min(s["ts"] for s in ss)
+            last = max(s["ts"] + s.get("dur", 0) for s in ss)
+            row["wall_ms"] = round((last - first) / _NS_PER_MS, 3)
+            row["task_time_ms"] = round(
+                sum(s.get("dur", 0) for s in ss) / _NS_PER_MS, 3
+            )
+            # queue wait: first task start minus when the stage COULD have
+            # started (all producers done; job submit for leaf stages)
+            ready = root_ts
+            for p in preds.get(sid, []):
+                pe = _stage_end(p)
+                if pe is not None:
+                    ready = pe if ready is None else max(ready, pe)
+            if ready is not None:
+                row["queue_wait_ms"] = round(max(first - ready, 0) / _NS_PER_MS, 3)
+        else:
+            row["wall_ms"] = None
+            row["task_time_ms"] = None
+            row["queue_wait_ms"] = None
+
+        if tpu:
+            row["tpu"] = {
+                "compile_ms": round(tpu.get("tpu_compile_ns", 0) / _NS_PER_MS, 3),
+                "execute_ms": round(tpu.get("tpu_execute_ns", 0) / _NS_PER_MS, 3),
+                "compile_cache_hits": tpu.get("compile_cache_hits", 0),
+                "compile_cache_misses": tpu.get("compile_cache_misses", 0),
+            }
+        stages.append(row)
+
+    out = {
+        "job_id": detail.get("job_id"),
+        "state": detail.get("state"),
+        "task_retries": detail.get("task_retries", 0),
+        "attempt_histogram": detail.get("attempt_histogram", {}),
+        "stages": stages,
+        "span_count": len(spans),
+    }
+    if detail.get("error"):
+        out["error"] = detail["error"]
+    return out

@@ -1,0 +1,207 @@
+"""Unified counter/gauge/histogram registry.
+
+Replaces the ad-hoc metric dicts that grew in PR 1/2 (``shuffle/fetcher``
+fetch counters, ``ExecutorManager.quarantines_total``,
+``TaskManager.task_retries_total`` and the hand-assembled ``/api/metrics``
+response): every process-level counter now lives in ONE place with a
+Prometheus text exposition.
+
+Two registry scopes:
+
+* ``MetricsRegistry()`` instances — per scheduler (a test process may run
+  several schedulers; their job/slot counters must not bleed into each
+  other).  ``SchedulerState`` owns one.
+* :func:`process_registry` — the process-wide singleton for data-plane
+  counters (shuffle fetch bytes/retries, flight serving) where the
+  process IS the natural scope.
+
+Gauges take a callable so values are computed at scrape time (alive
+executors, available slots) instead of being pushed on every change.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Union
+
+# go-style duration buckets (seconds) scaled to ns histograms' needs; for
+# generic value histograms powers of 4 keep bucket counts small
+DEFAULT_BUCKETS = tuple(4.0**i for i in range(-1, 12))
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Union[int, float]:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value: either pushed via :meth:`set` or computed by a
+    provider callable at read time."""
+
+    __slots__ = ("name", "help", "_value", "_fn", "_lock")
+
+    def __init__(self, name: str, help: str = "", fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v: Union[int, float]) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self) -> Union[int, float]:
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 - a dead provider reads as 0
+                return 0
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n", "_lock")
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: Union[int, float]) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._n,
+                "sum": self._sum,
+                "buckets": dict(
+                    zip([_fmt(b) for b in self.buckets] + ["+Inf"], self._cumulative())
+                ),
+            }
+
+    def _cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self._counts:
+            acc += c
+            out.append(acc)
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self, namespace: str = "ballista"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+
+    # ------------------------------------------------------- constructors
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help), Counter)
+
+    def gauge(
+        self, name: str, help: str = "", fn: Optional[Callable[[], float]] = None
+    ) -> Gauge:
+        g = self._get_or_make(name, lambda: Gauge(name, help, fn), Gauge)
+        if fn is not None:
+            g._fn = fn  # re-registration rebinds the provider (tests)
+        return g
+
+    def histogram(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(
+            name, lambda: Histogram(name, help, buckets), Histogram
+        )
+
+    def _get_or_make(self, name: str, make: Callable, kind: type):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = make()
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def value(self, name: str, default: Union[int, float] = 0) -> Union[int, float]:
+        m = self.get(name)
+        return default if m is None or isinstance(m, Histogram) else m.value
+
+    # ------------------------------------------------------------ exports
+    def snapshot(self) -> dict:
+        """{name: value} for counters/gauges, {name: {count,sum,buckets}}
+        for histograms — the JSON shape behind /api/metrics."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict = {}
+        for m in metrics:
+            out[m.name] = m.snapshot() if isinstance(m, Histogram) else m.value
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: List[str] = []
+        for m in metrics:
+            full = f"{self.namespace}_{m.name}" if self.namespace else m.name
+            if m.help:
+                lines.append(f"# HELP {full} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {_fmt(m.value)}")
+            else:
+                snap = m.snapshot()
+                lines.append(f"# TYPE {full} histogram")
+                for le, c in snap["buckets"].items():
+                    lines.append(f'{full}_bucket{{le="{le}"}} {c}')
+                lines.append(f"{full}_sum {_fmt(snap['sum'])}")
+                lines.append(f"{full}_count {snap['count']}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+_process_registry = MetricsRegistry()
+
+
+def process_registry() -> MetricsRegistry:
+    """The process-wide registry for data-plane counters (shuffle fetch,
+    flight serving, span-buffer drops)."""
+    return _process_registry

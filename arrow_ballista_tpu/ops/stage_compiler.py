@@ -978,6 +978,38 @@ class TpuStageExec(ExecutionPlan):
         # untraced function to wrap with the cross-chip reduction
         self._raw_kernel, self._jit_kernel = self._kernel_for(self.capacity)
 
+    def _timed_jit(self, fn):
+        """Wrap a shared jitted kernel with THIS stage's compile/execute
+        attribution: a call that grows the jit's compiled-signature cache
+        paid trace + XLA compilation (jit compiles synchronously inside
+        the call; only execution is async), everything else is dispatch.
+        Backs the /api/jobs/{id}/profile compile-vs-execute split."""
+        import time as _t
+
+        metrics = self.metrics
+        cache_size = getattr(fn, "_cache_size", None)
+
+        def call(*args):
+            before = cache_size() if cache_size is not None else -1
+            t0 = _t.perf_counter_ns()
+            out = fn(*args)
+            dt = _t.perf_counter_ns() - t0
+            if before >= 0 and cache_size() > before:
+                metrics.add("tpu_compile_ns", dt)
+                metrics.add("kernel_compiles", 1)
+            else:
+                metrics.add("tpu_execute_ns", dt)
+            return out
+
+        return call
+
+    def _note_kernel_cache(self, hit: bool) -> None:
+        """Process-wide compiled-kernel cache accounting (plans rebuild
+        per query; a miss here means a fresh trace + XLA compile)."""
+        self.metrics.add(
+            "compile_cache_hits" if hit else "compile_cache_misses", 1
+        )
+
     def _kernel_for(self, capacity: int, dense: bool = False):
         """(raw, jitted) fused kernel at the given segment capacity.
 
@@ -993,31 +1025,33 @@ class TpuStageExec(ExecutionPlan):
             + K.algo_cache_token()
         )
         cached = _KERNEL_CACHE.get(key)
+        self._note_kernel_cache(cached is not None)
         if cached is None:
             import jax
 
-            inner = K.make_partial_agg_kernel(
-                self._filter_closure,
-                self._arg_closures,
-                self.specs,
-                capacity,
-                self._flat_names,
-                # variance moments need the per-element-compensated scan
-                force_sort=any(e[0] == "var" for e in self._emit),
-            )
-            if self.fused.join is not None:
-                kernel = K.make_join_kernel(
-                    inner,
+            with self.metrics.timer("tpu_compile_ns"):
+                inner = K.make_partial_agg_kernel(
+                    self._filter_closure,
+                    self._arg_closures,
+                    self.specs,
+                    capacity,
                     self._flat_names,
-                    self._join_slots,
-                    len(self._device_build_cols),
-                    dense=dense,
+                    # variance moments need the per-element-compensated scan
+                    force_sort=any(e[0] == "var" for e in self._emit),
                 )
-            else:
-                kernel = inner
-            cached = (kernel, jax.jit(kernel))
+                if self.fused.join is not None:
+                    kernel = K.make_join_kernel(
+                        inner,
+                        self._flat_names,
+                        self._join_slots,
+                        len(self._device_build_cols),
+                        dense=dense,
+                    )
+                else:
+                    kernel = inner
+                cached = (kernel, jax.jit(kernel))
             _KERNEL_CACHE[key] = cached
-        return cached
+        return cached[0], self._timed_jit(cached[1])
 
     @property
     def schema(self) -> pa.Schema:
@@ -1503,6 +1537,7 @@ class TpuStageExec(ExecutionPlan):
             + K.algo_cache_token()
         )
         cached = _KERNEL_CACHE.get(key)
+        self._note_kernel_cache(cached is not None)
         if cached is None:
             import jax
 
@@ -1527,7 +1562,7 @@ class TpuStageExec(ExecutionPlan):
                 kernel = inner
             cached = (holder, jax.jit(kernel))
             _KERNEL_CACHE[key] = cached
-        return cached
+        return cached[0], self._timed_jit(cached[1])
 
     def _median_extra_names(self) -> tuple:
         """Env names of the median/corr argument leaves, buffered raw
@@ -1908,6 +1943,7 @@ class TpuStageExec(ExecutionPlan):
             + K.algo_cache_token()
         )
         cached = _KERNEL_CACHE.get(key)
+        self._note_kernel_cache(cached is not None)
         if cached is None:
             import jax
 
@@ -1928,7 +1964,7 @@ class TpuStageExec(ExecutionPlan):
 
             cached = jax.jit(fn)
             _KERNEL_CACHE[key] = cached
-        return cached
+        return self._timed_jit(cached)
 
     def _encode_groups(self, batch, key_encoders, group_table):
         """Vectorized multi-key → dense group id encoding, any key count.

@@ -39,6 +39,7 @@ CONFIG_KEYS = {
     "quarantine_threshold": (int, 5, "failures in-window that quarantine an executor; 0 disables"),
     "quarantine_window_seconds": (float, 60.0, "sliding window for the per-executor failure count"),
     "quarantine_backoff_seconds": (float, 30.0, "reservation exclusion period for quarantined executors"),
+    "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "scheduler", "log file prefix"),
@@ -132,6 +133,13 @@ def main(argv=None) -> None:
         if cfg["scheduler_policy"] == "push-staged"
         else TaskSchedulingPolicy.PULL_STAGED
     )
+    if cfg["obs_enabled"]:
+        from ..obs import get_recorder, trace, trace_store
+
+        trace.configure(enabled=True, process="scheduler")
+        get_recorder().set_forward(trace_store().add)
+        log.info("observability forced on (--obs-enabled)")
+
     backend = make_backend(cfg)
     scheduler_id = f"{cfg['bind_host']}:{cfg['bind_port']}:{uuid.uuid4().hex[:6]}"
     server = SchedulerServer(

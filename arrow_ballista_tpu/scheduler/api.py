@@ -8,8 +8,13 @@ REST and gRPC on one port via Accept-header dispatch
 outright, so here REST serves on its own port (``scheduler_port + 1`` by
 convention in the binary).
 
-Extra endpoints beyond the reference: ``/api/jobs`` (job table) and
-``/api/metrics`` (slot accounting) — the scheduler UI needs both.
+Extra endpoints beyond the reference: ``/api/jobs`` (job table),
+``/api/metrics`` (unified registry snapshot, backward-compatible shape),
+``/api/metrics/prometheus`` (text exposition, also served at
+``/metrics``), ``/api/jobs/{id}/trace`` (Chrome-trace/Perfetto JSON of
+the job's stitched spans) and ``/api/jobs/{id}/profile``
+(EXPLAIN-ANALYZE-style per-stage rollup) — see
+docs/user-guide/observability.md.
 """
 
 from __future__ import annotations
@@ -264,49 +269,111 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             self._json({"jobs": tm.list_jobs()})
             return
         if path.startswith("/api/job/"):
-            tm = srv.state.task_manager
-            rest = path[len("/api/job/"):]
-            if rest.endswith("/dot"):
-                dot = tm.get_job_dot(rest[: -len("/dot")])
-                if dot is None:
-                    self._json({"error": "no such job"}, 404)
-                    return
-                body = dot.encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/vnd.graphviz")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            detail = tm.get_job_detail(rest)
-            if detail is None:
-                self._json({"error": "no such job"}, 404)
-                return
-            self._json(detail)
+            self._job_routes(srv, path[len("/api/job/"):])
             return
         if path == "/api/metrics":
-            em = srv.state.executor_manager
-            tm = srv.state.task_manager
-            self._json(
-                {
-                    "available_slots": em.available_slots(),
-                    "alive_executors": len(em.get_alive_executors()),
-                    "active_jobs": len(tm.active_job_ids()),
-                    "task_retries": tm.task_retries_total,
-                    "executors_quarantined": len(em.quarantined_executors()),
-                    "quarantines_total": em.quarantines_total,
-                }
-            )
+            # unified registry snapshot; the legacy top-level keys keep
+            # their names so dashboards/tests stay compatible
+            snap = srv.state.metrics.snapshot()
+            snap["task_retries"] = snap.get("task_retries_total", 0)
+            self._json(snap)
             return
-        if path in ("", "/", "/ui"):
-            body = DASHBOARD_HTML.encode()
+        if path in ("/api/metrics/prometheus", "/metrics"):
+            from ..obs.registry import process_registry
+
+            text = srv.state.metrics.prometheus_text() + (
+                process_registry().prometheus_text()
+            )
+            body = text.encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
             return
+        if path.startswith("/api/jobs/"):
+            # /api/jobs/{id}[/dot] aliases /api/job/{id}[/dot], plus the
+            # observability routes /trace and /profile
+            self._job_routes(srv, path[len("/api/jobs/"):])
+            return
+        if path in ("", "/", "/ui"):  # noqa: RET505 - route ladder
+            self._dashboard()
+            return
         self._json({"error": f"no such route {path}"}, 404)
+
+    def _job_routes(self, srv, rest: str) -> None:
+        """Per-job routes, shared by /api/job/ and /api/jobs/:
+        {id} detail, {id}/dot, {id}/trace, {id}/profile."""
+        tm = srv.state.task_manager
+        if rest.endswith("/trace"):
+            self._job_trace(srv, rest[: -len("/trace")])
+            return
+        if rest.endswith("/profile"):
+            self._job_profile(srv, rest[: -len("/profile")])
+            return
+        if rest.endswith("/dot"):
+            dot = tm.get_job_dot(rest[: -len("/dot")])
+            if dot is None:
+                self._json({"error": "no such job"}, 404)
+                return
+            body = dot.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/vnd.graphviz")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        detail = tm.get_job_detail(rest)
+        if detail is None:
+            self._json({"error": "no such job"}, 404)
+            return
+        self._json(detail)
+
+    def _dashboard(self) -> None:
+        body = DASHBOARD_HTML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _job_spans(self, srv, job_id: str) -> list:
+        from ..obs.recorder import get_recorder, trace_store
+
+        spans = trace_store().for_job(job_id)
+        if not spans:
+            # scheduler spans not yet forwarded (forward hook installs on
+            # the first obs-enabled submit): fall back to the ring buffer
+            spans = [
+                s
+                for s in get_recorder().snapshot()
+                if (s.get("attrs") or {}).get("job") == job_id
+            ]
+        return spans
+
+    def _job_trace(self, srv, job_id: str) -> None:
+        from ..obs.export import chrome_trace
+
+        spans = self._job_spans(srv, job_id)
+        if not spans:
+            self._json(
+                {"error": f"no trace recorded for job {job_id!r} "
+                          "(is ballista.obs.enabled set?)"},
+                404,
+            )
+            return
+        self._json(chrome_trace(spans, job_id))
+
+    def _job_profile(self, srv, job_id: str) -> None:
+        from ..obs.export import job_profile
+
+        detail = srv.state.task_manager.get_job_detail(job_id)
+        if detail is None:
+            self._json({"error": "no such job"}, 404)
+            return
+        self._json(job_profile(detail, self._job_spans(srv, job_id)))
 
 
 def make_api_server(

@@ -11,6 +11,7 @@ re-dispatches in-flight work (`execution_graph.rs:867-920`).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
 
@@ -49,6 +50,9 @@ class Task:
     plan: ShuffleWriterExec
     output_partitioning: Optional[object]  # Partitioning of the shuffle write
     attempt: int = 0  # 0-based attempt counter, shipped in TaskDefinition
+    # the job's trace id ("" = untraced/unsampled); shipped in
+    # TaskDefinition so executor task spans stitch under the job trace
+    trace_id: str = ""
 
 
 DEFAULT_TASK_MAX_ATTEMPTS = 4
@@ -89,6 +93,12 @@ class ExecutionGraph:
         )
         self.task_retries = 0  # transient-failure re-queues over job lifetime
         self.stage_reset_counts: Dict[int, int] = {}  # executor-loss resets
+        # tracing: set by the scheduler at submit when the session has
+        # ballista.obs.enabled (and the job is sampled); in-memory only —
+        # a trace does not survive scheduler restart
+        self.trace_id = ""
+        self.submitted_unix_ns = time.time_ns()
+        self.submitted_mono_ns = time.monotonic_ns()
 
         planner = DistributedPlanner(work_dir, config)
         stage_plans = planner.plan_query_stages(job_id, plan)
@@ -170,6 +180,7 @@ class ExecutionGraph:
                     stage.plan,
                     stage.plan.shuffle_output_partitioning,
                     attempt,
+                    trace_id=self.trace_id,
                 )
         return None
 
@@ -491,6 +502,14 @@ class ExecutionGraph:
                 sp.completed.plan = BallistaCodec.encode_physical(stage.plan)
                 sp.completed.output_links.extend(stage.output_links)
                 _encode_inputs(sp.completed.inputs, stage.inputs)
+                # merged operator metrics survive completion: the REST
+                # detail and /api/jobs/{id}/profile read them from the
+                # persisted graph once the cache entry is evicted
+                for op, vals in stage.stage_metrics.items():
+                    m = sp.completed.stage_metrics.add()
+                    m.operator_name = op
+                    for k, v in vals.items():
+                        m.values[k] = int(v)
                 for t in stage.task_statuses:
                     if t is None:
                         continue
@@ -522,6 +541,9 @@ class ExecutionGraph:
         self.scheduler_id = g.scheduler_id
         self.job_id = g.job_id
         self.session_id = g.session_id
+        self.trace_id = ""  # traces don't survive restart/adoption
+        self.submitted_unix_ns = time.time_ns()
+        self.submitted_mono_ns = time.monotonic_ns()
         self.output_partitions = g.output_partitions
         self.output_locations = []
         self.error = ""
@@ -595,6 +617,10 @@ class ExecutionGraph:
                     list(s.output_links),
                     _decode_inputs(s.inputs),
                     statuses,
+                    stage_metrics={
+                        m.operator_name: dict(m.values)
+                        for m in s.stage_metrics
+                    },
                     task_attempts=attempts,
                     task_fetch_retries=fetch_retries,
                 )

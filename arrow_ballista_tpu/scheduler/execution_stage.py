@@ -48,6 +48,9 @@ class TaskInfo:
     metrics: List[tuple] = field(default_factory=list)  # (operator, {k: v})
     attempt: int = 0  # which attempt this status describes (0-based)
     fetch_retries: int = 0  # shuffle-fetch retries this attempt paid
+    # finished spans piggybacked from the executor (obs/recorder.py span
+    # dicts); absorbed into the scheduler's TraceStore, never persisted
+    spans: List[dict] = field(default_factory=list)
 
 
 @dataclass

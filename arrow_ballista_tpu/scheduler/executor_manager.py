@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
 from ..errors import SchedulerError
+from ..obs.registry import MetricsRegistry
 from .kvstore import LeaseFenced
 from ..proto import pb
 from ..serde.scheduler_types import ExecutorMetadata
@@ -83,10 +84,17 @@ class ExecutorManager:
         quarantine_window_s: float = DEFAULT_QUARANTINE_WINDOW_S,
         quarantine_backoff_s: float = DEFAULT_QUARANTINE_BACKOFF_S,
         launch_failure_threshold: int = DEFAULT_LAUNCH_FAILURE_THRESHOLD,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.backend = backend
         self.liveness_window_s = liveness_window_s
         self._heartbeats: Dict[str, ExecutorHeartbeat] = {}
+        # monotonic receipt anchor per executor: ALL elapsed-time checks
+        # (liveness, staleness, quarantine windows) run on time.monotonic
+        # so a wall-clock jump can neither spuriously expire an executor
+        # nor un-quarantine one.  The wall timestamp stays on the
+        # persisted heartbeat for display / cross-process age estimates.
+        self._hb_mono: Dict[str, float] = {}
         self._dead: Set[str] = set()
         self._hb_lock = threading.Lock()
         # ---- quarantine: sliding-window failure accounting per executor
@@ -99,8 +107,21 @@ class ExecutorManager:
         self._quarantined_until: Dict[str, float] = {}
         self._launch_failures: Dict[str, int] = {}  # consecutive
         self._pending_expulsions: Set[str] = set()
-        self.quarantines_total = 0
+        self.registry = registry or MetricsRegistry()
+        self._quarantines = self.registry.counter(
+            "quarantines_total",
+            "executors newly quarantined over scheduler lifetime",
+        )
+        self._task_failures_recorded = self.registry.counter(
+            "executor_task_failures_total",
+            "task/launch failures fed into quarantine windows",
+        )
         self._unsubscribe = backend.watch(Keyspace.Heartbeats, "", self._on_hb_event)
+
+    @property
+    def quarantines_total(self) -> int:
+        """Back-compat read surface for the old ad-hoc counter."""
+        return int(self._quarantines.value)
 
     def close(self) -> None:
         self._unsubscribe()
@@ -198,8 +219,14 @@ class ExecutorManager:
     def _on_hb_event(self, event: WatchEvent) -> None:
         if event.kind == WatchEvent.PUT and event.value is not None:
             hb = ExecutorHeartbeat.from_bytes(event.value)
+            # anchor the monotonic receipt by the beat's wall age ONCE
+            # (a replayed stale heartbeat — e.g. HA standby catching up —
+            # must not look fresh); after this single wall read, liveness
+            # math is purely monotonic and immune to clock jumps
+            mono = time.monotonic() - max(0.0, time.time() - hb.timestamp)
             with self._hb_lock:
                 self._heartbeats[hb.executor_id] = hb
+                self._hb_mono[hb.executor_id] = mono
                 if hb.status == "dead":
                     self._dead.add(hb.executor_id)
 
@@ -209,24 +236,28 @@ class ExecutorManager:
             return list(self._heartbeats.values())
 
     def get_alive_executors(self, now: Optional[float] = None) -> Set[str]:
-        now = time.time() if now is None else now
+        """Executors whose last beat is inside the liveness window.
+        ``now`` is in the time.monotonic domain (tests inject values)."""
+        now = time.monotonic() if now is None else now
         cutoff = now - self.liveness_window_s
         with self._hb_lock:
             return {
                 eid
                 for eid, hb in self._heartbeats.items()
-                if hb.status == "active" and hb.timestamp >= cutoff
+                if hb.status == "active"
+                and self._hb_mono.get(eid, float("-inf")) >= cutoff
             }
 
     def get_expired_executors(
         self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
     ) -> List[ExecutorHeartbeat]:
-        cutoff = time.time() - timeout_s
+        cutoff = time.monotonic() - timeout_s
         with self._hb_lock:
             return [
                 hb
-                for hb in self._heartbeats.values()
-                if hb.status == "active" and hb.timestamp < cutoff
+                for eid, hb in self._heartbeats.items()
+                if hb.status == "active"
+                and self._hb_mono.get(eid, float("-inf")) < cutoff
             ]
 
     def last_seen(self, executor_id: str) -> Optional[float]:
@@ -241,7 +272,10 @@ class ExecutorManager:
         then resets its in-flight tasks)."""
         if self.quarantine_threshold <= 0 or not executor_id:
             return False
-        now = time.time() if now is None else now
+        # monotonic domain: a wall-clock jump must not age failures out of
+        # the window (spuriously un-quarantining) or pile them in
+        now = time.monotonic() if now is None else now
+        self._task_failures_recorded.inc()
         with self._q_lock:
             dq = self._failure_times.setdefault(executor_id, deque())
             dq.append(now)
@@ -273,7 +307,7 @@ class ExecutorManager:
                 return False  # raced: someone else quarantined it
             dq = self._failure_times.setdefault(executor_id, deque())
             self._quarantined_until[executor_id] = now + self.quarantine_backoff_s
-            self.quarantines_total += 1
+            self._quarantines.inc()
             dq.clear()  # the window restarts after the backoff
         log.warning(
             "executor %s quarantined for %.0fs (%d failures in %.0fs window)",
@@ -320,12 +354,12 @@ class ExecutorManager:
         return out
 
     def is_quarantined(self, executor_id: str, now: Optional[float] = None) -> bool:
-        now = time.time() if now is None else now
+        now = time.monotonic() if now is None else now
         with self._q_lock:
             return self._quarantined_until.get(executor_id, 0.0) > now
 
     def quarantined_executors(self, now: Optional[float] = None) -> List[str]:
-        now = time.time() if now is None else now
+        now = time.monotonic() if now is None else now
         with self._q_lock:
             return sorted(
                 eid

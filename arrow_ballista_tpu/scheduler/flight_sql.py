@@ -94,7 +94,9 @@ class FlightSqlService(flight.FlightServerBase):
 
     def _check_job(self, job_id: str) -> list[PartitionLocation]:
         """Poll until terminal (reference: check_job flight_sql.rs:99-139)."""
-        deadline = time.time() + self._job_timeout_s()
+        # monotonic deadline: a wall-clock jump must neither cut a
+        # running statement short nor extend it
+        deadline = time.monotonic() + self._job_timeout_s()
         tm = self.scheduler.state.task_manager
         while True:
             status = tm.get_job_status(job_id)
@@ -105,7 +107,7 @@ class FlightSqlService(flight.FlightServerBase):
                     raise flight.FlightServerError(
                         f"job {job_id} failed: {status.get('error', 'unknown')}"
                     )
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise flight.FlightServerError(f"job {job_id} timed out")
             time.sleep(JOB_POLL_INTERVAL_S)
 

@@ -103,6 +103,10 @@ class SchedulerGrpcService:
         self.server.state.executor_manager.save_heartbeat(
             ExecutorHeartbeat(request.executor_id, time.time(), "active")
         )
+        if request.spans_json:
+            from ..obs.recorder import trace_store
+
+            trace_store().add_json(request.spans_json)
         return pb.HeartBeatResult(reregister=False)
 
     def UpdateTaskStatus(

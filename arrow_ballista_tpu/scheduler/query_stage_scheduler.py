@@ -95,9 +95,29 @@ def post_job_events(state: SchedulerState, sender, events) -> None:
 class QueryStageScheduler(EventAction):
     def __init__(self, state: SchedulerState):
         self.state = state
+        # event-loop observability: every mutation runs on this single
+        # thread, so handling latency IS scheduler responsiveness
+        self._event_latency = state.metrics.histogram(
+            "scheduler_event_handle_seconds",
+            "query-stage event handling latency (event-loop thread)",
+            buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+        )
+        self._events = state.metrics.counter(
+            "scheduler_events_total", "query-stage events processed"
+        )
 
     # ---------------------------------------------------------- dispatch
     def on_receive(self, event, sender: EventSender) -> None:
+        import time as _t
+
+        t0 = _t.monotonic()
+        try:
+            self._dispatch(event, sender)
+        finally:
+            self._events.inc()
+            self._event_latency.observe(_t.monotonic() - t0)
+
+    def _dispatch(self, event, sender: EventSender) -> None:
         if isinstance(event, JobQueued):
             self._on_job_queued(event, sender)
         elif isinstance(event, JobSubmitted):

@@ -16,6 +16,9 @@ from ..context import SessionContext
 from ..errors import BallistaError
 from ..exec.operators import ExecutionPlan
 from ..exec.planner import PhysicalPlanner
+from ..obs import trace
+from ..obs.recorder import get_recorder, trace_store
+from ..obs.registry import MetricsRegistry
 from ..plan import logical as lp
 from ..plan.optimizer import optimize
 from ..serde.scheduler_types import ExecutorMetadata
@@ -52,6 +55,10 @@ class SchedulerState:
         self.backend = backend
         self.scheduler_id = scheduler_id
         self.policy = policy
+        # unified metrics: one registry per scheduler instance (a test
+        # process may run several side by side) backing /api/metrics and
+        # the Prometheus endpoint; managers register their counters here
+        self.metrics = MetricsRegistry()
         self.executor_manager = ExecutorManager(
             backend,
             liveness_window_s,
@@ -70,11 +77,34 @@ class SchedulerState:
                 if quarantine_backoff_s is None
                 else quarantine_backoff_s
             ),
+            registry=self.metrics,
         )
         self.task_manager = TaskManager(
-            backend, self.executor_manager, scheduler_id, launcher, work_dir
+            backend, self.executor_manager, scheduler_id, launcher, work_dir,
+            registry=self.metrics,
         )
         self.session_manager = SessionManager(backend, session_builder)
+        # scrape-time gauges (computed on read, not pushed on change)
+        self.metrics.gauge(
+            "available_slots", "task slots free across alive executors",
+            fn=self.executor_manager.available_slots,
+        )
+        self.metrics.gauge(
+            "alive_executors", "executors inside the liveness window",
+            fn=lambda: len(self.executor_manager.get_alive_executors()),
+        )
+        self.metrics.gauge(
+            "active_jobs", "jobs currently cached as active",
+            fn=lambda: len(self.task_manager.active_job_ids()),
+        )
+        self.metrics.gauge(
+            "executors_quarantined", "executors currently in quarantine backoff",
+            fn=lambda: len(self.executor_manager.quarantined_executors()),
+        )
+        self.metrics.gauge(
+            "trace_store_spans", "spans held for /api/jobs/{id}/trace",
+            fn=lambda: trace_store().span_count(),
+        )
 
     # ------------------------------------------------------------ planning
     def plan_job(
@@ -92,8 +122,32 @@ class SchedulerState:
         session_ctx: SessionContext,
         plan: lp.LogicalPlan,
     ) -> None:
-        physical = self.plan_job(session_ctx, plan)
-        self.task_manager.submit_job(job_id, session_ctx.session_id, physical)
+        trace_id = self._maybe_start_trace(job_id, session_ctx)
+        if trace_id:
+            with trace.activate(trace_id), trace.span("job.plan", job=job_id):
+                physical = self.plan_job(session_ctx, plan)
+        else:
+            physical = self.plan_job(session_ctx, plan)
+        self.task_manager.submit_job(
+            job_id, session_ctx.session_id, physical, trace_id=trace_id
+        )
+
+    def _maybe_start_trace(self, job_id: str, session_ctx: SessionContext) -> str:
+        """Mint the job's trace id when the session asks for observability
+        (ratchets process tracing on; spans recorded in this process
+        forward straight into the TraceStore — no transport needed).
+        Returns "" for untraced/unsampled jobs."""
+        config = getattr(session_ctx, "config", None)
+        if config is None or not trace.enable_from_config(
+            config, process="scheduler"
+        ):
+            return ""
+        get_recorder().set_forward(trace_store().add)
+        if not trace.sampled():
+            return ""
+        trace_id = trace.new_id()
+        trace_store().bind(trace_id, job_id)
+        return trace_id
 
     # ------------------------------------------------------------- updates
     def update_task_statuses(

@@ -14,11 +14,15 @@ from __future__ import annotations
 import random
 import string
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import SchedulerError
 from ..exec.operators import ExecutionPlan
+from ..obs import trace
+from ..obs.recorder import trace_store
+from ..obs.registry import MetricsRegistry
 from ..proto import pb
 from ..serde import BallistaCodec, partitioning_to_proto
 from ..serde.scheduler_types import ExecutorMetadata, PartitionId
@@ -97,6 +101,7 @@ class TaskManager:
         scheduler_id: str,
         launcher: Optional[TaskLauncher] = None,
         work_dir: str = "/tmp/ballista-tpu",
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.backend = backend
         self.executor_manager = executor_manager
@@ -105,9 +110,24 @@ class TaskManager:
         self.work_dir = work_dir
         self._cache: Dict[str, JobEntry] = {}
         self._cache_lock = threading.Lock()
-        # scheduler-lifetime counter of transient-failure re-queues
-        # (surfaced as `task_retries` on /api/metrics)
-        self.task_retries_total = 0
+        # scheduler-lifetime counters live in the unified registry
+        # (obs/registry.py) backing /api/metrics + Prometheus exposition
+        self.registry = registry or MetricsRegistry()
+        self._retries = self.registry.counter(
+            "task_retries_total",
+            "transient-failure task re-queues over scheduler lifetime",
+        )
+        self._jobs_completed = self.registry.counter(
+            "jobs_completed_total", "jobs that reached COMPLETED"
+        )
+        self._jobs_failed = self.registry.counter(
+            "jobs_failed_total", "jobs that reached FAILED"
+        )
+
+    @property
+    def task_retries_total(self) -> int:
+        """Back-compat read surface for the old ad-hoc counter."""
+        return int(self._retries.value)
 
     # ------------------------------------------------------------ helpers
     def _entry(self, job_id: str) -> JobEntry:
@@ -207,6 +227,7 @@ class TaskManager:
         job_id: str,
         session_id: str,
         plan: ExecutionPlan,
+        trace_id: str = "",
     ) -> ExecutionGraph:
         from ..config import BallistaConfig
 
@@ -216,6 +237,10 @@ class TaskManager:
         graph = ExecutionGraph(
             self.scheduler_id, job_id, session_id, plan, self.work_dir, config
         )
+        # set BEFORE the graph becomes poppable: a concurrent pull-mode
+        # PollWork may dispatch first-stage tasks the moment the entry is
+        # cached, and those TaskDefinitions must already carry the trace
+        graph.trace_id = trace_id
         graph.revive()
         entry = self._entry(job_id)
         with entry.lock:
@@ -370,10 +395,16 @@ class TaskManager:
                 if graph is None:
                     continue
                 for info in infos:
+                    if info.spans:
+                        # piggybacked executor spans → per-job trace store
+                        # (dedup by span id there; stale-attempt statuses
+                        # still surrender their spans before being dropped)
+                        trace_store().add(info.spans)
+                        info.spans = []
                     evs = graph.update_task_status(info, executor)
                     for ev in evs:
                         if ev == "task_retried":
-                            self.task_retries_total += 1
+                            self._retries.inc()
                         events.append((job_id, ev))
                     if info.state == "failed" and evs:
                         from .failure import is_transient
@@ -396,7 +427,7 @@ class TaskManager:
                 # one task_requeued per reset task: the event loop mints a
                 # replacement reservation for each in push mode (the
                 # quarantined executor's own slots are sidelined)
-                self.task_retries_total += n
+                self._retries.inc(n)
                 events.extend([(job_id, "task_requeued")] * n)
         return events
 
@@ -505,6 +536,15 @@ class TaskManager:
         td.session_id = task.session_id
         td.curator_scheduler_id = self.scheduler_id
         td.attempt = task.attempt
+        # trace propagation: executor task spans parent under the job's
+        # root span (root span id == trace id by convention).  A traced
+        # task also carries the obs prop so executors ratchet tracing on
+        # even when it was forced scheduler-side (--obs-enabled) rather
+        # than set on the session.
+        td.trace_id = task.trace_id
+        td.parent_span_id = task.trace_id
+        if task.trace_id and "ballista.obs.enabled" not in td.props:
+            td.props["ballista.obs.enabled"] = "true"
         # ship the session settings so the executor's TaskContext + TPU
         # acceleration pass see the client's config (reference: grpc.rs
         # poll_work/launch builds TaskDefinition.props from session props)
@@ -525,6 +565,19 @@ class TaskManager:
         from ..testing.faults import fault_point
 
         defs = [self.prepare_task_definition(t) for t in tasks]
+        if tasks and tasks[0].trace_id:
+            trace.record_raw(
+                "scheduler.launch",
+                tasks[0].trace_id,
+                trace.new_id(),
+                tasks[0].trace_id,
+                time.time_ns(),
+                0,
+                job=tasks[0].partition.job_id,
+                executor=executor.id,
+                tasks=len(tasks),
+                stages=sorted({t.partition.stage_id for t in tasks}),
+            )
         try:
             fault_point("scheduler.launch_task", executor_id=executor.id)
             self.launcher.launch(executor, defs, self.scheduler_id)
@@ -552,12 +605,32 @@ class TaskManager:
                 self._persist(graph)
 
     # --------------------------------------------------------- transitions
+    def _emit_job_span(self, graph, status: str) -> None:
+        """The trace's root span, timed submit → terminal state (its id IS
+        the trace id; every shipped child parented under it)."""
+        if graph is None or not getattr(graph, "trace_id", ""):
+            return
+        trace.record_raw(
+            "job",
+            graph.trace_id,
+            graph.trace_id,
+            "",
+            graph.submitted_unix_ns,
+            time.monotonic_ns() - graph.submitted_mono_ns,
+            job=graph.job_id,
+            status=status,
+            task_retries=graph.task_retries,
+            stages=len(graph.stages),
+        )
+
     def complete_job(self, job_id: str) -> None:
         entry = self._entry(job_id)
         with entry.lock:
             graph = self._load(job_id, entry)
             if graph is not None:
                 self._persist(graph)
+            self._emit_job_span(graph, "completed")
+            self._jobs_completed.inc()
             self.backend.mv(Keyspace.ActiveJobs, Keyspace.CompletedJobs, job_id)
             with self._cache_lock:
                 self._cache.pop(job_id, None)
@@ -566,6 +639,16 @@ class TaskManager:
         entry = self._entry(job_id)
         with entry.lock:
             graph = self._load(job_id, entry)
+            # two fatal tasks of one job each post JobRunningFailed; only
+            # the FIRST fail_job (which moves the job into FailedJobs)
+            # emits the root span + counter.  The graph's own status is
+            # no signal — it's already FAILED before the event arrives.
+            already_failed = (
+                self.backend.get(Keyspace.FailedJobs, job_id) is not None
+            )
+            if not already_failed:
+                self._emit_job_span(graph, "failed")
+                self._jobs_failed.inc()
             tombstone = graph is None
             if graph is not None:
                 if graph.status != FAILED:

@@ -7,6 +7,7 @@ executor-side Result → protobuf mapping) and the scheduler-side decode in
 
 from __future__ import annotations
 
+import json
 from typing import List
 
 from ..proto import pb
@@ -14,11 +15,23 @@ from ..serde.scheduler_types import PartitionId, ShuffleWritePartition
 from .execution_stage import TaskInfo
 
 
+def _spans_from_json(payload: bytes) -> List[dict]:
+    if not payload:
+        return []
+    try:
+        spans = json.loads(payload.decode())
+        return spans if isinstance(spans, list) else []
+    except Exception:  # noqa: BLE001 - malformed piggyback must not drop status
+        return []
+
+
 def task_info_to_proto(info: TaskInfo) -> pb.TaskStatus:
     msg = pb.TaskStatus()
     msg.task_id.CopyFrom(info.partition_id.to_proto())
     msg.attempt = info.attempt
     msg.fetch_retries = info.fetch_retries
+    if info.spans:
+        msg.spans_json = json.dumps(info.spans).encode()
     if info.state == "running":
         msg.running.executor_id = info.executor_id
     elif info.state == "failed":
@@ -41,6 +54,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
     pid = PartitionId.from_proto(msg.task_id)
     which = msg.WhichOneof("status")
     metrics = [(m.operator_name, dict(m.values)) for m in msg.metrics]
+    spans = _spans_from_json(msg.spans_json)
     if which == "running":
         return TaskInfo(
             pid,
@@ -49,6 +63,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             metrics=metrics,
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
+            spans=spans,
         )
     if which == "failed":
         return TaskInfo(
@@ -58,6 +73,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             metrics=metrics,
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
+            spans=spans,
         )
     if which == "completed":
         parts = [
@@ -71,6 +87,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             metrics=metrics,
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
+            spans=spans,
         )
     raise ValueError(f"TaskStatus with no status set for {pid}")
 

@@ -389,23 +389,39 @@ class ShuffleReaderExec(ExecutionPlan):
         retry/backoff, streaming memory profile, cancel wake-up and
         shutdown-abort registration as the pipelined path instead of
         being a second, less robust code path."""
+        from ..obs import trace
         from .fetcher import FetchPolicy, ShuffleFetcher
 
         locations = self.partition[partition]
         if not locations:
             return
         policy = FetchPolicy.from_config(ctx.config)
-        fetcher = ShuffleFetcher(
-            locations,
-            policy,
-            self.metrics,
-            cancel_event=ctx.cancel_event,
-            owner=ctx.work_dir,
+        # manual (stack-free) span: this is a generator — a context-pushing
+        # span would stay "current" on the consuming thread between yields
+        sp = trace.manual_span(
+            "shuffle.fetch",
+            stage=self.stage_id,
+            partition=partition,
+            locations=len(locations),
         )
-        for b in fetcher:
-            ctx.check_cancelled()
-            self.metrics.add("output_rows", b.num_rows)
-            yield b
+        try:
+            fetcher = ShuffleFetcher(
+                locations,
+                policy,
+                self.metrics,
+                cancel_event=ctx.cancel_event,
+                owner=ctx.work_dir,
+                trace_parent=sp.ctx,
+            )
+            rows = 0
+            for b in fetcher:
+                ctx.check_cancelled()
+                rows += b.num_rows
+                self.metrics.add("output_rows", b.num_rows)
+                yield b
+            sp.set_attr("rows", rows)
+        finally:
+            sp.finish()
 
     def with_new_children(self, children):
         assert not children

@@ -47,7 +47,51 @@ from typing import Callable, Iterator, Optional
 
 import pyarrow as pa
 
+from ..obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
+
+
+class _TeeMetrics:
+    """Forward operator-metric adds into the process-wide registry
+    (obs/registry.py) so fetch totals are scrapable per process, while
+    the per-operator set keeps feeding stage metrics unchanged."""
+
+    _REGISTRY_NAMES = {
+        "bytes_fetched": "shuffle_bytes_fetched_total",
+        "fetch_retries": "shuffle_fetch_retries_total",
+        "locations_fetched": "shuffle_locations_fetched_total",
+        "fetch_queue_full_ns": "shuffle_fetch_queue_full_ns_total",
+        "fetch_wait_time_ns": "shuffle_fetch_wait_ns_total",
+    }
+    _counters: dict = {}
+    _counters_lock = threading.Lock()
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    @classmethod
+    def _counter(cls, name: str):
+        c = cls._counters.get(name)
+        if c is None:
+            from ..obs.registry import process_registry
+
+            with cls._counters_lock:
+                c = cls._counters.get(name)
+                if c is None:
+                    c = process_registry().counter(
+                        name, "shuffle fetch data-plane total"
+                    )
+                    cls._counters[name] = c
+        return c
+
+    def add(self, name: str, v: int) -> None:
+        self._inner.add(name, v)
+        reg_name = self._REGISTRY_NAMES.get(name)
+        if reg_name is not None:
+            self._counter(reg_name).inc(v)
 
 # Host-side staging accounting: bytes sitting in prefetch queues (fetched
 # but not yet consumed).  Lives HERE, jax-free — ops.device_cache.stats()
@@ -128,12 +172,26 @@ def fetch_location(loc) -> Iterator[pa.RecordBatch]:
     client = BallistaClient.get(
         loc.executor_meta.host, loc.executor_meta.flight_port
     )
-    yield from client.fetch_partition(
-        loc.partition_id.job_id,
-        loc.partition_id.stage_id,
-        loc.partition_id.partition_id,
-        loc.path,
-    )
+    # trace context crosses the Flight hop as gRPC metadata so the
+    # SERVING executor's do_get span stitches into this job's trace;
+    # the kwarg is only passed when tracing — client doubles without it
+    # keep working untraced
+    headers = obs_trace.propagation_headers()
+    if headers:
+        yield from client.fetch_partition(
+            loc.partition_id.job_id,
+            loc.partition_id.stage_id,
+            loc.partition_id.partition_id,
+            loc.path,
+            headers=headers,
+        )
+    else:
+        yield from client.fetch_partition(
+            loc.partition_id.job_id,
+            loc.partition_id.stage_id,
+            loc.partition_id.partition_id,
+            loc.path,
+        )
 
 
 def retrying_fetch(
@@ -323,14 +381,18 @@ class ShuffleFetcher:
         cancel_event: Optional[threading.Event] = None,
         fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
         owner: Optional[str] = None,
+        trace_parent=None,
     ) -> None:
         self.owner = owner
         self._locations = list(locations)
         self._policy = policy
-        self._metrics = metrics
+        self._metrics = _TeeMetrics(metrics)
+        # explicit parent for per-location spans: fetch workers run on
+        # their own threads, so thread-local context can't propagate
+        self._trace_parent = trace_parent
         self._cancel = cancel_event
         self._fetch_fn = fetch_fn or fetch_location
-        self._q = _PrefetchQueue(policy.prefetch_bytes, metrics)
+        self._q = _PrefetchQueue(policy.prefetch_bytes, self._metrics)
         self._cursor = 0
         self._cursor_lock = threading.Lock()
         self._error: Optional[BaseException] = None
@@ -445,22 +507,37 @@ class ShuffleFetcher:
     def _fetch_one(self, loc) -> None:
         """Stream one location into the queue via :func:`retrying_fetch`
         (retry/backoff + mid-stream resume shared with the sequential
-        reader)."""
+        reader).  The location span (explicit parent — this is a worker
+        thread) also installs the trace context this thread forwards over
+        Flight metadata."""
         t0 = time.monotonic_ns()
         self._enter_location()
         try:
             if self._cancel is not None and self._cancel.is_set():
                 raise _cancelled()
-            for batch in retrying_fetch(
-                loc,
-                self._policy,
-                self._metrics,
-                fetch_fn=self._fetch_fn,
-                stop_event=self._stop,
-            ):
-                nbytes = int(getattr(batch, "nbytes", 0) or 0)
-                self._q.put(batch, nbytes)
-                self._metrics.add("bytes_fetched", nbytes)
+            span_cm = (
+                obs_trace.span(
+                    "shuffle.fetch.location",
+                    parent=self._trace_parent,
+                    path=getattr(loc, "path", ""),
+                )
+                if self._trace_parent is not None
+                else obs_trace.NOOP
+            )
+            with span_cm as sp:
+                total = 0
+                for batch in retrying_fetch(
+                    loc,
+                    self._policy,
+                    self._metrics,
+                    fetch_fn=self._fetch_fn,
+                    stop_event=self._stop,
+                ):
+                    nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                    self._q.put(batch, nbytes)
+                    self._metrics.add("bytes_fetched", nbytes)
+                    total += nbytes
+                sp.set_attr("bytes", total)
             self._metrics.add("fetch_time_ns", time.monotonic_ns() - t0)
             self._metrics.add("locations_fetched", 1)
         finally:

@@ -101,7 +101,7 @@ def absorb_spooled(spool_dir: str, path: str) -> bool:
         return False
     with _lock:
         _store[key] = buf
-        _job_touched[key[0]] = time.time()
+        _job_touched[key[0]] = time.monotonic()
     return True
 
 
@@ -134,7 +134,7 @@ def put(
         return path
     with _lock:
         _store[key] = buf
-        _job_touched[job_id] = time.time()
+        _job_touched[job_id] = time.monotonic()
     return path
 
 
@@ -178,7 +178,8 @@ def sweep(ttl_s: float) -> List[str]:
     the work-dir sweep)."""
     import time
 
-    now = time.time()
+    # monotonic ages: a wall-clock jump must not mass-evict live jobs
+    now = time.monotonic()
     with _lock:
         stale = [j for j, t in _job_touched.items() if now - t > ttl_s]
     for j in stale:

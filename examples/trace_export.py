@@ -1,0 +1,76 @@
+"""Trace-export example: run a distributed aggregate with observability
+on, then write the job's Perfetto/Chrome trace JSON and per-stage
+profile to disk.
+
+    JAX_PLATFORMS=cpu python examples/trace_export.py
+
+Open ``/tmp/ballista-trace.json`` at https://ui.perfetto.dev (Open trace
+file) — the scheduler and each executor render as separate process lanes
+under one stitched trace.  See docs/user-guide/observability.md.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import pyarrow as pa
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.config import BallistaConfig
+from arrow_ballista_tpu.context import MemoryTable
+from arrow_ballista_tpu.obs.export import chrome_trace, job_profile
+from arrow_ballista_tpu.obs.recorder import trace_store
+
+TRACE_PATH = "/tmp/ballista-trace.json"
+PROFILE_PATH = "/tmp/ballista-profile.json"
+
+
+def main() -> None:
+    config = (
+        BallistaConfig.builder()
+        .set("ballista.obs.enabled", "true")
+        .set("ballista.shuffle.partitions", "2")
+        .set("ballista.mesh.enable", "false")
+        .build()
+    )
+    ctx = BallistaContext.standalone(config=config, num_executors=2)
+    try:
+        ctx.register_table(
+            "sales",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "region": ["north", "south", "east", "west"] * 2500,
+                        "amount": [float(i % 97) for i in range(10_000)],
+                    }
+                ),
+                partitions=2,
+            ),
+        )
+        table = ctx.sql(
+            "SELECT region, SUM(amount) AS total, COUNT(amount) AS n "
+            "FROM sales GROUP BY region"
+        ).collect()
+        print(table.to_pydict())
+
+        (job_id,) = ctx._job_ids
+        scheduler, _executors = ctx._standalone_handles
+        scheduler.server.drain()  # let the job-completion span land
+
+        spans = trace_store().for_job(job_id)
+        with open(TRACE_PATH, "w") as f:
+            json.dump(chrome_trace(spans, job_id), f, indent=1)
+        detail = scheduler.server.state.task_manager.get_job_detail(job_id)
+        with open(PROFILE_PATH, "w") as f:
+            json.dump(job_profile(detail, spans), f, indent=1)
+        procs = sorted({s["proc"] for s in spans})
+        print(f"{len(spans)} spans from {procs} -> {TRACE_PATH}")
+        print(f"per-stage profile -> {PROFILE_PATH}")
+    finally:
+        ctx.close()
+
+
+if __name__ == "__main__":
+    main()
