@@ -43,6 +43,11 @@ SHUFFLE_PREFETCH_BYTES = "ballista.shuffle.prefetch_bytes"
 SHUFFLE_FETCH_RETRIES = "ballista.shuffle.fetch_retries"
 SHUFFLE_FETCH_BACKOFF_MS = "ballista.shuffle.fetch_backoff_ms"
 SHUFFLE_COALESCE_ROWS = "ballista.shuffle.coalesce_rows"
+SHUFFLE_WRITE_COALESCE_ROWS = "ballista.shuffle.write_coalesce_rows"
+SHUFFLE_WRITE_QUEUE_BYTES = "ballista.shuffle.write_queue_bytes"
+SHUFFLE_WRITE_CONCURRENCY = "ballista.shuffle.write_concurrency"
+SHUFFLE_WRITE_PIPELINED = "ballista.shuffle.write_pipelined"
+SHUFFLE_COMPRESSION = "ballista.shuffle.compression"
 # Fault tolerance (see docs/user-guide/fault-tolerance.md)
 TASK_MAX_ATTEMPTS = "ballista.task.max_attempts"
 STAGE_MAX_ATTEMPTS = "ballista.stage.max_attempts"
@@ -69,6 +74,13 @@ def _parse_bool(v: str) -> bool:
     if v.lower() in ("false", "0", "no"):
         return False
     raise ValueError(f"not a boolean: {v!r}")
+
+
+def _parse_compression(v: str) -> str:
+    codec = v.lower()
+    if codec not in ("none", "lz4", "zstd"):
+        raise ValueError(f"compression must be none|lz4|zstd, got {v!r}")
+    return codec
 
 
 def _parse_highcard_mode(v: str) -> str:
@@ -262,6 +274,52 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "0",
         ),
         ConfigEntry(
+            SHUFFLE_WRITE_COALESCE_ROWS,
+            "target row count per slab flush on the shuffle WRITE side: "
+            "hash-split row runs coalesce in per-output-partition slab "
+            "buffers until this many rows, so IPC files hold few large "
+            "batches instead of one fragment per (input batch, output "
+            "partition); 0 follows 4 x ballista.batch.size, negative "
+            "writes every split run straight through",
+            int,
+            "0",
+        ),
+        ConfigEntry(
+            SHUFFLE_WRITE_QUEUE_BYTES,
+            "byte budget of coalesced-but-unwritten shuffle batches per "
+            "write task; the compute thread blocks (backpressure) once "
+            "the writer pool's queues hold this much",
+            int,
+            str(32 << 20),
+        ),
+        ConfigEntry(
+            SHUFFLE_WRITE_CONCURRENCY,
+            "writer-pool threads per shuffle write task (output "
+            "partitions are sharded across them, so per-sink batch order "
+            "is deterministic); serialization and sink I/O run there "
+            "instead of on the compute thread",
+            int,
+            "2",
+        ),
+        ConfigEntry(
+            SHUFFLE_WRITE_PIPELINED,
+            "false pins the pre-pipelining map-side path (argsort-based "
+            "permutation, synchronous uncoalesced per-run sink writes, "
+            "no compression — shuffle.compression only applies to the "
+            "pipelined path) — the A/B baseline for "
+            "benchmarks/shuffle_write.py",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            SHUFFLE_COMPRESSION,
+            "IPC body compression for written shuffle partitions "
+            "(none|lz4|zstd); pyarrow readers and the Flight server "
+            "decompress transparently, so only the write side pays",
+            _parse_compression,
+            "none",
+        ),
+        ConfigEntry(
             TASK_MAX_ATTEMPTS,
             "total attempts per task (first run + retries of transient "
             "failures) before the job fails with the accumulated error "
@@ -453,6 +511,26 @@ class BallistaConfig:
     @property
     def shuffle_coalesce_rows(self) -> int:
         return self._get(SHUFFLE_COALESCE_ROWS)
+
+    @property
+    def shuffle_write_coalesce_rows(self) -> int:
+        return self._get(SHUFFLE_WRITE_COALESCE_ROWS)
+
+    @property
+    def shuffle_write_queue_bytes(self) -> int:
+        return self._get(SHUFFLE_WRITE_QUEUE_BYTES)
+
+    @property
+    def shuffle_write_concurrency(self) -> int:
+        return self._get(SHUFFLE_WRITE_CONCURRENCY)
+
+    @property
+    def shuffle_write_pipelined(self) -> bool:
+        return self._get(SHUFFLE_WRITE_PIPELINED)
+
+    @property
+    def shuffle_compression(self) -> str:
+        return self._get(SHUFFLE_COMPRESSION)
 
     @property
     def task_max_attempts(self) -> int:

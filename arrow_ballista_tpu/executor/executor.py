@@ -213,6 +213,20 @@ class Executor:
                     wspan.set_attr(
                         "bytes", sum(p.num_bytes for p in partitions)
                     )
+                    wspan.set_attr("partitions", len(partitions))
+                    wspan.set_attr(
+                        "compression", config.shuffle_compression
+                    )
+                    wvals = writer.metrics.to_dict()
+                    for k in (
+                        "bytes_written_raw",
+                        "bytes_written_wire",
+                        "slab_flushes",
+                        "write_queue_full_ns",
+                        "device_pid_batches",
+                    ):
+                        if k in wvals:
+                            wspan.set_attr(k, wvals[k])
                 metrics = collect_plan_metrics(writer)
                 self.metrics_collector.record_stage(
                     pid.job_id, pid.stage_id, pid.partition_id, writer, metrics

@@ -105,11 +105,21 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
         metrics = r.get("metrics") or {}
         tpu = {}
         shuffle_bytes = 0
+        write = {}
         for op, vals in metrics.items():
             if op.startswith("TpuStage") or op.startswith("TpuWindow"):
                 for k, v in vals.items():
                     tpu[k] = tpu.get(k, 0) + v
             shuffle_bytes += vals.get("bytes_fetched", 0)
+            for k in (
+                "bytes_written_raw",
+                "bytes_written_wire",
+                "slab_flushes",
+                "write_queue_full_ns",
+                "device_pid_batches",
+            ):
+                if k in vals:
+                    write[k] = write.get(k, 0) + vals[k]
 
         row = {
             "stage_id": sid,
@@ -121,6 +131,20 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             "fetch_retries": r.get("fetch_retries", 0),
             "shuffle_bytes_fetched": shuffle_bytes,
         }
+        if write:
+            wire = write.get("bytes_written_wire", 0)
+            raw = write.get("bytes_written_raw", 0)
+            row["shuffle_write"] = {
+                "bytes_raw": raw,
+                "bytes_wire": wire,
+                # >1 means the IPC body compression paid for itself
+                "compression_ratio": round(raw / wire, 3) if wire else None,
+                "slab_flushes": write.get("slab_flushes", 0),
+                "queue_full_ms": round(
+                    write.get("write_queue_full_ns", 0) / _NS_PER_MS, 3
+                ),
+                "device_pid_batches": write.get("device_pid_batches", 0),
+            }
 
         ss = task_spans.get(sid)
         if ss:

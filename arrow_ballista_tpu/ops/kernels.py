@@ -2278,3 +2278,156 @@ def combine_states(
             i += 1
     out.append(acc[-1] + new[-1])  # presence
     return tuple(out)
+
+
+# --------------------------------------------------- shuffle hash partition
+# Device twin of exec.operators.hash_partition_indices: the SAME 64-bit
+# multiply/xorshift/combine hash, built from uint32 limb arithmetic so it
+# runs in x32 mode on accelerators without native 64-bit ALUs.  Map and
+# reduce sides of a join must co-partition, so assignments have to match
+# the host/native partitioner bit-for-bit (property-tested in
+# tests/test_shuffle_writer.py).
+
+_HASH_MUL = (0x9E3779B9, 0x7F4A7C15)  # (hi, lo) of the host multiplier
+_NULL_HASH = (0xA5A5A5A5, 0xDEADBEEF)  # (hi, lo) of the host null hash
+# the n <= 2^16 gate keeps every intermediate of the final 64-bit mod
+# inside uint32: (n-1)^2 + (n-1) < 2^32
+PID_MAX_PARTITIONS = 1 << 16
+
+
+def _mul64_limbs(ahi, alo, bhi, blo):
+    """Low 64 bits of a 64x64 product over (hi, lo) uint32 limbs —
+    16-bit half-products so nothing needs a widening multiply."""
+    mask16 = jnp.uint32(0xFFFF)
+    a0, a1 = alo & mask16, alo >> 16
+    b0, b1 = blo & mask16, blo >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    mid = (p00 >> 16) + (p01 & mask16) + (p10 & mask16)
+    lo = (mid << 16) | (p00 & mask16)
+    hi = a1 * b1 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    hi = hi + alo * bhi + ahi * blo  # uint32 wrap == mod 2^32
+    return hi, lo
+
+
+def _add64_limbs(ahi, alo, bhi, blo):
+    lo = alo + blo
+    carry = (lo < alo).astype(jnp.uint32)
+    return ahi + bhi + carry, lo
+
+
+_PID_KERNEL_CACHE: dict = {}
+
+
+def make_partition_id_kernel(n_cols: int, n_out: int):
+    """Jitted ``(hi, lo, is_null) x n_cols -> int32 partition ids``.
+
+    Per column: ``hv = (x * 0x9E3779B97F4A7C15) mod 2^64``,
+    ``hv ^= hv >> 32`` (both limbs uint32: the xorshift is one limb
+    xor), nulls replaced by the host's constant; columns combine as
+    ``h = h * 31 + hv``; the result is ``h mod n_out`` with the 64-bit
+    mod folded through ``2^32 mod n``.
+    """
+    key = (n_cols, n_out)
+    cached = _PID_KERNEL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    mul_hi = jnp.uint32(_HASH_MUL[0])
+    mul_lo = jnp.uint32(_HASH_MUL[1])
+    null_hi = jnp.uint32(_NULL_HASH[0])
+    null_lo = jnp.uint32(_NULL_HASH[1])
+    m = jnp.uint32(n_out)
+    pow32_mod = jnp.uint32((1 << 32) % n_out)
+
+    def kernel(*args):
+        hhi = jnp.zeros_like(args[0])
+        hlo = jnp.zeros_like(args[0])
+        for c in range(n_cols):
+            vhi, vlo, is_null = args[3 * c : 3 * c + 3]
+            phi, plo = _mul64_limbs(vhi, vlo, mul_hi, mul_lo)
+            plo = plo ^ phi  # hv ^= hv >> 32
+            phi = jnp.where(is_null, null_hi, phi)
+            plo = jnp.where(is_null, null_lo, plo)
+            thi, tlo = _mul64_limbs(hhi, hlo, jnp.uint32(0), jnp.uint32(31))
+            hhi, hlo = _add64_limbs(thi, tlo, phi, plo)
+        return (((hhi % m) * pow32_mod + (hlo % m)) % m).astype(jnp.int32)
+
+    cached = jax.jit(kernel)
+    _PID_KERNEL_CACHE[key] = cached
+    return cached
+
+
+def _pid_limbs(v: pa.Array) -> Optional[tuple]:
+    """(hi, lo, is_null) uint32/bool limb arrays for one key column —
+    the exact value prep of hash_partition_indices, or None when the
+    column type has no device hash (strings hash FNV over bytes on
+    host)."""
+    import pyarrow.compute as pc
+
+    t = v.type
+    if not (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_date(t)
+        or pa.types.is_timestamp(t)
+    ):
+        return None
+    is_null = (
+        np.asarray(pc.is_null(v))
+        if v.null_count
+        else np.zeros(len(v), dtype=bool)
+    )
+    if pa.types.is_date32(t):
+        v = v.cast(pa.int32())
+    elif pa.types.is_date64(t) or pa.types.is_timestamp(t):
+        v = v.cast(pa.int64())
+    elif pa.types.is_boolean(t):
+        v = v.cast(pa.int8())
+    if v.null_count:
+        v = v.fill_null(0)
+    x = np.asarray(v)
+    if x.dtype.kind == "f":
+        x = (
+            x.view(np.uint64)
+            if x.dtype == np.float64
+            else x.astype(np.float64).view(np.uint64)
+        )
+    else:
+        x = x.astype(np.int64).view(np.uint64)
+    hi = (x >> np.uint64(32)).astype(np.uint32)
+    lo = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo, is_null
+
+
+def device_partition_ids(
+    batch: pa.RecordBatch, exprs, n: int
+) -> Optional[np.ndarray]:
+    """Partition ids for ``batch`` through the jitted device hash, or
+    None when a key isn't device-hashable (non-column expression, string
+    key, n past PID_MAX_PARTITIONS) — the caller falls back to the host
+    partitioner.  Rows pad to power-of-two buckets so distinct XLA
+    shapes stay logarithmic in batch size."""
+    if n <= 0 or n > PID_MAX_PARTITIONS or batch.num_rows == 0:
+        return None
+    flat = []
+    for e in exprs:
+        if not isinstance(e, pe.Col) or not (0 <= e.index < batch.num_columns):
+            return None
+        limbs = _pid_limbs(batch.column(e.index))
+        if limbs is None:
+            return None
+        flat.append(limbs)
+    if not flat:
+        return None
+    n_rows = batch.num_rows
+    bucket = bucket_rows(n_rows, floor=256)
+    args = []
+    for hi, lo, is_null in flat:
+        args.append(_pad(hi, bucket))
+        args.append(_pad(lo, bucket))
+        args.append(_pad(is_null, bucket))
+    kernel = make_partition_id_kernel(len(flat), n)
+    out = np.asarray(kernel(*args))[:n_rows]
+    return out.astype(np.int64)

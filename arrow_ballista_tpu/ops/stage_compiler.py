@@ -973,6 +973,9 @@ class TpuStageExec(ExecutionPlan):
                 raise K.NotLowerable("build group keys without probe key")
         self._build_state = None  # lazily prepared per instance
         self._build_lock = __import__("threading").Lock()
+        # (exprs, n_out) installed by a downstream ShuffleWriterExec so
+        # the hash-partition ids ride the device instead of the host
+        self._shuffle_hint = None
 
         # raw kernel kept for mesh gang execution: shard_map needs the
         # untraced function to wrap with the cross-chip reduction
@@ -1087,6 +1090,17 @@ class TpuStageExec(ExecutionPlan):
             f"aggr={[a.name for a in self.fused.aggs]}, "
             f"filters={len(self.fused.filters)}, capacity={self.capacity}"
         )
+
+    def install_shuffle_hint(self, exprs, n_out: int) -> None:
+        """Downstream ShuffleWriterExec announces its hash partitioning
+        (exprs over THIS stage's output schema, n_out partitions):
+        ``_materialize`` then computes the partition-id column through
+        the jitted device hash kernel (``K.device_partition_ids``) and
+        appends it as ``SHUFFLE_PID_COLUMN``, so the writer's split skips
+        the host hash.  Assignments match the host partitioner
+        bit-for-bit by construction; keys the kernel can't hash (strings,
+        computed expressions) simply leave the hint unused."""
+        self._shuffle_hint = (list(exprs), int(n_out))
 
     # ------------------------------------------------------------ execute
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
@@ -2286,6 +2300,21 @@ class TpuStageExec(ExecutionPlan):
         out = pa.RecordBatch.from_arrays(cols, schema=schema)
         self.metrics.add("output_rows", out.num_rows)
         self.metrics.add("input_rows", n_rows_in)
+        hint = self._shuffle_hint
+        if hint is not None and out.num_rows:
+            pids = K.device_partition_ids(out, hint[0], hint[1])
+            if pids is not None:
+                from ..exec.operators import SHUFFLE_PID_COLUMN
+
+                # device_pid_batches is counted ONCE, by the consuming
+                # writer — a second add here would double it in the
+                # per-stage profile rollup
+                out = pa.RecordBatch.from_arrays(
+                    out.columns + [pa.array(pids.astype(np.int32), pa.int32())],
+                    schema=schema.append(
+                        pa.field(SHUFFLE_PID_COLUMN, pa.int32())
+                    ),
+                )
         yield out
 
 

@@ -71,15 +71,36 @@ WRITE_STATS_SCHEMA = pa.schema(
 
 class _IpcFileSink:
     """Arrow IPC file writer with write stats (reference:
-    core/src/utils.rs:60-97 write_stream_to_disk)."""
+    core/src/utils.rs:60-97 write_stream_to_disk).
 
-    def __init__(self, path: str, schema: pa.Schema):
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+    ``options`` enables IPC body compression; ``ensure_dir`` is the write
+    task's memoized mkdir (one syscall per output-partition dir instead
+    of one per sink).  ``wire_bytes`` is set by :meth:`close` — None
+    means the OS handle may still be open (the writer pool's abort path
+    keys off it)."""
+
+    def __init__(
+        self,
+        path: str,
+        schema: pa.Schema,
+        options=None,
+        ensure_dir=None,
+    ):
+        d = os.path.dirname(path)
+        if ensure_dir is not None:
+            ensure_dir(d)
+        else:
+            os.makedirs(d, exist_ok=True)
         self.path = path
         self.num_rows = 0
         self.num_batches = 0
+        self.wire_bytes: Optional[int] = None
         self._sink = pa.OSFile(path, "wb")
-        self._writer = pa.ipc.new_file(self._sink, schema)
+        try:
+            self._writer = pa.ipc.new_file(self._sink, schema, options=options)
+        except BaseException:
+            self._sink.close()
+            raise
 
     def write(self, batch: pa.RecordBatch) -> None:
         self._writer.write_batch(batch)
@@ -87,9 +108,26 @@ class _IpcFileSink:
         self.num_batches += 1
 
     def close(self) -> int:
-        self._writer.close()
-        self._sink.close()
-        return os.path.getsize(self.path)
+        # try/finally: a failed footer write (disk full, injected fault)
+        # must still release the OS file handle — a leaked fd per retry
+        # starves the executor of descriptors long before it fails tasks
+        try:
+            self._writer.close()
+        finally:
+            self._sink.close()
+        self.wire_bytes = os.path.getsize(self.path)
+        return self.wire_bytes
+
+    def abandon(self) -> None:
+        """Failed-task teardown: release the OS handle WITHOUT counting
+        the file as written (the partial file is clobbered by the retry
+        or swept with the job dir)."""
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001 - handle release is what matters
+            pass
+        finally:
+            self._sink.close()
 
 
 class _MemSink:
@@ -98,31 +136,46 @@ class _MemSink:
     TPU-first data plane: gang-stage outputs (and, with
     ``ballista.shuffle.to_memory``, every shuffle partition) stay in
     executor RAM and stream out of the Flight service without disk I/O.
+    Batches serialize into the IPC stream buffer AS THEY ARRIVE — the
+    partition is never held twice (batch list + serialized bytes), so
+    peak memory is the partition's wire size, not 2x its raw size.
     """
 
     def __init__(
         self, job_id: str, stage_id: int, out_part: int, in_part: int,
-        schema: pa.Schema,
+        schema: pa.Schema, options=None,
     ):
         from . import memory_store
 
         self.path = memory_store.make_path(job_id, stage_id, out_part, in_part)
         self._key = (job_id, stage_id, out_part, in_part)
-        self._schema = schema
-        self._batches: list[pa.RecordBatch] = []
         self.num_rows = 0
         self.num_batches = 0
+        self.wire_bytes: Optional[int] = None
+        self._buf = pa.BufferOutputStream()
+        self._writer = pa.ipc.new_stream(self._buf, schema, options=options)
 
     def write(self, batch: pa.RecordBatch) -> None:
-        self._batches.append(batch)
+        self._writer.write_batch(batch)
         self.num_rows += batch.num_rows
         self.num_batches += 1
 
     def close(self) -> int:
         from . import memory_store
 
-        path = memory_store.put(*self._key, self._schema, self._batches)
-        return memory_store.put_size(path)
+        self._writer.close()
+        memory_store.put_buffer(*self._key, self._buf.getvalue())
+        self.wire_bytes = memory_store.put_size(self.path)
+        return self.wire_bytes
+
+    def abandon(self) -> None:
+        """Failed-task teardown: drop the buffer WITHOUT publishing — a
+        partial partition stored under the canonical mem:// key would
+        shadow the retry's real output."""
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -140,6 +193,10 @@ class ShuffleWriterExec(ExecutionPlan):
         self.input = input
         self.work_dir = work_dir
         self.shuffle_output_partitioning = shuffle_output_partitioning
+        # True only after THIS writer asked its input stage for device
+        # partition ids — the pid-column pop is gated on it so a user
+        # column that happens to be named __shuffle_pid__ is never eaten
+        self._hint_installed = False
 
     @property
     def schema(self) -> pa.Schema:
@@ -174,51 +231,81 @@ class ShuffleWriterExec(ExecutionPlan):
             self.input, (MeshGangExec, MeshRepartitionExec)
         )
 
+    def _dir_memo(self):
+        """Memoized mkdir for this write task: one ``os.makedirs`` per
+        output-partition directory instead of one per sink.  Workers of
+        the writer pool shard partitions, so a duplicate check-then-add
+        race costs at most one extra (idempotent) makedirs."""
+        made: set = set()
+
+        def ensure(d: str) -> None:
+            if d not in made:
+                os.makedirs(d, exist_ok=True)
+                made.add(d)
+
+        return ensure
+
     def _sink(
         self, to_mem: bool, stage_dir: str, out_part: int, in_part: int,
-        schema: pa.Schema, single_file: bool,
+        schema: pa.Schema, single_file: bool, options=None, ensure_dir=None,
     ):
         if to_mem:
-            return _MemSink(self.job_id, self.stage_id, out_part, in_part, schema)
+            return _MemSink(
+                self.job_id, self.stage_id, out_part, in_part, schema,
+                options=options,
+            )
         name = "data.arrow" if single_file else f"data-{in_part}.arrow"
-        return _IpcFileSink(os.path.join(stage_dir, str(out_part), name), schema)
+        return _IpcFileSink(
+            os.path.join(stage_dir, str(out_part), name), schema,
+            options=options, ensure_dir=ensure_dir,
+        )
+
+    def _sink_factory(
+        self, to_mem: bool, stage_dir: str, in_part: int, schema: pa.Schema,
+        single_file: bool = False, fixed_out: Optional[int] = None,
+    ):
+        """Per-output-partition sink factory for the async writer pool —
+        invoked on the pool's threads, so opens/mkdirs stay off the
+        compute thread."""
+        from .writer import ipc_write_options
+
+        options = ipc_write_options(self._policy(None).compression)
+        ensure_dir = self._dir_memo()
+
+        def factory(out_part: int):
+            p = fixed_out if fixed_out is not None else out_part
+            return self._sink(
+                to_mem, stage_dir, p, in_part, schema, single_file,
+                options=options, ensure_dir=ensure_dir,
+            )
+
+        return factory
+
+    def _policy(self, ctx: Optional[TaskContext]):
+        from .writer import WritePolicy
+
+        if ctx is not None:
+            self._write_policy = WritePolicy.from_config(ctx.config)
+        return getattr(self, "_write_policy", None) or WritePolicy()
 
     # ------------------------------------------------------------- core
     def execute_shuffle_write(
         self, input_partition: int, ctx: TaskContext
     ) -> list[ShuffleWritePartition]:
         """Run the stage subplan for ``input_partition`` and persist its
-        output (reference: shuffle_writer.rs:142-292)."""
-        from ..serde.scheduler_types import ShuffleWritePartition
-
+        output (reference: shuffle_writer.rs:142-292) through the
+        slab-buffered async writer pool (``shuffle/writer.py``); the
+        pre-pipelining synchronous path stays callable via
+        ``ballista.shuffle.write_pipelined=false`` (A/B baseline)."""
         stage_dir = os.path.join(self.work_dir, self.job_id, str(self.stage_id))
         part = self.shuffle_output_partitioning
         to_mem = self._use_memory(ctx)
+        policy = self._policy(ctx)
 
         if part is None:
-            # no repartition: single output sink for this input partition
-            sink = None
-            with self.metrics.timer("write_time_ns"):
-                for batch in self.input.execute(input_partition, ctx):
-                    ctx.check_cancelled()
-                    if sink is None:
-                        sink = self._sink(
-                            to_mem, stage_dir, input_partition,
-                            input_partition, batch.schema, True,
-                        )
-                    sink.write(batch)
-                if sink is None:
-                    sink = self._sink(
-                        to_mem, stage_dir, input_partition, input_partition,
-                        self.input.schema, True,
-                    )
-                nbytes = sink.close()
-            self.metrics.add("output_rows", sink.num_rows)
-            return [
-                ShuffleWritePartition(
-                    input_partition, sink.path, sink.num_batches, sink.num_rows, nbytes
-                )
-            ]
+            return self._single_sink_write(
+                input_partition, ctx, stage_dir, to_mem, policy.pipelined
+            )
 
         if part.kind != "hash":
             raise ExecutionError(f"unsupported shuffle partitioning {part.kind}")
@@ -236,22 +323,180 @@ class ShuffleWriterExec(ExecutionPlan):
                 self.metrics.add("mesh_exchange_fallback", 1)
                 return self._fallback_hash_write(ctx, stage_dir, part)
 
-        sinks: list = [None] * part.n
-        for batch in self.input.execute(input_partition, ctx):
-            ctx.check_cancelled()
-            self._hash_split_into_sinks(
-                batch, part, sinks, to_mem, stage_dir, input_partition
+        if not policy.pipelined:
+            sinks: list = [None] * part.n
+            for batch in self.input.execute(input_partition, ctx):
+                ctx.check_cancelled()
+                self._hash_split_into_sinks(
+                    batch, part, sinks, to_mem, stage_dir, input_partition
+                )
+            return self._close_sinks(
+                sinks, to_mem, stage_dir, input_partition, self.input.schema
             )
-        return self._close_sinks(
-            sinks, to_mem, stage_dir, input_partition, self.input.schema
+
+        # device stages compute the hash on device and attach the pid
+        # column; every other input hashes on host inside the split
+        if hasattr(self.input, "install_shuffle_hint"):
+            self.input.install_shuffle_hint(list(part.exprs), part.n)
+            self._hint_installed = True
+
+        def batches():
+            for batch in self.input.execute(input_partition, ctx):
+                ctx.check_cancelled()
+                yield batch
+
+        return self._pipelined_hash_write(
+            batches(), part, ctx, stage_dir, to_mem, input_partition
         )
+
+    def _single_sink_write(
+        self, input_partition: int, ctx: TaskContext, stage_dir: str,
+        to_mem: bool, pipelined: bool,
+    ) -> list[ShuffleWritePartition]:
+        """No repartition: one output sink for this input partition."""
+        from ..serde.scheduler_types import ShuffleWritePartition
+
+        if pipelined:
+            from .writer import AsyncShuffleWriter
+
+            writer = AsyncShuffleWriter(
+                1,
+                self._sink_factory(
+                    to_mem, stage_dir, input_partition, self.input.schema,
+                    single_file=True, fixed_out=input_partition,
+                ),
+                self._policy(None),
+                self.metrics,
+                cancel_event=ctx.cancel_event,
+            )
+            try:
+                for batch in self.input.execute(input_partition, ctx):
+                    ctx.check_cancelled()
+                    writer.append(0, batch)
+                (sink,) = writer.finish()
+            except BaseException:
+                writer.abort()
+                raise
+            self.metrics.add("output_rows", sink.num_rows)
+            return [
+                ShuffleWritePartition(
+                    input_partition, sink.path, sink.num_batches,
+                    sink.num_rows, sink.wire_bytes,
+                )
+            ]
+        sink = None
+        with self.metrics.timer("write_time_ns"):
+            for batch in self.input.execute(input_partition, ctx):
+                ctx.check_cancelled()
+                if sink is None:
+                    sink = self._sink(
+                        to_mem, stage_dir, input_partition,
+                        input_partition, batch.schema, True,
+                    )
+                sink.write(batch)
+            if sink is None:
+                sink = self._sink(
+                    to_mem, stage_dir, input_partition, input_partition,
+                    self.input.schema, True,
+                )
+            nbytes = sink.close()
+        self.metrics.add("output_rows", sink.num_rows)
+        return [
+            ShuffleWritePartition(
+                input_partition, sink.path, sink.num_batches, sink.num_rows,
+                nbytes,
+            )
+        ]
+
+    def _pipelined_hash_write(
+        self, batch_iter, part: Partitioning, ctx: TaskContext,
+        stage_dir: str, to_mem: bool, in_part: int,
+        schema: Optional[pa.Schema] = None,
+    ) -> list[ShuffleWritePartition]:
+        """Hash-split a batch stream into the async writer pool: the
+        compute thread pays only the O(n) counting-sort permutation and
+        one ``take`` per batch; slab coalescing, IPC serialization
+        (+compression) and sink I/O run on the pool."""
+        from .writer import AsyncShuffleWriter
+
+        writer = AsyncShuffleWriter(
+            part.n,
+            self._sink_factory(
+                to_mem, stage_dir, in_part,
+                schema if schema is not None else self.input.schema,
+            ),
+            self._policy(None),
+            self.metrics,
+            cancel_event=ctx.cancel_event,
+        )
+        try:
+            for batch in batch_iter:
+                self._split_into_writer(batch, part, writer)
+            sinks = writer.finish()
+        except BaseException:
+            writer.abort()
+            raise
+        return self._stats_from_sinks(sinks)
+
+    def _split_into_writer(
+        self, batch: pa.RecordBatch, part: Partitioning, writer
+    ) -> None:
+        from ..exec.operators import partition_permutation
+
+        n_out = part.n
+        with self.metrics.timer("repart_time_ns"):
+            batch, idx = self._partition_ids(batch, part)
+            if batch.num_rows == 0:
+                return
+            order, bounds = partition_permutation(idx, n_out)
+        # no `take` here: the per-partition row gathers run on the pool
+        # threads at slab-flush time (writer.append_rows), so the compute
+        # thread never pays a row copy
+        for p in range(n_out):
+            lo, hi = int(bounds[p]), int(bounds[p + 1])
+            if hi > lo:
+                writer.append_rows(p, batch, order[lo:hi])
+
+    def _partition_ids(self, batch: pa.RecordBatch, part: Partitioning):
+        """(payload batch, partition id per row): pop the device-computed
+        pid column when the input stage attached one (install_shuffle_hint),
+        else run the host/native partitioner."""
+        import numpy as np
+
+        from ..exec.operators import SHUFFLE_PID_COLUMN
+
+        ncols = batch.num_columns
+        if (
+            self._hint_installed
+            and ncols
+            and batch.schema.field(ncols - 1).name == SHUFFLE_PID_COLUMN
+        ):
+            idx = np.asarray(batch.column(ncols - 1)).astype(np.int64)
+            self.metrics.add("device_pid_batches", 1)
+            return batch.select(range(ncols - 1)), idx
+        return batch, partition_indices(batch, list(part.exprs), part.n)
+
+    def _stats_from_sinks(self, sinks: list) -> list[ShuffleWritePartition]:
+        from ..serde.scheduler_types import ShuffleWritePartition
+
+        out = []
+        for p, s in enumerate(sinks):
+            self.metrics.add("output_rows", s.num_rows)
+            out.append(
+                ShuffleWritePartition(
+                    p, s.path, s.num_batches, s.num_rows, s.wire_bytes
+                )
+            )
+        return out
 
     def _hash_split_into_sinks(
         self, batch, part: Partitioning, sinks: list, to_mem: bool,
         stage_dir: str, in_part: int,
     ) -> None:
-        """Hash-split one batch and append each run to its partition sink
-        (the reference hot loop, shuffle_writer.rs:201-285)."""
+        """Pre-pipelining hash split (the reference hot loop,
+        shuffle_writer.rs:201-285): argsort permutation + one synchronous
+        uncoalesced sink write per split run.  Kept as the measured A/B
+        baseline behind ``ballista.shuffle.write_pipelined=false``."""
         import numpy as np
 
         n_out = part.n
@@ -301,24 +546,50 @@ class ShuffleWriterExec(ExecutionPlan):
         self, input_partition: int, ctx: TaskContext, stage_dir: str
     ) -> list[ShuffleWritePartition]:
         """Persist already-exchanged (out_partition, batch) pairs from a
-        MeshRepartitionExec stage body — the write half of the ICI shuffle."""
+        MeshRepartitionExec stage body — the write half of the ICI
+        shuffle.  No hash-split work here, but the batches still ride the
+        slab-buffered async pool (coalescing + off-thread serialization
+        + compression)."""
         assert input_partition == 0, "mesh-exchanged stages are single-task"
+        from .writer import AsyncShuffleWriter
+
         to_mem = self._use_memory(ctx)
-        sinks: list = [None] * self.shuffle_output_partitioning.n
-        for out_p, batch in self.input.execute_exchanged(ctx):
-            ctx.check_cancelled()
-            with self.metrics.timer("write_time_ns"):
-                if sinks[out_p] is None:
-                    sinks[out_p] = self._sink(
-                        to_mem, stage_dir, out_p, 0, batch.schema, False
-                    )
-                sinks[out_p].write(batch)
-        return self._close_sinks(sinks, to_mem, stage_dir, 0, self.input.schema)
+        if not self._policy(None).pipelined:
+            # the A/B baseline flag pins the pre-pipelining behavior on
+            # EVERY write shape, this one included
+            sinks: list = [None] * self.shuffle_output_partitioning.n
+            for out_p, batch in self.input.execute_exchanged(ctx):
+                ctx.check_cancelled()
+                with self.metrics.timer("write_time_ns"):
+                    if sinks[out_p] is None:
+                        sinks[out_p] = self._sink(
+                            to_mem, stage_dir, out_p, 0, batch.schema, False
+                        )
+                    sinks[out_p].write(batch)
+            return self._close_sinks(
+                sinks, to_mem, stage_dir, 0, self.input.schema
+            )
+        writer = AsyncShuffleWriter(
+            self.shuffle_output_partitioning.n,
+            self._sink_factory(to_mem, stage_dir, 0, self.input.schema),
+            self._policy(None),
+            self.metrics,
+            cancel_event=ctx.cancel_event,
+        )
+        try:
+            for out_p, batch in self.input.execute_exchanged(ctx):
+                ctx.check_cancelled()
+                writer.append(out_p, batch)
+            sinks = writer.finish()
+        except BaseException:
+            writer.abort()
+            raise
+        return self._stats_from_sinks(sinks)
 
     def _fallback_hash_write(
         self, ctx: TaskContext, stage_dir: str, part: Partitioning
     ) -> list[ShuffleWritePartition]:
-        """Exchange fallback: run the classic hash-split over EVERY inner
+        """Exchange fallback: run the hash-split over EVERY inner
         partition inside this one task (still correct, no collective).
 
         Sinks follow the EXPLICIT config only — the mesh-input heuristic
@@ -327,6 +598,19 @@ class ShuffleWriterExec(ExecutionPlan):
         whole in executor memory anyway."""
         to_mem = ctx.config.shuffle_to_memory
         inner = self.input.children()[0]
+
+        if self._policy(None).pipelined:
+
+            def batches():
+                for in_p in range(inner.output_partitioning().n):
+                    for batch in inner.execute(in_p, ctx):
+                        ctx.check_cancelled()
+                        yield batch
+
+            return self._pipelined_hash_write(
+                batches(), part, ctx, stage_dir, to_mem, 0,
+                schema=inner.schema,
+            )
         sinks: list = [None] * part.n
         for in_p in range(inner.output_partitioning().n):
             for batch in inner.execute(in_p, ctx):

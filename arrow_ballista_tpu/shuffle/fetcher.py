@@ -54,10 +54,12 @@ log = logging.getLogger(__name__)
 
 class _TeeMetrics:
     """Forward operator-metric adds into the process-wide registry
-    (obs/registry.py) so fetch totals are scrapable per process, while
-    the per-operator set keeps feeding stage metrics unchanged."""
+    (obs/registry.py) so data-plane totals are scrapable per process,
+    while the per-operator set keeps feeding stage metrics unchanged.
+    ``names`` maps operator metric -> registry counter; the default map
+    covers the fetch side, ``shuffle/writer.py`` passes the write map."""
 
-    _REGISTRY_NAMES = {
+    _FETCH_NAMES = {
         "bytes_fetched": "shuffle_bytes_fetched_total",
         "fetch_retries": "shuffle_fetch_retries_total",
         "locations_fetched": "shuffle_locations_fetched_total",
@@ -67,10 +69,11 @@ class _TeeMetrics:
     _counters: dict = {}
     _counters_lock = threading.Lock()
 
-    __slots__ = ("_inner",)
+    __slots__ = ("_inner", "_names")
 
-    def __init__(self, inner):
+    def __init__(self, inner, names: Optional[dict] = None):
         self._inner = inner
+        self._names = names if names is not None else self._FETCH_NAMES
 
     @classmethod
     def _counter(cls, name: str):
@@ -82,14 +85,14 @@ class _TeeMetrics:
                 c = cls._counters.get(name)
                 if c is None:
                     c = process_registry().counter(
-                        name, "shuffle fetch data-plane total"
+                        name, "shuffle data-plane total"
                     )
                     cls._counters[name] = c
         return c
 
     def add(self, name: str, v: int) -> None:
         self._inner.add(name, v)
-        reg_name = self._REGISTRY_NAMES.get(name)
+        reg_name = self._names.get(name)
         if reg_name is not None:
             self._counter(reg_name).inc(v)
 

@@ -113,13 +113,23 @@ def put(
     schema: pa.Schema,
     batches: List[pa.RecordBatch],
 ) -> str:
-    import time
-
     sink = pa.BufferOutputStream()
     with pa.ipc.new_stream(sink, schema) as writer:
         for b in batches:
             writer.write_batch(b)
-    buf = sink.getvalue()
+    return put_buffer(job_id, stage_id, out_part, in_part, sink.getvalue())
+
+
+def put_buffer(
+    job_id: str, stage_id: int, out_part: int, in_part: int, buf: pa.Buffer
+) -> str:
+    """Store an already-serialized IPC stream buffer.
+
+    The write-side sink streams batches into its own IPC writer as they
+    arrive (optionally compressed) and hands the finished buffer here, so
+    the partition is never double-buffered as a Python batch list on top
+    of its serialized bytes."""
+    import time
 
     key = (job_id, stage_id, out_part, in_part)
     path = make_path(*key)

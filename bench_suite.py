@@ -13,8 +13,10 @@ Runs the measured configs beyond bench.py's default (q1 SF10 = config #2):
 Each config emits one JSON line (same shape as bench.py) and everything
 is appended to BENCH_SUITE_r05.json so the results ship with the repo.
 
-  plus a shuffle-fetch data-plane micro-bench (shuffle_fetch_mb_per_sec,
-  pipelined vs sequential reduce-side read)
+  plus shuffle data-plane micro-benches: shuffle_fetch_mb_per_sec
+  (pipelined vs sequential reduce-side read) and shuffle_write_mb_per_sec
+  (slab-buffered async map-side write vs the synchronous baseline, with
+  the zstd wire-compression ratio)
 
 Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|shuffle|all]
 (default all)
@@ -579,6 +581,32 @@ def bench_shuffle_fetch() -> None:
     )
 
 
+def bench_shuffle_write() -> None:
+    """Config #7: shuffle write data plane — MB/s through the
+    slab-buffered async writer pool vs the pre-pipelining synchronous
+    path (argsort + one uncoalesced sink write per split run), plus the
+    zstd wire-compression ratio."""
+    from benchmarks.shuffle_write import run_write_bench
+
+    rec = run_write_bench(
+        n_batches=int(os.environ.get("BENCH_SHUFFLE_WRITE_BATCHES", "32")),
+        rows_per_batch=int(
+            os.environ.get("BENCH_SHUFFLE_WRITE_ROWS", "65536")
+        ),
+        n_out=int(os.environ.get("BENCH_SHUFFLE_WRITE_PARTITIONS", "8")),
+        compression=os.environ.get("BENCH_SHUFFLE_COMPRESSION", "zstd"),
+    )
+    _emit(
+        {
+            "metric": "shuffle_write_mb_per_sec",
+            "value": rec["pipelined_mb_per_sec"],
+            "unit": "MB/s",
+            "vs_baseline": rec["speedup"],
+            **rec,
+        }
+    )
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
@@ -603,6 +631,7 @@ def main() -> None:
         bench_h2o()
     if which in ("shuffle", "all"):
         bench_shuffle_fetch()
+        bench_shuffle_write()
 
 
 if __name__ == "__main__":

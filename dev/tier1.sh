@@ -4,13 +4,39 @@
 # hide the rest).  Prints DOTS_PASSED= the count of passing tests and
 # exits with pytest's status.
 #
-# Usage: dev/tier1.sh [extra pytest args...]
+# Usage: dev/tier1.sh [--bench-smoke] [extra pytest args...]
+#   --bench-smoke  additionally run the shuffle write/fetch micro-benches
+#                  on tiny inputs after the tests — a compile/regression
+#                  smoke for the benchmark harnesses themselves, NOT a
+#                  measurement and NOT part of default tier-1.
 set -o pipefail
 cd "$(dirname "$0")/.."
+BENCH_SMOKE=0
+if [ "$1" = "--bench-smoke" ]; then
+  BENCH_SMOKE=1
+  shift
+fi
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   "$@" 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "$BENCH_SMOKE" = "1" ]; then
+  echo "--- bench smoke (tiny inputs; compile check, not a measurement) ---"
+  timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.shuffle_fetch import run_fetch_bench
+from benchmarks.shuffle_write import run_write_bench
+
+print(json.dumps({"bench_smoke": "shuffle_fetch",
+                  **run_fetch_bench(n_locations=4, mb_per_location=0.5,
+                                    batch_rows=4096, concurrency=2)}))
+print(json.dumps({"bench_smoke": "shuffle_write",
+                  **run_write_bench(n_batches=4, rows_per_batch=8192,
+                                    n_out=4, compression="zstd", iters=1)}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
+fi
 exit $rc
