@@ -50,7 +50,15 @@ SHUFFLE_WRITE_PIPELINED = "ballista.shuffle.write_pipelined"
 SHUFFLE_COMPRESSION = "ballista.shuffle.compression"
 # Fault tolerance (see docs/user-guide/fault-tolerance.md)
 TASK_MAX_ATTEMPTS = "ballista.task.max_attempts"
+TASK_TIMEOUT_S = "ballista.task.timeout_seconds"
 STAGE_MAX_ATTEMPTS = "ballista.stage.max_attempts"
+# Speculative execution (straggler mitigation; fault-tolerance.md)
+SPECULATION_ENABLED = "ballista.speculation.enabled"
+SPECULATION_INTERVAL_S = "ballista.speculation.interval_seconds"
+SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
+SPECULATION_MIN_COMPLETED_FRACTION = "ballista.speculation.min_completed_fraction"
+SPECULATION_MIN_RUNTIME_S = "ballista.speculation.min_runtime_seconds"
+SPECULATION_MAX_COPIES_PER_STAGE = "ballista.speculation.max_copies_per_stage"
 EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
 EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
 EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
@@ -328,11 +336,68 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "4",
         ),
         ConfigEntry(
+            TASK_TIMEOUT_S,
+            "hard deadline (seconds) for one task attempt: a 'running' "
+            "task older than this on a live-but-wedged executor is "
+            "cancelled and re-queued through the normal transient path "
+            "WITHOUT consuming its attempt budget; 0 disables",
+            float,
+            "0",
+        ),
+        ConfigEntry(
             STAGE_MAX_ATTEMPTS,
             "executor-loss rollbacks per stage before the job fails "
             "instead of looping against a flapping executor",
             int,
             "4",
+        ),
+        ConfigEntry(
+            SPECULATION_ENABLED,
+            "launch a duplicate attempt of a straggling task on a "
+            "DIFFERENT executor once enough of its stage has finished; "
+            "first completion wins, the loser is cancelled and its late "
+            "status dropped as stale",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            SPECULATION_INTERVAL_S,
+            "how often (seconds) the scheduler's speculation scan visits "
+            "this job's running stages (the scan thread ticks at the "
+            "scheduler-level speculation_interval_seconds; a larger "
+            "per-session value skips intermediate ticks)",
+            float,
+            "1.0",
+        ),
+        ConfigEntry(
+            SPECULATION_MULTIPLIER,
+            "a running task becomes a speculation candidate once its "
+            "elapsed time exceeds multiplier x median(completed task "
+            "runtimes in its stage)",
+            float,
+            "1.5",
+        ),
+        ConfigEntry(
+            SPECULATION_MIN_COMPLETED_FRACTION,
+            "fraction of a stage's tasks that must have completed before "
+            "the runtime median is trusted for speculation",
+            float,
+            "0.75",
+        ),
+        ConfigEntry(
+            SPECULATION_MIN_RUNTIME_S,
+            "floor (seconds) under which a task is never speculated, "
+            "whatever the median says — duplicating sub-second tasks "
+            "wastes slots",
+            float,
+            "1.0",
+        ),
+        ConfigEntry(
+            SPECULATION_MAX_COPIES_PER_STAGE,
+            "total speculative duplicates one stage may launch over its "
+            "lifetime (bounds wasted work on a generally-slow cluster)",
+            int,
+            "2",
         ),
         ConfigEntry(
             EXECUTOR_QUARANTINE_THRESHOLD,
@@ -535,6 +600,34 @@ class BallistaConfig:
     @property
     def task_max_attempts(self) -> int:
         return self._get(TASK_MAX_ATTEMPTS)
+
+    @property
+    def task_timeout_seconds(self) -> float:
+        return self._get(TASK_TIMEOUT_S)
+
+    @property
+    def speculation_enabled(self) -> bool:
+        return self._get(SPECULATION_ENABLED)
+
+    @property
+    def speculation_interval_seconds(self) -> float:
+        return self._get(SPECULATION_INTERVAL_S)
+
+    @property
+    def speculation_multiplier(self) -> float:
+        return self._get(SPECULATION_MULTIPLIER)
+
+    @property
+    def speculation_min_completed_fraction(self) -> float:
+        return self._get(SPECULATION_MIN_COMPLETED_FRACTION)
+
+    @property
+    def speculation_min_runtime_seconds(self) -> float:
+        return self._get(SPECULATION_MIN_RUNTIME_S)
+
+    @property
+    def speculation_max_copies_per_stage(self) -> int:
+        return self._get(SPECULATION_MAX_COPIES_PER_STAGE)
 
     @property
     def stage_max_attempts(self) -> int:

@@ -32,6 +32,36 @@ class SerdeError(BallistaError):
     """Plan (de)serialization failure."""
 
 
+class ShuffleFetchFailed(ExecutionError):
+    """A shuffle reader exhausted its per-location fetch retries: the map
+    output it needs is gone (wiped work_dir, evicted memory partition,
+    dead serving process).  Carries the producer coordinates so the
+    scheduler can re-run just the lost partitions instead of burning the
+    consumer's attempt budget — the message embeds them in a stable
+    ``stage=N partition=M executor=E`` form that survives the
+    string-only TaskStatus wire format
+    (``scheduler/failure.py parse_shuffle_fetch_failure``)."""
+
+    def __init__(
+        self,
+        stage_id: int,
+        map_partition: int,
+        executor_id: str,
+        detail: str = "",
+    ):
+        self.stage_id = stage_id
+        self.map_partition = map_partition
+        self.executor_id = executor_id
+        msg = (
+            "shuffle fetch exhausted retries for map output "
+            f"stage={stage_id} partition={map_partition} "
+            f"executor={executor_id or '<unknown>'}"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 class SchedulerError(BallistaError):
     """Scheduler-side state machine failure."""
 

@@ -152,7 +152,12 @@ class Executor:
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
         self.task_isolation = task_isolation
         self.plugin_dir = plugin_dir
-        self._abort_handles: Dict[PartitionId, threading.Event] = {}
+        # pid -> {attempt: handle}: two attempts of one partition can
+        # coexist on this executor (a deadline-reaped task re-dispatched
+        # here while the wedged copy still runs), so the table must not
+        # let the re-dispatch clobber the old handle — or the old task's
+        # cleanup pop the new task's handle
+        self._abort_handles: Dict[PartitionId, Dict[int, threading.Event]] = {}
         self._abort_lock = threading.Lock()
         self._idle_workers: List[_ProcessWorker] = []
         self._worker_lock = threading.Lock()
@@ -176,7 +181,7 @@ class Executor:
         pid = PartitionId.from_proto(task.task_id)
         cancel_event = threading.Event()
         with self._abort_lock:
-            self._abort_handles[pid] = cancel_event
+            self._abort_handles.setdefault(pid, {})[task.attempt] = cancel_event
         try:
             with trace.activate(task.trace_id, task.parent_span_id), trace.span(
                 "task.execute",
@@ -185,6 +190,7 @@ class Executor:
                 partition=pid.partition_id,
                 attempt=task.attempt,
                 executor=self.id,
+                speculative=bool(task.speculative),
             ):
                 fault_point(
                     "executor.execute_task",
@@ -193,6 +199,20 @@ class Executor:
                     stage_id=pid.stage_id,
                     partition_id=pid.partition_id,
                     attempt=task.attempt,
+                )
+                # delay-friendly point (faults action="delay"): manufactures
+                # deterministic stragglers/wedged tasks for the speculation
+                # and deadline-reaper tests; cancel_event cuts the sleep
+                # short so CancelTasks still aborts a "wedged" task promptly
+                fault_point(
+                    "task.run",
+                    executor_id=self.id,
+                    job_id=pid.job_id,
+                    stage_id=pid.stage_id,
+                    partition_id=pid.partition_id,
+                    attempt=task.attempt,
+                    speculative=bool(task.speculative),
+                    cancel_event=cancel_event,
                 )
                 with trace.span("task.prepare"):
                     plan = BallistaCodec.decode_physical(task.plan, self.work_dir)
@@ -239,6 +259,7 @@ class Executor:
                     metrics=metrics,
                     attempt=task.attempt,
                     fetch_retries=_sum_metric(metrics, "fetch_retries"),
+                    speculative=bool(task.speculative),
                 )
         except Exception as e:  # noqa: BLE001 - every failure must report
             log.warning("task %s failed: %s", pid, e, exc_info=True)
@@ -248,10 +269,10 @@ class Executor:
                 executor_id=self.id,
                 error=f"{type(e).__name__}: {e}",
                 attempt=task.attempt,
+                speculative=bool(task.speculative),
             )
         finally:
-            with self._abort_lock:
-                self._abort_handles.pop(pid, None)
+            self._drop_abort_handle(pid, task.attempt)
         if trace.is_enabled():
             # piggyback every span finished in this process (this task's
             # and any stragglers) onto the status report
@@ -319,12 +340,11 @@ class Executor:
             worker = _ProcessWorker(self.id, self.work_dir, self.plugin_dir)
         abort = _WorkerAbort(worker)
         with self._abort_lock:
-            self._abort_handles[pid] = abort
+            self._abort_handles.setdefault(pid, {})[task.attempt] = abort
         try:
             out = worker.run(task.SerializeToString())
         finally:
-            with self._abort_lock:
-                self._abort_handles.pop(pid, None)
+            self._drop_abort_handle(pid, task.attempt)
         if out is None:
             worker.kill()
             # a deliberate cancel is fatal (no retry); an unexplained
@@ -339,6 +359,7 @@ class Executor:
                 executor_id=self.id,
                 error=error,
                 attempt=task.attempt,
+                speculative=bool(task.speculative),
             )
             return task_info_to_proto(info)
         with self._worker_lock:
@@ -370,9 +391,22 @@ class Executor:
             w.close()
 
     # --------------------------------------------------------------- abort
-    def cancel_task(self, pid: PartitionId) -> bool:
+    def _drop_abort_handle(self, pid: PartitionId, attempt: int) -> None:
         with self._abort_lock:
-            ev = self._abort_handles.get(pid)
+            per = self._abort_handles.get(pid)
+            if per is not None:
+                per.pop(attempt, None)
+                if not per:
+                    self._abort_handles.pop(pid, None)
+
+    def cancel_task(self, pid: PartitionId) -> bool:
+        """Abort the OLDEST live attempt of ``pid`` — CancelTasks is
+        pid-addressed and always targets a superseded copy (losing
+        duplicate, reaped straggler, cancelled job), so when two attempts
+        coexist here the newer one must survive the cancel."""
+        with self._abort_lock:
+            per = self._abort_handles.get(pid)
+            ev = per[min(per)] if per else None
         if ev is None:
             return False
         ev.set()
@@ -380,11 +414,14 @@ class Executor:
 
     def active_task_count(self) -> int:
         with self._abort_lock:
-            return len(self._abort_handles)
+            return sum(len(per) for per in self._abort_handles.values())
 
     def cancel_all(self) -> int:
         with self._abort_lock:
-            handles = list(self._abort_handles.values())
+            handles = [
+                ev for per in self._abort_handles.values()
+                for ev in per.values()
+            ]
         for ev in handles:
             ev.set()
         return len(handles)

@@ -131,6 +131,15 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             "fetch_retries": r.get("fetch_retries", 0),
             "shuffle_bytes_fetched": shuffle_bytes,
         }
+        spec = r.get("speculation")
+        if spec:
+            # straggler mitigation rollup: duplicates launched for this
+            # stage, how many committed first, how many were wasted work
+            row["speculation"] = {
+                "launched": spec.get("launched", 0),
+                "wins": spec.get("wins", 0),
+                "wasted": spec.get("wasted", 0),
+            }
         if write:
             wire = write.get("bytes_written_wire", 0)
             raw = write.get("bytes_written_raw", 0)

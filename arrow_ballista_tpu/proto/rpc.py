@@ -12,6 +12,8 @@ Reference service definitions: ``core/proto/ballista.proto:852-882``
 
 from __future__ import annotations
 
+import threading
+
 import grpc
 
 from . import pb
@@ -160,6 +162,24 @@ def add_kvstore_servicer(server: grpc.Server, servicer) -> None:
 
 def make_channel(host: str, port: int) -> grpc.Channel:
     return grpc.insecure_channel(f"{host}:{port}", options=GRPC_OPTIONS)
+
+
+# Process-wide executor-stub pool: every scheduler-side control-plane call
+# to an executor (LaunchTask, CancelTasks, StopExecutor) reuses one cached
+# channel per host:port instead of paying a fresh gRPC channel handshake
+# per fan-out (the pre-existing GrpcLauncher cache, generalized).
+_executor_stubs: dict = {}
+_executor_stubs_lock = threading.Lock()
+
+
+def executor_stub(host: str, port: int) -> ExecutorGrpcStub:
+    key = f"{host}:{port}"
+    with _executor_stubs_lock:
+        stub = _executor_stubs.get(key)
+        if stub is None:
+            stub = ExecutorGrpcStub(make_channel(host, port))
+            _executor_stubs[key] = stub
+        return stub
 
 
 def make_server(executor_workers: int = 16) -> grpc.Server:

@@ -39,6 +39,9 @@ CONFIG_KEYS = {
     "quarantine_threshold": (int, 5, "failures in-window that quarantine an executor; 0 disables"),
     "quarantine_window_seconds": (float, 60.0, "sliding window for the per-executor failure count"),
     "quarantine_backoff_seconds": (float, 30.0, "reservation exclusion period for quarantined executors"),
+    "speculation_enabled": (int, 0, "1 = speculatively re-run stragglers for every session (sessions can also opt in via ballista.speculation.enabled)"),
+    "speculation_interval_seconds": (float, 1.0, "period of the straggler/deadline scan on the event loop"),
+    "task_timeout_seconds": (float, 0.0, "reap running tasks older than this for every session (0 = off; sessions can set ballista.task.timeout_seconds)"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
@@ -151,6 +154,9 @@ def main(argv=None) -> None:
         quarantine_threshold=cfg["quarantine_threshold"],
         quarantine_window_s=cfg["quarantine_window_seconds"],
         quarantine_backoff_s=cfg["quarantine_backoff_seconds"],
+        speculation_interval_s=cfg["speculation_interval_seconds"],
+        speculation_force_enabled=bool(cfg["speculation_enabled"]),
+        task_timeout_force_s=cfg["task_timeout_seconds"],
     ).init()
     # the curator address executors dial back: must be reachable, never
     # the 0.0.0.0 wildcard

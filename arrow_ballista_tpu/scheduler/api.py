@@ -180,7 +180,9 @@ async function refresh() {
       `${metrics.alive_executors} executor(s) · ${metrics.available_slots} slot(s) · ` +
       `${metrics.active_jobs} active job(s) · ` +
       `${metrics.task_retries || 0} task retr${metrics.task_retries === 1 ? 'y' : 'ies'} · ` +
-      `${metrics.executors_quarantined || 0} quarantined`;
+      `${metrics.executors_quarantined || 0} quarantined · ` +
+      `spec ${metrics.speculative_wins || 0}/${metrics.speculative_launched || 0} won · ` +
+      `${metrics.task_timeouts_total || 0} reaped`;
     const etb = document.querySelector('#executors tbody');
     etb.innerHTML = '';
     for (const e of state.executors) {

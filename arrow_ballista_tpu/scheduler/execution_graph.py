@@ -53,6 +53,12 @@ class Task:
     # the job's trace id ("" = untraced/unsampled); shipped in
     # TaskDefinition so executor task spans stitch under the job trace
     trace_id: str = ""
+    # scheduler-launched duplicate of a straggling partition (same
+    # attempt number as the primary; first completion wins)
+    speculative: bool = False
+    # ballista.task.timeout_seconds at dispatch (0 = none); informational
+    # for the executor — the scheduler's scan enforces it
+    timeout_seconds: float = 0.0
 
 
 DEFAULT_TASK_MAX_ATTEMPTS = 4
@@ -93,6 +99,20 @@ class ExecutionGraph:
         )
         self.task_retries = 0  # transient-failure re-queues over job lifetime
         self.stage_reset_counts: Dict[int, int] = {}  # executor-loss resets
+        # speculative execution + deadline policy from the session config
+        # (scheduler flags can force-enable; see scheduler/speculation.py).
+        # In-memory only — a restarted scheduler re-derives nothing here
+        # (Running stages persist as Resolved, so timing state is gone).
+        self._init_speculation_policy(config)
+        # CancelTasks fan-out queue: (executor_id, PartitionId) of losing
+        # duplicate attempts / reaped deadline-timeouts, drained by the
+        # TaskManager after graph mutations commit
+        self.pending_cancels: List[tuple] = []
+        # wasted-duplicate count not yet flushed into the scheduler's
+        # registry counter (TaskManager._persist drains it, so every
+        # drop site — commit, failure, reset, reap — reconciles with the
+        # per-stage spec_stats rollup)
+        self.spec_wasted_pending = 0
         # tracing: set by the scheduler at submit when the session has
         # ballista.obs.enabled (and the job is sampled); in-memory only —
         # a trace does not survive scheduler restart
@@ -105,6 +125,36 @@ class ExecutionGraph:
         self._final_stage_id = stage_plans[-1].stage_id
         self.output_partitions = stage_plans[-1].output_partitioning().n
         self.stages = _build_stages(stage_plans)
+
+    def _init_speculation_policy(self, config) -> None:
+        if config is not None:
+            self.spec_enabled = config.speculation_enabled
+            self.spec_interval_s = config.speculation_interval_seconds
+            self.spec_multiplier = config.speculation_multiplier
+            self.spec_min_completed_fraction = (
+                config.speculation_min_completed_fraction
+            )
+            self.spec_min_runtime_s = config.speculation_min_runtime_seconds
+            self.spec_max_copies_per_stage = (
+                config.speculation_max_copies_per_stage
+            )
+            self.task_timeout_s = config.task_timeout_seconds
+        else:
+            self.spec_enabled = False
+            self.spec_interval_s = 1.0
+            self.spec_multiplier = 1.5
+            self.spec_min_completed_fraction = 0.75
+            self.spec_min_runtime_s = 1.0
+            self.spec_max_copies_per_stage = 2
+            self.task_timeout_s = 0.0
+
+    def take_pending_cancels(self) -> List[tuple]:
+        out, self.pending_cancels = self.pending_cancels, []
+        return out
+
+    def take_spec_wasted(self) -> int:
+        n, self.spec_wasted_pending = self.spec_wasted_pending, 0
+        return n
 
     # ------------------------------------------------------------- intro
     @property
@@ -156,7 +206,11 @@ class ExecutionGraph:
         A partition whose last transient failure happened on
         ``executor_id`` is skipped (the retry must land elsewhere) unless
         ``allow_excluded`` — the liveness escape hatch when no other
-        executor exists (``task_manager.fill_reservations``)."""
+        executor exists (``task_manager.fill_reservations``).
+
+        Unclaimed partitions are served first; pending speculation
+        requests (straggler duplicates flagged by the scan) come second
+        and only ever land on an executor OTHER than the primary's."""
         for sid in sorted(self.stages):
             stage = self.stages[sid]
             if not isinstance(stage, RunningStage):
@@ -174,6 +228,7 @@ class ExecutionGraph:
                 stage.task_statuses[p] = TaskInfo(
                     pid, "running", executor_id, attempt=attempt
                 )
+                stage.task_started_mono[p] = time.monotonic()
                 return Task(
                     self.session_id,
                     pid,
@@ -181,24 +236,78 @@ class ExecutionGraph:
                     stage.plan.shuffle_output_partitioning,
                     attempt,
                     trace_id=self.trace_id,
+                    timeout_seconds=self.task_timeout_s,
                 )
+            task = self._pop_speculative(sid, stage, executor_id)
+            if task is not None:
+                return task
+        return None
+
+    def _pop_speculative(
+        self, sid: int, stage: RunningStage, executor_id: str
+    ) -> Optional[Task]:
+        """Hand out one pending straggler duplicate to ``executor_id``.
+        The duplicate shares the primary's attempt number — whichever copy
+        completes first commits; the other's late status fails the
+        "partition already completed" guard."""
+        for p, primary_eid in sorted(stage.speculation_requests.items()):
+            t = stage.task_statuses[p]
+            if t is None or t.state != "running":
+                # the primary failed/was reset/completed since the scan
+                # flagged it: the request is stale
+                stage.speculation_requests.pop(p, None)
+                continue
+            if executor_id == t.executor_id or executor_id == primary_eid:
+                continue  # the duplicate must race on a DIFFERENT host
+            if stage.task_exclusions.get(p) == executor_id:
+                continue  # ...and never on a host that already failed p
+            if p in stage.speculative_statuses:
+                stage.speculation_requests.pop(p, None)
+                continue
+            attempt = stage.task_attempts.get(p, 0)
+            pid = PartitionId(self.job_id, sid, p)
+            stage.speculative_statuses[p] = TaskInfo(
+                pid, "running", executor_id, attempt=attempt, speculative=True
+            )
+            stage.spec_started_mono[p] = time.monotonic()
+            stage.bump_spec_stat("launched")
+            stage.speculation_requests.pop(p, None)
+            return Task(
+                self.session_id,
+                pid,
+                stage.plan,
+                stage.plan.shuffle_output_partitioning,
+                attempt,
+                trace_id=self.trace_id,
+                speculative=True,
+                timeout_seconds=self.task_timeout_s,
+            )
         return None
 
     def reset_task_status(
-        self, partition: PartitionId, exclude_executor: str = ""
+        self, partition: PartitionId, exclude_executor: str = "",
+        speculative: bool = False,
     ) -> None:
         """Return a handed-out task to the pool (launch failed / reservation
         cancelled).  ``exclude_executor`` keeps the re-dispatch off the
-        executor the launch just failed against."""
+        executor the launch just failed against.  A failed SPECULATIVE
+        launch only forgets the duplicate — the primary attempt keeps the
+        partition."""
         stage = self.stages.get(partition.stage_id)
-        if isinstance(stage, RunningStage):
-            t = stage.task_statuses[partition.partition_id]
-            if t is not None and t.state == "running":
-                stage.task_statuses[partition.partition_id] = None
-                if exclude_executor:
-                    stage.task_exclusions[partition.partition_id] = (
-                        exclude_executor
-                    )
+        if not isinstance(stage, RunningStage):
+            return
+        p = partition.partition_id
+        if speculative:
+            if stage.drop_speculative(p) is not None:
+                stage.bump_spec_stat("wasted")
+                self.spec_wasted_pending += 1
+            return
+        t = stage.task_statuses[p]
+        if t is not None and t.state == "running":
+            stage.task_statuses[p] = None
+            stage.task_started_mono.pop(p, None)
+            if exclude_executor:
+                stage.task_exclusions[p] = exclude_executor
 
     def reset_running_tasks(self, executor_id: str) -> int:
         """Re-queue every task currently running on ``executor_id`` with
@@ -209,14 +318,37 @@ class ExecutionGraph:
         The attempt counter is bumped: the quarantined executor was never
         told to stop, so its late status for the superseded attempt must
         fail the stale-attempt guards instead of double-completing or
-        double-failing the partition."""
+        double-failing the partition.  A primary whose healthy duplicate
+        is still racing elsewhere is not re-queued — the duplicate is
+        promoted in place (same attempt, partition stays covered)."""
         n = 0
         for stage in self.stages.values():
             if not isinstance(stage, RunningStage):
                 continue
+            for p, si in list(stage.speculative_statuses.items()):
+                if si.executor_id == executor_id:
+                    stage.drop_speculative(p)
+                    stage.bump_spec_stat("wasted")
+                    self.spec_wasted_pending += 1
             for p, t in enumerate(stage.task_statuses):
                 if t is not None and t.state == "running" and t.executor_id == executor_id:
+                    spec_started = stage.spec_started_mono.get(p)
+                    shadow = stage.drop_speculative(p)
+                    if shadow is not None:
+                        stage.task_statuses[p] = shadow
+                        if spec_started is not None:
+                            stage.task_started_mono[p] = spec_started
+                        else:
+                            stage.task_started_mono.pop(p, None)
+                        # the quarantined host's copy is superseded: abort
+                        # it (best-effort) — its late reports are dropped
+                        # by the superseded-copy guard either way
+                        self.pending_cancels.append(
+                            (executor_id, t.partition_id)
+                        )
+                        continue
                     stage.task_statuses[p] = None
+                    stage.task_started_mono.pop(p, None)
                     stage.task_exclusions[p] = executor_id
                     stage.task_attempts[p] = stage.task_attempts.get(p, 0) + 1
                     self.task_retries += 1
@@ -242,16 +374,38 @@ class ExecutionGraph:
             return []
 
         events: List[str] = []
+        p = info.partition_id.partition_id
+        committed = (
+            0 <= p < stage.partitions
+            and stage.task_statuses[p] is not None
+            and stage.task_statuses[p].state == "completed"
+        )
+        if committed:
+            # first-completion-wins: the partition already committed, so
+            # ANY later report — the cancelled loser's success as much as
+            # its failure, or a duplicate delivery — is stale.  Dropping
+            # it here keeps the committed output locations stable (no
+            # double propagation to consumers) and burns no failure
+            # budget.
+            return []
+
         if info.state == "failed":
             return self._on_task_failed(stage, info)
 
-        p = info.partition_id.partition_id
         if info.attempt < stage.task_attempts.get(p, 0):
             # late status from a superseded attempt (the task was reset by
             # quarantine and re-dispatched): accepting it would overwrite
             # the live attempt's status — and a stale completion would
             # propagate the same partition's output twice
             return []
+        if info.state == "running" and info.speculative:
+            # progress report from a duplicate attempt: it must never
+            # shadow the primary's slot
+            if p in stage.speculative_statuses:
+                stage.speculative_statuses[p] = info
+            return []
+        if info.state == "completed":
+            events.extend(self._commit_winner(stage, info))
         stage.update_task_status(info)
         if info.state == "completed":
             if info.fetch_retries:
@@ -283,14 +437,67 @@ class ExecutionGraph:
                 events.append("job_updated")
         return events
 
+    def _commit_winner(self, stage: RunningStage, info: TaskInfo) -> List[str]:
+        """First-completion-wins bookkeeping for one completed report:
+        identify the losing copy (if the partition was racing two), queue
+        its CancelTasks, and record the winner's runtime for the stage's
+        speculation median.  The caller then commits ``info`` as the
+        partition's status."""
+        p = info.partition_id.partition_id
+        events: List[str] = []
+        cur = stage.task_statuses[p]
+        started = stage.task_started_mono.get(p)
+        shadow_started = stage.spec_started_mono.get(p)
+        shadow = stage.drop_speculative(p)
+        if info.speculative:
+            # the duplicate beat the straggler: the still-running primary
+            # is the loser — cancel it; its late status will hit the
+            # committed-partition guard
+            if (
+                cur is not None
+                and cur.state == "running"
+                and cur.executor_id != info.executor_id
+            ):
+                self.pending_cancels.append(
+                    (cur.executor_id, info.partition_id)
+                )
+            stage.bump_spec_stat("wins")
+            events.append("speculative_win")
+            started = shadow_started if shadow_started is not None else started
+        elif shadow is not None:
+            # the primary won the race after all: the duplicate is wasted
+            self.pending_cancels.append(
+                (shadow.executor_id, info.partition_id)
+            )
+            stage.bump_spec_stat("wasted")
+            self.spec_wasted_pending += 1
+            events.append("speculative_wasted")
+        stage.task_started_mono.pop(p, None)
+        if started is not None:
+            stage.completed_runtime_s.append(
+                max(0.0, time.monotonic() - started)
+            )
+        return events
+
     def _on_task_failed(self, stage: RunningStage, info: TaskInfo) -> List[str]:
         """Bounded retry with failure classification (the reference fails
         the whole job on the first failed task; production cannot):
         transient failures re-queue the partition — excluded from the
         executor that just failed it — until ``ballista.task.max_attempts``
         is spent, then the job fails with the accumulated error history.
-        Fatal (plan/serde/SQL) errors fail fast on attempt 1."""
-        from .failure import FATAL, classify_failure
+        Fatal (plan/serde/SQL) errors fail fast on attempt 1.
+
+        Speculation interplay: while a partition races two copies, one
+        copy's failure only drops THAT copy (the other keeps the
+        partition; no re-queue, no attempt burned).  A consumer failing
+        with a structured ShuffleFetchFailed triggers producer-partition
+        recovery instead of burning its own attempts on data that no
+        longer exists."""
+        from .failure import (
+            FATAL,
+            classify_failure,
+            parse_shuffle_fetch_failure,
+        )
 
         sid = info.partition_id.stage_id
         p = info.partition_id.partition_id
@@ -302,16 +509,87 @@ class ExecutionGraph:
         if info.fetch_retries:
             stage.task_fetch_retries[p] = info.fetch_retries
         error = info.error or "task failed"
+
+        shadow = stage.speculative_statuses.get(p)
+        cur = stage.task_statuses[p]
+        if info.speculative:
+            # the duplicate died; the primary still owns the partition
+            if shadow is not None and info.executor_id == shadow.executor_id:
+                stage.drop_speculative(p)
+                stage.bump_spec_stat("wasted")
+                self.spec_wasted_pending += 1
+                return ["speculative_wasted"]
+            if not (
+                cur is not None
+                and cur.state == "running"
+                and cur.executor_id == info.executor_id
+            ):
+                return []  # duplicate already dropped/superseded: stale
+            # the duplicate was PROMOTED to primary (its reports still
+            # carry speculative=true from the TaskDefinition): this is
+            # now the partition's only live attempt — fall through to the
+            # normal failure path so it re-queues instead of stranding
+            # the partition in "running" forever
+        elif cur is None or cur.state != "running":
+            # no live attempt owns this partition: it was reset (launch
+            # failure, stage rollback, lost-shuffle recovery) and will
+            # re-dispatch through the normal path.  The report is from a
+            # superseded copy — e.g. a recovery-cancelled consumer task's
+            # late "Cancelled:" — and must neither burn budget nor
+            # fail-fast a job mid-recovery.
+            return []
+        elif (
+            cur.executor_id
+            and info.executor_id
+            and cur.executor_id != info.executor_id
+        ):
+            # same-attempt failure from an executor that no longer owns
+            # the partition (e.g. a quarantine reset promoted the
+            # duplicate in place and the old primary limped on): the
+            # live attempt on cur.executor_id keeps the partition — do
+            # not wipe it or burn budget for a superseded copy
+            return []
+        if (
+            shadow is not None
+            and cur is not None
+            and cur.state == "running"
+            and info.executor_id == cur.executor_id
+        ):
+            # the primary died but its duplicate races on: promote it in
+            # place (same attempt number) instead of re-queueing
+            spec_started = stage.spec_started_mono.get(p)
+            promoted = stage.drop_speculative(p)
+            stage.task_statuses[p] = promoted
+            if spec_started is not None:
+                stage.task_started_mono[p] = spec_started
+            else:
+                stage.task_started_mono.pop(p, None)
+            stage.task_failures.setdefault(p, []).append(
+                f"attempt {current} on {info.executor_id or '<unknown>'}: "
+                f"{error} (duplicate attempt promoted)"
+            )
+            return ["job_updated"]
+
+        lost = parse_shuffle_fetch_failure(error)
+        if lost is not None:
+            recovered = self._recover_lost_shuffle(stage, *lost)
+            if recovered is not None:
+                return recovered
+
         history = stage.task_failures.setdefault(p, [])
         history.append(
             f"attempt {current} on {info.executor_id or '<unknown>'}: {error}"
         )
         kind = classify_failure(error)
-        if kind != FATAL and current + 1 < self.task_max_attempts:
+        # deadline reaps bump the attempt counter for staleness but grant
+        # a free attempt — they never consume the failure budget
+        budget = self.task_max_attempts + stage.task_free_attempts.get(p, 0)
+        if kind != FATAL and current + 1 < budget:
             stage.task_attempts[p] = current + 1
             if info.executor_id:
                 stage.task_exclusions[p] = info.executor_id
             stage.task_statuses[p] = None
+            stage.task_started_mono.pop(p, None)
             self.task_retries += 1
             return ["task_retried"]
 
@@ -365,6 +643,228 @@ class ExecutionGraph:
                     )
                 )
 
+    # ------------------------------------------ lost-shuffle recovery
+    def _recover_lost_shuffle(
+        self,
+        consumer: RunningStage,
+        prod_sid: int,
+        map_partition: int,
+        executor_id: str,
+    ) -> Optional[List[str]]:
+        """A consumer task exhausted its fetch retries against map output
+        that no longer exists (``ShuffleFetchFailed``): re-run only the
+        PRODUCER partitions that lived on ``executor_id`` and roll the
+        consumer back to Unresolved, instead of burning the consumer's
+        attempt budget on data nobody can serve.  Returns the job events,
+        or None when recovery does not apply (the normal transient retry
+        path then takes over).  Bounded by the same
+        ``ballista.stage.max_attempts`` ledger as executor-loss resets."""
+        producer = self.stages.get(prod_sid)
+        if producer is None or prod_sid == consumer.stage_id:
+            return None
+        csid = consumer.stage_id
+        inp = consumer.inputs.get(prod_sid)
+        lost_in_consumer = inp is not None and any(
+            l.executor_meta.id == executor_id
+            for locs in inp.partition_locations.values()
+            for l in locs
+        )
+        producer_has_lost_tasks = isinstance(producer, CompletedStage) and any(
+            t is not None and t.executor_id == executor_id
+            for t in producer.task_statuses
+        )
+        producer_rerunning = isinstance(producer, (RunningStage, ResolvedStage, UnresolvedStage))
+        if not (lost_in_consumer or producer_has_lost_tasks or producer_rerunning):
+            return None
+
+        # bounded: repeated data loss on the same stages must fail the
+        # job with the ledger, not loop forever
+        for sid in (prod_sid, csid):
+            count = self.stage_reset_counts.get(sid, 0) + 1
+            self.stage_reset_counts[sid] = count
+            if count >= self.stage_max_attempts:
+                self.status = FAILED
+                self.error = (
+                    f"stage {sid} reset {count} times recovering lost "
+                    f"shuffle output of stage {prod_sid} on {executor_id}; "
+                    f"exceeded ballista.stage.max_attempts="
+                    f"{self.stage_max_attempts}"
+                )
+                return ["job_failed"]
+
+        # 1) abandon the consumer's other in-flight tasks (their input
+        #    set is about to change) and roll it back to Unresolved,
+        #    stripping ONLY the lost executor's locations for prod_sid
+        for t in consumer.task_statuses:
+            if t is not None and t.state == "running":
+                self.pending_cancels.append((t.executor_id, t.partition_id))
+        for si in consumer.speculative_statuses.values():
+            self.pending_cancels.append((si.executor_id, si.partition_id))
+        unresolved = consumer.to_resolved().to_unresolved()
+        uinp = unresolved.inputs.get(prod_sid)
+        if uinp is not None:
+            stripped = False
+            for q, locs in uinp.partition_locations.items():
+                kept = [
+                    l for l in locs if l.executor_meta.id != executor_id
+                ]
+                if len(kept) != len(locs):
+                    stripped = True
+                uinp.partition_locations[q] = kept
+            if stripped or producer_has_lost_tasks or producer_rerunning:
+                uinp.complete = False
+        self.stages[csid] = unresolved
+
+        # 2) re-run just the producer tasks whose output lived there
+        n_rerun = 0
+        if isinstance(producer, CompletedStage):
+            running = producer.to_running()
+            n_rerun = running.reset_tasks(executor_id)
+            if n_rerun:
+                self.stages[prod_sid] = running
+        self.revive()
+        return ["job_updated"] + ["task_requeued"] * n_rerun
+
+    # --------------------------------------- speculation/deadline scan
+    def scan_speculation(
+        self,
+        now: Optional[float] = None,
+        force_enabled: bool = False,
+        force_timeout_s: float = 0.0,
+    ) -> dict:
+        """One pass of the scheduler's periodic straggler/deadline scan
+        (runs on the event-loop thread via ``scheduler/speculation.py``).
+        Flags stragglers for duplicate dispatch, reaps running tasks past
+        ``ballista.task.timeout_seconds``, and returns
+        ``{"new_requests", "timeouts", "events"}``.  Cancellations queue
+        on ``pending_cancels``."""
+        now = time.monotonic() if now is None else now
+        out = {"new_requests": 0, "timeouts": 0, "events": []}
+        if self.status != RUNNING:
+            return out
+        enabled = self.spec_enabled or force_enabled
+        timeout_s = self.task_timeout_s or force_timeout_s
+        for sid, stage in list(self.stages.items()):
+            if not isinstance(stage, RunningStage):
+                continue
+            if timeout_s > 0:
+                self._reap_deadlines(sid, stage, now, timeout_s, out)
+                if self.status == FAILED:
+                    return out
+            if enabled:
+                self._request_speculation(stage, now, out)
+        return out
+
+    def _reap_deadlines(
+        self, sid: int, stage: RunningStage, now: float, timeout_s: float,
+        out: dict,
+    ) -> None:
+        # wedged duplicates just disappear (wasted); the primary keeps
+        # the partition
+        for p, si in list(stage.speculative_statuses.items()):
+            started = stage.spec_started_mono.get(p)
+            if started is not None and now - started >= timeout_s:
+                stage.drop_speculative(p)
+                stage.bump_spec_stat("wasted")
+                self.spec_wasted_pending += 1
+                self.pending_cancels.append(
+                    (si.executor_id, si.partition_id)
+                )
+                out["timeouts"] += 1
+        for p, t in enumerate(stage.task_statuses):
+            if t is None or t.state != "running":
+                continue
+            started = stage.task_started_mono.get(p)
+            if started is None or now - started < timeout_s:
+                continue
+            pid = PartitionId(self.job_id, sid, p)
+            self.pending_cancels.append((t.executor_id, pid))
+            out["timeouts"] += 1
+            spec_started = stage.spec_started_mono.get(p)
+            shadow = stage.drop_speculative(p)
+            if shadow is not None:
+                # a healthy duplicate takes over in place (same attempt)
+                stage.task_statuses[p] = shadow
+                if spec_started is not None:
+                    stage.task_started_mono[p] = spec_started
+                else:
+                    stage.task_started_mono.pop(p, None)
+                out["events"].append("job_updated")
+                continue
+            cur = stage.task_attempts.get(p, 0)
+            stage.task_failures.setdefault(p, []).append(
+                f"attempt {cur} on {t.executor_id or '<unknown>'}: task "
+                f"deadline exceeded after {now - started:.1f}s (reaped)"
+            )
+            # reaps are budget-free but NOT unbounded: a partition whose
+            # every attempt outlives the deadline (the timeout is simply
+            # below its genuine runtime) must fail the job with a clear
+            # error, not loop dispatch→reap forever
+            reaps = stage.task_free_attempts.get(p, 0) + 1
+            if reaps >= max(2, self.task_max_attempts):
+                detail = "; ".join(stage.task_failures.get(p, []))
+                self.stages[sid] = stage.to_failed(detail)
+                self.status = FAILED
+                self.error = (
+                    f"stage {sid} task {p} reaped {reaps} times at "
+                    f"ballista.task.timeout_seconds={timeout_s:g} — the "
+                    f"deadline is below the task's real runtime: {detail}"
+                )
+                out["events"].append("job_failed")
+                return
+            stage.task_statuses[p] = None
+            stage.task_started_mono.pop(p, None)
+            if t.executor_id:
+                stage.task_exclusions[p] = t.executor_id
+            # the bump keeps the wedged executor's late report stale; the
+            # free attempt keeps the reap out of the failure budget
+            stage.task_attempts[p] = cur + 1
+            stage.task_free_attempts[p] = reaps
+            self.task_retries += 1
+            out["events"].append("task_requeued")
+
+    def _request_speculation(
+        self, stage: RunningStage, now: float, out: dict
+    ) -> None:
+        import math
+        import statistics
+
+        launched = stage.spec_stats.get("launched", 0)
+        budget = (
+            self.spec_max_copies_per_stage
+            - launched
+            - len(stage.speculation_requests)
+        )
+        if budget <= 0:
+            return
+        runtimes = stage.completed_runtime_s
+        need = max(
+            1,
+            math.ceil(self.spec_min_completed_fraction * stage.partitions),
+        )
+        if not runtimes or stage.completed_tasks() < need:
+            return
+        threshold = max(
+            self.spec_multiplier * statistics.median(runtimes),
+            self.spec_min_runtime_s,
+        )
+        for p, t in enumerate(stage.task_statuses):
+            if budget <= 0:
+                break
+            if t is None or t.state != "running":
+                continue
+            if (
+                p in stage.speculative_statuses
+                or p in stage.speculation_requests
+            ):
+                continue
+            started = stage.task_started_mono.get(p)
+            if started is None or now - started <= threshold:
+                continue
+            stage.speculation_requests[p] = t.executor_id
+            out["new_requests"] += 1
+            budget -= 1
+
     # ------------------------------------------------------------- failure
     def fail_job(self, error: str) -> None:
         self.status = FAILED
@@ -382,11 +882,16 @@ class ExecutionGraph:
         Returns the number of affected stages."""
         affected = set()
 
-        # 1) running stages: reset that executor's tasks
+        # 1) running stages: reset that executor's tasks (duplicates the
+        #    stage drops count toward the wasted registry counter)
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, RunningStage):
+                wasted_before = stage.spec_stats.get("wasted", 0)
                 if stage.reset_tasks(executor_id):
                     affected.add(sid)
+                self.spec_wasted_pending += (
+                    stage.spec_stats.get("wasted", 0) - wasted_before
+                )
 
         # 2) strip lost input locations everywhere; find consumers that lost
         #    data and must re-resolve
@@ -510,6 +1015,13 @@ class ExecutionGraph:
                     m.operator_name = op
                     for k, v in vals.items():
                         m.values[k] = int(v)
+                sp.completed.speculative_launched = stage.spec_stats.get(
+                    "launched", 0
+                )
+                sp.completed.speculative_wins = stage.spec_stats.get("wins", 0)
+                sp.completed.speculative_wasted = stage.spec_stats.get(
+                    "wasted", 0
+                )
                 for t in stage.task_statuses:
                     if t is None:
                         continue
@@ -555,6 +1067,12 @@ class ExecutionGraph:
         self.stage_reset_counts = dict(
             zip(g.stage_reset_ids, g.stage_reset_counts)
         )
+        # speculation/deadline policy is session-config derived and not
+        # persisted: a recovered/adopted graph runs without it until its
+        # stages complete (timing anchors are gone anyway)
+        self._init_speculation_policy(None)
+        self.pending_cancels = []
+        self.spec_wasted_pending = 0
         which = g.status.WhichOneof("status")
         if which == "queued":
             self.status = QUEUED
@@ -611,6 +1129,15 @@ class ExecutionGraph:
                         attempts[pid.partition_id] = ts.attempt
                     if ts.fetch_retries:
                         fetch_retries[pid.partition_id] = ts.fetch_retries
+                spec_stats = {
+                    k: v
+                    for k, v in (
+                        ("launched", s.speculative_launched),
+                        ("wins", s.speculative_wins),
+                        ("wasted", s.speculative_wasted),
+                    )
+                    if v
+                }
                 stage = CompletedStage(
                     s.stage_id,
                     BallistaCodec.decode_physical(s.plan, work_dir),
@@ -623,6 +1150,7 @@ class ExecutionGraph:
                     },
                     task_attempts=attempts,
                     task_fetch_retries=fetch_retries,
+                    spec_stats=spec_stats,
                 )
             else:
                 s = sp.failed

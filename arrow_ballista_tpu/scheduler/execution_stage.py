@@ -51,6 +51,9 @@ class TaskInfo:
     # finished spans piggybacked from the executor (obs/recorder.py span
     # dicts); absorbed into the scheduler's TraceStore, never persisted
     spans: List[dict] = field(default_factory=list)
+    # True for the scheduler-launched duplicate copy racing a straggler
+    # (TaskDefinition.speculative, echoed back in TaskStatus.speculative)
+    speculative: bool = False
 
 
 @dataclass
@@ -187,13 +190,51 @@ class RunningStage:
     # back there while another live executor exists
     task_exclusions: Dict[int, str] = field(default_factory=dict)
     task_fetch_retries: Dict[int, int] = field(default_factory=dict)
+    # ---- speculative execution + deadlines (all transient: Running
+    # stages persist as Resolved, so none of this survives restart) ----
+    # partition -> the PRIMARY attempt's executor id: the speculation
+    # scan flagged it a straggler; the normal dispatch path hands the
+    # duplicate to any OTHER executor
+    speculation_requests: Dict[int, str] = field(default_factory=dict)
+    # partition -> the duplicate attempt currently running (at most one
+    # shadow per partition; same attempt number as the primary)
+    speculative_statuses: Dict[int, "TaskInfo"] = field(default_factory=dict)
+    # monotonic dispatch anchors (primary / shadow) for runtime stats,
+    # the straggler threshold and the deadline reaper
+    task_started_mono: Dict[int, float] = field(default_factory=dict)
+    spec_started_mono: Dict[int, float] = field(default_factory=dict)
+    # runtimes (seconds) of this stage's committed completions: the
+    # median feeds the speculation threshold
+    completed_runtime_s: List[float] = field(default_factory=list)
+    # attempts granted beyond ballista.task.max_attempts (deadline reaps
+    # bump the attempt counter for staleness but must not consume the
+    # task's failure budget)
+    task_free_attempts: Dict[int, int] = field(default_factory=dict)
+    # cumulative launched/wins/wasted rollup (carried to CompletedStage
+    # for the /api/jobs/{id}/profile speculation column)
+    spec_stats: Dict[str, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
         return len(self.task_statuses)
 
     def available_tasks(self) -> int:
-        return sum(1 for t in self.task_statuses if t is None)
+        # pending speculation requests count as dispatchable work so push
+        # mode mints slots for them
+        return (
+            sum(1 for t in self.task_statuses if t is None)
+            + len(self.speculation_requests)
+        )
+
+    def bump_spec_stat(self, key: str, n: int = 1) -> None:
+        self.spec_stats[key] = self.spec_stats.get(key, 0) + n
+
+    def drop_speculative(self, p: int) -> Optional["TaskInfo"]:
+        """Forget partition ``p``'s duplicate attempt (loser/failed/reset);
+        returns the dropped TaskInfo so the caller can cancel it."""
+        self.spec_started_mono.pop(p, None)
+        self.speculation_requests.pop(p, None)
+        return self.speculative_statuses.pop(p, None)
 
     def update_task_status(self, info: TaskInfo) -> None:
         p = info.partition_id.partition_id
@@ -220,12 +261,33 @@ class RunningStage:
         )
 
     def reset_tasks(self, executor_id: str) -> int:
-        """Clear every task that ran on a lost executor; returns count."""
+        """Clear every task that ran on a lost executor; returns count.
+
+        Speculation interplay: a duplicate attempt ON the lost executor
+        simply disappears (wasted); a duplicate running ELSEWHERE is
+        promoted to primary when its primary was on the lost host — the
+        partition stays covered without a re-dispatch."""
+        for p, si in list(self.speculative_statuses.items()):
+            if si.executor_id == executor_id:
+                self.drop_speculative(p)
+                self.bump_spec_stat("wasted")
         n = 0
         for i, t in enumerate(self.task_statuses):
             if t is not None and t.executor_id == executor_id:
-                self.task_statuses[i] = None
-                n += 1
+                shadow = None
+                if t.state == "running":
+                    spec_started = self.spec_started_mono.get(i)
+                    shadow = self.drop_speculative(i)
+                if shadow is not None:
+                    self.task_statuses[i] = shadow
+                    if spec_started is not None:
+                        self.task_started_mono[i] = spec_started
+                    else:
+                        self.task_started_mono.pop(i, None)
+                else:
+                    self.task_statuses[i] = None
+                    self.task_started_mono.pop(i, None)
+                    n += 1
         return n
 
     def to_completed(self) -> "CompletedStage":
@@ -238,6 +300,7 @@ class RunningStage:
             dict(self.stage_metrics),
             dict(self.task_attempts),
             dict(self.task_fetch_retries),
+            spec_stats=dict(self.spec_stats),
         )
 
     def to_failed(self, error: str) -> "FailedStage":
@@ -266,6 +329,8 @@ class CompletedStage:
     stage_metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
     task_attempts: Dict[int, int] = field(default_factory=dict)
     task_fetch_retries: Dict[int, int] = field(default_factory=dict)
+    # speculation rollup inherited from the RunningStage (profile column)
+    spec_stats: Dict[str, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -289,6 +354,7 @@ class CompletedStage:
             {},
             {},
             dict(self.task_fetch_retries),
+            spec_stats=dict(self.spec_stats),
         )
 
     def reset_tasks(self, executor_id: str) -> int:

@@ -21,6 +21,9 @@ Policy (mirrors what production Ballista deployments converge on):
 
 from __future__ import annotations
 
+import re
+from typing import Optional, Tuple
+
 TRANSIENT = "transient"
 FATAL = "fatal"
 
@@ -73,3 +76,37 @@ def classify_failure(error: str) -> str:
 
 def is_transient(error: str) -> bool:
     return classify_failure(error) == TRANSIENT
+
+
+# ``errors.ShuffleFetchFailed.__str__`` embeds these fields; the executor
+# wire-formats failures as "ExceptionName: message", so the scheduler
+# recovers the structure with a match on that string (the exception object
+# never crosses the process boundary).
+_SHUFFLE_FETCH_RE = re.compile(
+    r"stage=(\d+)\s+partition=(\d+)\s+executor=([^\s:]+)"
+)
+
+
+def parse_shuffle_fetch_failure(
+    error: str,
+) -> Optional[Tuple[int, int, str]]:
+    """Decode a consumer task's structured lost-shuffle failure into
+    ``(producer_stage_id, map_partition, executor_id)``; None for every
+    other error.  Drives producer-partition recovery in
+    ``ExecutionGraph._recover_lost_shuffle`` instead of burning the
+    consumer's attempts on data that no longer exists."""
+    err = (error or "").strip()
+    if not err.startswith("ShuffleFetchFailed"):
+        return None
+    m = _SHUFFLE_FETCH_RE.search(err)
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2)), m.group(3)
+
+
+def indicts_reporter(error: str) -> bool:
+    """Should this failure count against the REPORTING executor's
+    quarantine window?  Transient infrastructure failures do; a lost
+    map-output fetch does not — the consumer's host is healthy, the
+    producer's data vanished."""
+    return is_transient(error) and parse_shuffle_fetch_failure(error) is None

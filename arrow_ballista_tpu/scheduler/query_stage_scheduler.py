@@ -79,6 +79,13 @@ class ExecutorLost:
     reason: str = ""
 
 
+@dataclass
+class SpeculationScan:
+    """Periodic tick from the SchedulerServer's speculation timer: run
+    one straggler/deadline scan on the event-loop thread (all graph
+    mutations stay on the single-thread discipline)."""
+
+
 def post_job_events(state: SchedulerState, sender, events) -> None:
     """Map task-manager job events onto scheduler events; shared by the
     event-loop TaskUpdating handler and the pull-mode poll_work path."""
@@ -138,6 +145,8 @@ class QueryStageScheduler(EventAction):
             self._on_reservation_offering(event, sender)
         elif isinstance(event, ExecutorLost):
             self._on_executor_lost(event, sender)
+        elif isinstance(event, SpeculationScan):
+            self._on_speculation_scan(sender)
         else:
             log.warning("unknown scheduler event %r", event)
 
@@ -207,6 +216,19 @@ class QueryStageScheduler(EventAction):
             # Re-posting here would spin the loop.
             self.state.executor_manager.cancel_reservations(leftover)
         self._drain_expulsions(sender)
+
+    def _on_speculation_scan(self, sender: EventSender) -> None:
+        events, slots_wanted = self.state.speculation.scan()
+        post_job_events(self.state, sender, events)
+        if slots_wanted and self.state.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            # duplicates must land on a DIFFERENT executor than the
+            # straggler's; reserve cluster-wide and let pop_next_task's
+            # same-host guard sort the placement
+            reservations = self.state.executor_manager.reserve_slots(
+                slots_wanted
+            )
+            if reservations:
+                sender.post(ReservationOffering(reservations))
 
     def _drain_expulsions(self, sender: EventSender) -> None:
         """Executors whose repeated launch failures crossed the threshold

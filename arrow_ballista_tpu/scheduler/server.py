@@ -63,6 +63,9 @@ class SchedulerServer:
         quarantine_threshold: Optional[int] = None,
         quarantine_window_s: Optional[float] = None,
         quarantine_backoff_s: Optional[float] = None,
+        speculation_interval_s: float = 1.0,
+        speculation_force_enabled: bool = False,
+        task_timeout_force_s: float = 0.0,
     ):
         self.scheduler_id = scheduler_id
         self.policy = policy
@@ -77,6 +80,8 @@ class SchedulerServer:
             quarantine_threshold=quarantine_threshold,
             quarantine_window_s=quarantine_window_s,
             quarantine_backoff_s=quarantine_backoff_s,
+            speculation_force_enabled=speculation_force_enabled,
+            task_timeout_force_s=task_timeout_force_s,
         )
         self.event_loop = EventLoop(
             "query_stage", EVENT_LOOP_BUFFER, QueryStageScheduler(self.state)
@@ -85,7 +90,11 @@ class SchedulerServer:
         self.reaper_interval_s = (
             reaper_interval_s if reaper_interval_s is not None else executor_timeout_s
         )
+        # straggler/deadline scan period (tests shrink the attr live; the
+        # timer re-reads it each tick)
+        self.speculation_interval_s = speculation_interval_s
         self._reaper: Optional[threading.Thread] = None
+        self._spec_timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
@@ -101,6 +110,10 @@ class SchedulerServer:
             target=self._reaper_loop, name="executor-reaper", daemon=True
         )
         self._reaper.start()
+        self._spec_timer = threading.Thread(
+            target=self._speculation_loop, name="speculation-timer", daemon=True
+        )
+        self._spec_timer.start()
         return self
 
     def stop(self) -> None:
@@ -207,6 +220,20 @@ class SchedulerServer:
             except Exception:  # noqa: BLE001
                 log.exception("scheduler-liveness sweep failed")
 
+    def _speculation_loop(self) -> None:
+        """Periodically post a SpeculationScan onto the event loop — the
+        straggler/deadline scan itself runs on the event-loop thread, so
+        every graph mutation keeps the single-thread discipline.  Idle
+        schedulers (no active jobs) skip the post entirely."""
+        from .query_stage_scheduler import SpeculationScan
+
+        while not self._stop.wait(max(0.05, self.speculation_interval_s)):
+            try:
+                if self.state.task_manager.active_job_ids():
+                    self.event_loop.get_sender().post(SpeculationScan())
+            except Exception:  # noqa: BLE001 - timer must never die
+                log.exception("speculation timer iteration failed")
+
     # --------------------------------------------------------- HA failover
     SCHEDULER_HB_PREFIX = "scheduler:"
     # a peer is dead only after missing several sweeps: the publish period
@@ -287,10 +314,9 @@ class SchedulerServer:
         if not meta.grpc_port:
             return
         try:
-            from ..proto.rpc import ExecutorGrpcStub, make_channel
+            from ..proto.rpc import executor_stub
 
-            stub = ExecutorGrpcStub(make_channel(meta.host, meta.grpc_port))
-            stub.StopExecutor(
+            executor_stub(meta.host, meta.grpc_port).StopExecutor(
                 pb.StopExecutorParams(
                     executor_id=executor_id, reason=reason, force=True
                 ),
@@ -301,17 +327,18 @@ class SchedulerServer:
 
     # --------------------------------------------------------------- misc
     def cancel_job(self, job_id: str) -> None:
-        """Fail the job and tell executors to abort its running tasks
-        (reference: grpc.rs CancelJob → task_manager.rs:225-303)."""
+        """Fail the job and tell executors to abort its running tasks over
+        the pooled channel cache — one cached channel per executor instead
+        of a fresh handshake per fan-out (reference: grpc.rs CancelJob →
+        task_manager.rs:225-303)."""
         running = self.state.task_manager.cancel_job(job_id)
-        from ..proto.rpc import ExecutorGrpcStub, make_channel
+        from ..proto.rpc import executor_stub
 
         for meta, pids in running:
             if not meta.grpc_port:
                 continue
             try:
-                stub = ExecutorGrpcStub(make_channel(meta.host, meta.grpc_port))
-                stub.CancelTasks(
+                executor_stub(meta.host, meta.grpc_port).CancelTasks(
                     pb.CancelTasksParams(
                         partition_ids=[p.to_proto() for p in pids]
                     ),

@@ -45,6 +45,8 @@ class SchedulerState:
         quarantine_threshold: Optional[int] = None,
         quarantine_window_s: Optional[float] = None,
         quarantine_backoff_s: Optional[float] = None,
+        speculation_force_enabled: bool = False,
+        task_timeout_force_s: float = 0.0,
     ):
         from .executor_manager import (
             DEFAULT_QUARANTINE_BACKOFF_S,
@@ -84,6 +86,16 @@ class SchedulerState:
             registry=self.metrics,
         )
         self.session_manager = SessionManager(backend, session_builder)
+        # straggler mitigation: the periodic scan body (invoked on the
+        # event-loop thread via the SpeculationScan event); the force
+        # flags come from the scheduler binary and apply to every session
+        from .speculation import SpeculationManager
+
+        self.speculation = SpeculationManager(
+            self,
+            force_enabled=speculation_force_enabled,
+            force_task_timeout_s=task_timeout_force_s,
+        )
         # scrape-time gauges (computed on read, not pushed on change)
         self.metrics.gauge(
             "available_slots", "task slots free across alive executors",

@@ -55,22 +55,15 @@ class NoopLauncher(TaskLauncher):
 
 
 class GrpcLauncher(TaskLauncher):
-    """Real transport: LaunchTask RPC on the executor's grpc port, with a
-    cached channel per executor (reference: task_manager.rs:416-438)."""
-
-    def __init__(self) -> None:
-        self._stubs: Dict[str, object] = {}
-        self._lock = threading.Lock()
+    """Real transport: LaunchTask RPC on the executor's grpc port, over
+    the process-wide pooled channel cache shared with every other
+    scheduler→executor control-plane call (``proto/rpc.executor_stub``;
+    reference: task_manager.rs:416-438)."""
 
     def launch(self, executor, tasks, scheduler_id):
-        from ..proto.rpc import ExecutorGrpcStub, make_channel
+        from ..proto.rpc import executor_stub
 
-        key = f"{executor.host}:{executor.grpc_port}"
-        with self._lock:
-            stub = self._stubs.get(key)
-            if stub is None:
-                stub = ExecutorGrpcStub(make_channel(executor.host, executor.grpc_port))
-                self._stubs[key] = stub
+        stub = executor_stub(executor.host, executor.grpc_port)
         stub.LaunchTask(
             pb.LaunchTaskParams(tasks=tasks, scheduler_id=scheduler_id),
             timeout=20,
@@ -123,6 +116,20 @@ class TaskManager:
         self._jobs_failed = self.registry.counter(
             "jobs_failed_total", "jobs that reached FAILED"
         )
+        # speculative execution (scheduler/speculation.py drives the scan;
+        # dispatch/commit paths here own the counters)
+        self._spec_launched = self.registry.counter(
+            "speculative_launched",
+            "duplicate straggler attempts dispatched",
+        )
+        self._spec_wins = self.registry.counter(
+            "speculative_wins",
+            "partitions committed by a speculative duplicate",
+        )
+        self._spec_wasted = self.registry.counter(
+            "speculative_wasted",
+            "speculative duplicates that lost the race or died",
+        )
 
     @property
     def task_retries_total(self) -> int:
@@ -148,6 +155,13 @@ class TaskManager:
         return entry.graph
 
     def _persist(self, graph: ExecutionGraph) -> None:
+        # single choke point every graph mutation passes through: flush
+        # wasted-duplicate counts into the registry so /api/metrics stays
+        # reconciled with the per-stage spec_stats rollup whichever path
+        # (commit, failure, reset, reap, executor loss) dropped the copy
+        wasted = graph.take_spec_wasted()
+        if wasted:
+            self._spec_wasted.inc(wasted)
         try:
             self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
         except Exception:
@@ -333,6 +347,9 @@ class TaskManager:
             fetch_retries = getattr(stage, "task_fetch_retries", None)
             if fetch_retries:
                 row["fetch_retries"] = sum(fetch_retries.values())
+            spec_stats = getattr(stage, "spec_stats", None)
+            if spec_stats:
+                row["speculation"] = dict(spec_stats)
             failures = getattr(stage, "task_failures", None)
             if failures:
                 row["failures"] = {p: list(h) for p, h in failures.items()}
@@ -388,6 +405,7 @@ class TaskManager:
 
         events: List[Tuple[str, str]] = []
         newly_quarantined: List[str] = []
+        cancels: List[Tuple[str, PartitionId]] = []
         for job_id, infos in per_job.items():
             entry = self._entry(job_id)
             with entry.lock:
@@ -403,25 +421,40 @@ class TaskManager:
                         info.spans = []
                     evs = graph.update_task_status(info, executor)
                     for ev in evs:
+                        # speculation outcomes feed counters, not the
+                        # job-event stream (the accompanying completion
+                        # already carries job_updated/job_completed)
+                        if ev == "speculative_win":
+                            self._spec_wins.inc()
+                            continue
+                        if ev == "speculative_wasted":
+                            continue  # counted via _persist's drain
                         if ev == "task_retried":
                             self._retries.inc()
                         events.append((job_id, ev))
                     if info.state == "failed" and evs:
-                        from .failure import is_transient
+                        from .failure import indicts_reporter
 
                         # only infrastructure (transient) failures that the
                         # graph actually PROCESSED indict the host: a fatal
-                        # plan/serde error is the job's fault, and a stale
+                        # plan/serde error is the job's fault, a stale
                         # duplicate of a superseded attempt (evs == [])
                         # must not re-count one real failure into the
-                        # quarantine window
-                        if is_transient(info.error) and (
+                        # quarantine window, and a lost-shuffle fetch
+                        # failure blames the vanished producer data, not
+                        # the healthy consumer host
+                        if indicts_reporter(info.error) and (
                             self.executor_manager.record_task_failure(
                                 info.executor_id
                             )
                         ):
                             newly_quarantined.append(info.executor_id)
+                cancels.extend(graph.take_pending_cancels())
                 self._persist(graph)
+        if cancels:
+            # after the locks drop: losing duplicate attempts / reaped
+            # stragglers get a best-effort CancelTasks fan-out
+            self.cancel_task_attempts(cancels)
         for eid in newly_quarantined:
             for job_id, n in self.reset_executor_running_tasks(eid).items():
                 # one task_requeued per reset task: the event loop mints a
@@ -430,6 +463,63 @@ class TaskManager:
                 self._retries.inc(n)
                 events.extend([(job_id, "task_requeued")] * n)
         return events
+
+    def cancel_task_attempts(
+        self, cancels: List[Tuple[str, PartitionId]]
+    ) -> None:
+        """Best-effort CancelTasks fan-out for losing duplicate attempts
+        and reaped stragglers, grouped per executor over the pooled
+        channel cache (``proto/rpc.executor_stub``).  The RPCs run on a
+        detached thread: a cancel is advisory (the committed-partition
+        guard drops the loser's results either way), so a dead executor's
+        5s RPC timeout must never stall the event-loop thread issuing it.
+        Pull-mode executors expose no gRPC port and are skipped."""
+        per: Dict[str, List[PartitionId]] = {}
+        metas: Dict[str, ExecutorMetadata] = {}
+        for executor_id, pid in cancels:
+            if not executor_id:
+                continue
+            if executor_id not in metas:
+                try:
+                    metas[executor_id] = (
+                        self.executor_manager.get_executor_metadata(executor_id)
+                    )
+                except Exception:  # noqa: BLE001 - executor may be gone
+                    continue
+            if not metas[executor_id].grpc_port:
+                continue
+            per.setdefault(executor_id, []).append(pid)
+        if not per:
+            return
+        threading.Thread(
+            target=self._cancel_fanout,
+            args=(per, metas),
+            name="cancel-tasks-fanout",
+            daemon=True,
+        ).start()
+
+    @staticmethod
+    def _cancel_fanout(
+        per: Dict[str, List[PartitionId]],
+        metas: Dict[str, ExecutorMetadata],
+    ) -> None:
+        import logging
+
+        from ..proto.rpc import executor_stub
+
+        for executor_id, pids in per.items():
+            meta = metas[executor_id]
+            try:
+                executor_stub(meta.host, meta.grpc_port).CancelTasks(
+                    pb.CancelTasksParams(
+                        partition_ids=[p.to_proto() for p in pids]
+                    ),
+                    timeout=5,
+                )
+            except Exception as e:  # noqa: BLE001 - cancel is advisory
+                logging.getLogger(__name__).warning(
+                    "CancelTasks on %s failed: %s", executor_id, e
+                )
 
     def reset_executor_running_tasks(self, executor_id: str) -> Dict[str, int]:
         """Re-queue (with exclusion) every in-flight task on a quarantined
@@ -499,6 +589,8 @@ class TaskManager:
                     if task is None:
                         still_free.append(r)
                         continue
+                    if task.speculative:
+                        self._spec_launched.inc()
                     assignments.append((r.executor_id, task))
                     changed = True
                 free = still_free
@@ -536,6 +628,8 @@ class TaskManager:
         td.session_id = task.session_id
         td.curator_scheduler_id = self.scheduler_id
         td.attempt = task.attempt
+        td.speculative = task.speculative
+        td.timeout_seconds = task.timeout_seconds
         # trace propagation: executor task spans parent under the job's
         # root span (root span id == trace id by convention).  A traced
         # task also carries the obs prop so executors ratchet tracing on
@@ -585,9 +679,15 @@ class TaskManager:
             # hand the tasks back — excluded from this executor so the
             # re-dispatch goes elsewhere — and feed the quarantine window;
             # repeated launch failures queue the executor for expulsion
-            # (drained into ExecutorLost by the query-stage scheduler)
+            # (drained into ExecutorLost by the query-stage scheduler).
+            # A failed SPECULATIVE launch only forgets the duplicate; the
+            # primary attempt keeps the partition.
             for t in tasks:
-                self.reset_task(t.partition, exclude_executor=executor.id)
+                self.reset_task(
+                    t.partition,
+                    exclude_executor=executor.id,
+                    speculative=t.speculative,
+                )
             self.executor_manager.record_launch_failure(executor.id)
             raise SchedulerError(
                 f"launching {len(tasks)} task(s) on {executor.id} failed: {e}"
@@ -595,13 +695,16 @@ class TaskManager:
         self.executor_manager.record_launch_success(executor.id)
 
     def reset_task(
-        self, partition: PartitionId, exclude_executor: str = ""
+        self, partition: PartitionId, exclude_executor: str = "",
+        speculative: bool = False,
     ) -> None:
         entry = self._entry(partition.job_id)
         with entry.lock:
             graph = self._load(partition.job_id, entry)
             if graph is not None:
-                graph.reset_task_status(partition, exclude_executor)
+                graph.reset_task_status(
+                    partition, exclude_executor, speculative=speculative
+                )
                 self._persist(graph)
 
     # --------------------------------------------------------- transitions
@@ -694,6 +797,11 @@ class TaskManager:
                             running.setdefault(t.executor_id, []).append(
                                 t.partition_id
                             )
+                    # duplicate attempts racing stragglers abort too
+                    for si in stage.speculative_statuses.values():
+                        running.setdefault(si.executor_id, []).append(
+                            si.partition_id
+                        )
         self.fail_job(job_id, "job cancelled by user")
         out = []
         for eid, pids in running.items():

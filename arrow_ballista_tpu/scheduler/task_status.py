@@ -30,6 +30,7 @@ def task_info_to_proto(info: TaskInfo) -> pb.TaskStatus:
     msg.task_id.CopyFrom(info.partition_id.to_proto())
     msg.attempt = info.attempt
     msg.fetch_retries = info.fetch_retries
+    msg.speculative = info.speculative
     if info.spans:
         msg.spans_json = json.dumps(info.spans).encode()
     if info.state == "running":
@@ -64,6 +65,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
             spans=spans,
+            speculative=msg.speculative,
         )
     if which == "failed":
         return TaskInfo(
@@ -74,6 +76,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
             spans=spans,
+            speculative=msg.speculative,
         )
     if which == "completed":
         parts = [
@@ -88,6 +91,7 @@ def task_info_from_proto(msg: pb.TaskStatus) -> TaskInfo:
             attempt=msg.attempt,
             fetch_retries=msg.fetch_retries,
             spans=spans,
+            speculative=msg.speculative,
         )
     raise ValueError(f"TaskStatus with no status set for {pid}")
 

@@ -236,7 +236,7 @@ def retrying_fetch(
         except Exception as e:
             attempt += 1
             if attempt > policy.retries:
-                raise
+                raise _exhausted(loc, e) from e
             metrics.add("fetch_retries", 1)
             delay = policy.backoff_s * (2 ** (attempt - 1))
             log.warning(
@@ -253,6 +253,28 @@ def retrying_fetch(
                     raise
             else:
                 time.sleep(delay)
+
+
+def _exhausted(loc, error: BaseException) -> BaseException:
+    """Retry budget spent on one location: surface a structured
+    :class:`ShuffleFetchFailed` naming the producer partition and serving
+    executor, so the scheduler can recompute exactly the lost map output
+    (``scheduler/failure.py``).  Cancellation and bare test doubles
+    (locations without scheduler coordinates) re-raise unchanged."""
+    from ..errors import Cancelled, ShuffleFetchFailed
+
+    if isinstance(error, (Cancelled, ShuffleFetchFailed)):
+        return error
+    pid = getattr(loc, "partition_id", None)
+    meta = getattr(loc, "executor_meta", None)
+    if pid is None or meta is None:
+        return error
+    return ShuffleFetchFailed(
+        pid.stage_id,
+        pid.partition_id,
+        getattr(meta, "id", ""),
+        detail=f"{type(error).__name__}: {error}",
+    )
 
 
 class _Closed(Exception):

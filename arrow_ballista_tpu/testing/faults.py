@@ -25,10 +25,11 @@ subprocesses, which inherit the environment, participate)::
     BALLISTA_FAULTS="executor.execute_task:2,executor.task_runner:1:exit"
 
 Spec grammar: ``name[:times[:action]]`` comma-separated; ``times``
-defaults to 1 (``-1`` = unlimited), ``action`` is ``raise`` (default) or
-``exit`` (``os._exit`` — a hard worker crash).  The variable is read once
-at import; production processes never set it, so **injection defaults to
-off everywhere**.
+defaults to 1 (``-1`` = unlimited), ``action`` is ``raise`` (default),
+``exit`` (``os._exit`` — a hard worker crash) or ``delay[=ms]`` (sleep at
+the point instead of raising — a manufactured straggler/wedged task;
+default 1000ms).  The variable is read once at import; production
+processes never set it, so **injection defaults to off everywhere**.
 """
 
 from __future__ import annotations
@@ -51,10 +52,11 @@ class FaultInjected(ExecutionError):
 class _Fault:
     name: str
     remaining: int  # -1 = unlimited
-    action: str = "raise"  # "raise" | "exit"
+    action: str = "raise"  # "raise" | "exit" | "delay"
     message: str = ""
     match: Optional[Callable[..., bool]] = None
     hits: int = 0
+    delay_ms: int = 0  # action="delay": sleep this long instead of raising
 
 
 _lock = threading.Lock()
@@ -77,13 +79,20 @@ def arm(
     action: str = "raise",
     message: str = "",
     match: Optional[Callable[..., bool]] = None,
+    delay_ms: int = 0,
 ) -> None:
-    """Arm ``name`` for the next ``times`` matching hits (-1 = unlimited)."""
-    if action not in ("raise", "exit"):
+    """Arm ``name`` for the next ``times`` matching hits (-1 = unlimited).
+
+    ``action="delay"`` sleeps ``delay_ms`` at the injection point instead
+    of raising — a deterministic straggler/wedged-task factory for the
+    speculation and deadline-reaper tests.  A delay at a site that passes
+    a ``cancel_event`` in its context wakes early when the task is
+    cancelled (the site's own cancellation check then fires)."""
+    if action not in ("raise", "exit", "delay"):
         raise ValueError(f"unknown fault action {action!r}")
     with _lock:
         _faults.setdefault(name, []).append(
-            _Fault(name, times, action, message, match)
+            _Fault(name, times, action, message, match, delay_ms=delay_ms)
         )
         _refresh_active()
 
@@ -146,13 +155,26 @@ def fault_point(name: str, **ctx) -> None:
             f.hits += 1
             _hit_counts[name] = _hit_counts.get(name, 0) + 1
             _refresh_active()
-            action, message = f.action, f.message
+            action, message, delay_ms = f.action, f.message, f.delay_ms
             break
         else:
             return
     if action == "exit":
         # hard crash (worker-kill simulation): no cleanup, no status reply
         os._exit(17)
+    if action == "delay":
+        # manufactured straggler: sleep instead of raising.  A site that
+        # passes its cancel_event lets the sleep end early on abort (the
+        # site's own cancellation check raises right after).
+        cancel = ctx.get("cancel_event")
+        delay_s = max(0, delay_ms) / 1000.0
+        if cancel is not None and hasattr(cancel, "wait"):
+            cancel.wait(delay_s)
+        else:
+            import time
+
+            time.sleep(delay_s)
+        return
     raise FaultInjected(
         message or f"fault injected at {name} ({ctx or 'no context'})"
     )
@@ -175,6 +197,15 @@ def _load_env(spec: str) -> None:
         except ValueError:
             times = 1
         action = fields[2] if len(fields) > 2 else "raise"
+        delay_ms = 0
+        if action.startswith("delay"):
+            # "delay=500" sleeps 500ms at the point (default 1000)
+            _, _, ms = action.partition("=")
+            try:
+                delay_ms = int(ms) if ms else 1000
+            except ValueError:
+                delay_ms = 1000
+            action = "delay"
         match = None
         if len(fields) > 3 and "=" in fields[3]:
             key, _, raw = fields[3].partition("=")
@@ -183,7 +214,7 @@ def _load_env(spec: str) -> None:
                 return str(ctx.get(__key)) == __want
 
         try:
-            arm(name, times=times, action=action, match=match)
+            arm(name, times=times, action=action, match=match, delay_ms=delay_ms)
         except ValueError:
             arm(name, times=times, match=match)
 

@@ -16,6 +16,9 @@ if [ "$1" = "--bench-smoke" ]; then
   BENCH_SMOKE=1
   shift
 fi
+# proto drift gate: a NEW_FIELDS edit without regeneration (or a
+# generated field missing from ballista.proto) fails fast, before tests
+timeout -k 10 60 env JAX_PLATFORMS=cpu python dev/regen_proto.py --check || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -1,0 +1,744 @@
+"""Speculative execution, task deadlines and lost-shuffle recovery
+(ISSUE 5).
+
+Graph-level tests drive ``ExecutionGraph`` by hand (the
+``test_execution_graph.py`` strategy) to pin the two-attempts-per-
+partition state machine: duplicate placement, first-completion-wins
+commit, the late-loser stale guards on BOTH the success and failure
+sides, deadline reaping outside the failure budget, and producer-scoped
+lost-shuffle rollback.  End-to-end tests run real standalone clusters
+with the faults harness manufacturing deterministic stragglers
+(``task.run`` delay point) and a deleted map-output file.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.context import SessionContext
+from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    COMPLETED,
+    FAILED,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.execution_stage import (
+    RunningStage,
+    TaskInfo,
+    UnresolvedStage,
+)
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052)
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052)
+
+CPU_CONFIG = {
+    "ballista.tpu.enable": "false",
+    "ballista.mesh.enable": "false",
+    "ballista.shuffle.partitions": "2",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def sales_parquet(tmp_path):
+    table = pa.table(
+        {
+            "g": pa.array([f"g{i % 7}" for i in range(400)]),
+            "v": pa.array([float(i % 113) for i in range(400)]),
+        }
+    )
+    path = str(tmp_path / "sales.parquet")
+    pq.write_table(table, path)
+    return path
+
+
+def _rows(table: pa.Table):
+    cols = sorted(table.column_names)
+    d = table.to_pydict()
+    return sorted(zip(*(d[c] for c in cols)))
+
+
+# --------------------------------------------------------------- helpers
+def make_graph(job_id="job-spec", partitions=3):
+    ctx = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a", "c"], pa.string()),
+                "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+            }
+        ),
+        partitions=partitions,
+    )
+    df = ctx.sql("select g, sum(v) as s from t group by g")
+    plan = PhysicalPlanner(ctx.config).create_physical_plan(
+        df.optimized_plan()
+    )
+    graph = ExecutionGraph(
+        "sched-1", job_id, ctx.session_id, plan, config=ctx.config
+    )
+    graph.revive()
+    return graph
+
+
+def _arm_speculation(graph):
+    """Make every running task an immediate speculation candidate once
+    one stage task finished (unit tests control time explicitly)."""
+    graph.spec_enabled = True
+    graph.spec_min_runtime_s = 0.0
+    graph.spec_multiplier = 0.0
+    graph.spec_min_completed_fraction = 0.3
+    graph.spec_max_copies_per_stage = 1  # deterministic: only p0 races
+
+
+def _completed(task, executor_id, speculative=False, tag="x"):
+    part = task.output_partitioning
+    n = part.n if part is not None else 1
+    partitions = [
+        ShuffleWritePartition(p, f"/fake/{executor_id}/{tag}/{p}.arrow", 1, 10, 100)
+        for p in range(n)
+    ]
+    return TaskInfo(
+        task.partition,
+        "completed",
+        executor_id,
+        partitions=partitions,
+        attempt=task.attempt,
+        speculative=speculative,
+    )
+
+
+def _race(graph):
+    """Start the three leaf tasks on exec-1, finish one (the median
+    sample), flag partition 0 as a straggler and launch its duplicate on
+    exec-2 — partition 2 keeps running so the stage stays open while the
+    race resolves.  Returns (straggler_primary_task, duplicate, stage)."""
+    t0 = graph.pop_next_task("exec-1")
+    t1 = graph.pop_next_task("exec-1")
+    t2 = graph.pop_next_task("exec-1")
+    assert t0 is not None and t1 is not None and t2 is not None
+    graph.update_task_status(_completed(t1, "exec-1"), EXEC1)
+    _arm_speculation(graph)
+    out = graph.scan_speculation(now=time.monotonic() + 5.0)
+    assert out["new_requests"] == 1
+    stage = graph.stages[t0.partition.stage_id]
+    assert stage.speculation_requests == {t0.partition.partition_id: "exec-1"}
+    # the duplicate must never land back on the straggler's executor
+    assert graph.pop_next_task("exec-1") is None
+    dup = graph.pop_next_task("exec-2")
+    assert dup is not None and dup.speculative
+    assert dup.partition == t0.partition
+    assert dup.attempt == t0.attempt  # same attempt: staleness by commit
+    assert stage.spec_stats.get("launched") == 1
+    return t0, dup, stage
+
+
+# =====================================================================
+# 1. duplicate dispatch mechanics
+# =====================================================================
+def test_duplicate_launches_on_different_executor_only():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    assert stage.speculative_statuses[p].executor_id == "exec-2"
+    # request budget: max_copies_per_stage bounds further duplicates
+    # (partition 2 is still a straggler but the stage budget is spent)
+    out = graph.scan_speculation(now=time.monotonic() + 10.0)
+    assert out["new_requests"] == 0
+
+
+def test_speculation_disabled_by_default():
+    graph = make_graph()
+    graph.pop_next_task("exec-1")
+    t1 = graph.pop_next_task("exec-1")
+    graph.update_task_status(_completed(t1, "exec-1"), EXEC1)
+    out = graph.scan_speculation(now=time.monotonic() + 3600.0)
+    assert out == {"new_requests": 0, "timeouts": 0, "events": []}
+
+
+# =====================================================================
+# 2. first-completion-wins + the late-loser races (satellite 4)
+# =====================================================================
+def test_duplicate_wins_commits_and_late_loser_success_is_stale():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+
+    evs = graph.update_task_status(_completed(dup, "exec-2", speculative=True, tag="win"), EXEC2)
+    assert "speculative_win" in evs
+    # the straggling primary was queued for CancelTasks
+    assert ("exec-1", t0.partition) in graph.pending_cancels
+    assert stage.spec_stats.get("wins") == 1
+    committed = stage.task_statuses[p]
+    assert committed.executor_id == "exec-2"
+    assert not stage.speculative_statuses
+
+    # consumer stage got exactly one set of locations (the winner's)
+    consumer = next(
+        s for s in graph.stages.values() if isinstance(s, UnresolvedStage)
+    )
+    inp = consumer.inputs[t0.partition.stage_id]
+    locs_before = {
+        l.path for locs in inp.partition_locations.values() for l in locs
+    }
+    assert any("/win/" in path for path in locs_before)
+
+    # ...the cancelled loser reports a late SUCCESS: dropped as stale
+    late = _completed(t0, "exec-1", tag="loser")
+    assert graph.update_task_status(late, EXEC1) == []
+    assert stage.task_statuses[p] is committed  # commit unchanged
+    locs_after = {
+        l.path for locs in inp.partition_locations.values() for l in locs
+    }
+    assert locs_after == locs_before  # nothing double-propagated
+    assert not stage.task_failures  # no failure recorded
+    assert graph.task_retries == 0
+
+
+def test_late_loser_failure_consumes_no_budget():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    graph.update_task_status(_completed(dup, "exec-2", speculative=True), EXEC2)
+    # the cancelled loser dies with Cancelled (or anything): stale
+    late = TaskInfo(
+        t0.partition, "failed", "exec-1",
+        error="Cancelled: task cancelled", attempt=t0.attempt,
+    )
+    assert graph.update_task_status(late, EXEC1) == []
+    assert stage.task_attempts.get(p, 0) == 0
+    assert not stage.task_failures
+    assert graph.task_retries == 0
+    assert graph.status != FAILED
+
+
+def test_primary_wins_duplicate_is_wasted_and_cancelled():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    evs = graph.update_task_status(_completed(t0, "exec-1"), EXEC1)
+    assert "speculative_wasted" in evs
+    assert ("exec-2", t0.partition) in graph.pending_cancels
+    assert stage.spec_stats.get("wasted") == 1
+    assert stage.task_statuses[t0.partition.partition_id].executor_id == "exec-1"
+    # duplicate's own late success is stale too
+    assert graph.update_task_status(
+        _completed(dup, "exec-2", speculative=True), EXEC2
+    ) == []
+
+
+def test_duplicate_failure_keeps_primary_running():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    evs = graph.update_task_status(
+        TaskInfo(dup.partition, "failed", "exec-2",
+                 error="OSError: disk", attempt=dup.attempt, speculative=True),
+        EXEC2,
+    )
+    assert evs == ["speculative_wasted"]
+    assert p not in stage.speculative_statuses
+    assert stage.task_statuses[p].state == "running"
+    assert stage.task_attempts.get(p, 0) == 0  # no budget burned
+    assert p not in stage.task_exclusions
+
+
+def test_primary_failure_promotes_duplicate_in_place():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    evs = graph.update_task_status(
+        TaskInfo(t0.partition, "failed", "exec-1",
+                 error="OSError: disk on fire", attempt=t0.attempt),
+        EXEC1,
+    )
+    assert evs == ["job_updated"]
+    promoted = stage.task_statuses[p]
+    assert promoted.executor_id == "exec-2" and promoted.state == "running"
+    assert not stage.speculative_statuses
+    assert stage.task_attempts.get(p, 0) == 0  # same attempt, no requeue
+    # the promoted duplicate's completion commits normally
+    evs = graph.update_task_status(_completed(dup, "exec-2", speculative=True), EXEC2)
+    assert "job_updated" in evs or "job_completed" in evs
+
+
+def test_promoted_duplicate_failure_requeues_instead_of_stranding():
+    """A promoted duplicate still reports speculative=true (its
+    TaskDefinition said so).  Its failure must take the normal retry
+    path — dropping it would strand the partition in 'running' forever."""
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    # primary fails -> duplicate promoted in place
+    graph.update_task_status(
+        TaskInfo(t0.partition, "failed", "exec-1",
+                 error="OSError: disk", attempt=t0.attempt),
+        EXEC1,
+    )
+    assert stage.task_statuses[p].executor_id == "exec-2"
+    # ...then the promoted duplicate ALSO fails (flag still true)
+    evs = graph.update_task_status(
+        TaskInfo(dup.partition, "failed", "exec-2",
+                 error="OSError: also dead", attempt=dup.attempt,
+                 speculative=True),
+        EXEC2,
+    )
+    assert evs == ["task_retried"]
+    assert stage.task_statuses[p] is None  # re-queued, not stranded
+    assert stage.task_attempts[p] == 1
+    task = graph.pop_next_task("exec-1")
+    assert task is not None and task.partition.partition_id == p
+
+
+def test_quarantine_promotion_drops_superseded_primary_failure():
+    """reset_running_tasks promotes the healthy duplicate and cancels the
+    quarantined primary; the old primary's late same-attempt failure must
+    not wipe the promoted attempt or burn budget."""
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    n = graph.reset_running_tasks("exec-1")
+    # t0's partition was promoted (not counted as reset); the OTHER
+    # exec-1 task (partition 2, no duplicate) was re-queued
+    assert n == 1
+    promoted = stage.task_statuses[p]
+    assert promoted.executor_id == "exec-2" and promoted.state == "running"
+    assert ("exec-1", t0.partition) in graph.pending_cancels
+    # the quarantined host's copy limps on and fails: superseded, dropped
+    evs = graph.update_task_status(
+        TaskInfo(t0.partition, "failed", "exec-1",
+                 error="OSError: sick host", attempt=t0.attempt),
+        EXEC1,
+    )
+    assert evs == []
+    assert stage.task_statuses[p] is promoted  # not wiped
+    assert stage.task_attempts.get(p, 0) == 0  # no budget burned
+
+
+def test_reap_loop_is_bounded_and_fails_the_job():
+    """A task whose genuine runtime exceeds the deadline must fail the
+    job with a clear error after bounded reaps, not loop forever."""
+    graph = make_graph()
+    graph.task_timeout_s = 5.0
+    bound = max(2, graph.task_max_attempts)
+    executors = ["exec-1", "exec-2"]
+    for i in range(bound + 2):
+        task = graph.pop_next_task(executors[i % 2])
+        assert task is not None
+        out = graph.scan_speculation(now=time.monotonic() + 3600.0)
+        if "job_failed" in out["events"]:
+            break
+    else:
+        pytest.fail("reap loop never failed the job")
+    assert graph.status == FAILED
+    assert "deadline is below the task's real runtime" in graph.error
+    assert i + 1 == bound  # failed exactly at the bound
+
+
+# =====================================================================
+# 3. deadline reaper
+# =====================================================================
+def test_deadline_reap_requeues_with_exclusion_and_free_attempt():
+    graph = make_graph()
+    graph.task_timeout_s = 5.0
+    t0 = graph.pop_next_task("exec-1")
+    p = t0.partition.partition_id
+    stage = graph.stages[t0.partition.stage_id]
+
+    out = graph.scan_speculation(now=time.monotonic() + 60.0)
+    assert out["timeouts"] == 1
+    assert out["events"] == ["task_requeued"]
+    assert ("exec-1", t0.partition) in graph.take_pending_cancels()
+    assert stage.task_statuses[p] is None
+    assert stage.task_exclusions[p] == "exec-1"
+    assert stage.task_attempts[p] == 1  # staleness bump...
+    assert stage.task_free_attempts[p] == 1  # ...but budget-neutral
+
+    # the wedged executor's late success is stale (superseded attempt)
+    assert graph.update_task_status(_completed(t0, "exec-1"), EXEC1) == []
+
+    # budget neutrality: the task still survives max_attempts-1 REAL
+    # failures after the reap before the job fails
+    executors = {"exec-1": EXEC1, "exec-2": EXEC2}
+    retried = 0
+    for i in range(graph.task_max_attempts):
+        eid = "exec-2" if i % 2 == 0 else "exec-1"
+        task = graph.pop_next_task(eid)
+        assert task is not None, f"round {i}: task not re-queued"
+        evs = graph.update_task_status(
+            TaskInfo(task.partition, "failed", eid,
+                     error=f"OSError: boom {i}", attempt=task.attempt),
+            executors[eid],
+        )
+        if evs == ["task_retried"]:
+            retried += 1
+        else:
+            assert evs == ["job_failed"]
+            break
+    assert retried == graph.task_max_attempts - 1
+    assert graph.status == FAILED
+    assert "deadline exceeded" in graph.error  # reap is in the history
+
+
+def test_deadline_reap_promotes_healthy_duplicate():
+    graph = make_graph()
+    t0, dup, stage = _race(graph)
+    p = t0.partition.partition_id
+    graph.task_timeout_s = 10.0
+    # primary started long ago; the duplicate is fresh
+    stage.task_started_mono[p] = time.monotonic() - 60.0
+    out = graph.scan_speculation(now=time.monotonic())
+    assert out["timeouts"] == 1
+    assert stage.task_statuses[p].executor_id == "exec-2"
+    assert stage.task_attempts.get(p, 0) == 0  # promoted, not re-queued
+    assert ("exec-1", t0.partition) in graph.take_pending_cancels()
+
+
+# =====================================================================
+# 4. lost-shuffle recovery (graph level)
+# =====================================================================
+def test_lost_shuffle_failure_reruns_producer_not_consumer_budget():
+    from arrow_ballista_tpu.scheduler.execution_stage import CompletedStage
+
+    graph = make_graph()
+    # drain ONLY the leaf (producer) stage on exec-1
+    producer_sid = next(
+        sid for sid, s in graph.stages.items() if isinstance(s, RunningStage)
+    )
+    while not isinstance(graph.stages[producer_sid], CompletedStage):
+        task = graph.pop_next_task("exec-1")
+        assert task is not None and task.partition.stage_id == producer_sid
+        graph.update_task_status(_completed(task, "exec-1"), EXEC1)
+    graph.revive()
+    consumer_sid = next(
+        sid for sid, s in graph.stages.items() if isinstance(s, RunningStage)
+    )
+    ct = graph.pop_next_task("exec-2")
+    assert ct is not None and ct.partition.stage_id == consumer_sid
+
+    error = (
+        "ShuffleFetchFailed: shuffle fetch exhausted retries for map "
+        f"output stage={producer_sid} partition=0 executor=exec-1: "
+        "FlightUnavailableError: gone"
+    )
+    evs = graph.update_task_status(
+        TaskInfo(ct.partition, "failed", "exec-2", error=error,
+                 attempt=ct.attempt),
+        EXEC2,
+    )
+    assert "job_updated" in evs
+    assert evs.count("task_requeued") >= 1
+    # producer re-runs the lost partitions; consumer rolled back without
+    # burning attempts
+    assert isinstance(graph.stages[producer_sid], RunningStage)
+    assert isinstance(graph.stages[consumer_sid], UnresolvedStage)
+    assert graph.stage_reset_counts[producer_sid] == 1
+    assert graph.stage_reset_counts[consumer_sid] == 1
+    # finish the job: producer re-runs, consumer resolves again
+    while graph.status not in (COMPLETED, FAILED):
+        task = graph.pop_next_task("exec-2")
+        if task is None:
+            graph.revive()
+            task = graph.pop_next_task("exec-2")
+            if task is None:
+                break
+        graph.update_task_status(_completed(task, "exec-2"), EXEC2)
+    assert graph.status == COMPLETED
+
+
+def test_parse_shuffle_fetch_failure():
+    from arrow_ballista_tpu.errors import ShuffleFetchFailed
+    from arrow_ballista_tpu.scheduler.failure import (
+        indicts_reporter,
+        is_transient,
+        parse_shuffle_fetch_failure,
+    )
+
+    e = ShuffleFetchFailed(3, 1, "exec-9", detail="OSError: gone")
+    wire = f"{type(e).__name__}: {e}"
+    assert parse_shuffle_fetch_failure(wire) == (3, 1, "exec-9")
+    assert parse_shuffle_fetch_failure("OSError: gone") is None
+    assert is_transient(wire)  # falls back to normal retry when needed
+    assert not indicts_reporter(wire)  # the consumer host is innocent
+    assert indicts_reporter("OSError: flaky disk")
+
+
+# =====================================================================
+# 5. faults delay action
+# =====================================================================
+def test_delay_fault_sleeps_instead_of_raising():
+    faults.arm("unit.delay", times=1, action="delay", delay_ms=150)
+    t0 = time.monotonic()
+    faults.fault_point("unit.delay")  # no raise
+    assert time.monotonic() - t0 >= 0.12
+    faults.fault_point("unit.delay")  # budget spent: instant
+    assert faults.hits("unit.delay") == 1
+
+
+def test_delay_fault_wakes_on_cancel_event():
+    ev = threading.Event()
+    faults.arm("unit.delay.cancel", times=1, action="delay", delay_ms=30_000)
+    t0 = time.monotonic()
+    threading.Timer(0.1, ev.set).start()
+    faults.fault_point("unit.delay.cancel", cancel_event=ev)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_env_spec_delay_grammar():
+    faults._load_env("unit.envdelay:1:delay=120")
+    t0 = time.monotonic()
+    faults.fault_point("unit.envdelay")
+    assert time.monotonic() - t0 >= 0.1
+
+
+# =====================================================================
+# 6. wire format
+# =====================================================================
+def test_task_status_serde_carries_speculative():
+    from arrow_ballista_tpu.scheduler.task_status import (
+        task_info_from_proto,
+        task_info_to_proto,
+    )
+    from arrow_ballista_tpu.serde.scheduler_types import PartitionId
+
+    pid = PartitionId("job-s", 1, 0)
+    info = TaskInfo(pid, "completed", "exec-1", attempt=1, speculative=True)
+    assert task_info_from_proto(task_info_to_proto(info)).speculative
+    info2 = TaskInfo(pid, "failed", "exec-1", error="x", speculative=False)
+    assert not task_info_from_proto(task_info_to_proto(info2)).speculative
+
+
+def test_regen_proto_check_passes_on_committed_tree():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "dev", "regen_proto.py"), "--check"],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# =====================================================================
+# 7. end-to-end: straggler acceptance (file + mem:// shuffle stores)
+# =====================================================================
+@pytest.mark.parametrize("to_memory", [False, True], ids=["file", "mem"])
+def test_straggler_speculation_end_to_end(sales_parquet, to_memory):
+    """2-executor standalone cluster, one map task delayed ~10x: the job
+    completes with >= 1 speculative win, results multiset-identical to
+    the undelayed run, and the cancelled loser never corrupts or shadows
+    the committed shuffle output."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    sql = "SELECT g, SUM(v) AS s, COUNT(v) AS n FROM sales GROUP BY g"
+    local = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    local.register_parquet("sales", sales_parquet)
+    expected = local.sql(sql).collect()
+
+    config = dict(CPU_CONFIG)
+    config.update(
+        {
+            "ballista.speculation.enabled": "true",
+            "ballista.speculation.interval_seconds": "0.2",
+            "ballista.speculation.min_runtime_seconds": "0.5",
+            "ballista.speculation.multiplier": "1.5",
+            "ballista.speculation.min_completed_fraction": "0.25",
+            "ballista.shuffle.to_memory": "true" if to_memory else "false",
+        }
+    )
+    # the straggler: the 2-task aggregate stage's partition 0 sleeps 8s
+    # on its FIRST execution (stage 1 is the single-task scan; the armed
+    # budget is one hit, so the duplicate runs full speed)
+    faults.arm(
+        "task.run",
+        times=1,
+        action="delay",
+        delay_ms=8000,
+        match=lambda stage_id=0, partition_id=-1, attempt=0, speculative=False, **_:
+            stage_id == 2 and partition_id == 0 and attempt == 0
+            and not speculative,
+    )
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(config), num_executors=2, concurrent_tasks=2
+    )
+    scheduler, _executors = ctx._standalone_handles
+    scheduler.server.speculation_interval_s = 0.2
+    try:
+        ctx.register_parquet("sales", sales_parquet)
+        result = ctx.sql(sql).collect()
+        assert _rows(result) == _rows(expected)
+        assert faults.hits("task.run") == 1
+
+        snap = scheduler.server.state.metrics.snapshot()
+        assert snap.get("speculative_launched", 0) >= 1
+        assert snap.get("speculative_wins", 0) >= 1, snap
+        # the loser never consumed failure budget
+        tm = scheduler.server.state.task_manager
+        (job_id,) = ctx._job_ids
+        detail = tm.get_job_detail(job_id)
+        assert detail["state"] == "completed"
+        assert detail["task_retries"] == 0
+        rollup = {
+            k: v
+            for row in detail["stages"]
+            for k, v in (row.get("speculation") or {}).items()
+        }
+        assert rollup.get("launched", 0) >= 1
+        assert rollup.get("wins", 0) >= 1
+        # and the per-stage rollup rides into the profile export
+        from arrow_ballista_tpu.obs.export import job_profile
+
+        prof = job_profile(detail, [])
+        spec_rows = [r["speculation"] for r in prof["stages"] if "speculation" in r]
+        assert spec_rows and any(r["wins"] >= 1 for r in spec_rows)
+    finally:
+        ctx.close()
+
+
+# =====================================================================
+# 8. end-to-end: lost shuffle data recovered mid-job
+# =====================================================================
+def test_lost_map_output_recovered_end_to_end(sales_parquet):
+    """Delete one stage-1 shuffle file while the consumer stage is held
+    at a delay point: the consumer's fetch exhausts retries, the
+    scheduler re-runs only the producer partitions, and the job still
+    completes with correct results."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    sql = "SELECT g, SUM(v) AS s FROM sales GROUP BY g"
+    local = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    local.register_parquet("sales", sales_parquet)
+    expected = local.sql(sql).collect()
+
+    config = dict(CPU_CONFIG)
+    config.update(
+        {
+            "ballista.shuffle.fetch_retries": "1",
+            "ballista.shuffle.fetch_backoff_ms": "10",
+        }
+    )
+    # hold BOTH final-stage tasks long enough for the main thread to
+    # delete a map file from under them (first attempts only)
+    faults.arm(
+        "task.run",
+        times=2,
+        action="delay",
+        delay_ms=2500,
+        match=lambda stage_id=0, attempt=0, **_: stage_id == 2 and attempt == 0,
+    )
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(config), num_executors=1, concurrent_tasks=2
+    )
+    scheduler, executors = ctx._standalone_handles
+    work_dir = executors[0].executor.work_dir
+    try:
+        ctx.register_parquet("sales", sales_parquet)
+        result = {}
+
+        def run():
+            try:
+                result["table"] = ctx.sql(sql).collect()
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # wait for stage-1 map output to land, then wipe one file
+        deadline = time.monotonic() + 30
+        victims = []
+        while time.monotonic() < deadline:
+            victims = glob.glob(os.path.join(work_dir, "*", "1", "*", "*"))
+            if victims:
+                break
+            time.sleep(0.05)
+        assert victims, "no stage-1 shuffle output appeared"
+        os.remove(victims[0])
+        t.join(120)
+        assert not t.is_alive(), "job did not finish"
+        assert "error" not in result, result.get("error")
+        assert _rows(result["table"]) == _rows(expected)
+        # the recovery rolled back producer + consumer exactly once each
+        (job_id,) = ctx._job_ids
+        detail = scheduler.server.state.task_manager.get_job_detail(job_id)
+        assert detail["state"] == "completed"
+    finally:
+        ctx.close()
+
+
+# =====================================================================
+# 9. cancel_job: pooled CancelTasks fan-out drains the executor
+# =====================================================================
+def test_cancel_job_aborts_tasks_and_returns_slots(sales_parquet):
+    """Push-mode cluster with every task wedged at a delay point:
+    cancel_job must CancelTasks (pooled channel), the executor's
+    active_task_count must drop to 0, and its slots must return."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    # cancel-aware wedge: the delay waits on the task's cancel_event, so
+    # CancelTasks aborts it promptly instead of after 60s
+    faults.arm("task.run", times=-1, action="delay", delay_ms=60_000)
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(dict(CPU_CONFIG)),
+        num_executors=1,
+        concurrent_tasks=2,
+        policy=TaskSchedulingPolicy.PUSH_STAGED,
+    )
+    scheduler, executors = ctx._standalone_handles
+    executor = executors[0].executor
+    em = scheduler.server.state.executor_manager
+    try:
+        ctx.register_parquet("sales", sales_parquet)
+        result = {}
+
+        def run():
+            try:
+                result["table"] = ctx.sql(sql := "SELECT g, SUM(v) AS s FROM sales GROUP BY g").collect()  # noqa: F841
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and executor.active_task_count() == 0:
+            time.sleep(0.05)
+        assert executor.active_task_count() >= 1, "no task ever started"
+        job_ids = scheduler.server.state.task_manager.active_job_ids()
+        assert job_ids, "no active job found"
+
+        scheduler.server.cancel_job(job_ids[0])
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and executor.active_task_count() > 0:
+            time.sleep(0.05)
+        assert executor.active_task_count() == 0
+        # slots return to the pool once the Cancelled statuses land
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and em.available_slots() < 2:
+            time.sleep(0.05)
+        assert em.available_slots() == 2
+        t.join(30)
+        assert "error" in result  # the client sees the cancelled job fail
+    finally:
+        ctx.close()
