@@ -120,10 +120,14 @@ class BallistaContext:
         # release this client's memory-plane shuffle partitions (the
         # counterpart of the executor janitor's work-dir sweep for jobs
         # that ran with ballista.shuffle.to_memory / mesh gang stages)
-        from ..shuffle import memory_store
+        from ..shuffle import memory_store, store
 
+        ext = self.config.shuffle_external_path
         for job_id in self._job_ids:
             memory_store.delete_job(job_id)
+            # external partitions/replicas of this client's jobs go too
+            # (the object-store analogue of the work-dir sweep)
+            store.delete_job(ext, job_id)
         self._job_ids.clear()
         if self._standalone_handles is not None:
             scheduler, executors = self._standalone_handles
@@ -274,7 +278,9 @@ class BallistaContext:
 
 
 def _fetch_partition(loc: PartitionLocation):
-    """Returns (schema, batches) for one completed partition."""
+    """Returns (schema, batches) for one completed partition.  A dead
+    result-serving executor degrades to the external-store replica when
+    the location names one (ISSUE 6) instead of failing the collect."""
     # local fast path (standalone mode shares the filesystem)
     if loc.path and os.path.exists(loc.path):
         with pa.OSFile(loc.path, "rb") as f:
@@ -283,12 +289,31 @@ def _fetch_partition(loc: PartitionLocation):
                 reader.get_batch(i) for i in range(reader.num_record_batches)
             ]
         return reader.schema, batches
-    from ..flight.client import BallistaClient
+    try:
+        from ..flight.client import BallistaClient
 
-    client = BallistaClient.get(loc.executor_meta.host, loc.executor_meta.flight_port)
-    return client.fetch_partition_with_schema(
-        loc.partition_id.job_id,
-        loc.partition_id.stage_id,
-        loc.partition_id.partition_id,
-        loc.path,
-    )
+        client = BallistaClient.get(
+            loc.executor_meta.host, loc.executor_meta.flight_port
+        )
+        return client.fetch_partition_with_schema(
+            loc.partition_id.job_id,
+            loc.partition_id.stage_id,
+            loc.partition_id.partition_id,
+            loc.path,
+        )
+    except Exception:
+        # only fail over to a replica that actually EXISTS: async
+        # replication stamps the path optimistically, and a dangling one
+        # must not mask the original Flight error with FileNotFoundError
+        if not loc.replica_path or not os.path.exists(loc.replica_path):
+            raise
+        from ..shuffle.store import read_batches, read_schema
+
+        log.warning(
+            "fetching job output %s from its replica %s (executor %s "
+            "unreachable)", loc.path, loc.replica_path, loc.executor_meta.id,
+        )
+        batches = list(read_batches(loc.replica_path))
+        if not batches:  # zero-row partitions still carry a schema
+            return read_schema(loc.replica_path), []
+        return batches[0].schema, batches

@@ -48,6 +48,10 @@ SHUFFLE_WRITE_QUEUE_BYTES = "ballista.shuffle.write_queue_bytes"
 SHUFFLE_WRITE_CONCURRENCY = "ballista.shuffle.write_concurrency"
 SHUFFLE_WRITE_PIPELINED = "ballista.shuffle.write_pipelined"
 SHUFFLE_COMPRESSION = "ballista.shuffle.compression"
+# Pluggable shuffle storage + replication (docs/user-guide/fault-tolerance.md)
+SHUFFLE_STORE = "ballista.shuffle.store"
+SHUFFLE_REPLICATION = "ballista.shuffle.replication"
+SHUFFLE_EXTERNAL_PATH = "ballista.shuffle.external_path"
 # Fault tolerance (see docs/user-guide/fault-tolerance.md)
 TASK_MAX_ATTEMPTS = "ballista.task.max_attempts"
 TASK_TIMEOUT_S = "ballista.task.timeout_seconds"
@@ -59,6 +63,7 @@ SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
 SPECULATION_MIN_COMPLETED_FRACTION = "ballista.speculation.min_completed_fraction"
 SPECULATION_MIN_RUNTIME_S = "ballista.speculation.min_runtime_seconds"
 SPECULATION_MAX_COPIES_PER_STAGE = "ballista.speculation.max_copies_per_stage"
+EXECUTOR_DRAIN_TIMEOUT_S = "ballista.executor.drain_timeout_seconds"
 EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
 EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
 EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
@@ -89,6 +94,20 @@ def _parse_compression(v: str) -> str:
     if codec not in ("none", "lz4", "zstd"):
         raise ValueError(f"compression must be none|lz4|zstd, got {v!r}")
     return codec
+
+
+def _parse_shuffle_store(v: str) -> str:
+    kind = v.lower()
+    if kind not in ("local", "mem", "external"):
+        raise ValueError(f"shuffle store must be local|mem|external, got {v!r}")
+    return kind
+
+
+def _parse_replication(v: str) -> str:
+    mode = v.lower()
+    if mode not in ("none", "async", "sync"):
+        raise ValueError(f"replication must be none|async|sync, got {v!r}")
+    return mode
 
 
 def _parse_highcard_mode(v: str) -> str:
@@ -326,6 +345,49 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "decompress transparently, so only the write side pays",
             _parse_compression,
             "none",
+        ),
+        ConfigEntry(
+            SHUFFLE_STORE,
+            "where written shuffle partitions live: 'local' (Arrow IPC "
+            "files under the executor work_dir, served over Flight — the "
+            "fast path), 'mem' (executor-memory store, equivalent to "
+            "ballista.shuffle.to_memory=true), or 'external' (the shared "
+            "directory at ballista.shuffle.external_path, standing in for "
+            "an object store: partitions survive their producer, so "
+            "executor loss never triggers recompute)",
+            _parse_shuffle_store,
+            "local",
+        ),
+        ConfigEntry(
+            SHUFFLE_REPLICATION,
+            "upload a replica of each finished local/mem shuffle partition "
+            "to the external store: 'none' (off), 'async' (writer-pool "
+            "thread hands the finished partition to a background uploader "
+            "— task completion never waits), 'sync' (upload completes "
+            "before the task reports; a failed upload degrades to single "
+            "copy, never fails the task).  Requires "
+            "ballista.shuffle.external_path; ignored when the store IS "
+            "external",
+            _parse_replication,
+            "none",
+        ),
+        ConfigEntry(
+            SHUFFLE_EXTERNAL_PATH,
+            "shared directory (object-store stand-in) holding external "
+            "shuffle partitions and replicas; must be reachable from "
+            "every executor and the scheduler",
+            str,
+            "",
+        ),
+        ConfigEntry(
+            EXECUTOR_DRAIN_TIMEOUT_S,
+            "graceful-decommission budget (seconds): a draining executor "
+            "finishes its running tasks within this window (past it they "
+            "are cancelled and handed off without consuming retry "
+            "budget), uploads un-replicated shuffle partitions to the "
+            "external store, then exits",
+            float,
+            "30",
         ),
         ConfigEntry(
             TASK_MAX_ATTEMPTS,
@@ -596,6 +658,22 @@ class BallistaConfig:
     @property
     def shuffle_compression(self) -> str:
         return self._get(SHUFFLE_COMPRESSION)
+
+    @property
+    def shuffle_store(self) -> str:
+        return self._get(SHUFFLE_STORE)
+
+    @property
+    def shuffle_replication(self) -> str:
+        return self._get(SHUFFLE_REPLICATION)
+
+    @property
+    def shuffle_external_path(self) -> str:
+        return self._get(SHUFFLE_EXTERNAL_PATH)
+
+    @property
+    def executor_drain_timeout_seconds(self) -> float:
+        return self._get(EXECUTOR_DRAIN_TIMEOUT_S)
 
     @property
     def task_max_attempts(self) -> int:

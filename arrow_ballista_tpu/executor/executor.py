@@ -178,6 +178,7 @@ class Executor:
         # task's trace context (minted at the scheduler) adopts on this
         # thread so every child span stitches under the job's trace
         trace.enable_from_props(task.props, process=f"executor:{self.id}")
+        self._note_external_root(task)
         pid = PartitionId.from_proto(task.task_id)
         cancel_event = threading.Event()
         with self._abort_lock:
@@ -279,6 +280,19 @@ class Executor:
             info.spans = get_recorder().drain()
         return task_info_to_proto(info)
 
+    @staticmethod
+    def _note_external_root(task: pb.TaskDefinition) -> None:
+        """Remember the session's external shuffle root process-wide: the
+        drain-time replica upload needs it after the last task finished,
+        when no session config is in scope."""
+        from ..config import SHUFFLE_EXTERNAL_PATH
+
+        ext = task.props.get(SHUFFLE_EXTERNAL_PATH, "")
+        if ext:
+            from ..shuffle import store as shuffle_store
+
+            shuffle_store.note_external_root(ext)
+
     def _new_shuffle_writer(
         self, pid: PartitionId, plan, task: pb.TaskDefinition, config: BallistaConfig
     ) -> ShuffleWriterExec:
@@ -332,6 +346,7 @@ class Executor:
         # TaskStatus bytes); the parent still ratchets obs on so ITS
         # heartbeat piggyback and Flight-serving spans flow too
         trace.enable_from_props(task.props, process=f"executor:{self.id}")
+        self._note_external_root(task)
         with self._worker_lock:
             worker = (
                 self._idle_workers.pop() if self._idle_workers else None

@@ -48,8 +48,20 @@ class ExecutorGrpcService:
         self, request: pb.StopExecutorParams, context
     ) -> pb.StopExecutorResult:
         log.info(
-            "StopExecutor received (force=%s): %s", request.force, request.reason
+            "StopExecutor received (force=%s, drain=%s): %s",
+            request.force, request.drain, request.reason,
         )
+        if request.drain:
+            # graceful decommission: drain on a detached thread — finish
+            # running tasks inside the budget, upload un-replicated
+            # shuffle partitions, report ExecutorStopped, then exit
+            threading.Thread(
+                target=self.server.drain,
+                args=(request.reason, request.drain_timeout_seconds),
+                name="executor-drain",
+                daemon=True,
+            ).start()
+            return pb.StopExecutorResult()
         if request.force:
             self.server.executor.cancel_all()
         self.server.trigger_shutdown(request.reason)
@@ -155,6 +167,8 @@ class ExecutorServer:
         )
         self._tasks: "queue.Queue" = queue.Queue()
         self._statuses: "queue.Queue" = queue.Queue()
+        self._draining = False
+        self._drain_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._grpc_server: Optional[grpc.Server] = None
@@ -225,6 +239,75 @@ class ExecutorServer:
             threading.Thread(
                 target=self.on_shutdown, args=(reason,), daemon=True
             ).start()
+
+    # ---------------------------------------------------------------- drain
+    def drain(self, reason: str, timeout_s: float = 0.0) -> int:
+        """Graceful decommission (ISSUE 6): finish running tasks within
+        ``timeout_s`` (the scheduler has already stopped sending new
+        ones), cancel-and-hand-off whatever outlives the budget, flush
+        reported statuses, upload every un-replicated shuffle partition
+        to the external store, report ExecutorStopped, then shut down.
+        Returns the number of partitions uploaded."""
+        import time as _time
+
+        from ..shuffle import store as shuffle_store
+
+        with self._drain_lock:
+            # concurrent drain RPCs (operator REST + scheduler, or a gRPC
+            # retry) must collapse to ONE drain cycle
+            if self._draining:
+                return 0
+            self._draining = True
+        timeout = timeout_s if timeout_s > 0 else 30.0
+        deadline = _time.monotonic() + timeout
+        log.info("draining executor %s (budget %.0fs)", self.executor.id, timeout)
+        while (
+            _time.monotonic() < deadline
+            and (self.executor.active_task_count() > 0 or not self._tasks.empty())
+        ):
+            _time.sleep(0.05)
+        if self.executor.active_task_count() > 0:
+            # past the budget: cancel the stragglers — the scheduler's
+            # draining-handoff guard re-queues them budget-free
+            n = self.executor.cancel_all()
+            log.warning(
+                "drain budget exhausted with %d task(s) running; cancelled",
+                n,
+            )
+            grace = _time.monotonic() + 5.0
+            while _time.monotonic() < grace and self.executor.active_task_count() > 0:
+                _time.sleep(0.05)
+        # let the status reporter flush: a completed status that never
+        # reaches the scheduler before ExecutorStopped would be dropped
+        # by the dead-executor guard and strand its partition
+        flush_deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < flush_deadline and not self._statuses.empty():
+            _time.sleep(0.05)
+        _time.sleep(0.25)  # in-flight UpdateTaskStatus RPC tail
+        # upload whatever has no external copy yet, then flush the async
+        # replicator so nothing queued is lost with this process
+        uploaded, failed = shuffle_store.drain_upload(
+            self.executor.work_dir, shuffle_store.noted_external_root()
+        )
+        shuffle_store.replicator().flush(timeout=30.0)
+        if failed:
+            log.warning("drain: %d upload(s) failed (degraded)", len(failed))
+        log.info(
+            "drain complete: %d partition(s) uploaded; reporting stopped",
+            uploaded,
+        )
+        try:
+            self.scheduler.ExecutorStopped(
+                pb.ExecutorStoppedParams(
+                    executor_id=self.executor.id,
+                    reason=f"drained: {reason} ({uploaded} partition(s) uploaded)",
+                ),
+                timeout=10,
+            )
+        except grpc.RpcError as e:
+            log.warning("ExecutorStopped after drain failed: %s", e.code())
+        self.trigger_shutdown(f"drained: {reason}")
+        return uploaded
 
     # ------------------------------------------------------------- running
     def enqueue_task(self, task: pb.TaskDefinition, scheduler_id: str) -> None:

@@ -95,7 +95,12 @@ def new_standalone_executor(
             server.grpc_port,
             flight.port,
         )
-        return StandaloneExecutor(executor, flight, server=server)
+        handle = StandaloneExecutor(executor, flight, server=server)
+        # a drained (or stopped) executor must stop SERVING too — wire
+        # the server's shutdown hook to the whole handle so decommission
+        # takes the Flight endpoint down exactly like a real process exit
+        server.on_shutdown = lambda reason: handle.shutdown()
+        return handle
 
     stub = SchedulerGrpcStub(make_channel(scheduler_host, scheduler_port))
     loop = PollLoop(executor, stub, poll_interval_s).start()

@@ -105,18 +105,22 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
         metrics = r.get("metrics") or {}
         tpu = {}
         shuffle_bytes = 0
+        replica_fetches = 0
         write = {}
         for op, vals in metrics.items():
             if op.startswith("TpuStage") or op.startswith("TpuWindow"):
                 for k, v in vals.items():
                     tpu[k] = tpu.get(k, 0) + v
             shuffle_bytes += vals.get("bytes_fetched", 0)
+            replica_fetches += vals.get("replica_fetches", 0)
             for k in (
                 "bytes_written_raw",
                 "bytes_written_wire",
                 "slab_flushes",
                 "write_queue_full_ns",
                 "device_pid_batches",
+                "replicas_written",
+                "replica_upload_failures",
             ):
                 if k in vals:
                     write[k] = write.get(k, 0) + vals[k]
@@ -131,6 +135,10 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             "fetch_retries": r.get("fetch_retries", 0),
             "shuffle_bytes_fetched": shuffle_bytes,
         }
+        if replica_fetches:
+            # reads this stage served from an external-store replica
+            # after its primary's executor went away
+            row["replica_fetches"] = replica_fetches
         spec = r.get("speculation")
         if spec:
             # straggler mitigation rollup: duplicates launched for this
@@ -154,6 +162,15 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
                 ),
                 "device_pid_batches": write.get("device_pid_batches", 0),
             }
+            if write.get("replicas_written") or write.get(
+                "replica_upload_failures"
+            ):
+                row["shuffle_write"]["replicas_written"] = write.get(
+                    "replicas_written", 0
+                )
+                row["shuffle_write"]["replica_upload_failures"] = write.get(
+                    "replica_upload_failures", 0
+                )
 
         ss = task_spans.get(sid)
         if ss:

@@ -28,6 +28,9 @@ _SCHEDULER_METHODS = {
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "ExecutorStopped": (pb.ExecutorStoppedParams, pb.ExecutorStoppedResult),
     "CancelJob": (pb.CancelJobParams, pb.CancelJobResult),
+    # graceful decommission (ISSUE 6): same message shapes as
+    # ExecutorStopped — executor_id + reason in, empty ack out
+    "DecommissionExecutor": (pb.ExecutorStoppedParams, pb.ExecutorStoppedResult),
 }
 
 _EXECUTOR_METHODS = {

@@ -42,6 +42,7 @@ CONFIG_KEYS = {
     "speculation_enabled": (int, 0, "1 = speculatively re-run stragglers for every session (sessions can also opt in via ballista.speculation.enabled)"),
     "speculation_interval_seconds": (float, 1.0, "period of the straggler/deadline scan on the event loop"),
     "task_timeout_seconds": (float, 0.0, "reap running tasks older than this for every session (0 = off; sessions can set ballista.task.timeout_seconds)"),
+    "drain_timeout_seconds": (float, 30.0, "graceful-decommission budget handed to a draining executor (DecommissionExecutor RPC / POST /api/executors/{id}/decommission)"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
@@ -157,6 +158,7 @@ def main(argv=None) -> None:
         speculation_interval_s=cfg["speculation_interval_seconds"],
         speculation_force_enabled=bool(cfg["speculation_enabled"]),
         task_timeout_force_s=cfg["task_timeout_seconds"],
+        drain_timeout_s=cfg["drain_timeout_seconds"],
     ).init()
     # the curator address executors dial back: must be reachable, never
     # the 0.0.0.0 wildcard

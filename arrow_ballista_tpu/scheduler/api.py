@@ -245,6 +245,7 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
         if path == "/api/state":
             em = srv.state.executor_manager
             alive = em.get_alive_executors()
+            draining = set(em.draining_executors())
             executors = []
             for meta in em.executors():
                 executors.append(
@@ -255,6 +256,7 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
                         "grpc_port": meta.grpc_port,
                         "last_seen": em.last_seen(meta.id),
                         "alive": meta.id in alive,
+                        "draining": meta.id in draining,
                     }
                 )
             self._json(
@@ -302,6 +304,26 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             return
         if path in ("", "/", "/ui"):  # noqa: RET505 - route ladder
             self._dashboard()
+            return
+        self._json({"error": f"no such route {path}"}, 404)
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        """Operator actions.  ``POST /api/executors/{id}/decommission``
+        gracefully drains an executor (ISSUE 6) — the REST spelling of
+        the DecommissionExecutor RPC."""
+        srv = type(self).scheduler
+        if srv is None:
+            self._json({"error": "scheduler not attached"}, 500)
+            return
+        path = self.path.split("?")[0].rstrip("/")
+        prefix, suffix = "/api/executors/", "/decommission"
+        if path.startswith(prefix) and path.endswith(suffix):
+            executor_id = path[len(prefix):-len(suffix)]
+            ok = srv.decommission_executor(executor_id)
+            self._json(
+                {"executor_id": executor_id, "draining": bool(ok)},
+                200 if ok else 404,
+            )
             return
         self._json({"error": f"no such route {path}"}, 404)
 

@@ -99,6 +99,12 @@ class ExecutionGraph:
         )
         self.task_retries = 0  # transient-failure re-queues over job lifetime
         self.stage_reset_counts: Dict[int, int] = {}  # executor-loss resets
+        # ballista.shuffle.external_path: lets executor-loss handling
+        # re-point lost locations at external replicas (probe-derived for
+        # drain-time uploads) instead of recomputing
+        self.external_shuffle_path = (
+            config.shuffle_external_path if config is not None else ""
+        )
         # speculative execution + deadline policy from the session config
         # (scheduler flags can force-enable; see scheduler/speculation.py).
         # In-memory only — a restarted scheduler re-derives nothing here
@@ -617,6 +623,7 @@ class ExecutionGraph:
                 executor,
                 PartitionStats(p.num_rows, p.num_batches, p.num_bytes),
                 p.path,
+                replica_path=p.replica_path,
             )
             for p in info.partitions
         ]
@@ -640,6 +647,7 @@ class ExecutionGraph:
                         meta if meta is not None else ExecutorMetadata("", "", 0),
                         PartitionStats(p.num_rows, p.num_batches, p.num_bytes),
                         p.path,
+                        replica_path=p.replica_path,
                     )
                 )
 
@@ -659,6 +667,8 @@ class ExecutionGraph:
         or None when recovery does not apply (the normal transient retry
         path then takes over).  Bounded by the same
         ``ballista.stage.max_attempts`` ledger as executor-loss resets."""
+        from ..shuffle.store import EXTERNAL_EXECUTOR_ID
+
         producer = self.stages.get(prod_sid)
         if producer is None or prod_sid == consumer.stage_id:
             return None
@@ -669,6 +679,19 @@ class ExecutionGraph:
             for locs in inp.partition_locations.values()
             for l in locs
         )
+        # an EXTERNAL-STORE loss (a repointed location's copy vanished):
+        # record which paths the strip below will remove, so the re-run
+        # covers exactly the map tasks backing them — resetting healthy
+        # executors' tasks too would re-propagate (duplicate) locations
+        # the consumer still holds
+        sentinel_paths: set = set()
+        if executor_id == EXTERNAL_EXECUTOR_ID and inp is not None:
+            sentinel_paths = {
+                l.path
+                for locs in inp.partition_locations.values()
+                for l in locs
+                if l.executor_meta.id == EXTERNAL_EXECUTOR_ID
+            }
         producer_has_lost_tasks = isinstance(producer, CompletedStage) and any(
             t is not None and t.executor_id == executor_id
             for t in producer.task_statuses
@@ -719,7 +742,25 @@ class ExecutionGraph:
         n_rerun = 0
         if isinstance(producer, CompletedStage):
             running = producer.to_running()
-            n_rerun = running.reset_tasks(executor_id)
+            if executor_id == EXTERNAL_EXECUTOR_ID:
+                # the external store lost data: re-run the map tasks
+                # backing the stripped sentinel locations (matched by
+                # replica/primary path; every task when the paths are
+                # unknown) — the sentinel must never leave the consumer
+                # stranded on an input nobody will complete
+                for i, t in enumerate(running.task_statuses):
+                    if t is None:
+                        continue
+                    backs_sentinel = not sentinel_paths or any(
+                        p.replica_path in sentinel_paths
+                        or p.path in sentinel_paths
+                        for p in t.partitions
+                    )
+                    if backs_sentinel:
+                        running.task_statuses[i] = None
+                        n_rerun += 1
+            else:
+                n_rerun = running.reset_tasks(executor_id)
             if n_rerun:
                 self.stages[prod_sid] = running
         self.revive()
@@ -870,24 +911,253 @@ class ExecutionGraph:
         self.status = FAILED
         self.error = error
 
+    # ------------------------------------------- replica repoint helpers
+    def _external_location(self, loc: PartitionLocation, path: str) -> PartitionLocation:
+        from ..shuffle.store import EXTERNAL_EXECUTOR
+
+        return PartitionLocation(
+            loc.partition_id, EXTERNAL_EXECUTOR, loc.partition_stats, path
+        )
+
+    @staticmethod
+    def _exists_memo():
+        """Memoized ``os.path.exists``: one reset_stages pass probes the
+        same replica paths from several angles (annotate, victim split,
+        keep_task, repoint) and runs on the single event-loop thread —
+        with the external root on a network mount each stat is a round
+        trip, so pay it once per path per loss, not four times."""
+        import os
+
+        cache: Dict[str, bool] = {}
+
+        def probe(path: str) -> bool:
+            v = cache.get(path)
+            if v is None:
+                v = os.path.exists(path)
+                cache[path] = v
+            return v
+
+        return probe
+
+    def _derived_replica(self, path: str, probe=None) -> str:
+        """External-store copy of ``path`` that actually exists, or "".
+        Covers drain-time uploads, which register no replica_path — the
+        mapping is deterministic, so the scheduler probes the shared
+        store instead of needing a new wire protocol."""
+        import os
+
+        from ..shuffle.store import external_replica_path, is_under_root
+
+        probe = os.path.exists if probe is None else probe
+        root = getattr(self, "external_shuffle_path", "")
+        if not root or not path:
+            return ""
+        if is_under_root(root, path):
+            # external-primary store: the partition IS the surviving copy
+            return path
+        cand = external_replica_path(root, path)
+        return cand if cand is not None and probe(cand) else ""
+
+    def _replica_of(
+        self, loc: PartitionLocation, probe=None
+    ) -> Optional[PartitionLocation]:
+        """A location for a surviving copy of ``loc``'s partition, or
+        None when no copy is KNOWN TO EXIST.  A registered replica_path
+        is probed too: replication=async stamps it optimistically, so a
+        failed background upload must not repoint consumers at a dangling
+        path (they would fetch-fail against the sentinel and the
+        producer would never recompute)."""
+        import os
+
+        probe = os.path.exists if probe is None else probe
+        if loc.replica_path and probe(loc.replica_path):
+            return self._external_location(loc, loc.replica_path)
+        derived = self._derived_replica(loc.path, probe)
+        return self._external_location(loc, derived) if derived else None
+
+    def _repoint_inputs(
+        self, executor_id: str, skip_paths=frozenset(), probe=None
+    ) -> int:
+        """Re-point every stage-input location served by ``executor_id``
+        at its surviving replica (external sentinel executor, so nothing
+        downstream ever strips it again).  Locations WITHOUT a surviving
+        copy — and locations in ``skip_paths`` (output of map tasks that
+        are about to RE-RUN: repointing half a task while the whole task
+        re-propagates would feed consumers the same data twice) — are
+        left for the strip/rollback passes.  Returns how many locations
+        were re-pointed."""
+        n = 0
+        for stage in self.stages.values():
+            inputs = getattr(stage, "inputs", None)
+            if not inputs:
+                continue
+            for inp in inputs.values():
+                for q, locs in inp.partition_locations.items():
+                    out = []
+                    for l in locs:
+                        if (
+                            l.executor_meta.id == executor_id
+                            and l.path not in skip_paths
+                        ):
+                            r = self._replica_of(l, probe)
+                            if r is not None:
+                                out.append(r)
+                                n += 1
+                                continue
+                        out.append(l)
+                    inp.partition_locations[q] = out
+        return n
+
+    def _annotate_completed_replicas(
+        self, executor_id: str, probe=None
+    ) -> int:
+        """Stamp probe-derived replica paths onto completed task stats of
+        ``executor_id`` (drain-time uploads registered none), so the
+        survivor/victim split can tell replicated partitions from truly
+        lost ones.  Running stages' COMPLETED tasks are annotated too —
+        a partially-finished stage's done work is just as protectable.
+        Returns the number of partitions annotated."""
+        from dataclasses import replace as _replace
+
+        n = 0
+        for stage in self.stages.values():
+            statuses = getattr(stage, "task_statuses", None)
+            if statuses is None:
+                continue
+            for t in statuses:
+                if (
+                    t is None
+                    or t.executor_id != executor_id
+                    or t.state != "completed"
+                ):
+                    continue
+                parts = []
+                changed = False
+                for p in t.partitions:
+                    if not p.replica_path:
+                        derived = self._derived_replica(p.path, probe)
+                        if derived:
+                            p = _replace(p, replica_path=derived)
+                            changed = True
+                            n += 1
+                    parts.append(p)
+                if changed:
+                    t.partitions = parts
+        return n
+
+    @staticmethod
+    def _fully_replicated(t: TaskInfo, probe=None) -> bool:
+        """Does every output partition of this completed task have a copy
+        that EXISTS on the shared store right now?  (An optimistic async
+        replica_path whose upload failed does not count.)"""
+        import os as _os
+
+        probe = _os.path.exists if probe is None else probe
+        return (
+            t.state == "completed"
+            and bool(t.partitions)
+            and all(
+                p.replica_path and probe(p.replica_path)
+                for p in t.partitions
+            )
+        )
+
+    def _victim_task_paths(self, executor_id: str, probe=None) -> set:
+        """Output paths of the lost executor's completed map tasks that
+        will have to RE-RUN (some partition has no surviving copy).
+        Their locations must be stripped — never repointed — so the
+        re-run's propagation is the single source of their data."""
+        out: set = set()
+        for stage in self.stages.values():
+            statuses = getattr(stage, "task_statuses", None)
+            if statuses is None:
+                continue
+            for t in statuses:
+                if (
+                    t is not None
+                    and t.executor_id == executor_id
+                    and t.state == "completed"
+                    and not self._fully_replicated(t, probe)
+                ):
+                    out.update(p.path for p in t.partitions)
+        return out
+
+    def handoff_task(self, partition: PartitionId, executor_id: str) -> bool:
+        """Graceful-decommission handoff: a DRAINING executor cancelled
+        (or otherwise failed) this task — re-queue it excluded from the
+        drainer WITHOUT consuming the failure budget (the attempt bump
+        keeps the drainer's late reports stale; the free attempt keeps
+        the budget whole).  A duplicate copy on the drainer just drops.
+        Returns True when the report was absorbed as a handoff."""
+        stage = self.stages.get(partition.stage_id)
+        if not isinstance(stage, RunningStage):
+            return False
+        p = partition.partition_id
+        if not (0 <= p < stage.partitions):
+            return False
+        si = stage.speculative_statuses.get(p)
+        if si is not None and si.executor_id == executor_id:
+            stage.drop_speculative(p)
+            stage.bump_spec_stat("wasted")
+            self.spec_wasted_pending += 1
+            return True
+        t = stage.task_statuses[p]
+        if t is None or t.state != "running" or t.executor_id != executor_id:
+            return False
+        cur = stage.task_attempts.get(p, 0)
+        stage.task_statuses[p] = None
+        stage.task_started_mono.pop(p, None)
+        stage.task_exclusions[p] = executor_id
+        stage.task_attempts[p] = cur + 1
+        stage.task_free_attempts[p] = stage.task_free_attempts.get(p, 0) + 1
+        return True
+
     def reset_stages(self, executor_id: str) -> int:
-        """Executor-loss rollback (reference: execution_graph.rs:499-622):
+        """Executor-loss rollback (reference: execution_graph.rs:499-622),
+        replica-aware (ISSUE 6):
 
+        * re-point locations with a surviving external-store copy at the
+          replica FIRST — those partitions are not lost, consumers keep
+          (or re-resolve to) working locations and nothing recomputes;
         * clear running tasks assigned to the executor;
-        * strip its partition locations from unresolved stages' inputs;
-        * roll Running/Resolved stages whose inputs lost data back to
-          UnResolved;
-        * re-run Completed stages whose map outputs were lost.
+        * strip its un-replicated partition locations from unresolved
+          stages' inputs;
+        * roll Running/Resolved stages whose inputs truly lost data back
+          to UnResolved;
+        * re-run Completed stages' map tasks only where some output
+          partition has NO surviving copy.
 
-        Returns the number of affected stages."""
+        Returns the number of affected/re-pointed stages; only genuine
+        rollbacks (not repoints) consume the stage_max_attempts ledger."""
         affected = set()
 
+        # 0) surviving copies first: annotate drain-uploaded partitions,
+        #    split the lost executor's completed tasks into survivors
+        #    (every partition has an existing copy) and victims (must
+        #    re-run), then re-point the SURVIVORS' input locations — the
+        #    strip/rollback passes below only ever see genuine losses,
+        #    and a victim's locations are never half-repointed (the
+        #    re-run re-propagates the whole task; a lingering sentinel
+        #    copy would duplicate its rows at the consumer)
+        probe = self._exists_memo()  # one stat per replica path per loss
+        repointed = self._annotate_completed_replicas(executor_id, probe)
+        victim_paths = self._victim_task_paths(executor_id, probe)
+        repointed += self._repoint_inputs(
+            executor_id, skip_paths=victim_paths, probe=probe
+        )
+
         # 1) running stages: reset that executor's tasks (duplicates the
-        #    stage drops count toward the wasted registry counter)
+        #    stage drops count toward the wasted registry counter).  A
+        #    COMPLETED task whose every partition has a surviving copy
+        #    is kept — its propagated locations were just repointed, so
+        #    a 90%-done stage on a drained executor re-runs nothing.
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, RunningStage):
                 wasted_before = stage.spec_stats.get("wasted", 0)
-                if stage.reset_tasks(executor_id):
+                if stage.reset_tasks(
+                    executor_id,
+                    keep_task=lambda t: self._fully_replicated(t, probe),
+                ):
                     affected.add(sid)
                 self.spec_wasted_pending += (
                     stage.spec_stats.get("wasted", 0) - wasted_before
@@ -930,12 +1200,24 @@ class ExecutionGraph:
             self.stages[sid] = unresolved
             affected.add(sid)
 
-        # 4) completed producers with lost map output re-run their lost tasks
+        # 4) completed producers re-run ONLY the victim map tasks (some
+        #    partition without an EXISTING copy — same split as step 0);
+        #    fully-replicated tasks keep their re-pointed locations
         for sid in sorted(rerun_producers):
             stage = self.stages.get(sid)
             if isinstance(stage, CompletedStage):
+                victims = [
+                    i
+                    for i, t in enumerate(stage.task_statuses)
+                    if t is not None
+                    and t.executor_id == executor_id
+                    and not self._fully_replicated(t, probe)
+                ]
+                if not victims:
+                    continue
                 running = stage.to_running()
-                running.reset_tasks(executor_id)
+                for i in victims:
+                    running.task_statuses[i] = None
                 self.stages[sid] = running
                 affected.add(sid)
 
@@ -959,7 +1241,9 @@ class ExecutionGraph:
         if affected and self.status == COMPLETED:
             self.status = RUNNING
         self.revive()
-        return len(affected)
+        # repoint-only changes (no rollback) still mutated locations and
+        # must persist — report them without burning the reset ledger
+        return len(affected) if affected else (1 if repointed else 0)
 
     # -------------------------------------------------------- persistence
     def encode(self) -> bytes:
@@ -973,6 +1257,7 @@ class ExecutionGraph:
         g.task_max_attempts = self.task_max_attempts
         g.stage_max_attempts = self.stage_max_attempts
         g.task_retries = self.task_retries
+        g.external_shuffle_path = self.external_shuffle_path
         for sid in sorted(self.stage_reset_counts):
             g.stage_reset_ids.append(sid)
             g.stage_reset_counts.append(self.stage_reset_counts[sid])
@@ -1064,6 +1349,7 @@ class ExecutionGraph:
         self.task_max_attempts = g.task_max_attempts or DEFAULT_TASK_MAX_ATTEMPTS
         self.stage_max_attempts = g.stage_max_attempts or DEFAULT_STAGE_MAX_ATTEMPTS
         self.task_retries = g.task_retries
+        self.external_shuffle_path = g.external_shuffle_path
         self.stage_reset_counts = dict(
             zip(g.stage_reset_ids, g.stage_reset_counts)
         )

@@ -260,8 +260,13 @@ class RunningStage:
             1 for t in self.task_statuses if t is not None and t.state == "completed"
         )
 
-    def reset_tasks(self, executor_id: str) -> int:
+    def reset_tasks(self, executor_id: str, keep_task=None) -> int:
         """Clear every task that ran on a lost executor; returns count.
+
+        ``keep_task(t)`` (optional) exempts a status from the reset —
+        the replica-aware executor-loss path keeps COMPLETED tasks whose
+        every output partition has a surviving external copy, so a
+        partially-finished stage on a drained executor re-runs nothing.
 
         Speculation interplay: a duplicate attempt ON the lost executor
         simply disappears (wasted); a duplicate running ELSEWHERE is
@@ -274,6 +279,8 @@ class RunningStage:
         n = 0
         for i, t in enumerate(self.task_statuses):
             if t is not None and t.executor_id == executor_id:
+                if keep_task is not None and keep_task(t):
+                    continue
                 shadow = None
                 if t.state == "running":
                     spec_started = self.spec_started_mono.get(i)

@@ -107,10 +107,20 @@ class ExecutorManager:
         self._quarantined_until: Dict[str, float] = {}
         self._launch_failures: Dict[str, int] = {}  # consecutive
         self._pending_expulsions: Set[str] = set()
+        # ---- graceful decommission: executor -> monotonic drain deadline.
+        # Draining executors take no NEW work (reserve_slots +
+        # fill_reservations exclude them) but keep running/reporting what
+        # they have; past the deadline (+grace) the reaper declares them
+        # lost so a wedged drain can't hold its tasks hostage.
+        self._draining: Dict[str, float] = {}
         self.registry = registry or MetricsRegistry()
         self._quarantines = self.registry.counter(
             "quarantines_total",
             "executors newly quarantined over scheduler lifetime",
+        )
+        self._drained = self.registry.counter(
+            "executors_drained_total",
+            "executors gracefully decommissioned (drain cycles concluded)",
         )
         self._task_failures_recorded = self.registry.counter(
             "executor_task_failures_total",
@@ -176,6 +186,7 @@ class ExecutorManager:
             self._quarantined_until.pop(metadata.id, None)
             self._launch_failures.pop(metadata.id, None)
             self._pending_expulsions.discard(metadata.id)
+            self._draining.pop(metadata.id, None)
         if reserve:
             return [ExecutorReservation(metadata.id) for _ in range(slots)]
         return []
@@ -195,6 +206,13 @@ class ExecutorManager:
             self._quarantined_until.pop(executor_id, None)
             self._launch_failures.pop(executor_id, None)
             self._pending_expulsions.discard(executor_id)
+            was_draining = executor_id in self._draining
+            self._draining.pop(executor_id, None)
+        if was_draining:
+            # a drain cycle concluded (graceful stop OR deadline expiry):
+            # the executor is out of the cluster with its locations
+            # re-pointed by the accompanying rollback
+            self._drained.inc()
 
     def get_executor_metadata(self, executor_id: str) -> ExecutorMetadata:
         raw = self.backend.get(Keyspace.Executors, executor_id)
@@ -353,6 +371,59 @@ class ExecutorManager:
             self._pending_expulsions.clear()
         return out
 
+    # ------------------------------------------------------------ draining
+    def mark_draining(self, executor_id: str, timeout_s: float) -> None:
+        """Graceful decommission step 1: exclude the executor from every
+        future reservation while it finishes/hands off its work."""
+        with self._q_lock:
+            self._draining[executor_id] = time.monotonic() + max(0.0, timeout_s)
+
+    def is_draining(self, executor_id: str) -> bool:
+        with self._q_lock:
+            return executor_id in self._draining
+
+    def draining_executors(self) -> List[str]:
+        with self._q_lock:
+            return sorted(self._draining)
+
+    # the deadline only bounds TASK time; a draining executor then still
+    # legitimately spends cancel grace + status flush + un-replicated
+    # partition uploads + replicator flush (up to ~45s of bounded waits,
+    # plus upload I/O) before ExecutorStopped — the watchdog grace must
+    # cover that or a busy drain gets declared lost mid-upload and
+    # triggers the recompute storm the drain exists to avoid
+    DRAIN_GRACE_S = 60.0
+    # upload I/O is unbounded (GBs to a slow shared store): a drain past
+    # the grace whose executor STILL HEARTBEATS is deferred up to this
+    # hard cap past its deadline — only a drain that is both overdue and
+    # silent (or wedged beyond the cap) is declared lost
+    DRAIN_HARD_CAP_S = 900.0
+
+    def overdue_drains(
+        self,
+        grace_s: Optional[float] = None,
+        alive: Optional[Set[str]] = None,
+        hard_cap_s: Optional[float] = None,
+    ) -> List[str]:
+        """Draining executors past deadline + grace that never reported
+        stopped: the reaper posts ExecutorLost for each so a wedged drain
+        cannot strand its tasks.  ``alive`` (heartbeat-fresh executor
+        ids) defers a live, still-uploading drain until ``hard_cap_s``
+        past its deadline.  Entries stay in ``_draining`` until
+        ``remove_executor`` concludes the cycle (and counts it)."""
+        grace_s = self.DRAIN_GRACE_S if grace_s is None else grace_s
+        hard_cap_s = self.DRAIN_HARD_CAP_S if hard_cap_s is None else hard_cap_s
+        hard_cap_s = max(hard_cap_s, grace_s)
+        alive = alive or set()
+        now = time.monotonic()
+        with self._q_lock:
+            return sorted(
+                eid
+                for eid, deadline in self._draining.items()
+                if now > deadline + grace_s
+                and (eid not in alive or now > deadline + hard_cap_s)
+            )
+
     def is_quarantined(self, executor_id: str, now: Optional[float] = None) -> bool:
         now = time.monotonic() if now is None else now
         with self._q_lock:
@@ -376,8 +447,11 @@ class ExecutorManager:
         if n <= 0:
             return []
         alive = self.get_alive_executors()
-        # quarantined executors take no new work until their backoff ends
+        # quarantined executors take no new work until their backoff
+        # ends; draining executors take no new work EVER
         for eid in self.quarantined_executors():
+            alive.discard(eid)
+        for eid in self.draining_executors():
             alive.discard(eid)
         # on LeaseFenced nothing was applied: re-scan and retry once
         # under a fresh grant (the counts may have changed meanwhile)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 
+import grpc
 import pyarrow as pa
 
 from ..config import TaskSchedulingPolicy
@@ -191,3 +192,22 @@ class SchedulerGrpcService:
     def CancelJob(self, request: pb.CancelJobParams, context) -> pb.CancelJobResult:
         self.server.cancel_job(request.job_id)
         return pb.CancelJobResult(cancelled=True)
+
+    def DecommissionExecutor(
+        self, request: pb.ExecutorStoppedParams, context
+    ) -> pb.ExecutorStoppedResult:
+        """Graceful decommission (ISSUE 6): operator-initiated drain —
+        reuses the ExecutorStopped message shapes on the wire."""
+        ok = self.server.decommission_executor(
+            request.executor_id,
+            request.reason or "decommissioned by operator",
+        )
+        if not ok:
+            # an unknown id must not look like a successful drain: the
+            # operator would terminate the instance believing its shuffle
+            # data was uploaded
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown executor {request.executor_id!r}",
+            )
+        return pb.ExecutorStoppedResult()

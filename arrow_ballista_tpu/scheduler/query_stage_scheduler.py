@@ -238,8 +238,18 @@ class QueryStageScheduler(EventAction):
             sender.post(ExecutorLost(eid, "repeated launch failures"))
 
     def _on_executor_lost(self, event: ExecutorLost, sender: EventSender) -> None:
+        """ALL executor-loss paths land here on the event-loop thread —
+        gRPC ExecutorStopped, repeated launch failures, heartbeat expiry
+        and drain deadlines — so rollback/repoint and drain bookkeeping
+        serialize instead of racing across threads."""
         log.warning("executor %s lost: %s", event.executor_id, event.reason)
-        self.state.executor_manager.remove_executor(event.executor_id)
+        em = self.state.executor_manager
+        if not em.is_draining(event.executor_id):
+            # a non-draining loss (crash/expiry) gets a best-effort
+            # force-stop so a half-dead process stops serving; a DRAINED
+            # executor is already exiting on its own terms
+            self.state.try_stop_executor(event.executor_id, event.reason)
+        em.remove_executor(event.executor_id)  # concludes any drain cycle
         affected = self.state.task_manager.executor_lost(event.executor_id)
         for job_id in affected:
             # bounded rollback: a stage reset past ballista.stage.max_attempts

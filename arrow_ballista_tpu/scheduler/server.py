@@ -66,6 +66,7 @@ class SchedulerServer:
         speculation_interval_s: float = 1.0,
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
+        drain_timeout_s: float = 30.0,
     ):
         self.scheduler_id = scheduler_id
         self.policy = policy
@@ -93,6 +94,10 @@ class SchedulerServer:
         # straggler/deadline scan period (tests shrink the attr live; the
         # timer re-reads it each tick)
         self.speculation_interval_s = speculation_interval_s
+        # graceful-decommission drain budget handed to executors
+        # (ballista.executor.drain_timeout_seconds is the session-side
+        # spelling; the scheduler flag wins for operator-driven drains)
+        self.drain_timeout_s = drain_timeout_s
         self._reaper: Optional[threading.Thread] = None
         self._spec_timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -162,6 +167,66 @@ class SchedulerServer:
     def executor_lost(self, executor_id: str, reason: str = "") -> None:
         self.event_loop.get_sender().post(ExecutorLost(executor_id, reason))
 
+    # ------------------------------------------------------- decommission
+    def decommission_executor(
+        self,
+        executor_id: str,
+        reason: str = "decommissioned by operator",
+        timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Graceful decommission (ISSUE 6): mark the executor DRAINING —
+        it takes no new work from this moment — and ask it to drain:
+        finish (or, past the timeout, cancel-and-hand-off) its running
+        tasks, upload un-replicated shuffle partitions to the external
+        store, report ExecutorStopped and exit.  The ExecutorStopped (or,
+        for a wedged drain, the reaper's deadline) then rides the normal
+        event-loop ExecutorLost path, which re-points shuffle locations
+        at replicas and only recomputes what truly has no surviving copy.
+
+        Pull-mode executors (no gRPC port) can't receive the drain RPC:
+        they are marked draining (starving them of work) and the deadline
+        concludes the drain.  Returns False for unknown executors."""
+        em = self.state.executor_manager
+        timeout = self.drain_timeout_s if timeout_s is None else timeout_s
+        try:
+            meta = em.get_executor_metadata(executor_id)
+        except Exception:  # noqa: BLE001
+            log.warning("cannot decommission unknown executor %s", executor_id)
+            return False
+        em.mark_draining(executor_id, timeout)
+        log.info(
+            "decommissioning executor %s (drain timeout %.0fs): %s",
+            executor_id, timeout, reason,
+        )
+        if meta.grpc_port:
+            # the drain RPC returns immediately; the executor drains in
+            # the background and reports ExecutorStopped when done.  Off
+            # the caller's thread: a dead host costs a 5s RPC timeout.
+            def _ask() -> None:
+                try:
+                    from ..proto.rpc import executor_stub
+
+                    executor_stub(meta.host, meta.grpc_port).StopExecutor(
+                        pb.StopExecutorParams(
+                            executor_id=executor_id,
+                            reason=reason,
+                            force=False,
+                            drain=True,
+                            drain_timeout_seconds=timeout,
+                        ),
+                        timeout=5,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "drain RPC to %s failed (the deadline watchdog "
+                        "will conclude the drain): %s", executor_id, e,
+                    )
+
+            threading.Thread(
+                target=_ask, name="drain-executor", daemon=True
+            ).start()
+        return True
+
     # ------------------------------------------------------------ pull mode
     def poll_work(
         self,
@@ -212,6 +277,7 @@ class SchedulerServer:
         while not self._stop.wait(self.reaper_interval_s):
             try:
                 self._expire_dead_executors()
+                self._expire_overdue_drains()
             except Exception:  # noqa: BLE001 - reaper must never die
                 log.exception("dead-executor reaper iteration failed")
             try:
@@ -291,6 +357,12 @@ class SchedulerServer:
         return adopted
 
     def _expire_dead_executors(self) -> None:
+        """Heartbeat-timeout expiry ONLY posts ExecutorLost: the loss
+        itself (state removal, StopExecutor, rollback/repoint, drain
+        bookkeeping) is handled on the event-loop thread exactly like
+        gRPC-reported loss, so the two paths can never interleave a
+        rollback with drain handling (ISSUE 6 satellite — previously the
+        StopExecutor RPC ran here on the reaper thread)."""
         expired = self.state.executor_manager.get_expired_executors(
             self.executor_timeout_s
         )
@@ -302,28 +374,30 @@ class SchedulerServer:
                 age,
                 self.executor_timeout_s,
             )
-            self._try_stop_executor(hb.executor_id, "heartbeat timed out")
             self.executor_lost(hb.executor_id, "heartbeat timed out")
 
-    def _try_stop_executor(self, executor_id: str, reason: str) -> None:
-        """Best-effort StopExecutor{force} RPC (reference: `:227-244`)."""
-        try:
-            meta = self.state.executor_manager.get_executor_metadata(executor_id)
-        except Exception:
-            return
-        if not meta.grpc_port:
-            return
-        try:
-            from ..proto.rpc import executor_stub
-
-            executor_stub(meta.host, meta.grpc_port).StopExecutor(
-                pb.StopExecutorParams(
-                    executor_id=executor_id, reason=reason, force=True
-                ),
-                timeout=5,
+    def _expire_overdue_drains(self) -> None:
+        """A draining executor that never reported stopped inside its
+        deadline (+grace) is declared lost — same event-loop path, so its
+        tasks hand off and its locations re-point exactly once.  One
+        still heartbeating (mid drain-upload) is deferred up to the
+        hard cap rather than interrupted mid-copy — but only push-mode
+        executors, which actually received the drain RPC; a pull-mode
+        drain has nothing to wait on, the deadline concludes it."""
+        em = self.state.executor_manager
+        draining = set()
+        for eid in em.get_alive_executors():
+            try:
+                if em.get_executor_metadata(eid).grpc_port:
+                    draining.add(eid)
+            except Exception:  # noqa: BLE001 - racing a removal
+                pass
+        for eid in em.overdue_drains(alive=draining):
+            log.warning(
+                "draining executor %s missed its drain deadline; "
+                "declaring it lost", eid,
             )
-        except Exception as e:  # noqa: BLE001 - executor may simply be gone
-            log.debug("StopExecutor(%s) failed: %s", executor_id, e)
+            self.executor_lost(eid, "drain deadline exceeded")
 
     # --------------------------------------------------------------- misc
     def cancel_job(self, job_id: str) -> None:

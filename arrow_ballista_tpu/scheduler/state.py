@@ -173,12 +173,48 @@ class SchedulerState:
         if (
             self.policy == TaskSchedulingPolicy.PUSH_STAGED
             and not self.executor_manager.is_quarantined(executor.id)
+            and not self.executor_manager.is_draining(executor.id)
         ):
             finished = sum(1 for s in statuses if s.state in ("completed", "failed"))
             reservations = [
                 ExecutorReservation(executor.id) for _ in range(finished)
             ]
         return events, reservations
+
+    # ------------------------------------------------------------ lifecycle
+    def try_stop_executor(
+        self, executor_id: str, reason: str, force: bool = True
+    ) -> None:
+        """Best-effort StopExecutor RPC on a detached thread (reference:
+        scheduler_server/mod.rs:227-244).  Runs off-thread so the 5s RPC
+        timeout against a dead host never stalls the caller — in
+        particular the event-loop thread handling ExecutorLost."""
+        try:
+            meta = self.executor_manager.get_executor_metadata(executor_id)
+        except Exception:  # noqa: BLE001 - already forgotten
+            return
+        if not meta.grpc_port:
+            return
+
+        def _stop() -> None:
+            try:
+                from ..proto import pb
+                from ..proto.rpc import executor_stub
+
+                executor_stub(meta.host, meta.grpc_port).StopExecutor(
+                    pb.StopExecutorParams(
+                        executor_id=executor_id, reason=reason, force=force
+                    ),
+                    timeout=5,
+                )
+            except Exception as e:  # noqa: BLE001 - executor may be gone
+                log.debug("StopExecutor(%s) failed: %s", executor_id, e)
+
+        import threading
+
+        threading.Thread(
+            target=_stop, name="stop-executor", daemon=True
+        ).start()
 
     # ------------------------------------------------------------ offering
     def offer_reservation(

@@ -130,6 +130,21 @@ class TaskManager:
             "speculative_wasted",
             "speculative duplicates that lost the race or died",
         )
+        # replicated shuffle storage (ISSUE 6): scheduler-side rollup of
+        # the data-plane counters so /api/metrics shows them even when
+        # executors run in other processes
+        self._replicas_written = self.registry.counter(
+            "shuffle_replicas_written",
+            "shuffle partitions committed with an external-store replica",
+        )
+        self._replica_fetches = self.registry.counter(
+            "replica_fetches_total",
+            "shuffle reads served by a replica after primary failover",
+        )
+        self._drain_handoffs = self.registry.counter(
+            "drain_handoffs_total",
+            "tasks handed off a draining executor without burning budget",
+        )
 
     @property
     def task_retries_total(self) -> int:
@@ -406,6 +421,7 @@ class TaskManager:
         events: List[Tuple[str, str]] = []
         newly_quarantined: List[str] = []
         cancels: List[Tuple[str, PartitionId]] = []
+        draining = self.executor_manager.is_draining(executor.id)
         for job_id, infos in per_job.items():
             entry = self._entry(job_id)
             with entry.lock:
@@ -419,7 +435,34 @@ class TaskManager:
                         # still surrender their spans before being dropped)
                         trace_store().add(info.spans)
                         info.spans = []
+                    if draining and info.state == "failed" and (
+                        self._is_drain_handoff(info.error)
+                    ):
+                        # graceful decommission: a draining executor's
+                        # cancellations/transient failures are HANDOFFS —
+                        # re-queue elsewhere without burning the failure
+                        # budget or feeding quarantine.  Structured
+                        # lost-shuffle failures and genuine fatal errors
+                        # still take the normal classification path (a
+                        # handoff would re-burn a full fetch cycle on
+                        # vanished data, or delay a poison-pill verdict).
+                        if graph.handoff_task(info.partition_id, executor.id):
+                            self._drain_handoffs.inc()
+                            events.append((job_id, "task_requeued"))
+                        continue
                     evs = graph.update_task_status(info, executor)
+                    if info.state == "completed" and evs:
+                        # committed (not a stale duplicate): roll the
+                        # data-plane replica counters up scheduler-side
+                        self._replicas_written.inc(
+                            sum(1 for p in info.partitions if p.replica_path)
+                        )
+                        fetched_from_replica = sum(
+                            int(vals.get("replica_fetches", 0))
+                            for _, vals in info.metrics
+                        )
+                        if fetched_from_replica:
+                            self._replica_fetches.inc(fetched_from_replica)
                     for ev in evs:
                         # speculation outcomes feed counters, not the
                         # job-event stream (the accompanying completion
@@ -463,6 +506,22 @@ class TaskManager:
                 self._retries.inc(n)
                 events.extend([(job_id, "task_requeued")] * n)
         return events
+
+    @staticmethod
+    def _is_drain_handoff(error: str) -> bool:
+        """Which failures from a DRAINING executor are absorbed as
+        budget-free handoffs: its drain-timeout cancellations (fatal by
+        classification, but deliberate here) and transient infra noise.
+        ShuffleFetchFailed must reach ``_recover_lost_shuffle`` and other
+        fatal errors must fail fast as usual."""
+        from .failure import FATAL, classify_failure, parse_shuffle_fetch_failure
+
+        err = (error or "").strip()
+        if err.startswith("Cancelled"):
+            return True
+        if parse_shuffle_fetch_failure(err) is not None:
+            return False
+        return classify_failure(err) != FATAL
 
     def cancel_task_attempts(
         self, cancels: List[Tuple[str, PartitionId]]
@@ -550,17 +609,19 @@ class TaskManager:
         (reference: task_manager.rs:184-221)."""
         em = self.executor_manager
         quarantined = set(em.quarantined_executors())
-        # a quarantined executor's slots sit out this cycle entirely —
-        # returned unfilled so the caller cancels them back to the pool
-        free = [r for r in reservations if r.executor_id not in quarantined]
-        sidelined = [r for r in reservations if r.executor_id in quarantined]
+        # quarantined AND draining executors' slots sit out this cycle
+        # entirely — returned unfilled so the caller cancels them back to
+        # the pool (a draining executor must never take NEW work)
+        sitting_out = quarantined | set(em.draining_executors())
+        free = [r for r in reservations if r.executor_id not in sitting_out]
+        sidelined = [r for r in reservations if r.executor_id in sitting_out]
         assignments: List[Tuple[str, Task]] = []
         pending = 0
 
         # exclusion escape hatch: a task is never retried on the executor
         # that just failed it UNLESS that executor is the only live
         # candidate (otherwise a 1-executor cluster could never retry)
-        alive = em.get_alive_executors() - quarantined
+        alive = em.get_alive_executors() - sitting_out
 
         def _allow_excluded(executor_id: str) -> bool:
             return not (alive - {executor_id})

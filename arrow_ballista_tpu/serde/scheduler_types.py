@@ -100,12 +100,17 @@ class PartitionStats:
 
 @dataclass(frozen=True)
 class PartitionLocation:
-    """A completed map-side shuffle partition an executor can serve."""
+    """A completed map-side shuffle partition an executor can serve.
+
+    ``replica_path`` ("" = single copy) names an external-store copy the
+    fetch path fails over to when the serving executor is unreachable;
+    the scheduler re-points whole locations at it on executor loss."""
 
     partition_id: PartitionId
     executor_meta: ExecutorMetadata
     partition_stats: PartitionStats
     path: str
+    replica_path: str = ""
 
     def to_proto(self) -> pb.PartitionLocation:
         return pb.PartitionLocation(
@@ -113,6 +118,7 @@ class PartitionLocation:
             executor_meta=self.executor_meta.to_proto(),
             partition_stats=self.partition_stats.to_proto(),
             path=self.path,
+            replica_path=self.replica_path,
         )
 
     @staticmethod
@@ -122,19 +128,23 @@ class PartitionLocation:
             ExecutorMetadata.from_proto(p.executor_meta),
             PartitionStats.from_proto(p.partition_stats),
             p.path,
+            p.replica_path,
         )
 
 
 @dataclass(frozen=True)
 class ShuffleWritePartition:
     """Stats for one output partition written by a shuffle-write task
-    (reference: shuffle_writer.rs ShuffleWritePartition)."""
+    (reference: shuffle_writer.rs ShuffleWritePartition).
+    ``replica_path`` carries the external-store copy's path ("" = single
+    copy)."""
 
     partition_id: int
     path: str
     num_batches: int
     num_rows: int
     num_bytes: int
+    replica_path: str = ""
 
     def to_proto(self) -> pb.ShuffleWritePartition:
         return pb.ShuffleWritePartition(
@@ -143,10 +153,12 @@ class ShuffleWritePartition:
             num_batches=self.num_batches,
             num_rows=self.num_rows,
             num_bytes=self.num_bytes,
+            replica_path=self.replica_path,
         )
 
     @staticmethod
     def from_proto(p: pb.ShuffleWritePartition) -> "ShuffleWritePartition":
         return ShuffleWritePartition(
-            p.partition_id, p.path, p.num_batches, p.num_rows, p.num_bytes
+            p.partition_id, p.path, p.num_batches, p.num_rows, p.num_bytes,
+            p.replica_path,
         )

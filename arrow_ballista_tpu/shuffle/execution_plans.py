@@ -20,6 +20,7 @@ implementation; both produce identical assignments by construction.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import TYPE_CHECKING, Iterator, Optional
 
@@ -44,6 +45,8 @@ try:  # native partitioner (C++); optional
     from ..native import native_hash_partition_indices
 except Exception:  # pragma: no cover - toolchain-less environments
     native_hash_partition_indices = None
+
+log = logging.getLogger(__name__)
 
 
 def partition_indices(batch: pa.RecordBatch, exprs: list[PhysicalExpr], n: int):
@@ -95,6 +98,7 @@ class _IpcFileSink:
         self.num_rows = 0
         self.num_batches = 0
         self.wire_bytes: Optional[int] = None
+        self.replica_path = ""  # set post-close by the replication hook
         self._sink = pa.OSFile(path, "wb")
         try:
             self._writer = pa.ipc.new_file(self._sink, schema, options=options)
@@ -119,15 +123,22 @@ class _IpcFileSink:
         return self.wire_bytes
 
     def abandon(self) -> None:
-        """Failed-task teardown: release the OS handle WITHOUT counting
-        the file as written (the partial file is clobbered by the retry
-        or swept with the job dir)."""
+        """Failed-task teardown: release the OS handle and delete the
+        partial file.  Closing the IPC writer leaves a READABLE file
+        (valid footer over the batches written so far) at the canonical
+        partition path — if it survived, a drain-time upload would
+        publish it as a complete replica and a consumer would silently
+        read fewer rows."""
         try:
             self._writer.close()
         except Exception:  # noqa: BLE001 - handle release is what matters
             pass
         finally:
             self._sink.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
 
 
 class _MemSink:
@@ -152,6 +163,8 @@ class _MemSink:
         self.num_rows = 0
         self.num_batches = 0
         self.wire_bytes: Optional[int] = None
+        self.replica_path = ""  # set post-close by the replication hook
+        self.serialized: Optional[pa.Buffer] = None  # the closed IPC bytes
         self._buf = pa.BufferOutputStream()
         self._writer = pa.ipc.new_stream(self._buf, schema, options=options)
 
@@ -164,7 +177,11 @@ class _MemSink:
         from . import memory_store
 
         self._writer.close()
-        memory_store.put_buffer(*self._key, self._buf.getvalue())
+        buf = self._buf.getvalue()
+        # keep the reference for the replication hook: the store holds the
+        # same buffer, so this pins no extra memory
+        self.serialized = buf
+        memory_store.put_buffer(*self._key, buf)
         self.wire_bytes = memory_store.put_size(self.path)
         return self.wire_bytes
 
@@ -222,14 +239,72 @@ class ShuffleWriterExec(ExecutionPlan):
             self.shuffle_output_partitioning,
         )
 
-    def _use_memory(self, ctx: TaskContext) -> bool:
-        """Memory data plane: explicit config, or a mesh stage (gang or
-        ICI-exchanged repartition) whose output never belongs on disk."""
+    def _store_kind(self, policy) -> str:
+        """Resolve the shuffle store for this write: a mesh stage (gang
+        or ICI-exchanged repartition) always stays in memory — its output
+        never belongs on disk — otherwise ``ballista.shuffle.store``
+        (with the legacy ``shuffle.to_memory`` folded in by
+        WritePolicy.from_config)."""
         from ..parallel.mesh_stage import MeshGangExec, MeshRepartitionExec
 
-        return ctx.config.shuffle_to_memory or isinstance(
-            self.input, (MeshGangExec, MeshRepartitionExec)
-        )
+        if isinstance(self.input, (MeshGangExec, MeshRepartitionExec)):
+            return "mem"
+        return policy.store
+
+    def _stage_base_dir(self, kind: str, policy) -> str:
+        """Root under which this stage's partition files land: the shared
+        external store when it IS the primary, the executor work_dir
+        otherwise."""
+        return policy.external_path if kind == "external" else self.work_dir
+
+    def _replicate_hook(self):
+        """Post-close replication hook for sinks (None when replication
+        is off).  Runs on writer-pool threads (pipelined path) or inline
+        (legacy path); NEVER raises — a failed upload degrades to a
+        single copy and the task still completes (the recompute path of
+        PR 5 covers a later loss)."""
+        policy = self._policy(None)
+        if not policy.replicate:
+            return None
+        from . import store as shuffle_store
+
+        sync = policy.replication == "sync"
+
+        def replicate(sink) -> None:
+            try:
+                if sink is None or getattr(sink, "wire_bytes", None) is None:
+                    return  # never closed: nothing durable to copy
+                dest = shuffle_store.external_replica_path(
+                    policy.external_path, sink.path
+                )
+                if dest is None:
+                    return
+                buf = getattr(sink, "serialized", None)
+                if sync:
+                    if buf is not None:
+                        shuffle_store.upload_buffer(buf, dest)
+                    else:
+                        shuffle_store.upload_file(sink.path, dest)
+                elif buf is not None:
+                    shuffle_store.replicator().submit_buffer(buf, dest)
+                else:
+                    shuffle_store.replicator().submit_file(sink.path, dest)
+                # async reports the destination optimistically: a failed
+                # background upload leaves a dangling replica_path, which
+                # the fetch failover treats as one more miss before the
+                # recompute path fires
+                sink.replica_path = dest
+                self.metrics.add("replicas_written", 1)
+            except Exception as e:  # noqa: BLE001 - degrade to single copy
+                shuffle_store.count_upload_failure()
+                self.metrics.add("replica_upload_failures", 1)
+                log.warning(
+                    "replica upload of %s failed (single copy only): %s",
+                    getattr(sink, "path", sink),
+                    e,
+                )
+
+        return replicate
 
     def _dir_memo(self):
         """Memoized mkdir for this write task: one ``os.makedirs`` per
@@ -297,10 +372,13 @@ class ShuffleWriterExec(ExecutionPlan):
         slab-buffered async writer pool (``shuffle/writer.py``); the
         pre-pipelining synchronous path stays callable via
         ``ballista.shuffle.write_pipelined=false`` (A/B baseline)."""
-        stage_dir = os.path.join(self.work_dir, self.job_id, str(self.stage_id))
         part = self.shuffle_output_partitioning
-        to_mem = self._use_memory(ctx)
         policy = self._policy(ctx)
+        kind = self._store_kind(policy)
+        to_mem = kind == "mem"
+        stage_dir = os.path.join(
+            self._stage_base_dir(kind, policy), self.job_id, str(self.stage_id)
+        )
 
         if part is None:
             return self._single_sink_write(
@@ -368,6 +446,7 @@ class ShuffleWriterExec(ExecutionPlan):
                 self._policy(None),
                 self.metrics,
                 cancel_event=ctx.cancel_event,
+                replicate_fn=self._replicate_hook(),
             )
             try:
                 for batch in self.input.execute(input_partition, ctx):
@@ -382,9 +461,11 @@ class ShuffleWriterExec(ExecutionPlan):
                 ShuffleWritePartition(
                     input_partition, sink.path, sink.num_batches,
                     sink.num_rows, sink.wire_bytes,
+                    replica_path=sink.replica_path,
                 )
             ]
         sink = None
+        replicate = self._replicate_hook()
         with self.metrics.timer("write_time_ns"):
             for batch in self.input.execute(input_partition, ctx):
                 ctx.check_cancelled()
@@ -400,11 +481,13 @@ class ShuffleWriterExec(ExecutionPlan):
                     self.input.schema, True,
                 )
             nbytes = sink.close()
+        if replicate is not None:
+            replicate(sink)
         self.metrics.add("output_rows", sink.num_rows)
         return [
             ShuffleWritePartition(
                 input_partition, sink.path, sink.num_batches, sink.num_rows,
-                nbytes,
+                nbytes, replica_path=sink.replica_path,
             )
         ]
 
@@ -428,6 +511,7 @@ class ShuffleWriterExec(ExecutionPlan):
             self._policy(None),
             self.metrics,
             cancel_event=ctx.cancel_event,
+            replicate_fn=self._replicate_hook(),
         )
         try:
             for batch in batch_iter:
@@ -484,7 +568,8 @@ class ShuffleWriterExec(ExecutionPlan):
             self.metrics.add("output_rows", s.num_rows)
             out.append(
                 ShuffleWritePartition(
-                    p, s.path, s.num_batches, s.num_rows, s.wire_bytes
+                    p, s.path, s.num_batches, s.num_rows, s.wire_bytes,
+                    replica_path=s.replica_path,
                 )
             )
         return out
@@ -526,6 +611,7 @@ class ShuffleWriterExec(ExecutionPlan):
         from ..serde.scheduler_types import ShuffleWritePartition
 
         out = []
+        replicate = self._replicate_hook()
         with self.metrics.timer("write_time_ns"):
             for p in range(len(sinks)):
                 s = sinks[p]
@@ -534,10 +620,13 @@ class ShuffleWriterExec(ExecutionPlan):
                         to_mem, stage_dir, p, in_part, in_schema, False
                     )
                 nbytes = s.close()
+                if replicate is not None:
+                    replicate(s)
                 self.metrics.add("output_rows", s.num_rows)
                 out.append(
                     ShuffleWritePartition(
-                        p, s.path, s.num_batches, s.num_rows, nbytes
+                        p, s.path, s.num_batches, s.num_rows, nbytes,
+                        replica_path=s.replica_path,
                     )
                 )
         return out
@@ -553,7 +642,7 @@ class ShuffleWriterExec(ExecutionPlan):
         assert input_partition == 0, "mesh-exchanged stages are single-task"
         from .writer import AsyncShuffleWriter
 
-        to_mem = self._use_memory(ctx)
+        to_mem = self._store_kind(self._policy(None)) == "mem"
         if not self._policy(None).pipelined:
             # the A/B baseline flag pins the pre-pipelining behavior on
             # EVERY write shape, this one included
@@ -575,6 +664,7 @@ class ShuffleWriterExec(ExecutionPlan):
             self._policy(None),
             self.metrics,
             cancel_event=ctx.cancel_event,
+            replicate_fn=self._replicate_hook(),
         )
         try:
             for out_p, batch in self.input.execute_exchanged(ctx):
@@ -593,10 +683,10 @@ class ShuffleWriterExec(ExecutionPlan):
         partition inside this one task (still correct, no collective).
 
         Sinks follow the EXPLICIT config only — the mesh-input heuristic
-        of _use_memory must not apply here, or a shuffle that fell back
+        of _store_kind must not apply here, or a shuffle that fell back
         precisely because it exceeded the row ceiling would be buffered
         whole in executor memory anyway."""
-        to_mem = ctx.config.shuffle_to_memory
+        to_mem = self._policy(None).store == "mem"
         inner = self.input.children()[0]
 
         if self._policy(None).pipelined:

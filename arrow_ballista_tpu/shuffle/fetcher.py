@@ -65,6 +65,7 @@ class _TeeMetrics:
         "locations_fetched": "shuffle_locations_fetched_total",
         "fetch_queue_full_ns": "shuffle_fetch_queue_full_ns_total",
         "fetch_wait_time_ns": "shuffle_fetch_wait_ns_total",
+        "replica_fetches": "shuffle_replica_fetches_total",
     }
     _counters: dict = {}
     _counters_lock = threading.Lock()
@@ -143,11 +144,17 @@ class FetchPolicy:
 
 
 def fetch_location(loc) -> Iterator[pa.RecordBatch]:
-    """Stream one map-side partition: memory-store fast path, local IPC
-    file, Arrow Flight otherwise — the single source-dispatch behind
-    every shuffle read."""
-    from . import memory_store
+    """Stream one map-side partition: external store, memory-store fast
+    path, local IPC file, Arrow Flight otherwise — the single
+    source-dispatch behind every shuffle read."""
+    from . import memory_store, store
 
+    if store.is_external_location(loc):
+        # external-store partition (replica failover or store=external):
+        # read the shared path directly; there is no Flight endpoint to
+        # fall back to, so a missing file fails fast into the retry loop
+        yield from store.read_batches(loc.path)
+        return
     if loc.path and loc.path.startswith(memory_store.SCHEME):
         hit = memory_store.get(loc.path)
         if hit is not None:
@@ -197,6 +204,37 @@ def fetch_location(loc) -> Iterator[pa.RecordBatch]:
         )
 
 
+def fetch_candidates(loc) -> list:
+    """Every known copy of one map-side partition, in preference order:
+    the executor-served primary first, the external-store replica second.
+    The scheduler threads the full replica set through the location
+    itself (``PartitionLocation.replica_path``), so each candidate gets
+    an INDEPENDENT retry budget instead of the whole budget burning on a
+    dead primary while a live copy waits."""
+    candidates = [loc]
+    replica = getattr(loc, "replica_path", "")
+    if replica and replica != getattr(loc, "path", ""):
+        candidates.append(_ReplicaCandidate(loc, replica))
+    return candidates
+
+
+class _ReplicaCandidate:
+    """External-store copy of a location: duck-types the
+    PartitionLocation surface the fetch path reads (path / executor_meta
+    / partition_id) without requiring the caller's location to be the
+    real dataclass — test doubles ride through unchanged."""
+
+    __slots__ = ("partition_id", "executor_meta", "path", "replica_path")
+
+    def __init__(self, loc, replica_path: str):
+        from .store import EXTERNAL_EXECUTOR
+
+        self.partition_id = getattr(loc, "partition_id", None)
+        self.executor_meta = EXTERNAL_EXECUTOR
+        self.path = replica_path
+        self.replica_path = ""
+
+
 def retrying_fetch(
     loc,
     policy: FetchPolicy,
@@ -204,55 +242,76 @@ def retrying_fetch(
     fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
     stop_event: Optional[threading.Event] = None,
 ) -> Iterator[pa.RecordBatch]:
-    """Stream one location with retry + exponential backoff.
+    """Stream one location with retry + exponential backoff and replica
+    failover.
 
-    A retry after a mid-stream failure skips the batches already
-    delivered (per location the serving order is deterministic: IPC file
-    order), so failures never duplicate rows.  Every fetch worker routes
-    through this — ``fetch_retries`` applies at any concurrency.
-    ``stop_event`` cuts a backoff wait short (the original error
+    Candidates (executor-served primary, then the external-store replica
+    when the location names one) each get an INDEPENDENT
+    ``fetch_retries`` budget; only when every copy is exhausted does the
+    structured :class:`ShuffleFetchFailed` surface.  A retry or failover
+    after a mid-stream failure skips the batches already delivered (per
+    partition the serving order is deterministic: IPC file order — the
+    replica is a byte copy of the primary), so failures never duplicate
+    rows.  ``stop_event`` cuts a backoff wait short (the original error
     re-raises).
     """
+    from ..errors import Cancelled
     from ..testing.faults import fault_point
 
     fetch = fetch_fn or fetch_location
-    attempt = 0
     delivered = 0
-    while True:
-        try:
-            fault_point(
-                "shuffle.fetch",
-                path=getattr(loc, "path", ""),
-                attempt=attempt,
-            )
-            skip = delivered
-            for batch in fetch(loc):
-                if skip > 0:
-                    skip -= 1
-                    continue
-                yield batch
-                delivered += 1
-            return
-        except Exception as e:
-            attempt += 1
-            if attempt > policy.retries:
-                raise _exhausted(loc, e) from e
-            metrics.add("fetch_retries", 1)
-            delay = policy.backoff_s * (2 ** (attempt - 1))
-            log.warning(
-                "shuffle fetch of %s failed (attempt %d/%d): %s; "
-                "retrying in %.0fms",
-                getattr(loc, "path", loc),
-                attempt,
-                policy.retries,
-                e,
-                delay * 1e3,
-            )
-            if stop_event is not None:
-                if stop_event.wait(delay):
+    last_error: Optional[BaseException] = None
+    candidates = fetch_candidates(loc)
+    for ci, cand in enumerate(candidates):
+        attempt = 0
+        while True:
+            try:
+                fault_point(
+                    "shuffle.fetch",
+                    path=getattr(cand, "path", ""),
+                    attempt=attempt,
+                )
+                skip = delivered
+                for batch in fetch(cand):
+                    if skip > 0:
+                        skip -= 1
+                        continue
+                    yield batch
+                    delivered += 1
+                if ci > 0:
+                    metrics.add("replica_fetches", 1)
+                return
+            except Exception as e:
+                if isinstance(e, Cancelled):
                     raise
-            else:
-                time.sleep(delay)
+                last_error = e
+                attempt += 1
+                if attempt > policy.retries:
+                    break  # this copy is spent: fail over to the next
+                metrics.add("fetch_retries", 1)
+                delay = policy.backoff_s * (2 ** (attempt - 1))
+                log.warning(
+                    "shuffle fetch of %s failed (attempt %d/%d): %s; "
+                    "retrying in %.0fms",
+                    getattr(cand, "path", cand),
+                    attempt,
+                    policy.retries,
+                    e,
+                    delay * 1e3,
+                )
+                if stop_event is not None:
+                    if stop_event.wait(delay):
+                        raise
+                else:
+                    time.sleep(delay)
+        if ci + 1 < len(candidates):
+            log.warning(
+                "shuffle fetch of %s exhausted its budget; failing over "
+                "to replica %s",
+                getattr(cand, "path", cand),
+                getattr(candidates[ci + 1], "path", ""),
+            )
+    raise _exhausted(loc, last_error) from last_error
 
 
 def _exhausted(loc, error: BaseException) -> BaseException:

@@ -202,6 +202,15 @@ def job_ids() -> List[str]:
         return sorted({k[0] for k in _store})
 
 
+def job_entries(job_id: str) -> List[Tuple[str, pa.Buffer]]:
+    """(path, serialized IPC stream buffer) for every stored partition of
+    one job — the drain-time replica upload walks these."""
+    with _lock:
+        return [
+            (make_path(*k), buf) for k, buf in _store.items() if k[0] == job_id
+        ]
+
+
 def stored_bytes() -> int:
     with _lock:
         return sum(buf.size for buf in _store.values())

@@ -57,13 +57,29 @@ _WRITE_REGISTRY_NAMES = {
 
 @dataclass(frozen=True)
 class WritePolicy:
-    """Map-side write knobs (see ``ballista.shuffle.write_*``)."""
+    """Map-side write knobs (see ``ballista.shuffle.write_*`` and the
+    storage/replication knobs ``ballista.shuffle.{store,replication,
+    external_path}``)."""
 
     coalesce_rows: int = 32768
     queue_bytes: int = 32 << 20
     concurrency: int = 2
     compression: str = "none"
     pipelined: bool = True
+    store: str = "local"  # local | mem | external
+    replication: str = "none"  # none | async | sync
+    external_path: str = ""
+
+    @property
+    def replicate(self) -> bool:
+        """Upload a replica of each finished partition?  Only meaningful
+        for local/mem primaries — an external-store primary already
+        survives its producer."""
+        return (
+            self.replication != "none"
+            and bool(self.external_path)
+            and self.store != "external"
+        )
 
     @staticmethod
     def from_config(config) -> "WritePolicy":
@@ -75,12 +91,18 @@ class WritePolicy:
             # default batch size), and downstream readers see 4x fewer
             # fragments
             rows = 4 * config.batch_size
+        store = config.shuffle_store
+        if store == "local" and config.shuffle_to_memory:
+            store = "mem"  # back-compat spelling of the mem store
         return WritePolicy(
             coalesce_rows=rows,
             queue_bytes=config.shuffle_write_queue_bytes,
             concurrency=config.shuffle_write_concurrency,
             compression=config.shuffle_compression,
             pipelined=config.shuffle_write_pipelined,
+            store=store,
+            replication=config.shuffle_replication,
+            external_path=config.shuffle_external_path,
         )
 
 
@@ -194,10 +216,16 @@ class AsyncShuffleWriter:
         policy: WritePolicy,
         metrics,
         cancel_event: Optional[threading.Event] = None,
+        replicate_fn: Optional[Callable[[object], None]] = None,
     ) -> None:
         self._n_out = n_out
         self._sink_factory = sink_factory
         self._policy = policy
+        # replication hook: invoked on the WORKER thread right after a
+        # sink closes (the partition's bytes are final) — uploads the
+        # external-store replica off the compute thread.  Must never
+        # raise (a failed upload degrades to single copy).
+        self._replicate_fn = replicate_fn
         self._metrics = _TeeMetrics(metrics, _WRITE_REGISTRY_NAMES)
         self._cancel = cancel_event
         self._slabs: List[list] = [[] for _ in range(n_out)]
@@ -422,6 +450,8 @@ class AsyncShuffleWriter:
                 s = self._sinks[p]
                 if s is not None:
                     self._metrics.add("bytes_written_wire", s.close())
+                    if self._replicate_fn is not None:
+                        self._replicate_fn(s)
             self._metrics.add("write_time_ns", time.monotonic_ns() - t0)
         except _Closed:
             # teardown (error elsewhere, abort or cancel): leave this

@@ -4,18 +4,26 @@
 # hide the rest).  Prints DOTS_PASSED= the count of passing tests and
 # exits with pytest's status.
 #
-# Usage: dev/tier1.sh [--bench-smoke] [extra pytest args...]
+# Usage: dev/tier1.sh [--bench-smoke] [--chaos-smoke] [extra pytest args...]
 #   --bench-smoke  additionally run the shuffle write/fetch micro-benches
 #                  on tiny inputs after the tests — a compile/regression
 #                  smoke for the benchmark harnesses themselves, NOT a
 #                  measurement and NOT part of default tier-1.
+#   --chaos-smoke  additionally run the bounded random-kill soak (pytest
+#                  -m chaos): executors are drained/killed at random
+#                  during small queries, which must still complete with
+#                  correct results.  Seeded via BALLISTA_CHAOS_SEED.
 set -o pipefail
 cd "$(dirname "$0")/.."
 BENCH_SMOKE=0
-if [ "$1" = "--bench-smoke" ]; then
-  BENCH_SMOKE=1
-  shift
-fi
+CHAOS_SMOKE=0
+while :; do
+  case "$1" in
+    --bench-smoke) BENCH_SMOKE=1; shift ;;
+    --chaos-smoke) CHAOS_SMOKE=1; shift ;;
+    *) break ;;
+  esac
+done
 # proto drift gate: a NEW_FIELDS edit without regeneration (or a
 # generated field missing from ballista.proto) fails fast, before tests
 timeout -k 10 60 env JAX_PLATFORMS=cpu python dev/regen_proto.py --check || exit 1
@@ -41,5 +49,12 @@ print(json.dumps({"bench_smoke": "shuffle_write",
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+fi
+if [ "$CHAOS_SMOKE" = "1" ]; then
+  echo "--- chaos smoke (bounded random kill/drain soak) ---"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+  chaos_rc=$?
+  [ $rc -eq 0 ] && rc=$chaos_rc
 fi
 exit $rc

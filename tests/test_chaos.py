@@ -1,0 +1,154 @@
+"""Bounded random-kill/drain soak (``dev/tier1.sh --chaos-smoke``).
+
+A small aggregate query runs repeatedly on a 2-executor push-mode
+cluster while a chaos loop randomly drains or hard-kills an executor
+mid-flight and immediately starts a replacement.  With async replication
+to the external store, every query must still complete with
+multiset-identical results — via replica fetch, drain handoff, or (for
+un-replicated losses) the bounded recompute path.
+
+Seeded via ``BALLISTA_CHAOS_SEED`` (default 7) so a failure reproduces.
+Marked ``chaos`` + ``slow``: excluded from default tier-1, run by
+``dev/tier1.sh --chaos-smoke``.
+"""
+
+import os
+import random
+import shutil
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.context import SessionContext
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+CPU_CONFIG = {
+    "ballista.tpu.enable": "false",
+    "ballista.mesh.enable": "false",
+    "ballista.shuffle.partitions": "2",
+}
+
+
+def _rows(table: pa.Table):
+    cols = sorted(table.column_names)
+    d = table.to_pydict()
+    return sorted(zip(*(d[c] for c in cols)))
+
+
+def test_random_kill_drain_soak(tmp_path):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.executor.standalone import new_standalone_executor
+    from arrow_ballista_tpu.scheduler.standalone import new_standalone_scheduler
+
+    rng = random.Random(int(os.environ.get("BALLISTA_CHAOS_SEED", "7")))
+    table = pa.table(
+        {
+            "g": pa.array([f"g{i % 11}" for i in range(2000)]),
+            "v": pa.array([float(i % 211) for i in range(2000)]),
+        }
+    )
+    parquet = str(tmp_path / "sales.parquet")
+    pq.write_table(table, parquet)
+    sql = "SELECT g, SUM(v) AS s, COUNT(v) AS n FROM sales GROUP BY g"
+    local = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    local.register_parquet("sales", parquet)
+    expected = _rows(local.sql(sql).collect())
+
+    ext = str(tmp_path / "ext")
+    config = dict(CPU_CONFIG)
+    config.update(
+        {
+            "ballista.shuffle.replication": "async",
+            "ballista.shuffle.external_path": ext,
+            "ballista.shuffle.fetch_retries": "2",
+            "ballista.shuffle.fetch_backoff_ms": "25",
+            # chaos kills mid-task: keep the retry/rollback budgets real
+            # but the cadence fast
+            "ballista.client.job_timeout_seconds": "120",
+        }
+    )
+    scheduler = new_standalone_scheduler(
+        policy=TaskSchedulingPolicy.PUSH_STAGED,
+        liveness_window_s=2.0,
+        executor_timeout_s=2.0,
+    )
+    scheduler.server.reaper_interval_s = 0.5
+    scheduler.server.drain_timeout_s = 5.0
+
+    executors = []
+    spawned = [0]
+
+    def spawn():
+        spawned[0] += 1
+        e = new_standalone_executor(
+            scheduler.host,
+            scheduler.port,
+            concurrent_tasks=2,
+            work_dir=str(tmp_path / f"exec-{spawned[0]}"),
+            policy=TaskSchedulingPolicy.PUSH_STAGED,
+        )
+        executors.append(e)
+        return e
+
+    spawn()
+    spawn()
+    ctx = BallistaContext(scheduler.host, scheduler.port, BallistaConfig(config))
+    ctx.register_parquet("sales", parquet)
+
+    try:
+        for round_i in range(3):
+            result = {}
+
+            def run():
+                try:
+                    result["table"] = ctx.sql(sql).collect()
+                except Exception as e:  # noqa: BLE001
+                    result["error"] = e
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            # strike while the query is in flight
+            time.sleep(rng.uniform(0.1, 0.6))
+            alive = [e for e in executors if e is not None]
+            victim_i = executors.index(rng.choice(alive))
+            victim = executors[victim_i]
+            executors[victim_i] = None
+            action = rng.choice(["drain", "kill"])
+            if action == "drain":
+                scheduler.server.decommission_executor(
+                    victim.executor.id, timeout_s=5.0
+                )
+                # the replacement registers while the victim drains
+                spawn()
+                deadline = time.monotonic() + 20
+                em = scheduler.server.state.executor_manager
+                while (
+                    time.monotonic() < deadline
+                    and em.is_draining(victim.executor.id)
+                ):
+                    time.sleep(0.1)
+                victim.shutdown()
+            else:
+                work_dir = victim.executor.work_dir
+                victim.shutdown()
+                shutil.rmtree(work_dir, ignore_errors=True)
+                spawn()
+            t.join(120)
+            assert not t.is_alive(), f"round {round_i}: query hung ({action})"
+            assert "error" not in result, (
+                f"round {round_i} ({action}): {result.get('error')}"
+            )
+            assert _rows(result["table"]) == expected, (
+                f"round {round_i} ({action}): wrong results"
+            )
+    finally:
+        ctx.close()
+        for e in executors:
+            if e is not None:
+                e.shutdown()
+        scheduler.shutdown()

@@ -1,0 +1,1078 @@
+"""Replicated, pluggable shuffle storage + graceful executor decommission
+(ISSUE 6).
+
+Unit tests pin the store mapping, the per-candidate fetch budgets
+(satellite: ``retrying_fetch`` no longer burns its whole budget on one
+copy), upload-failure degradation and the graph's repoint-at-executor-
+loss machinery.  End-to-end tests run real standalone clusters: killing
+the map-side executor after its stage completes must finish the query
+via replica fetch with ZERO producer re-runs (``replication=async``) or
+via the PR 5 recompute path (``replication=none``); a graceful
+decommission mid-query must complete with zero recompute and the drain
+counters visible in /api/metrics.
+"""
+
+import glob
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.request
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.context import SessionContext
+from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+from arrow_ballista_tpu.scheduler.execution_stage import (
+    CompletedStage,
+    RunningStage,
+    TaskInfo,
+    UnresolvedStage,
+)
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    PartitionId,
+    PartitionLocation,
+    PartitionStats,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.shuffle import store as shuffle_store
+from arrow_ballista_tpu.shuffle.fetcher import FetchPolicy, retrying_fetch
+from arrow_ballista_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052)
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052)
+
+CPU_CONFIG = {
+    "ballista.tpu.enable": "false",
+    "ballista.mesh.enable": "false",
+    "ballista.shuffle.partitions": "2",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def sales_parquet(tmp_path):
+    table = pa.table(
+        {
+            "g": pa.array([f"g{i % 7}" for i in range(400)]),
+            "v": pa.array([float(i % 113) for i in range(400)]),
+        }
+    )
+    path = str(tmp_path / "sales.parquet")
+    pq.write_table(table, path)
+    return path
+
+
+def _rows(table: pa.Table):
+    cols = sorted(table.column_names)
+    d = table.to_pydict()
+    return sorted(zip(*(d[c] for c in cols)))
+
+
+def _batch(n=8):
+    return pa.record_batch({"x": pa.array(list(range(n)), pa.int64())})
+
+
+class _Metrics:
+    def __init__(self):
+        self.values = {}
+
+    def add(self, name, v):
+        self.values[name] = self.values.get(name, 0) + v
+
+
+# =====================================================================
+# 1. store mapping + upload/read roundtrips
+# =====================================================================
+def test_replica_path_mapping_is_deterministic():
+    assert shuffle_store.external_replica_path(
+        "/ext", "/work/jobA/3/1/data-0.arrow"
+    ) == os.path.join("/ext", "jobA", "3", "1", "data-0.arrow")
+    assert shuffle_store.external_replica_path(
+        "/ext", "mem://jobA/3/1/0"
+    ) == os.path.join("/ext", "jobA", "3", "1", "mem-0.arrow")
+    assert shuffle_store.external_replica_path("/ext", "short/path") is None
+    assert shuffle_store.external_replica_path("", "/work/j/1/0/d.arrow") is None
+
+
+def test_upload_file_and_read_roundtrip(tmp_path):
+    batch = _batch()
+    src = str(tmp_path / "data-0.arrow")
+    with pa.OSFile(src, "wb") as f, pa.ipc.new_file(f, batch.schema) as w:
+        w.write_batch(batch)
+    dest = str(tmp_path / "ext" / "j" / "1" / "0" / "data-0.arrow")
+    shuffle_store.upload_file(src, dest)
+    out = list(shuffle_store.read_batches(dest))
+    assert len(out) == 1 and out[0].equals(batch)
+
+
+def test_upload_buffer_reads_back_as_stream(tmp_path):
+    batch = _batch()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    dest = str(tmp_path / "ext" / "j" / "1" / "0" / "mem-0.arrow")
+    shuffle_store.upload_buffer(sink.getvalue(), dest)
+    out = list(shuffle_store.read_batches(dest))
+    assert len(out) == 1 and out[0].equals(batch)
+
+
+def test_download_fault_point_fires(tmp_path):
+    dest = str(tmp_path / "r.arrow")
+    batch = _batch()
+    with pa.OSFile(dest, "wb") as f, pa.ipc.new_file(f, batch.schema) as w:
+        w.write_batch(batch)
+    with faults.inject("shuffle.store.download", times=1):
+        with pytest.raises(Exception, match="fault injected"):
+            list(shuffle_store.read_batches(dest))
+    assert len(list(shuffle_store.read_batches(dest))) == 1
+
+
+# =====================================================================
+# 2. per-candidate fetch budgets + replica failover (satellite 1)
+# =====================================================================
+def _loc(path, replica_path="", meta=EXEC1):
+    return PartitionLocation(
+        PartitionId("job", 1, 0), meta, PartitionStats(8, 1, 64), path,
+        replica_path=replica_path,
+    )
+
+
+def test_retrying_fetch_fails_over_with_independent_budgets():
+    """The primary burns ITS budget; the replica then serves with a fresh
+    one — previously the whole budget died on the first copy."""
+    calls = {"primary": 0, "replica": 0}
+
+    def fetch_fn(loc):
+        if loc.path == "/primary":
+            calls["primary"] += 1
+            raise OSError("primary executor is gone")
+        calls["replica"] += 1
+        if calls["replica"] == 1:
+            raise OSError("replica hiccup")  # its own budget absorbs this
+        yield _batch()
+
+    m = _Metrics()
+    policy = FetchPolicy(retries=2, backoff_s=0.001)
+    out = list(
+        retrying_fetch(
+            _loc("/primary", replica_path="/replica"), policy, m,
+            fetch_fn=fetch_fn,
+        )
+    )
+    assert len(out) == 1
+    assert calls["primary"] == 3  # 1 + retries: the primary's own budget
+    assert calls["replica"] == 2  # failed once INSIDE a fresh budget
+    assert m.values["fetch_retries"] == 3  # 2 primary + 1 replica
+    assert m.values["replica_fetches"] == 1
+
+
+def test_retrying_fetch_resumes_across_failover_without_duplicates():
+    """A mid-stream primary death resumes on the replica at the right
+    offset (the replica is a byte copy: same batch order)."""
+    batches = [_batch(4), _batch(5), _batch(6)]
+
+    def fetch_fn(loc):
+        if loc.path == "/primary":
+            yield batches[0]
+            raise OSError("died mid-stream")
+        yield from batches
+
+    m = _Metrics()
+    policy = FetchPolicy(retries=0, backoff_s=0.001)
+    out = list(
+        retrying_fetch(
+            _loc("/primary", replica_path="/replica"), policy, m,
+            fetch_fn=fetch_fn,
+        )
+    )
+    assert [b.num_rows for b in out] == [4, 5, 6]
+
+
+def test_retrying_fetch_exhausting_every_copy_is_structured():
+    from arrow_ballista_tpu.errors import ShuffleFetchFailed
+
+    def fetch_fn(loc):
+        raise OSError("all gone")
+        yield  # pragma: no cover
+
+    m = _Metrics()
+    with pytest.raises(ShuffleFetchFailed, match="stage=1 partition=0"):
+        list(
+            retrying_fetch(
+                _loc("/primary", replica_path="/replica"),
+                FetchPolicy(retries=1, backoff_s=0.001), m, fetch_fn=fetch_fn,
+            )
+        )
+
+
+def test_external_location_reads_store_directly(tmp_path):
+    """A location stamped with the external sentinel reads the shared
+    path (download fault point armed) and never dials Flight."""
+    from arrow_ballista_tpu.shuffle.fetcher import fetch_location
+
+    batch = _batch()
+    dest = str(tmp_path / "j" / "1" / "0" / "data-0.arrow")
+    os.makedirs(os.path.dirname(dest))
+    with pa.OSFile(dest, "wb") as f, pa.ipc.new_file(f, batch.schema) as w:
+        w.write_batch(batch)
+    loc = _loc(dest, meta=shuffle_store.EXTERNAL_EXECUTOR)
+    assert list(fetch_location(loc))[0].equals(batch)
+    missing = _loc(str(tmp_path / "nope.arrow"), meta=shuffle_store.EXTERNAL_EXECUTOR)
+    with pytest.raises(FileNotFoundError):
+        list(fetch_location(missing))
+
+
+# =====================================================================
+# 3. write-side replication: sync/async, upload-failure degradation
+# =====================================================================
+def _write_task(tmp_path, extra_config, in_rows=64):
+    """Run one real ShuffleWriterExec hash-write task; returns its
+    ShuffleWritePartition stats and the writer (for metrics)."""
+    from arrow_ballista_tpu.exec.operators import TaskContext
+    from arrow_ballista_tpu.shuffle.execution_plans import ShuffleWriterExec
+
+    config = BallistaConfig(dict(CPU_CONFIG, **extra_config))
+    ctx = SessionContext(config)
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array([f"g{i % 5}" for i in range(in_rows)]),
+                "v": pa.array([float(i) for i in range(in_rows)]),
+            }
+        ),
+    )
+    df = ctx.sql("select g, v from t")
+    plan = PhysicalPlanner(config).create_physical_plan(df.optimized_plan())
+    from arrow_ballista_tpu.exec.expressions import Col
+    from arrow_ballista_tpu.exec.operators import Partitioning
+
+    writer = ShuffleWriterExec(
+        "jobw", 1, plan, str(tmp_path / "work"),
+        Partitioning.hash((Col(0, "g"),), 2),
+    )
+    tctx = TaskContext(
+        session_id="s", config=config, work_dir=str(tmp_path / "work"),
+        job_id="jobw", stage_id=1,
+    )
+    stats = writer.execute_shuffle_write(0, tctx)
+    return stats, writer
+
+
+@pytest.mark.parametrize("mode", ["sync", "async"])
+def test_replication_uploads_and_stats_carry_replica_path(tmp_path, mode):
+    ext = str(tmp_path / "ext")
+    stats, writer = _write_task(
+        tmp_path,
+        {
+            "ballista.shuffle.replication": mode,
+            "ballista.shuffle.external_path": ext,
+        },
+    )
+    assert len(stats) == 2
+    for s in stats:
+        assert s.replica_path == shuffle_store.external_replica_path(ext, s.path)
+    if mode == "async":
+        assert shuffle_store.replicator().flush(timeout=10)
+    for s in stats:
+        assert os.path.exists(s.replica_path)
+        # the replica serves the same rows as the primary
+        replica_rows = sum(b.num_rows for b in shuffle_store.read_batches(s.replica_path))
+        assert replica_rows == s.num_rows
+    assert writer.metrics.to_dict().get("replicas_written") == 2
+
+
+def test_sync_upload_failure_degrades_to_single_copy(tmp_path):
+    """Satellite: a replica-upload failure must degrade, never fail the
+    task — stats report a single copy and the failure is counted."""
+    ext = str(tmp_path / "ext")
+    faults.arm("shuffle.store.upload", times=-1)
+    stats, writer = _write_task(
+        tmp_path,
+        {
+            "ballista.shuffle.replication": "sync",
+            "ballista.shuffle.external_path": ext,
+        },
+    )
+    assert len(stats) == 2  # the task completed
+    assert all(s.replica_path == "" for s in stats)
+    assert writer.metrics.to_dict().get("replica_upload_failures") == 2
+    assert faults.hits("shuffle.store.upload") == 2
+
+
+def test_external_store_is_the_primary(tmp_path):
+    """store=external writes partitions straight into the shared
+    directory: they survive the producer with no replication at all."""
+    ext = str(tmp_path / "ext")
+    stats, _writer = _write_task(
+        tmp_path,
+        {
+            "ballista.shuffle.store": "external",
+            "ballista.shuffle.external_path": ext,
+        },
+    )
+    for s in stats:
+        assert s.path.startswith(ext) and os.path.exists(s.path)
+        assert s.replica_path == ""  # the primary IS the surviving copy
+
+
+# =====================================================================
+# 4. graph: repoint-at-executor-loss instead of recompute
+# =====================================================================
+def make_graph(tmp_path, job_id="job-store", external=True):
+    config_d = dict(CPU_CONFIG)
+    if external:
+        config_d["ballista.shuffle.external_path"] = str(tmp_path / "ext")
+    config = BallistaConfig(config_d)
+    ctx = SessionContext(config)
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a", "c"], pa.string()),
+                "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+            }
+        ),
+        partitions=2,
+    )
+    df = ctx.sql("select g, sum(v) as s from t group by g")
+    plan = PhysicalPlanner(ctx.config).create_physical_plan(df.optimized_plan())
+    graph = ExecutionGraph(
+        "sched-1", job_id, ctx.session_id, plan, config=config
+    )
+    graph.revive()
+    return graph
+
+
+def _complete_map_stage(graph, executor_meta, replica_dir=None, tmp_path=None):
+    """Run the MAP stage's tasks to completion on ``executor_meta`` (the
+    consumer stage stays Running with nothing dispatched); each written
+    partition optionally gets a real replica file."""
+    while not isinstance(graph.stages[1], CompletedStage):
+        task = graph.pop_next_task(executor_meta.id)
+        if task is None:
+            break
+        n_out = task.output_partitioning.n if task.output_partitioning else 1
+        parts = []
+        for p in range(n_out):
+            path = str(
+                tmp_path / "work" / task.partition.job_id
+                / str(task.partition.stage_id) / str(p)
+                / f"data-{task.partition.partition_id}.arrow"
+            )
+            replica = ""
+            if replica_dir is not None:
+                replica = shuffle_store.external_replica_path(
+                    str(replica_dir), path
+                )
+                os.makedirs(os.path.dirname(replica), exist_ok=True)
+                batch = _batch()
+                with pa.OSFile(replica, "wb") as f, pa.ipc.new_file(
+                    f, batch.schema
+                ) as w:
+                    w.write_batch(batch)
+            parts.append(
+                ShuffleWritePartition(p, path, 1, 8, 64, replica_path=replica)
+            )
+        graph.update_task_status(
+            TaskInfo(
+                task.partition, "completed", executor_meta.id,
+                partitions=parts, attempt=task.attempt,
+            ),
+            executor_meta,
+        )
+
+
+def test_executor_loss_repoints_replicated_locations_zero_recompute(tmp_path):
+    graph = make_graph(tmp_path)
+    _complete_map_stage(graph, EXEC1, replica_dir=tmp_path / "ext", tmp_path=tmp_path)
+    map_sid = min(
+        sid for sid, s in graph.stages.items() if isinstance(s, CompletedStage)
+    )
+    assert graph.reset_stages("exec-1") > 0
+    # the producer did NOT re-run: its stage is still Completed and the
+    # reset ledger never charged it
+    assert isinstance(graph.stages[map_sid], CompletedStage)
+    assert map_sid not in graph.stage_reset_counts
+    # every consumer input location now points at the external store
+    for stage in graph.stages.values():
+        for inp in getattr(stage, "inputs", {}).values():
+            for locs in inp.partition_locations.values():
+                for loc in locs:
+                    assert loc.executor_meta.id == shuffle_store.EXTERNAL_EXECUTOR_ID
+                    assert os.path.exists(loc.path)
+
+
+def test_executor_loss_without_replicas_still_recomputes(tmp_path):
+    graph = make_graph(tmp_path, external=False)
+    _complete_map_stage(graph, EXEC1, replica_dir=None, tmp_path=tmp_path)
+    map_sid = min(
+        sid
+        for sid, s in graph.stages.items()
+        if isinstance(s, (CompletedStage, RunningStage))
+    )
+    assert graph.reset_stages("exec-1") > 0
+    # PR 5 behavior intact: the producer re-runs
+    assert isinstance(graph.stages[map_sid], RunningStage)
+    assert map_sid in graph.stage_reset_counts
+
+
+def test_drain_uploaded_partitions_are_probed_and_repointed(tmp_path):
+    """A drain-time upload registers NO replica_path — the scheduler
+    derives the external path and probes the shared store instead."""
+    graph = make_graph(tmp_path)
+    _complete_map_stage(graph, EXEC1, replica_dir=None, tmp_path=tmp_path)
+    # simulate the executor's drain upload: place files at the DERIVED
+    # external paths for every registered location
+    ext = str(tmp_path / "ext")
+    for stage in graph.stages.values():
+        for inp in getattr(stage, "inputs", {}).values():
+            for locs in inp.partition_locations.values():
+                for loc in locs:
+                    dest = shuffle_store.external_replica_path(ext, loc.path)
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    batch = _batch()
+                    with pa.OSFile(dest, "wb") as f, pa.ipc.new_file(
+                        f, batch.schema
+                    ) as w:
+                        w.write_batch(batch)
+    map_sid = min(
+        sid for sid, s in graph.stages.items() if isinstance(s, CompletedStage)
+    )
+    assert graph.reset_stages("exec-1") > 0
+    assert isinstance(graph.stages[map_sid], CompletedStage)
+    assert map_sid not in graph.stage_reset_counts
+    for stage in graph.stages.values():
+        for inp in getattr(stage, "inputs", {}).values():
+            for locs in inp.partition_locations.values():
+                for loc in locs:
+                    assert loc.executor_meta.id == shuffle_store.EXTERNAL_EXECUTOR_ID
+
+
+def test_is_under_root_requires_a_separator_boundary():
+    assert shuffle_store.is_under_root("/data/ext", "/data/ext/j/1/0/a.arrow")
+    assert shuffle_store.is_under_root("/data/ext/", "/data/ext/j/a.arrow")
+    # a sibling dir sharing the prefix is NOT inside the store
+    assert not shuffle_store.is_under_root("/data/ext", "/data/ext-work/j/a.arrow")
+    assert not shuffle_store.is_under_root("", "/data/ext/j/a.arrow")
+
+
+def test_replicator_flush_waits_for_in_flight_uploads(tmp_path):
+    """flush() must cover SUBMITTED uploads, not just an empty-looking
+    queue — a drain that exits early loses the replica with the process."""
+    batch = _batch()
+    src = str(tmp_path / "src.arrow")
+    with pa.OSFile(src, "wb") as f, pa.ipc.new_file(f, batch.schema) as w:
+        w.write_batch(batch)
+    dest = str(tmp_path / "ext" / "j" / "1" / "0" / "src.arrow")
+    faults.arm("shuffle.store.upload", times=1, action="delay", delay_ms=400)
+    rep = shuffle_store.replicator()
+    rep.submit_file(src, dest)
+    assert rep.flush(timeout=0.05) is False  # upload still in flight
+    assert rep.flush(timeout=10) is True
+    assert os.path.exists(dest)
+
+
+def test_dangling_async_replica_is_not_repointed(tmp_path):
+    """replication=async stamps replica_path optimistically; if the
+    background upload failed, executor loss must RECOMPUTE, not repoint
+    consumers at a path nobody can read."""
+    graph = make_graph(tmp_path)
+    # replica paths registered but never uploaded (no files on disk)
+    while not isinstance(graph.stages[1], CompletedStage):
+        task = graph.pop_next_task(EXEC1.id)
+        if task is None:
+            break
+        n_out = task.output_partitioning.n if task.output_partitioning else 1
+        parts = [
+            ShuffleWritePartition(
+                p,
+                f"/gone/{task.partition.partition_id}/{p}.arrow",
+                1, 8, 64,
+                replica_path=str(tmp_path / "ext" / "never-uploaded" / f"{p}.arrow"),
+            )
+            for p in range(n_out)
+        ]
+        graph.update_task_status(
+            TaskInfo(
+                task.partition, "completed", EXEC1.id,
+                partitions=parts, attempt=task.attempt,
+            ),
+            EXEC1,
+        )
+    map_sid = 1
+    assert graph.reset_stages("exec-1") > 0
+    assert isinstance(graph.stages[map_sid], RunningStage)  # recomputes
+    assert map_sid in graph.stage_reset_counts
+
+
+def test_lost_external_copy_reruns_the_producer(tmp_path):
+    """A repointed location whose external copy later vanishes must not
+    strand the consumer: ShuffleFetchFailed against the __external__
+    sentinel re-runs the producer's map tasks."""
+    from arrow_ballista_tpu.errors import ShuffleFetchFailed
+
+    graph = make_graph(tmp_path)
+    _complete_map_stage(graph, EXEC1, replica_dir=tmp_path / "ext", tmp_path=tmp_path)
+    assert graph.reset_stages("exec-1") > 0  # repointed at replicas
+    assert isinstance(graph.stages[1], CompletedStage)
+    # the external store loses the data; a consumer task fetch-fails
+    shutil.rmtree(str(tmp_path / "ext"), ignore_errors=True)
+    task = graph.pop_next_task(EXEC2.id)
+    assert task is not None and task.partition.stage_id == 2
+    err = ShuffleFetchFailed(
+        1, 0, shuffle_store.EXTERNAL_EXECUTOR_ID, detail="replica vanished"
+    )
+    graph.update_task_status(
+        TaskInfo(
+            task.partition, "failed", EXEC2.id,
+            error=f"ShuffleFetchFailed: {err}", attempt=task.attempt,
+        ),
+        EXEC2,
+    )
+    # every producer map task re-runs (the sentinel scopes no executor)
+    assert isinstance(graph.stages[1], RunningStage)
+    assert graph.stages[1].available_tasks() >= 1
+    assert graph.status != "failed"
+
+
+def _write_replica_file(path):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    batch = _batch()
+    with pa.OSFile(path, "wb") as f, pa.ipc.new_file(f, batch.schema) as w:
+        w.write_batch(batch)
+
+
+def test_partially_replicated_task_strips_instead_of_half_repointing(tmp_path):
+    """A map task with one replicated and one lost partition must RE-RUN
+    whole, with ALL its old locations stripped — a lingering repointed
+    sentinel copy plus the re-run's propagation would feed consumers the
+    same rows twice."""
+    graph = make_graph(tmp_path)
+    ext = tmp_path / "ext"
+    # complete the map stage: partition 0 of each task replicated, 1 not
+    while not isinstance(graph.stages[1], CompletedStage):
+        task = graph.pop_next_task(EXEC1.id)
+        if task is None:
+            break
+        n_out = task.output_partitioning.n if task.output_partitioning else 1
+        parts = []
+        for p in range(n_out):
+            path = str(
+                tmp_path / "work" / task.partition.job_id / "1" / str(p)
+                / f"data-{task.partition.partition_id}.arrow"
+            )
+            replica = ""
+            if p == 0:
+                replica = shuffle_store.external_replica_path(str(ext), path)
+                _write_replica_file(replica)
+            parts.append(
+                ShuffleWritePartition(p, path, 1, 8, 64, replica_path=replica)
+            )
+        graph.update_task_status(
+            TaskInfo(
+                task.partition, "completed", EXEC1.id,
+                partitions=parts, attempt=task.attempt,
+            ),
+            EXEC1,
+        )
+    assert graph.reset_stages("exec-1") > 0
+    # the producer re-runs (partition 1 has no copy)...
+    assert isinstance(graph.stages[1], RunningStage)
+    # ...and NO sentinel location lingers anywhere: the re-run is the
+    # single source of this task's data
+    for stage in graph.stages.values():
+        for inp in getattr(stage, "inputs", {}).values():
+            for locs in inp.partition_locations.values():
+                for loc in locs:
+                    assert loc.executor_meta.id != shuffle_store.EXTERNAL_EXECUTOR_ID
+                    assert loc.executor_meta.id != "exec-1"
+
+
+def test_running_stage_keeps_completed_replicated_tasks(tmp_path):
+    """Executor loss mid-stage: the lost executor's COMPLETED tasks with
+    surviving copies are kept (their locations repoint); only its
+    running task re-dispatches — a 90%-done stage re-runs nothing."""
+    graph = make_graph(tmp_path)
+    ext = tmp_path / "ext"
+    stage1 = graph.stages[1]
+    tasks = []
+    while True:
+        t = graph.pop_next_task(EXEC1.id)
+        if t is None or t.partition.stage_id != 1:
+            break
+        tasks.append(t)
+    assert len(tasks) >= 2
+    # complete all but the last, each fully replicated
+    for task in tasks[:-1]:
+        n_out = task.output_partitioning.n if task.output_partitioning else 1
+        parts = []
+        for p in range(n_out):
+            path = str(
+                tmp_path / "work" / task.partition.job_id / "1" / str(p)
+                / f"data-{task.partition.partition_id}.arrow"
+            )
+            replica = shuffle_store.external_replica_path(str(ext), path)
+            _write_replica_file(replica)
+            parts.append(
+                ShuffleWritePartition(p, path, 1, 8, 64, replica_path=replica)
+            )
+        graph.update_task_status(
+            TaskInfo(
+                task.partition, "completed", EXEC1.id,
+                partitions=parts, attempt=task.attempt,
+            ),
+            EXEC1,
+        )
+    stage1 = graph.stages[1]
+    assert isinstance(stage1, RunningStage)
+    done_before = stage1.completed_tasks()
+    assert done_before == len(tasks) - 1
+    assert graph.reset_stages("exec-1") > 0
+    stage1 = graph.stages[1]
+    assert isinstance(stage1, RunningStage)
+    # completed work survived; only the in-flight task re-dispatches
+    assert stage1.completed_tasks() == done_before
+    assert stage1.available_tasks() == 1
+
+
+def test_lost_external_copy_reruns_only_the_backing_tasks(tmp_path):
+    """External-store loss after a repoint re-runs only the map tasks
+    whose data rode the sentinel — a healthy executor's completed tasks
+    keep their statuses AND their consumer locations (re-running them
+    would re-propagate duplicates)."""
+    from arrow_ballista_tpu.errors import ShuffleFetchFailed
+
+    graph = make_graph(tmp_path)
+    ext = tmp_path / "ext"
+    # map task 0 on EXEC1 (replicated), map task 1 on EXEC2 (no replica)
+    owners = {0: (EXEC1, True), 1: (EXEC2, False)}
+    while not isinstance(graph.stages[1], CompletedStage):
+        task = (
+            graph.pop_next_task(EXEC1.id) or graph.pop_next_task(EXEC2.id)
+        )
+        if task is None:
+            break
+        meta, replicate = owners[task.partition.partition_id]
+        n_out = task.output_partitioning.n if task.output_partitioning else 1
+        parts = []
+        for p in range(n_out):
+            path = str(
+                tmp_path / "work" / task.partition.job_id / "1" / str(p)
+                / f"data-{task.partition.partition_id}.arrow"
+            )
+            replica = ""
+            if replicate:
+                replica = shuffle_store.external_replica_path(str(ext), path)
+                _write_replica_file(replica)
+            parts.append(
+                ShuffleWritePartition(p, path, 1, 8, 64, replica_path=replica)
+            )
+        graph.update_task_status(
+            TaskInfo(
+                task.partition, "completed", meta.id,
+                partitions=parts, attempt=task.attempt,
+            ),
+            meta,
+        )
+    # EXEC1 dies: its (fully replicated) task repoints, nothing re-runs
+    assert graph.reset_stages(EXEC1.id) > 0
+    assert isinstance(graph.stages[1], CompletedStage)
+    # now the external store loses the repointed copy mid-fetch
+    shutil.rmtree(str(ext), ignore_errors=True)
+    task = graph.pop_next_task(EXEC2.id)
+    assert task is not None and task.partition.stage_id == 2
+    err = ShuffleFetchFailed(
+        1, 0, shuffle_store.EXTERNAL_EXECUTOR_ID, detail="copy vanished"
+    )
+    graph.update_task_status(
+        TaskInfo(
+            task.partition, "failed", EXEC2.id,
+            error=f"ShuffleFetchFailed: {err}", attempt=task.attempt,
+        ),
+        EXEC2,
+    )
+    stage1 = graph.stages[1]
+    assert isinstance(stage1, RunningStage)
+    # exactly ONE task re-runs (EXEC1's, which backed the sentinel);
+    # EXEC2's completed task is untouched
+    assert stage1.available_tasks() == 1
+    kept = [t for t in stage1.task_statuses if t is not None]
+    assert len(kept) == 1 and kept[0].executor_id == EXEC2.id
+    # and EXEC2's locations survive in the consumer input (no re-add →
+    # no duplicates when it never re-runs)
+    consumer = graph.stages[2]
+    locs = [
+        l
+        for inp in consumer.inputs.values()
+        for ll in inp.partition_locations.values()
+        for l in ll
+    ]
+    assert any(l.executor_meta.id == EXEC2.id for l in locs)
+    assert all(
+        l.executor_meta.id != shuffle_store.EXTERNAL_EXECUTOR_ID for l in locs
+    )
+
+
+def test_drain_handoff_classification():
+    """Only cancels/transient failures absorb as handoffs; structured
+    lost-shuffle and genuine fatal errors keep the normal path."""
+    from arrow_ballista_tpu.scheduler.task_manager import TaskManager
+
+    f = TaskManager._is_drain_handoff
+    assert f("Cancelled: task cancelled (drain)") is True
+    assert f("ExecutionError: connection reset by peer") is True
+    assert f("FaultInjected: fault injected at task.run") is True
+    assert f("ShuffleFetchFailed: shuffle fetch exhausted retries "
+             "stage=1 partition=0 executor=exec-1") is False
+    assert f("PlanError: no such column") is False
+    assert f("TypeError: bad operand") is False
+
+
+def test_handoff_task_requeues_budget_free(tmp_path):
+    """Drain handoff: the task re-queues excluded from the drainer, the
+    attempt bump keeps late reports stale, and the failure budget is
+    untouched (free attempt granted)."""
+    graph = make_graph(tmp_path)
+    task = graph.pop_next_task("exec-1")
+    assert task is not None
+    stage = graph.stages[task.partition.stage_id]
+    p = task.partition.partition_id
+    assert graph.handoff_task(task.partition, "exec-1") is True
+    assert stage.task_statuses[p] is None
+    assert stage.task_exclusions[p] == "exec-1"
+    assert stage.task_attempts[p] == task.attempt + 1
+    assert stage.task_free_attempts[p] == 1
+    assert graph.task_retries == 0
+    # a second report for the same (now superseded) attempt is a no-op
+    assert graph.handoff_task(task.partition, "exec-1") is False
+
+
+def test_decommission_surfaces_rpc_and_rest(tmp_path):
+    """The operator surfaces: DecommissionExecutor RPC and
+    POST /api/executors/{id}/decommission both mark the executor
+    draining; unknown ids 404 without touching state."""
+    from arrow_ballista_tpu.executor.standalone import new_standalone_executor
+    from arrow_ballista_tpu.proto import pb
+    from arrow_ballista_tpu.proto.rpc import SchedulerGrpcStub, make_channel
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+    from arrow_ballista_tpu.scheduler.standalone import new_standalone_scheduler
+
+    scheduler = new_standalone_scheduler()
+    execs = [
+        new_standalone_executor(scheduler.host, scheduler.port)
+        for _ in range(2)
+    ]
+    api = ApiServerHandle(scheduler.server, host="127.0.0.1", port=0).start()
+    em = scheduler.server.state.executor_manager
+    try:
+        stub = SchedulerGrpcStub(make_channel(scheduler.host, scheduler.port))
+        stub.DecommissionExecutor(
+            pb.ExecutorStoppedParams(executor_id=execs[0].id, reason="test"),
+            timeout=10,
+        )
+        assert em.is_draining(execs[0].id)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}/api/executors/{execs[1].id}/decommission",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["draining"] is True
+        assert em.is_draining(execs[1].id)
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}/api/executors/zzz/decommission",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=10)
+        # draining executors are reported by /api/state
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/state", timeout=10
+        ) as resp:
+            state = json.loads(resp.read())
+        assert all(e["draining"] for e in state["executors"])
+    finally:
+        api.stop()
+        for e in execs:
+            e.shutdown()
+        scheduler.shutdown()
+
+
+# =====================================================================
+# 5. e2e: kill the map-side executor after its stage completes
+# =====================================================================
+@pytest.mark.parametrize("replication", ["async", "none"])
+def test_dead_map_executor_replica_fetch_vs_recompute(
+    sales_parquet, tmp_path, replication
+):
+    """Acceptance: with replication=async + external store, killing the
+    map-side executor after its stage completes finishes the query via
+    replica fetch with ZERO producer re-runs; with replication=none the
+    PR 5 recompute path fires.  Both runs return identical results."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.executor.standalone import new_standalone_executor
+    from arrow_ballista_tpu.scheduler.standalone import new_standalone_scheduler
+
+    sql = "SELECT g, SUM(v) AS s, COUNT(v) AS n FROM sales GROUP BY g"
+    local = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    local.register_parquet("sales", sales_parquet)
+    expected = local.sql(sql).collect()
+
+    ext = str(tmp_path / "ext")
+    config = dict(CPU_CONFIG)
+    config.update(
+        {
+            "ballista.shuffle.replication": replication,
+            "ballista.shuffle.external_path": ext,
+            "ballista.shuffle.fetch_retries": "1",
+            "ballista.shuffle.fetch_backoff_ms": "10",
+        }
+    )
+    scheduler = new_standalone_scheduler(
+        liveness_window_s=1.5, executor_timeout_s=1.5
+    )
+    scheduler.server.reaper_interval_s = 0.5
+    work_a = str(tmp_path / "exec-a")
+    exec_a = new_standalone_executor(
+        scheduler.host, scheduler.port, concurrent_tasks=2, work_dir=work_a
+    )
+    a_id = exec_a.executor.id
+    exec_b = None
+    ctx = None
+    try:
+        # wedge the REDUCE stage only while it runs on executor A (the
+        # cancel-aware delay wakes promptly when A dies)
+        faults.arm(
+            "task.run",
+            times=-1,
+            action="delay",
+            delay_ms=60_000,
+            match=lambda stage_id=0, executor_id="", **_:
+                stage_id == 2 and executor_id == a_id,
+        )
+        ctx = BallistaContext(
+            scheduler.host, scheduler.port, BallistaConfig(config)
+        )
+        ctx.register_parquet("sales", sales_parquet)
+        result = {}
+
+        def run():
+            try:
+                result["table"] = ctx.sql(sql).collect()
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        tm = scheduler.server.state.task_manager
+        deadline = time.monotonic() + 30
+        job_id = None
+        while time.monotonic() < deadline:
+            ids = tm.active_job_ids()
+            if ids:
+                job_id = ids[0]
+                detail = tm.get_job_detail(job_id) or {}
+                rows = {r["stage_id"]: r for r in detail.get("stages", [])}
+                if rows.get(1, {}).get("state") == "Completed":
+                    break
+            time.sleep(0.05)
+        assert job_id is not None, "job never became active"
+        assert (tm.get_job_detail(job_id)["stages"][0]["state"]) == "Completed"
+        if replication == "async":
+            # wait until the async replicas are durable before the kill
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len(glob.glob(os.path.join(ext, "*", "1", "*", "*"))) >= 2:
+                    break
+                time.sleep(0.05)
+            assert glob.glob(os.path.join(ext, "*", "1", "*", "*")), (
+                "async replicas never landed"
+            )
+
+        # executor B joins; A dies hard, its disk with it (machine loss)
+        exec_b = new_standalone_executor(
+            scheduler.host, scheduler.port, concurrent_tasks=2,
+            work_dir=str(tmp_path / "exec-b"),
+        )
+        exec_a.shutdown()
+        shutil.rmtree(work_a, ignore_errors=True)
+
+        t.join(120)
+        assert not t.is_alive(), "job did not finish after executor loss"
+        assert "error" not in result, result.get("error")
+        assert _rows(result["table"]) == _rows(expected)
+
+        detail = tm.get_job_detail(job_id)
+        assert detail["state"] == "completed"
+        stage_resets = {int(k): v for k, v in detail["stage_resets"].items()}
+        snap = scheduler.server.state.metrics.snapshot()
+        if replication == "async":
+            # zero producer re-runs: stage 1 never reset, never retried
+            assert 1 not in stage_resets, stage_resets
+            stage1 = detail["stages"][0]
+            assert stage1["state"] == "Completed"
+            assert not stage1.get("task_attempts"), stage1
+            # and at least one read was served by a replica
+            assert snap.get("replica_fetches_total", 0) >= 1, snap
+            assert snap.get("shuffle_replicas_written", 0) >= 2, snap
+            # the rollup also rides the job profile
+            from arrow_ballista_tpu.obs.export import job_profile
+
+            prof = job_profile(detail, [])
+            by_sid = {r["stage_id"]: r for r in prof["stages"]}
+            assert by_sid[1]["shuffle_write"]["replicas_written"] >= 2
+            assert by_sid[2].get("replica_fetches", 0) >= 1, by_sid[2]
+        else:
+            # PR 5 recompute: the producer stage was reset and re-ran
+            assert 1 in stage_resets, stage_resets
+    finally:
+        faults.clear()
+        if ctx is not None:
+            ctx.close()
+        if exec_b is not None:
+            exec_b.shutdown()
+        exec_a.shutdown()
+        scheduler.shutdown()
+
+
+# =====================================================================
+# 6. e2e: graceful decommission under load (drain)
+# =====================================================================
+@pytest.mark.parametrize("store_kind", ["local", "external"])
+def test_decommission_drains_busy_executor_zero_recompute(
+    sales_parquet, tmp_path, store_kind
+):
+    """Satellite: 2-executor cluster, decommission the map-side executor
+    mid-query — the query completes with zero recompute (stage-retry and
+    speculative_wasted counters flat), multiset-identical results, no
+    failed tasks, and the drain counters visible in /api/metrics."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+
+    sql = "SELECT g, SUM(v) AS s FROM sales GROUP BY g"
+    local = SessionContext(BallistaConfig(dict(CPU_CONFIG)))
+    local.register_parquet("sales", sales_parquet)
+    expected = local.sql(sql).collect()
+
+    ext = str(tmp_path / "ext")
+    config = dict(CPU_CONFIG)
+    if store_kind == "external":
+        config.update(
+            {
+                "ballista.shuffle.store": "external",
+                "ballista.shuffle.external_path": ext,
+            }
+        )
+    else:
+        config.update(
+            {
+                "ballista.shuffle.replication": "async",
+                "ballista.shuffle.external_path": ext,
+            }
+        )
+    config.update(
+        {
+            "ballista.shuffle.fetch_retries": "1",
+            "ballista.shuffle.fetch_backoff_ms": "10",
+        }
+    )
+    # hold the reduce tasks briefly so the decommission lands mid-query
+    faults.arm(
+        "task.run",
+        times=2,
+        action="delay",
+        delay_ms=2000,
+        match=lambda stage_id=0, attempt=0, **_: stage_id == 2 and attempt == 0,
+    )
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(config),
+        num_executors=2,
+        concurrent_tasks=2,
+        policy=TaskSchedulingPolicy.PUSH_STAGED,
+    )
+    scheduler, _executors = ctx._standalone_handles
+    api = ApiServerHandle(scheduler.server, host="127.0.0.1", port=0).start()
+    try:
+        ctx.register_parquet("sales", sales_parquet)
+        result = {}
+
+        def run():
+            try:
+                result["table"] = ctx.sql(sql).collect()
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        tm = scheduler.server.state.task_manager
+        deadline = time.monotonic() + 30
+        job_id, map_eid = None, None
+        while time.monotonic() < deadline and map_eid is None:
+            for jid in tm.active_job_ids():
+                entry = tm._entry(jid)
+                with entry.lock:
+                    graph = tm._load(jid, entry)
+                    if graph is None:
+                        continue
+                    stage1 = graph.stages.get(1)
+                    if isinstance(stage1, CompletedStage):
+                        job_id = jid
+                        map_eid = stage1.task_statuses[0].executor_id
+            time.sleep(0.05)
+        assert map_eid is not None, "map stage never completed"
+
+        assert scheduler.server.decommission_executor(
+            map_eid, timeout_s=20
+        ) is True
+        # the drain concludes: counter flips, executor leaves the cluster
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            snap = scheduler.server.state.metrics.snapshot()
+            if snap.get("executors_drained_total", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert snap.get("executors_drained_total", 0) == 1, snap
+
+        t.join(90)
+        assert not t.is_alive(), "job did not finish during decommission"
+        assert "error" not in result, result.get("error")
+        assert _rows(result["table"]) == _rows(expected)
+
+        detail = tm.get_job_detail(job_id)
+        assert detail["state"] == "completed"
+        # zero recompute, zero failed tasks, zero wasted speculation
+        assert detail["task_retries"] == 0, detail
+        snap = scheduler.server.state.metrics.snapshot()
+        assert snap.get("speculative_wasted", 0) == 0
+        assert snap.get("task_retries_total", 0) == 0
+        # acceptance: the new counters ride /api/metrics
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api/metrics", timeout=10
+        ) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics.get("executors_drained_total") == 1
+        assert "shuffle_replicas_written" in metrics
+        assert "replica_fetches_total" in metrics
+        if store_kind == "local":
+            assert metrics.get("shuffle_replicas_written", 0) >= 1, metrics
+    finally:
+        faults.clear()
+        api.stop()
+        ctx.close()
