@@ -89,13 +89,16 @@ class BallistaContext:
         heartbeat_interval_s: float = 5.0,
         task_isolation: str = "thread",
         plugin_dir: str = "",
+        event_journal_dir: str = "",
     ) -> "BallistaContext":
         """In-proc cluster: scheduler + executors over real gRPC/Flight on
         random localhost ports (reference: context.rs:140-210)."""
         from ..executor.standalone import new_standalone_executor
         from ..scheduler.standalone import new_standalone_scheduler
 
-        scheduler = new_standalone_scheduler(policy)
+        scheduler = new_standalone_scheduler(
+            policy, event_journal_dir=event_journal_dir
+        )
         executors = [
             new_standalone_executor(
                 scheduler.host,

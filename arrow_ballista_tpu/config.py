@@ -72,6 +72,9 @@ CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
 OBS_ENABLED = "ballista.obs.enabled"
 OBS_SAMPLE_RATE = "ballista.obs.sample_rate"
 OBS_BUFFER_SPANS = "ballista.obs.buffer_spans"
+# per-session job-latency SLO: completed jobs slower than this feed
+# slo_breaches_total + the burn-rate gauge (0 = untracked)
+OBS_SLO_JOB_LATENCY_S = "ballista.obs.slo.job_latency_seconds"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -510,6 +513,14 @@ _ENTRIES: dict[str, ConfigEntry] = {
             int,
             "4096",
         ),
+        ConfigEntry(
+            OBS_SLO_JOB_LATENCY_S,
+            "job-latency SLO for this session (seconds): a completed job "
+            "slower than this counts into slo_breaches_total and the "
+            "slo_burn_rate gauge on the scheduler; 0 disables tracking",
+            float,
+            "0",
+        ),
     ]
 }
 
@@ -738,6 +749,10 @@ class BallistaConfig:
     @property
     def obs_buffer_spans(self) -> int:
         return self._get(OBS_BUFFER_SPANS)
+
+    @property
+    def obs_slo_job_latency_seconds(self) -> float:
+        return self._get(OBS_SLO_JOB_LATENCY_S)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

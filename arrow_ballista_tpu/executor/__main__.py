@@ -44,6 +44,7 @@ CONFIG_KEYS = {
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
     "job_data_ttl_seconds": (int, 604800, "delete job dirs older than this"),
     "heartbeat_sidecar": (int, 1, "process-isolated liveness backstop (0=off)"),
+    "telemetry_enabled": (int, 1, "piggyback a resource snapshot (CPU%, RSS, shuffle disk, queue occupancy, slots) on every heartbeat; 0 disables (push mode only)"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "executor", "log file prefix"),
@@ -240,6 +241,7 @@ def main(argv=None) -> None:
             cfg["scheduler_port"],
             on_shutdown=lambda reason: stop.update(flag=True),
             bind_host=cfg["bind_host"],
+            telemetry_enabled=bool(cfg["telemetry_enabled"]),
         ).start()
     else:
         loop = PollLoop(executor, stub).start()

@@ -79,17 +79,25 @@ class ExecutorGrpcService:
 
 
 class Heartbeater:
-    """Periodic HeartBeatFromExecutor (reference: `:401-431`)."""
+    """Periodic HeartBeatFromExecutor (reference: `:401-431`).
+
+    ``telemetry`` (an ``obs.telemetry.TelemetrySampler``) piggybacks a
+    resource snapshot on every beat.  Unlike the span payload — which
+    requeues when the RPC fails, so traces keep no gaps — a telemetry
+    snapshot is latest-wins: a lost beat is simply superseded by the
+    fresh sample taken for the next one."""
 
     def __init__(
         self,
         executor_id: str,
         scheduler: SchedulerGrpcStub,
         interval_s: float = HEARTBEAT_INTERVAL_S,
+        telemetry=None,
     ):
         self.executor_id = executor_id
         self.scheduler = scheduler
         self.interval_s = interval_s
+        self.telemetry = telemetry
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -121,6 +129,12 @@ class Heartbeater:
             params = pb.HeartBeatParams(
                 executor_id=self.executor_id, status=status
             )
+            if self.telemetry is not None:
+                snap = self.telemetry.sample()
+                if snap is not None:
+                    import json as _json
+
+                    params.telemetry_json = _json.dumps(snap).encode()
             if trace.is_enabled():
                 # spans finished between task reports (Flight serving,
                 # cache activity) ride the heartbeat to the trace store
@@ -153,7 +167,10 @@ class ExecutorServer:
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
         on_shutdown: Optional[Callable[[str], None]] = None,
         bind_host: str = "0.0.0.0",
+        telemetry_enabled: bool = True,
     ):
+        from ..obs.telemetry import TelemetrySampler
+
         self.bind_host = bind_host
         self.executor = executor
         self.scheduler = SchedulerGrpcStub(
@@ -162,8 +179,17 @@ class ExecutorServer:
         self._scheduler_stubs: Dict[str, SchedulerGrpcStub] = {
             f"{scheduler_host}:{scheduler_port}": self.scheduler
         }
+        # the telemetry piggyback is the one obs piece on by default: the
+        # sampler is O(1) per beat (the work-dir disk walk is throttled)
+        self.telemetry = TelemetrySampler(
+            work_dir=executor.work_dir,
+            slots_total=executor.concurrent_tasks,
+            active_tasks_fn=executor.active_task_count,
+            enabled=telemetry_enabled,
+        )
         self.heartbeater = Heartbeater(
-            executor.id, self.scheduler, heartbeat_interval_s
+            executor.id, self.scheduler, heartbeat_interval_s,
+            telemetry=self.telemetry,
         )
         self._tasks: "queue.Queue" = queue.Queue()
         self._statuses: "queue.Queue" = queue.Queue()

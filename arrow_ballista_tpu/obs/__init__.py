@@ -15,10 +15,20 @@ end to end:
   ``/api/metrics`` and the Prometheus text-exposition endpoint;
 * :mod:`.export` — Chrome-trace/Perfetto JSON and the EXPLAIN-ANALYZE
   style per-stage profile behind ``GET /api/jobs/{id}/trace`` and
-  ``GET /api/jobs/{id}/profile``.
+  ``GET /api/jobs/{id}/profile``;
+* :mod:`.telemetry` — per-executor resource sampler whose snapshots ride
+  ``HeartBeatParams.telemetry_json`` to the scheduler;
+* :mod:`.timeseries` — scheduler-side bounded downsampling series
+  (per-executor + cluster aggregates) behind ``GET /api/cluster/health``
+  and ``GET /api/cluster/timeseries``, plus per-session SLO tracking;
+* :mod:`.events` — append-only size-rotated structured event journal
+  (job/stage/task lifecycle, retries, speculation, quarantine, drain)
+  behind ``GET /api/jobs/{id}/events`` and ``GET /api/events/tail``.
 
-Everything is gated by ``ballista.obs.enabled``; with it off the span API
-is a near-zero-cost no-op (one module attribute read per call).
+Tracing is gated by ``ballista.obs.enabled``; with it off the span API
+is a near-zero-cost no-op (one module attribute read per call).  The
+telemetry heartbeat piggyback is the one always-on piece; the journal
+and SLO tracking are off until configured.
 """
 
 from . import trace  # noqa: F401

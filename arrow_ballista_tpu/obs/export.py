@@ -16,6 +16,119 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+# ---------------------------------------------------------------------------
+# stage skew analytics (ISSUE 7 tentpole, part d)
+#
+# At stage completion the per-partition runtime and written-bytes
+# distributions reduce to p50/p99/max and a max-over-median skew
+# coefficient — the direct input for the ROADMAP's adaptive re-planning
+# (coalesce partitions when bytes skew is low and counts are high; split
+# when one partition dominates).  The reduction persists inside
+# ``CompletedStage.stage_metrics`` under synthetic operator names (the
+# stage-metrics proto already survives job-cache eviction), with ratios
+# scaled x1000 to fit the int-valued metric map:
+#
+#   __stage_skew__        {runtime_ms_{p50,p99,max}, runtime_ms_skew_x1000,
+#                          bytes_{raw,wire}_{p50,p99,max},
+#                          bytes_{raw,wire}_skew_x1000, partitions}
+#   __task_runtime_ms__   {str(partition): runtime_ms}   (raw distribution)
+#   __task_bytes_wire__   {str(partition): bytes}
+#   __task_bytes_raw__    {str(partition): bytes}
+#
+# ``job_profile`` lifts __stage_skew__ into a float-valued ``skew`` block
+# per stage; the raw per-partition maps stay available for independent
+# recomputation (tests do exactly that).
+STAGE_SKEW_OP = "__stage_skew__"
+TASK_RUNTIME_OP = "__task_runtime_ms__"
+TASK_BYTES_WIRE_OP = "__task_bytes_wire__"
+TASK_BYTES_RAW_OP = "__task_bytes_raw__"
+_SYNTHETIC_OPS = (
+    STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
+)
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0,1]) on a non-empty list."""
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def skew_coefficient(values: List[float]) -> float:
+    """max-over-median: 1.0 = perfectly balanced, large = one straggler
+    partition dominates.  0 when the distribution is degenerate."""
+    if not values:
+        return 0.0
+    med = percentile(values, 0.5)
+    return (max(values) / med) if med > 0 else 0.0
+
+
+def _dist_metrics(prefix: str, values: List[float]) -> Dict[str, int]:
+    return {
+        f"{prefix}_p50": int(percentile(values, 0.5)),
+        f"{prefix}_p99": int(percentile(values, 0.99)),
+        f"{prefix}_max": int(max(values)),
+        f"{prefix}_skew_x1000": int(round(skew_coefficient(values) * 1000)),
+    }
+
+
+def stage_skew_metrics(
+    task_runtime_s: Dict[int, float],
+    task_bytes: Dict[int, Dict[str, int]],
+) -> Dict[str, Dict[str, int]]:
+    """Reduce per-partition runtimes/bytes into the synthetic stage-metric
+    operators described above; {} when nothing was recorded (decoded
+    graphs, stages completed before this PR's scheduler)."""
+    out: Dict[str, Dict[str, int]] = {}
+    skew: Dict[str, int] = {}
+    if task_runtime_s:
+        # reduce over the SAME integer values published in the raw map,
+        # so an independent consumer recomputing quantiles from
+        # __task_runtime_ms__ lands on the exact stored coefficients
+        ms = {p: int(max(0.0, v) * 1e3) for p, v in task_runtime_s.items()}
+        skew.update(_dist_metrics("runtime_ms", list(ms.values())))
+        skew["partitions"] = len(ms)
+        out[TASK_RUNTIME_OP] = {str(p): v for p, v in ms.items()}
+    if task_bytes:
+        wire = {p: int(b.get("wire", 0)) for p, b in task_bytes.items()}
+        raw = {p: int(b.get("raw", 0)) for p, b in task_bytes.items()}
+        skew.update(_dist_metrics("bytes_wire", list(wire.values())))
+        skew.update(_dist_metrics("bytes_raw", list(raw.values())))
+        skew.setdefault("partitions", len(wire))
+        out[TASK_BYTES_WIRE_OP] = {str(p): v for p, v in wire.items()}
+        out[TASK_BYTES_RAW_OP] = {str(p): v for p, v in raw.items()}
+    if skew:
+        out[STAGE_SKEW_OP] = skew
+    return out
+
+
+def _skew_block(metrics: Dict[str, Dict[str, int]]) -> Optional[dict]:
+    """__stage_skew__ → the float-valued profile block."""
+    raw = metrics.get(STAGE_SKEW_OP)
+    if not raw:
+        return None
+
+    def dist(prefix: str) -> Optional[dict]:
+        if f"{prefix}_max" not in raw:
+            return None
+        return {
+            "p50": raw.get(f"{prefix}_p50", 0),
+            "p99": raw.get(f"{prefix}_p99", 0),
+            "max": raw.get(f"{prefix}_max", 0),
+            "max_over_median": raw.get(f"{prefix}_skew_x1000", 0) / 1000.0,
+        }
+
+    out = {"partitions": raw.get("partitions", 0)}
+    for key, prefix in (
+        ("runtime_ms", "runtime_ms"),
+        ("bytes_wire", "bytes_wire"),
+        ("bytes_raw", "bytes_raw"),
+    ):
+        d = dist(prefix)
+        if d is not None:
+            out[key] = d
+    return out
+
 
 def chrome_trace(spans: List[dict], job_id: str = "") -> dict:
     """Spans (recorder dicts) → Chrome trace JSON object."""
@@ -108,6 +221,8 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
         replica_fetches = 0
         write = {}
         for op, vals in metrics.items():
+            if op in _SYNTHETIC_OPS:
+                continue  # skew analytics, surfaced as row["skew"] below
             if op.startswith("TpuStage") or op.startswith("TpuWindow"):
                 for k, v in vals.items():
                     tpu[k] = tpu.get(k, 0) + v
@@ -139,6 +254,11 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             # reads this stage served from an external-store replica
             # after its primary's executor went away
             row["replica_fetches"] = replica_fetches
+        skew = _skew_block(metrics)
+        if skew is not None:
+            # stage-completion partition skew (runtime + written bytes):
+            # the coalesce/split signal for adaptive re-planning
+            row["skew"] = skew
         spec = r.get("speculation")
         if spec:
             # straggler mitigation rollup: duplicates launched for this

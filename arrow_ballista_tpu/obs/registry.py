@@ -17,28 +17,62 @@ Two registry scopes:
 
 Gauges take a callable so values are computed at scrape time (alive
 executors, available slots) instead of being pushed on every change.
+
+Labels (ISSUE 7): metrics may carry a label set — per-executor telemetry
+gauges mirror into the scheduler registry as one family with an
+``executor`` label.  The exposition groups a family's samples under ONE
+``# HELP``/``# TYPE`` pair and escapes label values per the Prometheus
+text format 0.0.4 (backslash, double-quote, newline).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 # go-style duration buckets (seconds) scaled to ns histograms' needs; for
 # generic value histograms powers of 4 keep bucket counts small
 DEFAULT_BUCKETS = tuple(4.0**i for i in range(-1, 12))
+
+Labels = Optional[Dict[str, str]]
 
 
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(float(v))
 
 
-class Counter:
-    __slots__ = ("name", "help", "_value", "_lock")
+def escape_label_value(v: str) -> str:
+    """Prometheus text format 0.0.4 label-value escaping."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
-    def __init__(self, name: str, help: str = ""):
+
+def _label_suffix(labels: Labels, extra: str = "") -> str:
+    """``{k="v",...}`` rendering (sorted, escaped); "" when empty."""
+    parts = [
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted((labels or {}).items())
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _labels_key(labels: Labels) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+class Counter:
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = "", labels: Labels = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self._value = 0
         self._lock = threading.Lock()
 
@@ -56,11 +90,18 @@ class Gauge:
     """Point-in-time value: either pushed via :meth:`set` or computed by a
     provider callable at read time."""
 
-    __slots__ = ("name", "help", "_value", "_fn", "_lock")
+    __slots__ = ("name", "help", "labels", "_value", "_fn", "_lock")
 
-    def __init__(self, name: str, help: str = "", fn: Optional[Callable[[], float]] = None):
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        fn: Optional[Callable[[], float]] = None,
+        labels: Labels = None,
+    ):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self._value = 0
         self._fn = fn
         self._lock = threading.Lock()
@@ -81,11 +122,15 @@ class Gauge:
 
 
 class Histogram:
-    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n", "_lock")
+    __slots__ = ("name", "help", "labels", "buckets", "_counts", "_sum", "_n", "_lock")
 
-    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+    def __init__(
+        self, name: str, help: str = "", buckets=DEFAULT_BUCKETS,
+        labels: Labels = None,
+    ):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
         self._sum = 0.0
@@ -124,39 +169,72 @@ class MetricsRegistry:
     def __init__(self, namespace: str = "ballista"):
         self.namespace = namespace
         self._lock = threading.Lock()
-        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+        # (name, sorted-label-items) -> metric; unlabeled metrics use ()
+        self._metrics: Dict[tuple, Union[Counter, Gauge, Histogram]] = {}
 
     # ------------------------------------------------------- constructors
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_make(name, lambda: Counter(name, help), Counter)
+    def counter(
+        self, name: str, help: str = "", labels: Labels = None
+    ) -> Counter:
+        return self._get_or_make(
+            name, labels, lambda: Counter(name, help, labels), Counter
+        )
 
     def gauge(
-        self, name: str, help: str = "", fn: Optional[Callable[[], float]] = None
+        self,
+        name: str,
+        help: str = "",
+        fn: Optional[Callable[[], float]] = None,
+        labels: Labels = None,
     ) -> Gauge:
-        g = self._get_or_make(name, lambda: Gauge(name, help, fn), Gauge)
+        g = self._get_or_make(
+            name, labels, lambda: Gauge(name, help, fn, labels), Gauge
+        )
         if fn is not None:
             g._fn = fn  # re-registration rebinds the provider (tests)
         return g
 
-    def histogram(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+    def histogram(
+        self, name: str, help: str = "", buckets=DEFAULT_BUCKETS,
+        labels: Labels = None,
+    ) -> Histogram:
         return self._get_or_make(
-            name, lambda: Histogram(name, help, buckets), Histogram
+            name, labels, lambda: Histogram(name, help, buckets, labels), Histogram
         )
 
-    def _get_or_make(self, name: str, make: Callable, kind: type):
+    def _get_or_make(self, name: str, labels: Labels, make: Callable, kind: type):
+        key = (name, _labels_key(labels))
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = self._metrics[name] = make()
+                m = self._metrics[key] = make()
             elif not isinstance(m, kind):
                 raise TypeError(
                     f"metric {name!r} already registered as {type(m).__name__}"
                 )
             return m
 
-    def get(self, name: str):
+    def get(self, name: str, labels: Labels = None):
         with self._lock:
-            return self._metrics.get(name)
+            return self._metrics.get((name, _labels_key(labels)))
+
+    def remove(self, name: str, labels: Labels = None) -> bool:
+        """Drop one metric (e.g. a lost executor's labeled gauges)."""
+        with self._lock:
+            return self._metrics.pop((name, _labels_key(labels)), None) is not None
+
+    def remove_by_label(self, label: str, value: str) -> int:
+        """Drop every metric whose label set contains ``label == value``
+        (the whole per-executor family when an executor leaves)."""
+        with self._lock:
+            doomed = [
+                key
+                for key, m in self._metrics.items()
+                if m.labels.get(label) == value
+            ]
+            for key in doomed:
+                del self._metrics[key]
+            return len(doomed)
 
     def value(self, name: str, default: Union[int, float] = 0) -> Union[int, float]:
         m = self.get(name)
@@ -165,36 +243,51 @@ class MetricsRegistry:
     # ------------------------------------------------------------ exports
     def snapshot(self) -> dict:
         """{name: value} for counters/gauges, {name: {count,sum,buckets}}
-        for histograms — the JSON shape behind /api/metrics."""
+        for histograms — the JSON shape behind /api/metrics.  Labeled
+        metrics nest one level: {name: {'k="v"': value, ...}}."""
         with self._lock:
             metrics = list(self._metrics.values())
         out: dict = {}
         for m in metrics:
-            out[m.name] = m.snapshot() if isinstance(m, Histogram) else m.value
+            v = m.snapshot() if isinstance(m, Histogram) else m.value
+            if m.labels:
+                out.setdefault(m.name, {})[_label_suffix(m.labels)[1:-1]] = v
+            else:
+                out[m.name] = v
         return out
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.  Samples of one family
+        (same name, different labels) group under a single HELP/TYPE."""
         with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics = sorted(
+                self._metrics.values(),
+                key=lambda m: (m.name, _labels_key(m.labels)),
+            )
         lines: List[str] = []
+        seen_family: set = set()
         for m in metrics:
             full = f"{self.namespace}_{m.name}" if self.namespace else m.name
-            if m.help:
-                lines.append(f"# HELP {full} {m.help}")
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {_fmt(m.value)}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {_fmt(m.value)}")
+            if m.name not in seen_family:
+                seen_family.add(m.name)
+                if m.help:
+                    lines.append(f"# HELP {full} {m.help}")
+                kind = (
+                    "counter"
+                    if isinstance(m, Counter)
+                    else "gauge" if isinstance(m, Gauge) else "histogram"
+                )
+                lines.append(f"# TYPE {full} {kind}")
+            lbl = _label_suffix(m.labels)
+            if isinstance(m, (Counter, Gauge)):
+                lines.append(f"{full}{lbl} {_fmt(m.value)}")
             else:
                 snap = m.snapshot()
-                lines.append(f"# TYPE {full} histogram")
                 for le, c in snap["buckets"].items():
-                    lines.append(f'{full}_bucket{{le="{le}"}} {c}')
-                lines.append(f"{full}_sum {_fmt(snap['sum'])}")
-                lines.append(f"{full}_count {snap['count']}")
+                    bucket_lbl = _label_suffix(m.labels, 'le="%s"' % le)
+                    lines.append(f"{full}_bucket{bucket_lbl} {c}")
+                lines.append(f"{full}_sum{lbl} {_fmt(snap['sum'])}")
+                lines.append(f"{full}_count{lbl} {snap['count']}")
         return "\n".join(lines) + "\n" if lines else ""
 
 

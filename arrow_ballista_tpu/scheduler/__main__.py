@@ -44,6 +44,10 @@ CONFIG_KEYS = {
     "task_timeout_seconds": (float, 0.0, "reap running tasks older than this for every session (0 = off; sessions can set ballista.task.timeout_seconds)"),
     "drain_timeout_seconds": (float, 30.0, "graceful-decommission budget handed to a draining executor (DecommissionExecutor RPC / POST /api/executors/{id}/decommission)"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
+    "event_journal_dir": (str, "", "directory for the append-only structured event journal (empty = disabled; see /api/jobs/{id}/events and /api/events/tail)"),
+    "event_journal_rotate_bytes": (int, 4 << 20, "rotate the active journal segment past this size"),
+    "event_journal_segments": (int, 4, "rotated journal segments kept before the oldest is deleted"),
+    "telemetry_sample_seconds": (float, 5.0, "period of the cluster-aggregate telemetry sample (queue depth, slots, shuffle backlog) feeding /api/cluster/timeseries"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "scheduler", "log file prefix"),
@@ -159,6 +163,10 @@ def main(argv=None) -> None:
         speculation_force_enabled=bool(cfg["speculation_enabled"]),
         task_timeout_force_s=cfg["task_timeout_seconds"],
         drain_timeout_s=cfg["drain_timeout_seconds"],
+        telemetry_sample_s=cfg["telemetry_sample_seconds"],
+        event_journal_dir=cfg["event_journal_dir"],
+        event_journal_rotate_bytes=cfg["event_journal_rotate_bytes"],
+        event_journal_segments=cfg["event_journal_segments"],
     ).init()
     # the curator address executors dial back: must be reachable, never
     # the 0.0.0.0 wildcard

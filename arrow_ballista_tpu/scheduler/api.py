@@ -12,8 +12,12 @@ Extra endpoints beyond the reference: ``/api/jobs`` (job table),
 ``/api/metrics`` (unified registry snapshot, backward-compatible shape),
 ``/api/metrics/prometheus`` (text exposition, also served at
 ``/metrics``), ``/api/jobs/{id}/trace`` (Chrome-trace/Perfetto JSON of
-the job's stitched spans) and ``/api/jobs/{id}/profile``
-(EXPLAIN-ANALYZE-style per-stage rollup) — see
+the job's stitched spans), ``/api/jobs/{id}/profile``
+(EXPLAIN-ANALYZE-style per-stage rollup incl. skew coefficients),
+``/api/cluster/health`` (live executors with slot/queue/resource gauges
++ cluster aggregates + SLO), ``/api/cluster/timeseries?metric=…``
+(bounded downsampled history), ``/api/jobs/{id}/events`` and
+``/api/events/tail`` (structured event journal) — see
 docs/user-guide/observability.md.
 """
 
@@ -91,7 +95,11 @@ async function showDetail(jobId) {
     const retr = (s.task_retries || s.fetch_retries)
       ? `task ${s.task_retries || 0} · fetch ${s.fetch_retries || 0}` : '—';
     const mets = s.metrics
-      ? esc(Object.entries(s.metrics).map(([op, m]) =>
+      ? esc(Object.entries(s.metrics)
+          // __-prefixed operators are the skew-analytics payloads
+          // (per-partition maps); the profile endpoint renders them
+          .filter(([op]) => !op.startsWith('__'))
+          .map(([op, m]) =>
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
         ).join(' · '))
       : '—';
@@ -299,8 +307,17 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             return
         if path.startswith("/api/jobs/"):
             # /api/jobs/{id}[/dot] aliases /api/job/{id}[/dot], plus the
-            # observability routes /trace and /profile
+            # observability routes /trace, /profile and /events
             self._job_routes(srv, path[len("/api/jobs/"):])
+            return
+        if path == "/api/cluster/health":
+            self._cluster_health(srv)
+            return
+        if path == "/api/cluster/timeseries":
+            self._cluster_timeseries(srv)
+            return
+        if path == "/api/events/tail":
+            self._events_tail(srv)
             return
         if path in ("", "/", "/ui"):  # noqa: RET505 - route ladder
             self._dashboard()
@@ -329,13 +346,27 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
 
     def _job_routes(self, srv, rest: str) -> None:
         """Per-job routes, shared by /api/job/ and /api/jobs/:
-        {id} detail, {id}/dot, {id}/trace, {id}/profile."""
+        {id} detail, {id}/dot, {id}/trace, {id}/profile, {id}/events."""
         tm = srv.state.task_manager
         if rest.endswith("/trace"):
             self._job_trace(srv, rest[: -len("/trace")])
             return
         if rest.endswith("/profile"):
             self._job_profile(srv, rest[: -len("/profile")])
+            return
+        if rest.endswith("/events"):
+            job_id = rest[: -len("/events")]
+            journal = srv.state.events
+            if not journal.enabled:
+                self._json(
+                    {"error": "event journal disabled "
+                              "(start the scheduler with --event-journal-dir)"},
+                    404,
+                )
+                return
+            self._json(
+                {"job_id": job_id, "events": journal.for_job(job_id)}
+            )
             return
         if rest.endswith("/dot"):
             dot = tm.get_job_dot(rest[: -len("/dot")])
@@ -354,6 +385,101 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             self._json({"error": "no such job"}, 404)
             return
         self._json(detail)
+
+    def _query(self) -> dict:
+        """Parsed query-string parameters ({key: last value})."""
+        from urllib.parse import parse_qs, urlsplit
+
+        try:
+            qs = parse_qs(urlsplit(self.path).query)
+            return {k: v[-1] for k, v in qs.items()}
+        except Exception:  # noqa: BLE001 - malformed query string
+            return {}
+
+    def _cluster_health(self, srv) -> None:
+        """Live cluster view: per-executor slot/queue/resource gauges
+        from the latest heartbeat telemetry, cluster aggregates, journal
+        health and SLO burn (ISSUE 7 tentpole, the /api surface both
+        ROADMAP consumers read)."""
+        state = srv.state
+        em = state.executor_manager
+        alive = em.get_alive_executors()
+        draining = set(em.draining_executors())
+        quarantined = set(em.quarantined_executors())
+        latest = state.telemetry.latest()
+        pending, running = state.task_manager.task_counts()
+        executors = []
+        for meta in em.executors():
+            row = {
+                "id": meta.id,
+                "host": meta.host,
+                "alive": meta.id in alive,
+                "draining": meta.id in draining,
+                "quarantined": meta.id in quarantined,
+                "last_seen": em.last_seen(meta.id),
+                "slots_total": meta.specification.task_slots,
+            }
+            snap = latest.get(meta.id)
+            if snap:
+                row["telemetry"] = snap
+            executors.append(row)
+        self._json(
+            {
+                "executors": executors,
+                "cluster": {
+                    "alive_executors": len(alive),
+                    "available_slots": em.available_slots(),
+                    "pending_tasks": pending,
+                    "running_tasks": running,
+                    "active_jobs": len(state.task_manager.active_job_ids()),
+                    "executors_quarantined": len(quarantined),
+                    "executors_draining": len(draining),
+                },
+                "slo": state.slo.snapshot(),
+                "events": state.events.stats(),
+            }
+        )
+
+    def _cluster_timeseries(self, srv) -> None:
+        """``?metric=<name>[&executor=<id>]`` returns that series'
+        ``[[ts, value], ...]`` points (cluster aggregate by default,
+        one executor's series with ``executor=``); without ``metric``
+        lists what is recorded."""
+        q = self._query()
+        metric = q.get("metric", "")
+        telemetry = srv.state.telemetry
+        if not metric:
+            self._json(telemetry.metric_names())
+            return
+        executor = q.get("executor") or None
+        points = telemetry.series(metric, executor)
+        if points is None:
+            self._json(
+                {"error": f"no series recorded for metric {metric!r}"
+                          + (f" executor {executor!r}" if executor else "")},
+                404,
+            )
+            return
+        self._json(
+            {"metric": metric, "executor": executor, "points": points}
+        )
+
+    def _events_tail(self, srv) -> None:
+        """``?n=100[&kind=task_retry]`` — the journal's newest events."""
+        journal = srv.state.events
+        if not journal.enabled:
+            self._json(
+                {"error": "event journal disabled "
+                          "(start the scheduler with --event-journal-dir)"},
+                404,
+            )
+            return
+        q = self._query()
+        try:
+            n = max(1, min(10_000, int(q.get("n", "100"))))
+        except ValueError:
+            n = 100
+        self._json({"events": journal.tail(n, kind=q.get("kind") or None)})
 
     def _dashboard(self) -> None:
         body = DASHBOARD_HTML.encode()

@@ -114,6 +114,12 @@ class ExecutionGraph:
         # duplicate attempts / reaped deadline-timeouts, drained by the
         # TaskManager after graph mutations commit
         self.pending_cancels: List[tuple] = []
+        # structured journal queue: lifecycle events recorded while
+        # mutating the graph ({"kind": ..., **fields}); TaskManager's
+        # _persist drains them into the EventJournal with job/trace ids
+        # attached (drained even when the journal is disabled, so the
+        # list never grows unbounded)
+        self.pending_events: List[dict] = []
         # wasted-duplicate count not yet flushed into the scheduler's
         # registry counter (TaskManager._persist drains it, so every
         # drop site — commit, failure, reset, reap — reconciles with the
@@ -158,6 +164,13 @@ class ExecutionGraph:
         out, self.pending_cancels = self.pending_cancels, []
         return out
 
+    def take_pending_events(self) -> List[dict]:
+        out, self.pending_events = self.pending_events, []
+        return out
+
+    def _journal(self, kind: str, **fields) -> None:
+        self.pending_events.append({"kind": kind, **fields})
+
     def take_spec_wasted(self) -> int:
         n, self.spec_wasted_pending = self.spec_wasted_pending, 0
         return n
@@ -182,6 +195,24 @@ class ExecutionGraph:
             for s in self.stages.values()
             if isinstance(s, RunningStage)
         )
+
+    def running_tasks(self) -> int:
+        """Tasks currently dispatched (primary + speculative copies) —
+        the slot-saturation input for cluster telemetry."""
+        n = 0
+        for s in self.stages.values():
+            if isinstance(s, RunningStage):
+                n += sum(
+                    1
+                    for t in s.task_statuses
+                    if t is not None and t.state == "running"
+                )
+                n += sum(
+                    1
+                    for t in s.speculative_statuses.values()
+                    if t.state == "running"
+                )
+        return n
 
     # ------------------------------------------------------------ revive
     def revive(self) -> bool:
@@ -278,6 +309,13 @@ class ExecutionGraph:
             stage.spec_started_mono[p] = time.monotonic()
             stage.bump_spec_stat("launched")
             stage.speculation_requests.pop(p, None)
+            self._journal(
+                "speculation_launched",
+                stage=sid,
+                partition=p,
+                executor=executor_id,
+                straggler=t.executor_id,
+            )
             return Task(
                 self.session_id,
                 pid,
@@ -417,12 +455,34 @@ class ExecutionGraph:
             if info.fetch_retries:
                 stage.task_fetch_retries[p] = info.fetch_retries
             stage.update_task_metrics(info)
+            # per-partition written-bytes distribution (skew input): wire
+            # bytes from the writer metrics when present, else the sum of
+            # the partition files' sizes; raw falls back to wire
+            wire_m = sum(
+                int(vals.get("bytes_written_wire", 0)) for _, vals in info.metrics
+            )
+            raw_m = sum(
+                int(vals.get("bytes_written_raw", 0)) for _, vals in info.metrics
+            )
+            wire = wire_m or sum(pt.num_bytes for pt in info.partitions)
+            stage.task_bytes[p] = {"raw": raw_m or wire, "wire": wire}
             if executor is not None:
                 self._propagate_output(stage, info, executor)
             if stage.is_completed():
                 sid = info.partition_id.stage_id
                 completed = stage.to_completed()
                 self.stages[sid] = completed
+                from ..obs.export import STAGE_SKEW_OP
+
+                skew = completed.stage_metrics.get(STAGE_SKEW_OP, {})
+                self._journal(
+                    "stage_completed",
+                    stage=sid,
+                    partitions=completed.partitions,
+                    task_retries=sum(completed.task_attempts.values()),
+                    runtime_skew=skew.get("runtime_ms_skew_x1000", 0) / 1000.0,
+                    bytes_skew=skew.get("bytes_wire_skew_x1000", 0) / 1000.0,
+                )
                 from .display import print_stage_metrics
 
                 print_stage_metrics(
@@ -469,6 +529,13 @@ class ExecutionGraph:
                 )
             stage.bump_spec_stat("wins")
             events.append("speculative_win")
+            self._journal(
+                "speculation_win",
+                stage=info.partition_id.stage_id,
+                partition=p,
+                executor=info.executor_id,
+                loser=cur.executor_id if cur is not None else "",
+            )
             started = shadow_started if shadow_started is not None else started
         elif shadow is not None:
             # the primary won the race after all: the duplicate is wasted
@@ -478,11 +545,18 @@ class ExecutionGraph:
             stage.bump_spec_stat("wasted")
             self.spec_wasted_pending += 1
             events.append("speculative_wasted")
+            self._journal(
+                "speculation_wasted",
+                stage=info.partition_id.stage_id,
+                partition=p,
+                executor=shadow.executor_id,
+            )
         stage.task_started_mono.pop(p, None)
         if started is not None:
-            stage.completed_runtime_s.append(
-                max(0.0, time.monotonic() - started)
-            )
+            runtime = max(0.0, time.monotonic() - started)
+            stage.completed_runtime_s.append(runtime)
+            # per-partition runtime distribution (skew input)
+            stage.task_runtime_s[p] = runtime
         return events
 
     def _on_task_failed(self, stage: RunningStage, info: TaskInfo) -> List[str]:
@@ -597,6 +671,14 @@ class ExecutionGraph:
             stage.task_statuses[p] = None
             stage.task_started_mono.pop(p, None)
             self.task_retries += 1
+            self._journal(
+                "task_retry",
+                stage=sid,
+                partition=p,
+                attempt=current,
+                executor=info.executor_id,
+                error=error[:200],
+            )
             return ["task_retried"]
 
         detail = "; ".join(history)
@@ -764,6 +846,13 @@ class ExecutionGraph:
             if n_rerun:
                 self.stages[prod_sid] = running
         self.revive()
+        self._journal(
+            "shuffle_lost_recovery",
+            producer_stage=prod_sid,
+            consumer_stage=csid,
+            executor=executor_id,
+            map_tasks_rerun=n_rerun,
+        )
         return ["job_updated"] + ["task_requeued"] * n_rerun
 
     # --------------------------------------- speculation/deadline scan
@@ -862,6 +951,14 @@ class ExecutionGraph:
             stage.task_attempts[p] = cur + 1
             stage.task_free_attempts[p] = reaps
             self.task_retries += 1
+            self._journal(
+                "task_reaped",
+                stage=sid,
+                partition=p,
+                executor=t.executor_id,
+                elapsed_s=round(now - started, 3),
+                timeout_s=timeout_s,
+            )
             out["events"].append("task_requeued")
 
     def _request_speculation(
@@ -1110,6 +1207,12 @@ class ExecutionGraph:
         stage.task_exclusions[p] = executor_id
         stage.task_attempts[p] = cur + 1
         stage.task_free_attempts[p] = stage.task_free_attempts.get(p, 0) + 1
+        self._journal(
+            "drain_handoff",
+            stage=partition.stage_id,
+            partition=p,
+            executor=executor_id,
+        )
         return True
 
     def reset_stages(self, executor_id: str) -> int:
@@ -1241,6 +1344,16 @@ class ExecutionGraph:
         if affected and self.status == COMPLETED:
             self.status = RUNNING
         self.revive()
+        if affected or repointed:
+            # replica repoint / executor-loss rollback: the post-mortem
+            # distinguishes "consumers re-pointed at replicas, nothing
+            # recomputed" from a genuine rollback storm
+            self._journal(
+                "executor_rollback",
+                executor=executor_id,
+                stages_affected=sorted(affected),
+                locations_repointed=repointed,
+            )
         # repoint-only changes (no rollback) still mutated locations and
         # must persist — report them without burning the reset ledger
         return len(affected) if affected else (1 if repointed else 0)
@@ -1358,6 +1471,7 @@ class ExecutionGraph:
         # stages complete (timing anchors are gone anyway)
         self._init_speculation_policy(None)
         self.pending_cancels = []
+        self.pending_events = []
         self.spec_wasted_pending = 0
         which = g.status.WhichOneof("status")
         if which == "queued":

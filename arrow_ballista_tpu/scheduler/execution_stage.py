@@ -213,6 +213,13 @@ class RunningStage:
     # cumulative launched/wins/wasted rollup (carried to CompletedStage
     # for the /api/jobs/{id}/profile speculation column)
     spec_stats: Dict[str, int] = field(default_factory=dict)
+    # ---- stage skew analytics (ISSUE 7): per-partition inputs for the
+    # completion-time reduction.  partition -> committed runtime seconds
+    # (the winner's, when a race ran) and -> written bytes {raw, wire};
+    # to_completed() reduces them to p50/p99/max-over-median coefficients
+    # inside stage_metrics, which already persist past cache eviction
+    task_runtime_s: Dict[int, float] = field(default_factory=dict)
+    task_bytes: Dict[int, Dict[str, int]] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -298,13 +305,20 @@ class RunningStage:
         return n
 
     def to_completed(self) -> "CompletedStage":
+        from ..obs.export import stage_skew_metrics
+
+        # reduce the per-partition runtime/bytes distributions to skew
+        # coefficients NOW — stage_metrics persist in the graph proto, so
+        # the profile keeps its skew column after cache eviction/restart
+        metrics = dict(self.stage_metrics)
+        metrics.update(stage_skew_metrics(self.task_runtime_s, self.task_bytes))
         return CompletedStage(
             self.stage_id,
             self.plan,
             list(self.output_links),
             dict(self.inputs),
             list(self.task_statuses),
-            dict(self.stage_metrics),
+            metrics,
             dict(self.task_attempts),
             dict(self.task_fetch_retries),
             spec_stats=dict(self.spec_stats),
@@ -350,6 +364,29 @@ class CompletedStage:
 
     def to_running(self) -> RunningStage:
         """Re-run after its shuffle files were lost with an executor."""
+        from ..obs.export import (
+            TASK_BYTES_RAW_OP,
+            TASK_BYTES_WIRE_OP,
+            TASK_RUNTIME_OP,
+        )
+
+        # Seed the skew inputs from the persisted per-partition maps so
+        # re-completion reduces over the FULL distribution (re-run
+        # partitions overwrite their own entries) — otherwise a 1-task
+        # lost-shuffle re-run would overwrite a 100-partition stage's
+        # skew with partitions=1.  ms + 0.5 survives to_completed's
+        # int(v * 1e3) truncation exactly (v/1e3*1e3 can land just
+        # below the integer).
+        runtime_s = {
+            int(p): (v + 0.5) / 1e3
+            for p, v in self.stage_metrics.get(TASK_RUNTIME_OP, {}).items()
+        }
+        wire = self.stage_metrics.get(TASK_BYTES_WIRE_OP, {})
+        raw = self.stage_metrics.get(TASK_BYTES_RAW_OP, {})
+        task_bytes = {
+            int(p): {"wire": int(wire.get(p, 0)), "raw": int(raw.get(p, 0))}
+            for p in set(wire) | set(raw)
+        }
         return RunningStage(
             self.stage_id,
             self.plan,
@@ -362,6 +399,8 @@ class CompletedStage:
             {},
             dict(self.task_fetch_retries),
             spec_stats=dict(self.spec_stats),
+            task_runtime_s=runtime_s,
+            task_bytes=task_bytes,
         )
 
     def reset_tasks(self, executor_id: str) -> int:

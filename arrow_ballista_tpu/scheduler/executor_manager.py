@@ -85,7 +85,14 @@ class ExecutorManager:
         quarantine_backoff_s: float = DEFAULT_QUARANTINE_BACKOFF_S,
         launch_failure_threshold: int = DEFAULT_LAUNCH_FAILURE_THRESHOLD,
         registry: Optional[MetricsRegistry] = None,
+        events=None,
     ):
+        from ..obs.events import EventJournal
+
+        # structured event journal (obs/events.py): membership churn —
+        # register/quarantine/drain/removal — is exactly what a
+        # post-mortem needs when a job's slowdown traces to the cluster
+        self.events = events if events is not None else EventJournal()
         self.backend = backend
         self.liveness_window_s = liveness_window_s
         self._heartbeats: Dict[str, ExecutorHeartbeat] = {}
@@ -187,6 +194,12 @@ class ExecutorManager:
             self._launch_failures.pop(metadata.id, None)
             self._pending_expulsions.discard(metadata.id)
             self._draining.pop(metadata.id, None)
+        self.events.emit(
+            "executor_registered",
+            executor=metadata.id,
+            host=metadata.host,
+            slots=slots,
+        )
         if reserve:
             return [ExecutorReservation(metadata.id) for _ in range(slots)]
         return []
@@ -213,6 +226,9 @@ class ExecutorManager:
             # the executor is out of the cluster with its locations
             # re-pointed by the accompanying rollback
             self._drained.inc()
+        self.events.emit(
+            "executor_removed", executor=executor_id, drained=was_draining
+        )
 
     def get_executor_metadata(self, executor_id: str) -> ExecutorMetadata:
         raw = self.backend.get(Keyspace.Executors, executor_id)
@@ -334,6 +350,12 @@ class ExecutorManager:
             self.quarantine_threshold,
             self.quarantine_window_s,
         )
+        self.events.emit(
+            "executor_quarantined",
+            executor=executor_id,
+            backoff_s=self.quarantine_backoff_s,
+            failures=self.quarantine_threshold,
+        )
         return True
 
     def record_launch_failure(self, executor_id: str) -> bool:
@@ -377,6 +399,9 @@ class ExecutorManager:
         future reservation while it finishes/hands off its work."""
         with self._q_lock:
             self._draining[executor_id] = time.monotonic() + max(0.0, timeout_s)
+        self.events.emit(
+            "executor_drain_started", executor=executor_id, timeout_s=timeout_s
+        )
 
     def is_draining(self, executor_id: str) -> bool:
         with self._q_lock:

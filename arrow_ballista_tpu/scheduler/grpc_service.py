@@ -108,6 +108,12 @@ class SchedulerGrpcService:
             from ..obs.recorder import trace_store
 
             trace_store().add_json(request.spans_json)
+        if request.telemetry_json:
+            # tolerant: an old executor ships nothing, a broken one may
+            # ship garbage — the store counts a parse error and moves on
+            self.server.state.telemetry.record_executor(
+                request.executor_id, request.telemetry_json
+            )
         return pb.HeartBeatResult(reregister=False)
 
     def UpdateTaskStatus(

@@ -244,6 +244,14 @@ class QueryStageScheduler(EventAction):
         serialize instead of racing across threads."""
         log.warning("executor %s lost: %s", event.executor_id, event.reason)
         em = self.state.executor_manager
+        self.state.events.emit(
+            "executor_lost",
+            executor=event.executor_id,
+            reason=(event.reason or "")[:200],
+        )
+        # the lost executor's telemetry series and labeled gauges go too
+        # (its last snapshot must not read as a live executor forever)
+        self.state.telemetry.forget_executor(event.executor_id)
         if not em.is_draining(event.executor_id):
             # a non-draining loss (crash/expiry) gets a best-effort
             # force-stop so a half-dead process stops serving; a DRAINED

@@ -67,6 +67,10 @@ class SchedulerServer:
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
         drain_timeout_s: float = 30.0,
+        telemetry_sample_s: float = 5.0,
+        event_journal_dir: str = "",
+        event_journal_rotate_bytes: Optional[int] = None,
+        event_journal_segments: Optional[int] = None,
     ):
         self.scheduler_id = scheduler_id
         self.policy = policy
@@ -83,6 +87,9 @@ class SchedulerServer:
             quarantine_backoff_s=quarantine_backoff_s,
             speculation_force_enabled=speculation_force_enabled,
             task_timeout_force_s=task_timeout_force_s,
+            event_journal_dir=event_journal_dir,
+            event_journal_rotate_bytes=event_journal_rotate_bytes,
+            event_journal_segments=event_journal_segments,
         )
         self.event_loop = EventLoop(
             "query_stage", EVENT_LOOP_BUFFER, QueryStageScheduler(self.state)
@@ -98,8 +105,12 @@ class SchedulerServer:
         # (ballista.executor.drain_timeout_seconds is the session-side
         # spelling; the scheduler flag wins for operator-driven drains)
         self.drain_timeout_s = drain_timeout_s
+        # cluster-aggregate sampling period (queue depth, running tasks,
+        # slots free → obs/timeseries.py rings); tests shrink the attr
+        self.telemetry_sample_s = telemetry_sample_s
         self._reaper: Optional[threading.Thread] = None
         self._spec_timer: Optional[threading.Thread] = None
+        self._telemetry_timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
@@ -119,12 +130,17 @@ class SchedulerServer:
             target=self._speculation_loop, name="speculation-timer", daemon=True
         )
         self._spec_timer.start()
+        self._telemetry_timer = threading.Thread(
+            target=self._telemetry_loop, name="cluster-telemetry", daemon=True
+        )
+        self._telemetry_timer.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         self.event_loop.stop()
         self.state.executor_manager.close()
+        self.state.events.close()
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Wait until the event loop has processed everything queued (test
@@ -299,6 +315,48 @@ class SchedulerServer:
                     self.event_loop.get_sender().post(SpeculationScan())
             except Exception:  # noqa: BLE001 - timer must never die
                 log.exception("speculation timer iteration failed")
+
+    def _telemetry_loop(self) -> None:
+        """Record the cluster-aggregate series (queue depth, running
+        tasks, slots free, shuffle backlog) into the bounded timeseries
+        rings — the history behind /api/cluster/timeseries; the same
+        values are scrape-time gauges on /api/metrics."""
+        while not self._stop.wait(max(0.1, self.telemetry_sample_s)):
+            try:
+                self.sample_cluster_telemetry()
+            except Exception:  # noqa: BLE001 - timer must never die
+                log.exception("cluster telemetry sample failed")
+
+    def sample_cluster_telemetry(self) -> Dict[str, float]:
+        """One cluster-aggregate sample (also callable from tests)."""
+        state = self.state
+        pending, running = state.task_manager.task_counts()
+        em = state.executor_manager
+        latest = state.telemetry.latest()
+        metrics: Dict[str, float] = {
+            "pending_tasks": pending,
+            "running_tasks": running,
+            "available_slots": em.available_slots(),
+            "alive_executors": len(em.get_alive_executors()),
+            "active_jobs": len(state.task_manager.active_job_ids()),
+            "executors_quarantined": len(em.quarantined_executors()),
+            "executors_draining": len(em.draining_executors()),
+            # shuffle backlog: queued-but-unmoved bytes + pending replica
+            # uploads summed over the latest executor snapshots
+            "shuffle_queue_bytes": sum(
+                (s.get("fetch_queue_bytes") or 0)
+                + (s.get("write_queue_bytes") or 0)
+                for s in latest.values()
+                if isinstance(s, dict)
+            ),
+            "replicator_backlog": sum(
+                s.get("replicator_backlog") or 0
+                for s in latest.values()
+                if isinstance(s, dict)
+            ),
+        }
+        state.telemetry.record_cluster(metrics)
+        return metrics
 
     # --------------------------------------------------------- HA failover
     SCHEDULER_HB_PREFIX = "scheduler:"

@@ -38,6 +38,8 @@ def new_standalone_scheduler(
     backend: Optional[StateBackend] = None,
     liveness_window_s: float = 60.0,
     executor_timeout_s: float = 180.0,
+    event_journal_dir: str = "",
+    telemetry_sample_s: float = 1.0,
 ) -> StandaloneScheduler:
     backend = backend or MemoryBackend()
     scheduler_id = f"localhost:{uuid.uuid4().hex[:6]}"
@@ -47,6 +49,10 @@ def new_standalone_scheduler(
         policy,
         liveness_window_s=liveness_window_s,
         executor_timeout_s=executor_timeout_s,
+        event_journal_dir=event_journal_dir,
+        # standalone exists for tests/local runs: sample the cluster
+        # aggregates tightly so short-lived clusters still get history
+        telemetry_sample_s=telemetry_sample_s,
     ).init()
     grpc_server = make_server()
     add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))

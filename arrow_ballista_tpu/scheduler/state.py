@@ -9,6 +9,8 @@ push scheduling and the pull-mode poll handler.
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..config import BallistaConfig, TaskSchedulingPolicy
@@ -47,7 +49,16 @@ class SchedulerState:
         quarantine_backoff_s: Optional[float] = None,
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
+        event_journal_dir: str = "",
+        event_journal_rotate_bytes: Optional[int] = None,
+        event_journal_segments: Optional[int] = None,
     ):
+        from ..obs.events import (
+            DEFAULT_KEEP_SEGMENTS,
+            DEFAULT_ROTATE_BYTES,
+            EventJournal,
+        )
+        from ..obs.timeseries import ClusterTelemetry, SloTracker
         from .executor_manager import (
             DEFAULT_QUARANTINE_BACKOFF_S,
             DEFAULT_QUARANTINE_THRESHOLD,
@@ -61,6 +72,27 @@ class SchedulerState:
         # process may run several side by side) backing /api/metrics and
         # the Prometheus endpoint; managers register their counters here
         self.metrics = MetricsRegistry()
+        # continuous cluster telemetry (ISSUE 7): heartbeat snapshots and
+        # the scheduler's own aggregates land in bounded downsampling
+        # rings behind /api/cluster/health + /api/cluster/timeseries
+        self.telemetry = ClusterTelemetry(registry=self.metrics)
+        # structured event journal (off unless a directory is configured;
+        # emit() is then one attribute check) — managers below share it
+        self.events = EventJournal(
+            event_journal_dir,
+            rotate_bytes=(
+                DEFAULT_ROTATE_BYTES
+                if event_journal_rotate_bytes is None
+                else event_journal_rotate_bytes
+            ),
+            keep_segments=(
+                DEFAULT_KEEP_SEGMENTS
+                if event_journal_segments is None
+                else event_journal_segments
+            ),
+        )
+        # per-session job-latency SLO (ballista.obs.slo.job_latency_seconds)
+        self.slo = SloTracker(self.metrics)
         self.executor_manager = ExecutorManager(
             backend,
             liveness_window_s,
@@ -80,10 +112,13 @@ class SchedulerState:
                 else quarantine_backoff_s
             ),
             registry=self.metrics,
+            events=self.events,
         )
         self.task_manager = TaskManager(
             backend, self.executor_manager, scheduler_id, launcher, work_dir,
             registry=self.metrics,
+            events=self.events,
+            slo=self.slo,
         )
         self.session_manager = SessionManager(backend, session_builder)
         # straggler mitigation: the periodic scan body (invoked on the
@@ -116,6 +151,32 @@ class SchedulerState:
         self.metrics.gauge(
             "trace_store_spans", "spans held for /api/jobs/{id}/trace",
             fn=lambda: trace_store().span_count(),
+        )
+        # autoscaling/admission signals (ROADMAP item 3): queue depth and
+        # slot saturation computed at scrape, recorded as history by the
+        # SchedulerServer's cluster sampling loop
+        # one task_counts() walk (it takes every cached job's entry lock)
+        # feeds both gauges: the providers are read back-to-back in a
+        # scrape, so a short memo halves the lock traffic without going
+        # stale between scrapes
+        counts_lock = threading.Lock()
+        counts_state = {"mono": -1.0, "value": (0, 0)}
+
+        def _task_counts_memo() -> Tuple[int, int]:
+            with counts_lock:
+                now = time.monotonic()
+                if now - counts_state["mono"] > 0.1:
+                    counts_state["value"] = self.task_manager.task_counts()
+                    counts_state["mono"] = now
+                return counts_state["value"]
+
+        self.metrics.gauge(
+            "pending_tasks", "dispatchable tasks waiting for a slot",
+            fn=lambda: _task_counts_memo()[0],
+        )
+        self.metrics.gauge(
+            "running_tasks", "tasks currently dispatched to executors",
+            fn=lambda: _task_counts_memo()[1],
         )
 
     # ------------------------------------------------------------ planning
@@ -209,8 +270,6 @@ class SchedulerState:
                 )
             except Exception as e:  # noqa: BLE001 - executor may be gone
                 log.debug("StopExecutor(%s) failed: %s", executor_id, e)
-
-        import threading
 
         threading.Thread(
             target=_stop, name="stop-executor", daemon=True

@@ -95,12 +95,21 @@ class TaskManager:
         launcher: Optional[TaskLauncher] = None,
         work_dir: str = "/tmp/ballista-tpu",
         registry: Optional[MetricsRegistry] = None,
+        events=None,
+        slo=None,
     ):
+        from ..obs.events import EventJournal
+
         self.backend = backend
         self.executor_manager = executor_manager
         self.scheduler_id = scheduler_id
         self.launcher = launcher or GrpcLauncher()
         self.work_dir = work_dir
+        # structured event journal + SLO tracker (obs/events.py,
+        # obs/timeseries.py): shared with the owning SchedulerState; a
+        # bare TaskManager (tests) gets a disabled journal
+        self.events = events if events is not None else EventJournal()
+        self.slo = slo
         self._cache: Dict[str, JobEntry] = {}
         self._cache_lock = threading.Lock()
         # scheduler-lifetime counters live in the unified registry
@@ -177,6 +186,15 @@ class TaskManager:
         wasted = graph.take_spec_wasted()
         if wasted:
             self._spec_wasted.inc(wasted)
+        # ...and drain the graph's queued journal events (stage
+        # completion/skew, retries, speculation outcomes, reaps,
+        # lost-shuffle recovery, drain handoffs) into the event journal —
+        # drained unconditionally so a disabled journal never accumulates
+        self.events.emit_many(
+            graph.take_pending_events(),
+            job=graph.job_id,
+            trace=graph.trace_id,
+        )
         try:
             self.backend.put(Keyspace.ActiveJobs, graph.job_id, graph.encode())
         except Exception:
@@ -271,6 +289,14 @@ class TaskManager:
         # cached, and those TaskDefinitions must already carry the trace
         graph.trace_id = trace_id
         graph.revive()
+        self.events.emit(
+            "job_submitted",
+            job=job_id,
+            trace=trace_id,
+            session=session_id,
+            stages=len(graph.stages),
+            partitions=graph.output_partitions,
+        )
         entry = self._entry(job_id)
         with entry.lock:
             entry.graph = graph
@@ -795,9 +821,42 @@ class TaskManager:
                 self._persist(graph)
             self._emit_job_span(graph, "completed")
             self._jobs_completed.inc()
+            self._observe_completion(graph)
             self.backend.mv(Keyspace.ActiveJobs, Keyspace.CompletedJobs, job_id)
             with self._cache_lock:
                 self._cache.pop(job_id, None)
+
+    def _observe_completion(self, graph: Optional[ExecutionGraph]) -> None:
+        """Journal the completion and feed the session's latency SLO
+        (``ballista.obs.slo.job_latency_seconds``; 0/absent = untracked).
+        The journal line is the job's post-mortem anchor — it survives
+        the cache eviction this very call performs."""
+        if graph is None:
+            return
+        latency_s = (time.monotonic_ns() - graph.submitted_mono_ns) / 1e9
+        breached = None
+        if self.slo is not None:
+            from ..config import OBS_SLO_JOB_LATENCY_S
+
+            try:
+                target = float(
+                    self._session_settings(graph.session_id).get(
+                        OBS_SLO_JOB_LATENCY_S, 0.0
+                    )
+                )
+            except (TypeError, ValueError):
+                target = 0.0
+            if target > 0:
+                breached = self.slo.observe(latency_s, target)
+        fields = {
+            "latency_s": round(latency_s, 4),
+            "task_retries": graph.task_retries,
+        }
+        if breached is not None:
+            fields["slo_breached"] = breached
+        self.events.emit(
+            "job_completed", job=graph.job_id, trace=graph.trace_id, **fields
+        )
 
     def fail_job(self, job_id: str, error: str) -> None:
         entry = self._entry(job_id)
@@ -813,6 +872,12 @@ class TaskManager:
             if not already_failed:
                 self._emit_job_span(graph, "failed")
                 self._jobs_failed.inc()
+                self.events.emit(
+                    "job_failed",
+                    job=job_id,
+                    trace=getattr(graph, "trace_id", "") or "",
+                    error=(error or "")[:500],
+                )
             tombstone = graph is None
             if graph is not None:
                 if graph.status != FAILED:
@@ -894,6 +959,23 @@ class TaskManager:
     def active_job_ids(self) -> List[str]:
         with self._cache_lock:
             return list(self._cache.keys())
+
+    def task_counts(self) -> Tuple[int, int]:
+        """(pending, running) task totals across cached active jobs —
+        the queue-depth and slot-saturation inputs for the cluster
+        telemetry rings and the autoscaling gauges.  Reads only cached
+        graphs (scrape-time: must never hit the backend)."""
+        pending = running = 0
+        with self._cache_lock:
+            entries = list(self._cache.values())
+        for entry in entries:
+            with entry.lock:
+                graph = entry.graph
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                pending += graph.available_tasks()
+                running += graph.running_tasks()
+        return pending, running
 
     def list_jobs(self) -> List[dict]:
         """Job table for the REST API: active, completed and failed jobs

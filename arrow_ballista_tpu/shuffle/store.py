@@ -316,6 +316,18 @@ def replicator() -> Replicator:
         return _replicator
 
 
+def replicator_backlog() -> int:
+    """Uploads submitted but unfinished, WITHOUT creating the replicator
+    (the telemetry sampler reads this every heartbeat on executors that
+    may never replicate anything)."""
+    with _replicator_lock:
+        rep = _replicator
+    if rep is None:
+        return 0
+    with rep._cv:
+        return rep._pending
+
+
 # ------------------------------------------------------------- drain upload
 def drain_upload(
     work_dir: str, external_root: str

@@ -54,6 +54,33 @@ _WRITE_REGISTRY_NAMES = {
     "write_time_ns": "shuffle_write_ns_total",
 }
 
+# Process-wide write-queue occupancy: bytes coalesced but not yet written
+# across every live AsyncShuffleWriter.  The telemetry heartbeat
+# piggyback (obs/telemetry.py) reports it next to the fetch side's
+# staging bytes — two plain ints, no jax/pyarrow on the read path.
+_queued_lock = threading.Lock()
+_queued_bytes = 0
+
+
+def _queued_add(n: int) -> None:
+    global _queued_bytes
+    with _queued_lock:
+        _queued_bytes += n
+
+
+def _queued_sub(n: int) -> None:
+    global _queued_bytes
+    with _queued_lock:
+        _queued_bytes -= n
+        if _queued_bytes < 0:  # defensive: never report negative pressure
+            _queued_bytes = 0
+
+
+def queued_bytes() -> int:
+    """Bytes sitting in shuffle write-pool queues right now."""
+    with _queued_lock:
+        return _queued_bytes
+
 
 @dataclass(frozen=True)
 class WritePolicy:
@@ -166,6 +193,7 @@ class _ByteQueue:
                 raise _Closed()
             self._items.append((item, nbytes))
             self._bytes += nbytes
+            _queued_add(nbytes)
             self._cv.notify_all()
 
     def finish(self) -> None:
@@ -187,6 +215,7 @@ class _ByteQueue:
                 return None
             item, nbytes = self._items.pop(0)
             self._bytes -= nbytes
+            _queued_sub(nbytes)
             self._cv.notify_all()
             return item
 
@@ -194,6 +223,7 @@ class _ByteQueue:
         with self._cv:
             self._closed = True
             self._items.clear()
+            _queued_sub(self._bytes)
             self._bytes = 0
             self._cv.notify_all()
 

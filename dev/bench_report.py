@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Aggregate the repo-root ``BENCH_*.json`` / ``BENCH_SUITE_*.json``
+snapshots into one markdown trajectory table (metric x revision).
+
+Every PR's bench runs left a snapshot named after its revision
+(``BENCH_r03.json``, ``BENCH_SUITE_r05.json``, ``BENCH_r05_dev.json``
+...).  Two shapes exist:
+
+* ``BENCH_<rev>.json`` — a single JSON object whose ``parsed`` field (or
+  the object itself) holds one ``{"metric", "value", "unit", ...}``
+  record;
+* ``BENCH_SUITE_<rev>.json`` — JSON Lines, one record per line.
+
+The report keeps the LAST record per (metric, revision) — suites re-run
+a metric to warm caches; the final run is the measurement.  Unknown or
+torn lines are skipped, never fatal: this is a reporting tool, and one
+corrupt snapshot must not hide the rest of the trajectory.
+
+Usage: ``python dev/bench_report.py [--root DIR]``.  ``dev/tier1.sh
+--bench-smoke`` prints it after the smoke benches so the trajectory
+rides every bench log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^BENCH_(SUITE_)?(?P<rev>r\d+[A-Za-z0-9_]*)\.json$")
+
+
+def _records_from(path: str) -> List[dict]:
+    """Tolerantly extract metric records from one snapshot file."""
+    out: List[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return out
+    # whole-file JSON object first (BENCH_<rev>.json shape)
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            rec = obj.get("parsed", obj)
+            if isinstance(rec, dict) and "metric" in rec:
+                out.append(rec)
+            return out
+        if isinstance(obj, list):
+            return [r for r in obj if isinstance(r, dict) and "metric" in r]
+    except Exception:  # noqa: BLE001 - fall through to JSONL
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except Exception:  # noqa: BLE001 - torn/garbage line
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def _rev_key(rev: str) -> Tuple[int, str]:
+    m = re.match(r"r(\d+)", rev)
+    return (int(m.group(1)) if m else 0, rev)
+
+
+def collect(root: str) -> Tuple[List[str], Dict[str, Dict[str, dict]]]:
+    """Scan ``root`` for snapshots; returns (revisions sorted,
+    {metric: {revision: last record}})."""
+    table: Dict[str, Dict[str, dict]] = {}
+    revs: set = set()
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return [], {}
+    for name in names:
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue
+        rev = m.group("rev")
+        records = _records_from(os.path.join(root, name))
+        if not records:
+            continue
+        revs.add(rev)
+        for rec in records:
+            metric = str(rec.get("metric"))
+            table.setdefault(metric, {})[rev] = rec  # last record wins
+    return sorted(revs, key=_rev_key), table
+
+
+def _fmt_value(rec: Optional[dict]) -> str:
+    if rec is None:
+        return "—"
+    v = rec.get("value")
+    if isinstance(v, (int, float)):
+        s = f"{v:,.2f}".rstrip("0").rstrip(".") if isinstance(v, float) else f"{v:,}"
+    else:
+        s = str(v)
+    vs = rec.get("vs_baseline")
+    if isinstance(vs, (int, float)):
+        s += f" ({vs:g}x)"
+    return s
+
+
+def markdown_report(root: str = ".") -> str:
+    revs, table = collect(root)
+    if not table:
+        return "(no BENCH_*.json / BENCH_SUITE_*.json snapshots found)"
+    lines = [
+        "### Benchmark trajectory (metric x revision)",
+        "",
+        "| metric (unit) | " + " | ".join(revs) + " |",
+        "|" + "---|" * (len(revs) + 1),
+    ]
+    for metric in sorted(table):
+        per_rev = table[metric]
+        unit = next(
+            (r.get("unit") for r in per_rev.values() if r.get("unit")), ""
+        )
+        label = f"{metric} ({unit})" if unit else metric
+        cells = [_fmt_value(per_rev.get(rev)) for rev in revs]
+        lines.append("| " + " | ".join([label, *cells]) + " |")
+    lines.append("")
+    lines.append(
+        "_(value (speedup vs baseline); last record per metric per "
+        "revision; — = not measured that revision)_"
+    )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_*.json snapshots (default: repo root)",
+    )
+    args = ap.parse_args()
+    print(markdown_report(args.root))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,8 @@ print(json.dumps({"bench_smoke": "shuffle_write",
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
+  timeout -k 10 60 python dev/bench_report.py || true
 fi
 if [ "$CHAOS_SMOKE" = "1" ]; then
   echo "--- chaos smoke (bounded random kill/drain soak) ---"
