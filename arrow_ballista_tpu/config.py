@@ -52,6 +52,16 @@ SHUFFLE_COMPRESSION = "ballista.shuffle.compression"
 SHUFFLE_STORE = "ballista.shuffle.store"
 SHUFFLE_REPLICATION = "ballista.shuffle.replication"
 SHUFFLE_EXTERNAL_PATH = "ballista.shuffle.external_path"
+# Adaptive query execution (see docs/user-guide/aqe.md)
+AQE_ENABLED = "ballista.aqe.enabled"
+AQE_COALESCE_ENABLED = "ballista.aqe.coalesce_enabled"
+AQE_BROADCAST_ENABLED = "ballista.aqe.broadcast_enabled"
+AQE_SKEW_ENABLED = "ballista.aqe.skew_enabled"
+AQE_TARGET_PARTITION_BYTES = "ballista.aqe.target_partition_bytes"
+AQE_BROADCAST_THRESHOLD_BYTES = "ballista.aqe.broadcast_threshold_bytes"
+AQE_SKEW_FACTOR = "ballista.aqe.skew_factor"
+AQE_MAX_SPLITS = "ballista.aqe.max_splits"
+AQE_COALESCE_MIN_PARTITIONS = "ballista.aqe.coalesce_min_partitions"
 # Fault tolerance (see docs/user-guide/fault-tolerance.md)
 TASK_MAX_ATTEMPTS = "ballista.task.max_attempts"
 TASK_TIMEOUT_S = "ballista.task.timeout_seconds"
@@ -383,6 +393,80 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "",
         ),
         ConfigEntry(
+            AQE_ENABLED,
+            "adaptive query execution: when a stage completes, its "
+            "observed per-partition shuffle sizes re-plan not-yet-"
+            "resolved consumer stages (partition coalescing, shuffle→"
+            "broadcast join conversion, skew splitting — each with its "
+            "own toggle below); false restores fully static plans",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            AQE_COALESCE_ENABLED,
+            "AQE rewrite 1: pack adjacent tiny reduce partitions into "
+            "fewer tasks until each reads ~aqe.target_partition_bytes",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            AQE_BROADCAST_ENABLED,
+            "AQE rewrite 2: when one side of a partitioned inner join "
+            "measures under aqe.broadcast_threshold_bytes before the "
+            "probe side has started, convert to a collect-left "
+            "broadcast join and strip the probe-side shuffle stage",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            AQE_SKEW_ENABLED,
+            "AQE rewrite 3: split a reduce partition whose observed "
+            "input exceeds aqe.skew_factor x median across several "
+            "tasks, each reading a disjoint subset of the map-side "
+            "fragments (joins duplicate the companion side's partition; "
+            "final aggregates re-merge partial states downstream)",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            AQE_TARGET_PARTITION_BYTES,
+            "coalescing packs reduce partitions up to this many "
+            "observed wire bytes per task; skew splitting sizes its "
+            "chunk count against it",
+            int,
+            str(16 << 20),
+        ),
+        ConfigEntry(
+            AQE_BROADCAST_THRESHOLD_BYTES,
+            "a completed build side smaller than this (total wire "
+            "bytes) qualifies for shuffle→broadcast join conversion",
+            int,
+            str(10 << 20),
+        ),
+        ConfigEntry(
+            AQE_SKEW_FACTOR,
+            "a reduce partition is skewed when its observed bytes "
+            "exceed this multiple of the stage's median partition "
+            "(and aqe.target_partition_bytes)",
+            float,
+            "4.0",
+        ),
+        ConfigEntry(
+            AQE_MAX_SPLITS,
+            "ceiling on the tasks one skewed partition splits into "
+            "(also bounded by its map-side fragment count)",
+            int,
+            "8",
+        ),
+        ConfigEntry(
+            AQE_COALESCE_MIN_PARTITIONS,
+            "shuffles with at most this many reduce partitions keep "
+            "their static layout — scheduling a handful of tasks costs "
+            "less than second-guessing them",
+            int,
+            "8",
+        ),
+        ConfigEntry(
             EXECUTOR_DRAIN_TIMEOUT_S,
             "graceful-decommission budget (seconds): a draining executor "
             "finishes its running tasks within this window (past it they "
@@ -681,6 +765,42 @@ class BallistaConfig:
     @property
     def shuffle_external_path(self) -> str:
         return self._get(SHUFFLE_EXTERNAL_PATH)
+
+    @property
+    def aqe_enabled(self) -> bool:
+        return self._get(AQE_ENABLED)
+
+    @property
+    def aqe_coalesce_enabled(self) -> bool:
+        return self._get(AQE_COALESCE_ENABLED)
+
+    @property
+    def aqe_broadcast_enabled(self) -> bool:
+        return self._get(AQE_BROADCAST_ENABLED)
+
+    @property
+    def aqe_skew_enabled(self) -> bool:
+        return self._get(AQE_SKEW_ENABLED)
+
+    @property
+    def aqe_target_partition_bytes(self) -> int:
+        return self._get(AQE_TARGET_PARTITION_BYTES)
+
+    @property
+    def aqe_broadcast_threshold_bytes(self) -> int:
+        return self._get(AQE_BROADCAST_THRESHOLD_BYTES)
+
+    @property
+    def aqe_skew_factor(self) -> float:
+        return self._get(AQE_SKEW_FACTOR)
+
+    @property
+    def aqe_max_splits(self) -> int:
+        return self._get(AQE_MAX_SPLITS)
+
+    @property
+    def aqe_coalesce_min_partitions(self) -> int:
+        return self._get(AQE_COALESCE_MIN_PARTITIONS)
 
     @property
     def executor_drain_timeout_seconds(self) -> float:

@@ -83,6 +83,24 @@ class HashJoinExec(ExecutionPlan):
             self.partition_mode, self.filter,
         )
 
+    def as_collect_left(
+        self, left: Optional[ExecutionPlan] = None,
+        right: Optional[ExecutionPlan] = None,
+    ) -> "HashJoinExec":
+        """This join rebuilt in COLLECT_LEFT (build-side broadcast) mode,
+        optionally with replacement inputs — the AQE shuffle→broadcast
+        conversion (scheduler/adaptive.py) swaps the probe-side shuffle
+        read for the producer's inlined subtree.  Only valid for inner
+        joins: broadcasting the build side against each probe partition
+        would emit per-partition unmatched/duplicate rows for any other
+        type (see the physical planner's mode selection)."""
+        assert self.join_type == "inner", "COLLECT_LEFT requires an inner join"
+        return HashJoinExec(
+            left if left is not None else self.left,
+            right if right is not None else self.right,
+            self.on, self.join_type, COLLECT_LEFT, self.filter,
+        )
+
     def __str__(self) -> str:
         on = ", ".join(f"{l}={r}" for l, r in self.on)
         return (

@@ -42,8 +42,13 @@ STAGE_SKEW_OP = "__stage_skew__"
 TASK_RUNTIME_OP = "__task_runtime_ms__"
 TASK_BYTES_WIRE_OP = "__task_bytes_wire__"
 TASK_BYTES_RAW_OP = "__task_bytes_raw__"
+# AQE replan summary (scheduler/adaptive.py): {tasks_before, tasks_after,
+# coalesced_groups, skew_splits, broadcast} — persisted through the same
+# stage-metrics proto path, lifted into row["aqe"] by job_profile
+AQE_OP = "__aqe__"
 _SYNTHETIC_OPS = (
     STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
+    AQE_OP,
 )
 
 
@@ -259,6 +264,11 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             # stage-completion partition skew (runtime + written bytes):
             # the coalesce/split signal for adaptive re-planning
             row["skew"] = skew
+        aqe = metrics.get(AQE_OP) or r.get("aqe")
+        if aqe:
+            # adaptive re-planning outcome: how the observed shuffle
+            # stats reshaped this stage's task layout
+            row["aqe"] = dict(aqe)
         spec = r.get("speculation")
         if spec:
             # straggler mitigation rollup: duplicates launched for this

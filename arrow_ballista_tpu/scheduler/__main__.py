@@ -43,6 +43,7 @@ CONFIG_KEYS = {
     "speculation_interval_seconds": (float, 1.0, "period of the straggler/deadline scan on the event loop"),
     "task_timeout_seconds": (float, 0.0, "reap running tasks older than this for every session (0 = off; sessions can set ballista.task.timeout_seconds)"),
     "drain_timeout_seconds": (float, 30.0, "graceful-decommission budget handed to a draining executor (DecommissionExecutor RPC / POST /api/executors/{id}/decommission)"),
+    "aqe_enabled": (int, 0, "1 = adaptive query execution (re-plan stages from observed shuffle stats) as the cluster-wide default; an explicit session ballista.aqe.* setting wins"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "event_journal_dir": (str, "", "directory for the append-only structured event journal (empty = disabled; see /api/jobs/{id}/events and /api/events/tail)"),
     "event_journal_rotate_bytes": (int, 4 << 20, "rotate the active journal segment past this size"),
@@ -162,6 +163,7 @@ def main(argv=None) -> None:
         speculation_interval_s=cfg["speculation_interval_seconds"],
         speculation_force_enabled=bool(cfg["speculation_enabled"]),
         task_timeout_force_s=cfg["task_timeout_seconds"],
+        aqe_force_enabled=bool(cfg["aqe_enabled"]),
         drain_timeout_s=cfg["drain_timeout_seconds"],
         telemetry_sample_s=cfg["telemetry_sample_seconds"],
         event_journal_dir=cfg["event_journal_dir"],

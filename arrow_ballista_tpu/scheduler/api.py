@@ -94,7 +94,13 @@ async function showDetail(jobId) {
       : Math.round(100 * s.completed_tasks / Math.max(1, s.partitions));
     const retr = (s.task_retries || s.fetch_retries)
       ? `task ${s.task_retries || 0} · fetch ${s.fetch_retries || 0}` : '—';
-    const mets = s.metrics
+    // adaptive re-plan badge: observed stats reshaped this stage's tasks
+    const aqe = s.aqe
+      ? `aqe ${s.aqe.tasks_before}→${s.aqe.tasks_after} tasks` +
+        (s.aqe.broadcast ? ' (broadcast)' : '') +
+        (s.aqe.skew_splits ? ` (${s.aqe.skew_splits} skew splits)` : '')
+      : '';
+    const opMets = s.metrics
       ? esc(Object.entries(s.metrics)
           // __-prefixed operators are the skew-analytics payloads
           // (per-partition maps); the profile endpoint renders them
@@ -102,7 +108,8 @@ async function showDetail(jobId) {
           .map(([op, m]) =>
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
         ).join(' · '))
-      : '—';
+      : '';
+    const mets = [aqe, opMets].filter(Boolean).join(' · ') || '—';
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
             `<td>${done}</td>` +
             `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +

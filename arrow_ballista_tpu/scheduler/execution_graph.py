@@ -11,6 +11,7 @@ re-dispatches in-flight work (`execution_graph.rs:867-920`).
 
 from __future__ import annotations
 
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
@@ -110,6 +111,12 @@ class ExecutionGraph:
         # In-memory only — a restarted scheduler re-derives nothing here
         # (Running stages persist as Resolved, so timing state is gone).
         self._init_speculation_policy(config)
+        # adaptive query execution (scheduler/adaptive.py): persisted in
+        # the graph proto so restart/HA adoption replays decisions for
+        # stages that resolve after the failover
+        from .adaptive import AqePolicy
+
+        self.aqe_policy = AqePolicy.from_config(config)
         # CancelTasks fan-out queue: (executor_id, PartitionId) of losing
         # duplicate attempts / reaped deadline-timeouts, drained by the
         # TaskManager after graph mutations commit
@@ -218,10 +225,16 @@ class ExecutionGraph:
     def revive(self) -> bool:
         """Resolve every resolvable stage and start every resolved stage
         (reference: execution_graph.rs:169-193).  Returns True if anything
-        changed."""
+        changed.
+
+        The moment a stage becomes resolvable every producer has
+        reported exact per-partition output sizes — the one window where
+        re-planning is free (nothing dispatched yet), so the AQE hook
+        runs here, just before ``to_resolved()``."""
         changed = False
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, UnresolvedStage) and stage.resolvable():
+                self._maybe_replan(stage)
                 self.stages[sid] = stage.to_resolved()
                 changed = True
         for sid, stage in list(self.stages.items()):
@@ -231,6 +244,39 @@ class ExecutionGraph:
         if changed and self.status == QUEUED:
             self.status = RUNNING
         return changed
+
+    def _maybe_replan(self, stage: UnresolvedStage) -> None:
+        """AQE coalesce/skew-split hook; an AQE bug must degrade to the
+        static plan, never fail the job."""
+        if not self.aqe_policy.enabled:
+            return
+        try:
+            from .adaptive import replan_stage
+
+            replan_stage(self, stage)
+        except Exception:  # noqa: BLE001 - fall back to the static plan
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "job %s: AQE replan of stage %s failed; keeping the "
+                "static plan", self.job_id, stage.stage_id,
+            )
+
+    def _maybe_broadcast(self, completed_sid: int) -> None:
+        """AQE shuffle→broadcast hook, same degrade-to-static contract."""
+        if not self.aqe_policy.enabled:
+            return
+        try:
+            from .adaptive import try_broadcast
+
+            try_broadcast(self, completed_sid)
+        except Exception:  # noqa: BLE001 - fall back to the static plan
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "job %s: AQE broadcast conversion after stage %s failed; "
+                "keeping the static plan", self.job_id, completed_sid,
+            )
 
     # ----------------------------------------------------------- dispatch
     def pop_next_task(
@@ -497,6 +543,11 @@ class ExecutionGraph:
                     self.status = COMPLETED
                     events.append("job_completed")
                 else:
+                    # AQE: a freshly-measured small build side may convert
+                    # a consumer's join to broadcast (stripping the
+                    # not-yet-started probe shuffle) BEFORE revive can
+                    # resolve anything against the static plan
+                    self._maybe_broadcast(sid)
                     self.revive()
                     events.append("job_updated")
             else:
@@ -1269,12 +1320,21 @@ class ExecutionGraph:
         # 2) strip lost input locations everywhere; find consumers that lost
         #    data and must re-resolve
         rollback_consumers = set()
+        rerun_producers = set()
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, UnresolvedStage):
                 before = _locations_of(stage, executor_id)
                 if before:
                     stage.remove_input_partitions(executor_id)
                     affected.add(sid)
+                    # a producer that already COMPLETED on the lost
+                    # executor has no rolled-back consumer to nominate it
+                    # below — without this the consumer waits forever on
+                    # an input nobody re-runs (step 4 ignores producers
+                    # that are merely mid-flight)
+                    for in_sid, inp in stage.inputs.items():
+                        if not inp.complete:
+                            rerun_producers.add(in_sid)
             elif isinstance(stage, (ResolvedStage, RunningStage)):
                 lost = any(
                     any(
@@ -1288,7 +1348,6 @@ class ExecutionGraph:
                     rollback_consumers.add(sid)
 
         # 3) roll back consumers to unresolved
-        rerun_producers = set()
         for sid in rollback_consumers:
             stage = self.stages[sid]
             if isinstance(stage, RunningStage):
@@ -1371,6 +1430,8 @@ class ExecutionGraph:
         g.stage_max_attempts = self.stage_max_attempts
         g.task_retries = self.task_retries
         g.external_shuffle_path = self.external_shuffle_path
+        if self.aqe_policy.enabled:
+            g.aqe_settings_json = self.aqe_policy.to_json()
         for sid in sorted(self.stage_reset_counts):
             g.stage_reset_ids.append(sid)
             g.stage_reset_counts.append(self.stage_reset_counts[sid])
@@ -1393,12 +1454,16 @@ class ExecutionGraph:
                 sp.unresolved.plan = BallistaCodec.encode_physical(stage.plan)
                 sp.unresolved.output_links.extend(stage.output_links)
                 _encode_inputs(sp.unresolved.inputs, stage.inputs)
+                if stage.aqe:
+                    sp.unresolved.aqe_summary_json = json.dumps(stage.aqe)
             elif isinstance(stage, ResolvedStage):
                 sp.resolved.stage_id = sid
                 sp.resolved.partitions = stage.partitions
                 sp.resolved.plan = BallistaCodec.encode_physical(stage.plan)
                 sp.resolved.output_links.extend(stage.output_links)
                 _encode_inputs(sp.resolved.inputs, stage.inputs)
+                if stage.aqe:
+                    sp.resolved.aqe_summary_json = json.dumps(stage.aqe)
             elif isinstance(stage, CompletedStage):
                 sp.completed.stage_id = sid
                 sp.completed.partitions = stage.partitions
@@ -1470,6 +1535,12 @@ class ExecutionGraph:
         # persisted: a recovered/adopted graph runs without it until its
         # stages complete (timing anchors are gone anyway)
         self._init_speculation_policy(None)
+        # AQE policy IS persisted: stats and already-made decisions live
+        # in the stage protos, so a restarted scheduler replays the same
+        # rewrites for stages that resolve after the failover
+        from .adaptive import AqePolicy
+
+        self.aqe_policy = AqePolicy.from_json(g.aqe_settings_json)
         self.pending_cancels = []
         self.pending_events = []
         self.spec_wasted_pending = 0
@@ -1498,6 +1569,7 @@ class ExecutionGraph:
                     BallistaCodec.decode_physical(s.plan, work_dir),
                     list(s.output_links),
                     _decode_inputs(s.inputs),
+                    aqe=_decode_aqe(s.aqe_summary_json),
                 )
             elif which == "resolved":
                 s = sp.resolved
@@ -1506,6 +1578,7 @@ class ExecutionGraph:
                     BallistaCodec.decode_physical(s.plan, work_dir),
                     list(s.output_links),
                     _decode_inputs(s.inputs),
+                    aqe=_decode_aqe(s.aqe_summary_json),
                 )
             elif which == "completed":
                 s = sp.completed
@@ -1563,6 +1636,14 @@ class ExecutionGraph:
             self.stages[stage.stage_id] = stage
             max_sid = max(max_sid, stage.stage_id)
         self._final_stage_id = max_sid
+        # a broadcast decision PENDING at failover (build side completed
+        # small, consumer still unresolved) replays now: completion events
+        # never re-fire for already-Completed stages on the adopting
+        # scheduler, and the conversion is idempotent (a converted
+        # consumer carries its aqe marker, persisted above)
+        for sid in sorted(self.stages):
+            if isinstance(self.stages.get(sid), CompletedStage):
+                self._maybe_broadcast(sid)
         return self
 
 
@@ -1574,6 +1655,15 @@ def _encode_inputs(out, inputs: Dict[int, StageInput]) -> None:
         for locs in inp.partition_locations.values():
             for l in locs:
                 m.partition_locations.add().CopyFrom(l.to_proto())
+
+
+def _decode_aqe(raw: str) -> Dict[str, int]:
+    if not raw:
+        return {}
+    try:
+        return dict(json.loads(raw))
+    except Exception:  # noqa: BLE001 - tolerate future/garbage payloads
+        return {}
 
 
 def _decode_inputs(msgs) -> Dict[int, StageInput]:

@@ -80,6 +80,13 @@ class UnresolvedStage:
     plan: ShuffleWriterExec
     output_links: List[int] = field(default_factory=list)
     inputs: Dict[int, StageInput] = field(default_factory=dict)
+    # AQE decision summary (scheduler/adaptive.py): {tasks_before,
+    # tasks_after, coalesced_groups, skew_splits, broadcast}.  Non-empty
+    # means the plan was already rewritten — replanning is idempotent
+    # across rollback/re-resolve.  Carried through every transition and
+    # merged into stage_metrics as __aqe__ at to_completed so it
+    # persists with the graph and surfaces in the job profile.
+    aqe: Dict[str, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -141,6 +148,7 @@ class UnresolvedStage:
             resolved_plan,
             list(self.output_links),
             dict(self.inputs),
+            aqe=dict(self.aqe),
         )
 
 
@@ -150,6 +158,7 @@ class ResolvedStage:
     plan: ShuffleWriterExec
     output_links: List[int] = field(default_factory=list)
     inputs: Dict[int, StageInput] = field(default_factory=dict)
+    aqe: Dict[str, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -162,15 +171,19 @@ class ResolvedStage:
             list(self.output_links),
             dict(self.inputs),
             [None] * self.partitions,
+            aqe=dict(self.aqe),
         )
 
     def to_unresolved(self) -> UnresolvedStage:
-        """Roll back for executor-loss recovery."""
+        """Roll back for executor-loss recovery.  The rolled-back plan
+        keeps its AQE selections (rollback_resolved_shuffles) and the
+        ``aqe`` marker, so re-resolution reuses the rewritten layout."""
         return UnresolvedStage(
             self.stage_id,
             rollback_resolved_shuffles(self.plan),
             list(self.output_links),
             dict(self.inputs),
+            aqe=dict(self.aqe),
         )
 
 
@@ -220,6 +233,8 @@ class RunningStage:
     # inside stage_metrics, which already persist past cache eviction
     task_runtime_s: Dict[int, float] = field(default_factory=dict)
     task_bytes: Dict[int, Dict[str, int]] = field(default_factory=dict)
+    # AQE decision summary (see UnresolvedStage.aqe)
+    aqe: Dict[str, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -305,13 +320,17 @@ class RunningStage:
         return n
 
     def to_completed(self) -> "CompletedStage":
-        from ..obs.export import stage_skew_metrics
+        from ..obs.export import AQE_OP, stage_skew_metrics
 
         # reduce the per-partition runtime/bytes distributions to skew
         # coefficients NOW — stage_metrics persist in the graph proto, so
         # the profile keeps its skew column after cache eviction/restart
         metrics = dict(self.stage_metrics)
         metrics.update(stage_skew_metrics(self.task_runtime_s, self.task_bytes))
+        if self.aqe:
+            # the replan decision rides the same persistence path as the
+            # skew analytics: visible in the profile after eviction/restart
+            metrics[AQE_OP] = dict(self.aqe)
         return CompletedStage(
             self.stage_id,
             self.plan,
@@ -336,7 +355,8 @@ class RunningStage:
         """Drop in-flight work (persistence rule: Running is stored as
         Resolved so a restarted scheduler re-dispatches)."""
         return ResolvedStage(
-            self.stage_id, self.plan, list(self.output_links), dict(self.inputs)
+            self.stage_id, self.plan, list(self.output_links),
+            dict(self.inputs), aqe=dict(self.aqe),
         )
 
 
@@ -362,9 +382,35 @@ class CompletedStage:
             1 for t in self.task_statuses if t is not None and t.state == "completed"
         )
 
+    def output_partition_bytes(self) -> Dict[int, int]:
+        """EXACT wire bytes per OUTPUT (reduce) partition this stage
+        wrote, summed over the committed winners' per-fragment stats —
+        the direct sizing input for adaptive re-planning.  Unlike the
+        ``__task_bytes_*__`` skew maps (keyed by MAP task, reduced to
+        quantiles), this is the reduce-side distribution, recomputed
+        from ``task_statuses`` (which persist in the graph proto), so
+        AQE never reconstructs sizes from metric rollups."""
+        return self._sum_output_partitions("num_bytes")
+
+    def output_partition_rows(self) -> Dict[int, int]:
+        """Row counterpart of :meth:`output_partition_bytes`."""
+        return self._sum_output_partitions("num_rows")
+
+    def _sum_output_partitions(self, attr: str) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for t in self.task_statuses:
+            if t is None:
+                continue
+            for p in t.partitions:
+                out[p.partition_id] = out.get(p.partition_id, 0) + int(
+                    getattr(p, attr) or 0
+                )
+        return out
+
     def to_running(self) -> RunningStage:
         """Re-run after its shuffle files were lost with an executor."""
         from ..obs.export import (
+            AQE_OP,
             TASK_BYTES_RAW_OP,
             TASK_BYTES_WIRE_OP,
             TASK_RUNTIME_OP,
@@ -401,6 +447,7 @@ class CompletedStage:
             spec_stats=dict(self.spec_stats),
             task_runtime_s=runtime_s,
             task_bytes=task_bytes,
+            aqe=dict(self.stage_metrics.get(AQE_OP, {})),
         )
 
     def reset_tasks(self, executor_id: str) -> int:

@@ -153,8 +153,17 @@ def remove_unresolved_shuffles(
     partition_locations: Dict[int, List[List[PartitionLocation]]],
 ) -> ExecutionPlan:
     """Swap every UnresolvedShuffleExec for a ShuffleReaderExec with the
-    producing stage's real output locations."""
+    producing stage's real output locations.
+
+    ``partition_locations[stage]`` is always keyed by SOURCE reduce
+    partition; a placeholder carrying AQE ``selections`` maps those
+    source lists onto its coalesced/split task layout here, so two
+    leaves reading the same producer stage can do so through different
+    layouts (e.g. the split side and the duplicated side of a skew-split
+    join)."""
     if isinstance(plan, UnresolvedShuffleExec):
+        from ..shuffle.execution_plans import apply_read_selections
+
         locs = partition_locations.get(plan.stage_id)
         if locs is None:
             raise PlanError(
@@ -165,7 +174,15 @@ def remove_unresolved_shuffles(
                 f"stage {plan.stage_id}: expected "
                 f"{plan.output_partition_count} output partitions, got {len(locs)}"
             )
-        return ShuffleReaderExec(plan.stage_id, plan.schema, locs)
+        if plan.selections is not None:
+            locs = apply_read_selections(plan.selections, locs)
+        return ShuffleReaderExec(
+            plan.stage_id,
+            plan.schema,
+            locs,
+            selections=plan.selections,
+            source_partition_count=plan.output_partition_count,
+        )
     children = plan.children()
     if not children:
         return plan
@@ -175,12 +192,24 @@ def remove_unresolved_shuffles(
 
 
 def rollback_resolved_shuffles(plan: ExecutionPlan) -> ExecutionPlan:
-    """Inverse of remove_unresolved_shuffles (executor-loss recovery)."""
+    """Inverse of remove_unresolved_shuffles (executor-loss recovery).
+
+    An AQE-rewritten reader rolls back to a placeholder carrying the
+    SAME selections, so the re-resolved consumer keeps its adaptive
+    task layout instead of silently reverting to the static plan (whose
+    partition indexing the reader's task count no longer matches)."""
     if isinstance(plan, ShuffleReaderExec):
-        n_out = len(plan.partition)
+        n_src = (
+            plan.source_partition_count
+            if plan.source_partition_count
+            else len(plan.partition)
+        )
         # input partition count is not recoverable from the reader alone and
         # is not needed to re-resolve; re-derived when the stage re-completes
-        return UnresolvedShuffleExec(plan.stage_id, plan.schema, n_out, n_out)
+        return UnresolvedShuffleExec(
+            plan.stage_id, plan.schema, n_src, n_src,
+            selections=plan.selections,
+        )
     children = plan.children()
     if not children:
         return plan

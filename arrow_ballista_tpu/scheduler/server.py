@@ -66,6 +66,7 @@ class SchedulerServer:
         speculation_interval_s: float = 1.0,
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
+        aqe_force_enabled: bool = False,
         drain_timeout_s: float = 30.0,
         telemetry_sample_s: float = 5.0,
         event_journal_dir: str = "",
@@ -87,6 +88,7 @@ class SchedulerServer:
             quarantine_backoff_s=quarantine_backoff_s,
             speculation_force_enabled=speculation_force_enabled,
             task_timeout_force_s=task_timeout_force_s,
+            aqe_force_enabled=aqe_force_enabled,
             event_journal_dir=event_journal_dir,
             event_journal_rotate_bytes=event_journal_rotate_bytes,
             event_journal_segments=event_journal_segments,

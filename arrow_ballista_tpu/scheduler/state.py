@@ -49,6 +49,7 @@ class SchedulerState:
         quarantine_backoff_s: Optional[float] = None,
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
+        aqe_force_enabled: bool = False,
         event_journal_dir: str = "",
         event_journal_rotate_bytes: Optional[int] = None,
         event_journal_segments: Optional[int] = None,
@@ -119,6 +120,11 @@ class SchedulerState:
             registry=self.metrics,
             events=self.events,
             slo=self.slo,
+            # --aqe-enabled seeds the cluster-wide default; an explicit
+            # session ballista.aqe.* setting still wins (A/B toggles)
+            config_overrides=(
+                {"ballista.aqe.enabled": "true"} if aqe_force_enabled else None
+            ),
         )
         self.session_manager = SessionManager(backend, session_builder)
         # straggler mitigation: the periodic scan body (invoked on the

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from ..errors import SchedulerError
 from ..exec.operators import ExecutionPlan
 from ..obs import trace
+from ..obs.export import AQE_OP
 from ..obs.recorder import trace_store
 from ..obs.registry import MetricsRegistry
 from ..proto import pb
@@ -97,6 +98,7 @@ class TaskManager:
         registry: Optional[MetricsRegistry] = None,
         events=None,
         slo=None,
+        config_overrides: Optional[Dict[str, str]] = None,
     ):
         from ..obs.events import EventJournal
 
@@ -105,6 +107,10 @@ class TaskManager:
         self.scheduler_id = scheduler_id
         self.launcher = launcher or GrpcLauncher()
         self.work_dir = work_dir
+        # scheduler-flag session-setting overrides (e.g. --aqe-enabled
+        # forces ballista.aqe.enabled for every submitted job); applied
+        # on top of the session settings at submit-time planning
+        self.config_overrides = dict(config_overrides or {})
         # structured event journal + SLO tracker (obs/events.py,
         # obs/timeseries.py): shared with the owning SchedulerState; a
         # bare TaskManager (tests) gets a disabled journal
@@ -279,8 +285,15 @@ class TaskManager:
         from ..config import BallistaConfig
 
         # the session's config steers distributed planning (mesh gang
-        # stages, shuffle data plane) exactly as it steers acceleration
-        config = BallistaConfig(self._session_settings(session_id))
+        # stages, shuffle data plane) exactly as it steers acceleration;
+        # scheduler-flag overrides seed cluster-wide defaults that an
+        # EXPLICIT session setting still wins over (session settings are
+        # sparse — only user-set keys ship), so per-session A/B toggles
+        # like ballista.aqe.enabled=false keep working under the flag
+        settings = self._session_settings(session_id)
+        if self.config_overrides:
+            settings = {**self.config_overrides, **settings}
+        config = BallistaConfig(settings)
         graph = ExecutionGraph(
             self.scheduler_id, job_id, session_id, plan, self.work_dir, config
         )
@@ -391,6 +404,13 @@ class TaskManager:
             spec_stats = getattr(stage, "spec_stats", None)
             if spec_stats:
                 row["speculation"] = dict(spec_stats)
+            aqe = getattr(stage, "aqe", None) or (
+                getattr(stage, "stage_metrics", None) or {}
+            ).get(AQE_OP)
+            if aqe:
+                # adaptive re-plan outcome (tasks before/after, rewrite
+                # counts) — also persisted inside stage_metrics[__aqe__]
+                row["aqe"] = dict(aqe)
             failures = getattr(stage, "task_failures", None)
             if failures:
                 row["failures"] = {p: list(h) for p, h in failures.items()}

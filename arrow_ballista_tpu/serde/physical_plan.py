@@ -50,6 +50,13 @@ from .expressions import (
 from .scheduler_types import PartitionLocation
 
 
+def _selections_from_json(raw: str):
+    """AQE read-selection triples from their JSON wire form ('' = none)."""
+    if not raw:
+        return None
+    return [[tuple(t) for t in task] for task in json.loads(raw)]
+
+
 def partitioning_to_proto(p: Partitioning) -> pb.PhysicalPartitioning:
     msg = pb.PhysicalPartitioning(kind=p.kind, partition_count=p.n)
     for e in p.exprs:
@@ -200,12 +207,20 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             ll = n.shuffle_reader.partition.add()
             for loc in locs:
                 ll.locations.add().CopyFrom(loc.to_proto())
+        # AQE provenance: lets executor-loss rollback rebuild the
+        # REWRITTEN placeholder after a scheduler restart too
+        if plan.selections is not None:
+            n.shuffle_reader.selections_json = json.dumps(plan.selections)
+        if plan.source_partition_count:
+            n.shuffle_reader.source_partition_count = plan.source_partition_count
         return n
     if isinstance(plan, UnresolvedShuffleExec):
         n.unresolved_shuffle.stage_id = plan.stage_id
         n.unresolved_shuffle.schema = schema_to_bytes(plan.schema)
         n.unresolved_shuffle.input_partition_count = plan.input_partition_count
         n.unresolved_shuffle.output_partition_count = plan.output_partition_count
+        if plan.selections is not None:
+            n.unresolved_shuffle.selections_json = json.dumps(plan.selections)
         return n
     from ..parallel.mesh_stage import MeshGangExec, MeshRepartitionExec
 
@@ -359,6 +374,10 @@ def physical_plan_from_proto(
             n.shuffle_reader.stage_id,
             schema_from_bytes(n.shuffle_reader.schema),
             partition,
+            selections=_selections_from_json(n.shuffle_reader.selections_json),
+            source_partition_count=(
+                n.shuffle_reader.source_partition_count or None
+            ),
         )
     if kind == "unresolved_shuffle":
         return UnresolvedShuffleExec(
@@ -366,6 +385,9 @@ def physical_plan_from_proto(
             schema_from_bytes(n.unresolved_shuffle.schema),
             n.unresolved_shuffle.input_partition_count,
             n.unresolved_shuffle.output_partition_count,
+            selections=_selections_from_json(
+                n.unresolved_shuffle.selections_json
+            ),
         )
     if kind == "mesh_gang":
         from ..parallel.mesh_stage import MeshGangExec

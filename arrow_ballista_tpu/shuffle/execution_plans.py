@@ -729,11 +729,45 @@ class ShuffleWriterExec(ExecutionPlan):
         return f"ShuffleWriterExec: job={self.job_id} stage={self.stage_id} partitioning={desc}"
 
 
+def apply_read_selections(
+    selections: list[list[tuple[int, int, int]]],
+    source_lists: list[list],
+) -> list[list]:
+    """Materialize AQE read selections against per-source-partition
+    fragment lists.
+
+    Each reduce TASK is a list of ``(source_partition, chunk_i, chunk_k)``
+    triples: the task reads chunk ``i`` of ``k`` index-contiguous slices
+    of that source partition's fragment list.  ``(p, 0, 1)`` reads the
+    whole partition; a coalesced task lists several whole partitions; a
+    skew-split task reads one chunk of one partition.  Chunks are derived
+    from the CURRENT fragment count, so any k chunks are always an exact
+    disjoint cover — a producer re-run (same map-task count, possibly
+    different paths) re-resolves to the same coverage without the
+    scheduler persisting fragment indices."""
+    out: list[list] = []
+    for sel in selections:
+        frags: list = []
+        for p, i, k in sel:
+            src = source_lists[p]
+            n = len(src)
+            lo, hi = (i * n) // k, ((i + 1) * n) // k
+            frags.extend(src[lo:hi])
+        out.append(frags)
+    return out
+
+
 class ShuffleReaderExec(ExecutionPlan):
     """Reads shuffle partitions written by upstream ShuffleWriter tasks.
 
     ``partition[p]`` lists every map-side location contributing to output
     partition ``p`` (reference: shuffle_reader.rs:44-130).
+
+    ``selections``/``source_partition_count`` record the AQE rewrite
+    (partition coalescing / skew splitting) this reader was resolved
+    with, so an executor-loss rollback reconstructs the REWRITTEN
+    placeholder — a rolled-back consumer re-resolves with the same
+    adaptive plan, not the original static one.
     """
 
     def __init__(
@@ -741,11 +775,15 @@ class ShuffleReaderExec(ExecutionPlan):
         stage_id: int,
         schema: pa.Schema,
         partition: list[list[PartitionLocation]],
+        selections: Optional[list[list[tuple[int, int, int]]]] = None,
+        source_partition_count: Optional[int] = None,
     ):
         super().__init__()
         self.stage_id = stage_id
         self._schema = schema
         self.partition = partition
+        self.selections = selections
+        self.source_partition_count = source_partition_count
 
     @property
     def schema(self) -> pa.Schema:
@@ -803,15 +841,28 @@ class ShuffleReaderExec(ExecutionPlan):
 
     def __str__(self) -> str:
         n_loc = sum(len(p) for p in self.partition)
+        aqe = (
+            f" aqe_source_partitions={self.source_partition_count}"
+            if self.selections is not None
+            else ""
+        )
         return (
             f"ShuffleReaderExec: stage={self.stage_id} "
-            f"partitions={len(self.partition)} locations={n_loc}"
+            f"partitions={len(self.partition)} locations={n_loc}{aqe}"
         )
 
 
 class UnresolvedShuffleExec(ExecutionPlan):
     """Placeholder for a dependency on stage ``stage_id`` that has not been
-    computed yet (reference: unresolved_shuffle.rs:33-110)."""
+    computed yet (reference: unresolved_shuffle.rs:33-110).
+
+    ``output_partition_count`` is always the SOURCE reduce-partition
+    count the producer stage writes.  ``selections`` (optional, set by
+    the AQE policy engine in ``scheduler/adaptive.py``) remaps those
+    source partitions onto a different reduce-task layout — coalesced
+    groups of tiny partitions and/or fragment-chunk splits of skewed
+    ones; when set, this node resolves to ``len(selections)`` tasks
+    instead of one per source partition."""
 
     def __init__(
         self,
@@ -819,19 +870,28 @@ class UnresolvedShuffleExec(ExecutionPlan):
         schema: pa.Schema,
         input_partition_count: int,
         output_partition_count: int,
+        selections: Optional[list[list[tuple[int, int, int]]]] = None,
     ):
         super().__init__()
         self.stage_id = stage_id
         self._schema = schema
         self.input_partition_count = input_partition_count
         self.output_partition_count = output_partition_count
+        self.selections = selections
 
     @property
     def schema(self) -> pa.Schema:
         return self._schema
 
+    @property
+    def reduce_task_count(self) -> int:
+        """Reduce tasks this placeholder resolves to (selections-aware)."""
+        if self.selections is not None:
+            return len(self.selections)
+        return self.output_partition_count
+
     def output_partitioning(self) -> Partitioning:
-        return Partitioning.unknown(self.output_partition_count)
+        return Partitioning.unknown(self.reduce_task_count)
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         raise ExecutionError(
@@ -844,4 +904,9 @@ class UnresolvedShuffleExec(ExecutionPlan):
         return self
 
     def __str__(self) -> str:
+        if self.selections is not None:
+            return (
+                f"UnresolvedShuffleExec: stage={self.stage_id} "
+                f"aqe_tasks={len(self.selections)}/{self.output_partition_count}"
+            )
         return f"UnresolvedShuffleExec: stage={self.stage_id}"

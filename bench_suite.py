@@ -18,7 +18,12 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   (slab-buffered async map-side write vs the synchronous baseline, with
   the zstd wire-compression ratio)
 
-Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|shuffle|all]
+  plus an AQE A/B leg (aqe_starjoin_rows_per_sec /
+  aqe_tiny_agg_rows_per_sec): skewed star join + tiny-partition
+  aggregate with ballista.aqe.enabled true vs false on identical
+  inputs, reporting before/after reduce-task counts
+
+Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|shuffle|aqe|all]
 (default all)
 """
 
@@ -607,6 +612,24 @@ def bench_shuffle_write() -> None:
     )
 
 
+def bench_aqe() -> None:
+    """Adaptive query execution A/B (ISSUE 8): a skewed star join and a
+    tiny-partition aggregate, each measured with ballista.aqe.enabled
+    true vs false on identical inputs over a real 2-executor standalone
+    cluster.  ``vs_baseline`` is static-time / adaptive-time; the
+    records carry the before/after reduce-task counts so the bench
+    report shows the plan shape alongside the throughput."""
+    from benchmarks.aqe_starjoin import run_aqe_starjoin, run_aqe_tiny_agg
+
+    star = run_aqe_starjoin(
+        n_fact=int(os.environ.get("BENCH_AQE_FACT_ROWS", "300000")),
+        skew=float(os.environ.get("BENCH_AQE_SKEW", "0.5")),
+        partitions=int(os.environ.get("BENCH_AQE_PARTITIONS", "24")),
+    )
+    _emit(star)
+    _emit(run_aqe_tiny_agg(partitions=64))
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
@@ -632,6 +655,8 @@ def main() -> None:
     if which in ("shuffle", "all"):
         bench_shuffle_fetch()
         bench_shuffle_write()
+    if which in ("aqe", "all"):
+        bench_aqe()
 
 
 if __name__ == "__main__":

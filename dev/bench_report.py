@@ -105,6 +105,12 @@ def _fmt_value(rec: Optional[dict]) -> str:
     vs = rec.get("vs_baseline")
     if isinstance(vs, (int, float)):
         s += f" ({vs:g}x)"
+    # plan shape: records from adaptive-execution legs carry the reduce
+    # task counts before/after the replan, so the trajectory shows WHAT
+    # the speedup bought (64→2 tasks), not just how much
+    before, after = rec.get("tasks_before"), rec.get("tasks_after")
+    if isinstance(before, int) and isinstance(after, int):
+        s += f" [{before}→{after} tasks]"
     return s
 
 

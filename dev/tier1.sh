@@ -49,6 +49,16 @@ print(json.dumps({"bench_smoke": "shuffle_write",
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.aqe_starjoin import run_aqe_smoke
+
+# AQE A/B on tiny inputs: asserts bit-identical results static-vs-
+# adaptive and that the tiny-partition aggregate actually coalesced
+print(json.dumps({"bench_smoke": "aqe", **run_aqe_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi

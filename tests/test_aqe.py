@@ -1,0 +1,869 @@
+"""Adaptive query execution (scheduler/adaptive.py).
+
+Three layers, mirroring the repo's other scheduler suites:
+
+* pure-function tests (selection cover, policy serde);
+* graph-level tests driving a real ExecutionGraph with a fake executor
+  (tests/test_execution_graph.py harness style) — rewrite structure,
+  gating, rollback composition, persistence replay;
+* end-to-end standalone-cluster runs asserting multiset identity of
+  ``ballista.aqe.enabled=true`` vs ``false`` over randomized skewed
+  inputs, plus the journal/profile surfaces.
+
+Environment note: ORDER BY is avoided everywhere (pyarrow sort_indices
+is broken in this container); result comparison is a python-level
+multiset of rows.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.exec.aggregates import FINAL, PARTIAL, HashAggregateExec
+from arrow_ballista_tpu.exec.joins import COLLECT_LEFT, HashJoinExec
+from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.adaptive import AqePolicy
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    COMPLETED,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.execution_stage import (
+    CompletedStage,
+    ResolvedStage,
+    RunningStage,
+    TaskInfo,
+    UnresolvedStage,
+)
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
+from arrow_ballista_tpu.shuffle.execution_plans import apply_read_selections
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052)
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052)
+
+BASE_SETTINGS = {
+    "ballista.tpu.enable": "false",
+    "ballista.mesh.enable": "false",
+}
+
+
+# ------------------------------------------------------------- harness
+def make_graph(sql, partitions=16, settings=None, job_id="aqe1"):
+    s = dict(BASE_SETTINGS)
+    s["ballista.shuffle.partitions"] = str(partitions)
+    s.update(settings or {})
+    ctx = SessionContext(BallistaConfig(s))
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a", "c"] * 4),
+                "v": pa.array([1.0, 2.0, 3.0, 4.0] * 4),
+                "k": pa.array(list(range(16)), pa.int64()),
+            }
+        ),
+        partitions=2,
+    )
+    ctx.register_arrow_table(
+        "u",
+        pa.table(
+            {
+                "k": pa.array([1, 2, 5], pa.int64()),
+                "w": pa.array(["x", "y", "z"]),
+            }
+        ),
+        partitions=2,
+    )
+    plan = PhysicalPlanner(ctx.config).create_physical_plan(
+        ctx.sql(sql).optimized_plan()
+    )
+    return ExecutionGraph(
+        "sched-1", job_id, ctx.session_id, plan, config=ctx.config
+    )
+
+
+def complete_task(graph, task, executor, bytes_for=None):
+    """Fake a completed shuffle-write; ``bytes_for(reduce_p)`` controls
+    the observed per-partition sizes AQE decides on."""
+    part = task.output_partitioning
+    size = bytes_for or (lambda p: 100)
+    if part is not None:
+        partitions = [
+            ShuffleWritePartition(
+                p, f"/fake/{task.partition}/{p}.arrow", 1, 10, size(p)
+            )
+            for p in range(part.n)
+        ]
+    else:
+        p = task.partition.partition_id
+        partitions = [
+            ShuffleWritePartition(
+                p, f"/fake/{task.partition}/data.arrow", 1, 10, size(p)
+            )
+        ]
+    info = TaskInfo(task.partition, "completed", executor.id, partitions=partitions)
+    return graph.update_task_status(info, executor)
+
+
+def drain(graph, executor=EXEC1, bytes_for=None, limit=500):
+    graph.revive()
+    n = 0
+    for _ in range(limit):
+        task = graph.pop_next_task(executor.id)
+        if task is None:
+            if graph.status == COMPLETED:
+                break
+            graph.revive()
+            task = graph.pop_next_task(executor.id)
+            if task is None:
+                break
+        complete_task(graph, task, executor, bytes_for=bytes_for)
+        n += 1
+    return n
+
+
+def replan_events(graph):
+    return [e for e in graph.pending_events if e["kind"] == "aqe_replan"]
+
+
+def stage_aqe(stage):
+    if getattr(stage, "aqe", None):
+        return stage.aqe
+    return (getattr(stage, "stage_metrics", {}) or {}).get("__aqe__")
+
+
+SKEW_ALL = {
+    # split-everything mode: threshold collapses to target=1 byte, so
+    # every non-empty partition is "skewed" — deterministic coverage of
+    # the split machinery without engineering a hash collision
+    "ballista.aqe.skew_enabled": "true",
+    "ballista.aqe.skew_factor": "0",
+    "ballista.aqe.target_partition_bytes": "1",
+}
+
+
+# ------------------------------------------------------ pure functions
+def test_selection_chunks_cover_fragments_exactly():
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        n_frags = int(rng.integers(0, 9))
+        k = int(rng.integers(1, 7))
+        frags = list(range(n_frags))
+        chunks = [
+            apply_read_selections([[(0, i, k)]], [frags])[0] for i in range(k)
+        ]
+        flat = [x for c in chunks for x in c]
+        assert flat == frags  # disjoint, ordered, exact cover
+
+
+def test_selection_merged_groups_concatenate():
+    src = [[1, 2], [3], [], [4, 5, 6]]
+    out = apply_read_selections([[(0, 0, 1), (2, 0, 1), (3, 0, 1)], [(1, 0, 1)]], src)
+    assert out == [[1, 2, 4, 5, 6], [3]]
+
+
+def test_policy_json_roundtrip():
+    p = AqePolicy(
+        enabled=True, skew_enabled=True, target_partition_bytes=123,
+        skew_factor=2.5, max_splits=3,
+    )
+    assert AqePolicy.from_json(p.to_json()) == p
+    assert AqePolicy.from_json("") == AqePolicy()
+    assert AqePolicy.from_json("not json") == AqePolicy()
+    # unknown fields from a future revision are ignored, not fatal
+    blob = json.dumps({"enabled": True, "from_the_future": 9})
+    assert AqePolicy.from_json(blob).enabled
+
+
+# ------------------------------------------------------- graph: coalesce
+def test_coalesce_packs_tiny_partitions():
+    g = make_graph("select g, sum(v) as s from t group by g")
+    drain(g)
+    assert g.status == COMPLETED
+    final = g.stages[g.final_stage_id]
+    info = stage_aqe(final)
+    assert info == {
+        "tasks_before": 16,
+        "tasks_after": 1,
+        "coalesced_groups": 1,
+        "skew_splits": 0,
+        "skewed_partitions": 0,
+    }
+    (ev,) = replan_events(g)
+    assert ev["rewrite"] == "coalesce"
+    assert ev["tasks_before"] == 16 and ev["tasks_after"] == 1
+    assert g.output_partitions == 1  # final-stage layout change tracked
+
+
+def test_coalesce_respects_target_bytes():
+    # 16 partitions x 200 B (2 map tasks x 100 B) against a 800 B target
+    # -> ceil(3200/800) = 4 groups of 4
+    g = make_graph(
+        "select g, sum(v) as s from t group by g",
+        settings={"ballista.aqe.target_partition_bytes": "800"},
+    )
+    drain(g)
+    assert g.status == COMPLETED
+    info = stage_aqe(g.stages[g.final_stage_id])
+    assert info["tasks_after"] == 4 and info["coalesced_groups"] == 4
+
+
+def test_coalesce_skips_small_shuffles():
+    # at/below ballista.aqe.coalesce_min_partitions (default 8) the
+    # static layout is kept — scheduling 4 tasks costs nothing
+    g = make_graph("select g, sum(v) as s from t group by g", partitions=4)
+    drain(g)
+    assert g.status == COMPLETED
+    assert stage_aqe(g.stages[g.final_stage_id]) is None
+    assert not replan_events(g)
+    assert g.stages[g.final_stage_id].partitions == 4
+
+
+def test_master_toggle_restores_static_plans():
+    g = make_graph(
+        "select g, sum(v) as s from t group by g",
+        settings={"ballista.aqe.enabled": "false"},
+    )
+    drain(g)
+    assert g.status == COMPLETED
+    assert g.stages[g.final_stage_id].partitions == 16
+    assert not replan_events(g)
+
+
+def test_scheduler_flag_is_default_and_session_setting_wins():
+    """--aqe-enabled seeds the cluster-wide default; a session that
+    explicitly sets ballista.aqe.enabled=false still wins, so the
+    documented per-session A/B path works under the flag."""
+    from arrow_ballista_tpu.scheduler.backend import MemoryBackend
+    from arrow_ballista_tpu.scheduler.state import SchedulerState
+
+    state = SchedulerState(
+        MemoryBackend(),
+        "sched-aqe-flag",
+        aqe_force_enabled=True,
+        work_dir="/tmp/abt-aqe-flag",
+    )
+    try:
+        for job_id, settings, expect in (
+            ("flag-default", dict(BASE_SETTINGS), True),
+            (
+                "session-wins",
+                {**BASE_SETTINGS, "ballista.aqe.enabled": "false"},
+                False,
+            ),
+        ):
+            ctx = state.session_manager.create_session(settings)
+            ctx.register_arrow_table(
+                "t",
+                pa.table({"g": ["a", "b"], "v": [1.0, 2.0]}),
+                partitions=2,
+            )
+            plan = ctx.sql(
+                "select g, sum(v) as s from t group by g"
+            ).logical_plan()
+            state.submit_job(job_id, ctx, plan)
+            graph = state.task_manager._cache[job_id].graph
+            assert graph.aqe_policy.enabled is expect, job_id
+    finally:
+        state.executor_manager.close()
+
+
+# ------------------------------------------------------ graph: skew split
+def test_skew_split_join_duplicates_companion_side():
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings=SKEW_ALL,
+    )
+    drain(g)
+    assert g.status == COMPLETED
+    join_sid = g.final_stage_id
+    info = stage_aqe(g.stages[join_sid])
+    # every partition had 2 map fragments -> k=2 chunks each: 4 -> 8
+    assert info["tasks_before"] == 4 and info["tasks_after"] == 8
+    assert info["skew_splits"] == 8 and info["skewed_partitions"] == 4
+    (ev,) = replan_events(g)
+    assert ev["rewrite"] == "skew_split"
+
+
+def test_skew_split_reader_layout():
+    """Resolved readers: the split side holds disjoint fragment chunks,
+    the companion side repeats the FULL partition per chunk task."""
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings=SKEW_ALL,
+    )
+    g.revive()
+    # complete both producer stages only
+    for _ in range(4):
+        t = g.pop_next_task(EXEC1.id)
+        complete_task(g, t, EXEC1)
+    g.revive()
+    consumer = g.stages[g.final_stage_id]
+    assert isinstance(consumer, RunningStage)
+    readers = []
+
+    def walk(node):
+        if isinstance(node, ShuffleReaderExec):
+            readers.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(consumer.plan)
+    assert len(readers) == 2
+    split = [r for r in readers if any(len(p) == 1 for p in r.partition)]
+    dup = [r for r in readers if all(len(p) == 2 for p in r.partition)]
+    assert len(split) == 1 and len(dup) == 1
+    # the two chunk tasks of one partition cover its 2 fragments exactly
+    split_paths = [tuple(l.path for l in p) for p in split[0].partition]
+    assert len(split_paths) == 8
+    for i in range(0, 8, 2):
+        merged = split_paths[i] + split_paths[i + 1]
+        assert len(set(merged)) == 2
+    assert split[0].source_partition_count == 4
+
+
+def test_skew_split_skipped_when_skew_is_on_companion_side():
+    """LEFT join: only the left side may split.  When the heavy bytes
+    sit on the RIGHT (companion) side, splitting the tiny left side
+    would duplicate the full heavy-partition read into every chunk
+    task — the replan must keep the static layout."""
+    g = make_graph(
+        "select t.g, u.w from t left join u on t.k = u.k",
+        partitions=4,
+        settings={
+            "ballista.aqe.skew_enabled": "true",
+            "ballista.aqe.skew_factor": "2",
+            "ballista.aqe.target_partition_bytes": "1000",
+            "ballista.aqe.coalesce_enabled": "false",
+        },
+    )
+    g.revive()
+    join_sid = g.final_stage_id
+    leaves = []
+
+    def walk(node):
+        if isinstance(node, UnresolvedShuffleExec):
+            leaves.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(g.stages[join_sid].plan)
+    left_sid = leaves[0].stage_id  # DFS order: the join's left side first
+    for _ in range(4):
+        t = g.pop_next_task(EXEC1.id)
+        heavy = t.partition.stage_id != left_sid
+        complete_task(
+            g,
+            t,
+            EXEC1,
+            bytes_for=lambda p, heavy=heavy: (
+                100_000 if heavy and p == 0 else 100
+            ),
+        )
+    g.revive()
+    # partition 0 is skewed in TOTAL bytes, but only because of the
+    # right side: no split, no replan, static 4-task layout
+    assert not replan_events(g)
+    resolved = g.stages[join_sid]
+    assert isinstance(resolved, RunningStage)
+    assert stage_aqe(resolved) is None
+    assert resolved.partitions == 4
+
+
+def test_skew_split_agg_rewrites_stage_and_consumer():
+    g = make_graph(
+        "select g, sum(v) s, count(*) c, avg(v) a, min(v) mn, max(v) mx "
+        "from t group by g limit 1000",
+        partitions=4,
+        settings=SKEW_ALL,
+    )
+    drain(g)
+    assert g.status == COMPLETED
+    agg_sid = g.final_stage_id - 1
+    agg_stage = g.stages[agg_sid]
+    info = stage_aqe(agg_stage)
+    assert info["skew_splits"] == 8 and info["tasks_after"] == 8
+    # the split stage now MERGES partial states and re-emits states
+    merge = agg_stage.plan.input
+    assert isinstance(merge, HashAggregateExec) and merge.mode == PARTIAL
+    assert any(a.name.endswith("#sum") for a in merge.aggs)  # avg state
+    # the consumer carries the deferred final merge above its coalesce,
+    # and its reader tracks the split stage's 8 task-indexed partitions
+    consumer = g.stages[g.final_stage_id]
+    found = []
+
+    def walk(node):
+        if isinstance(node, HashAggregateExec) and node.mode == FINAL:
+            found.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(consumer.plan)
+    assert len(found) == 1
+    reader = found[0]
+    while not isinstance(reader, ShuffleReaderExec):
+        reader = reader.children()[0]
+    assert len(reader.partition) == 8
+
+
+def test_skew_split_agg_skipped_for_final_stage():
+    # no downstream stage to carry the merge -> stays static (and the
+    # plain coalesce path is gated out by min_partitions here)
+    g = make_graph(
+        "select g, sum(v) s from t group by g", partitions=4,
+        settings=SKEW_ALL,
+    )
+    drain(g)
+    assert g.status == COMPLETED
+    assert not replan_events(g)
+
+
+# ------------------------------------------------------- graph: broadcast
+BROADCAST_ON = {
+    "ballista.aqe.broadcast_enabled": "true",
+    "ballista.aqe.broadcast_threshold_bytes": "1000000",
+}
+
+
+def test_broadcast_conversion_strips_probe_stage():
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings=BROADCAST_ON,
+    )
+    n_stages_before = len(g.stages)
+    drain(g)
+    assert g.status == COMPLETED
+    assert len(g.stages) == n_stages_before - 1  # probe stage deleted
+    consumer = g.stages[g.final_stage_id]
+    (ev,) = replan_events(g)
+    assert ev["rewrite"] == "broadcast"
+    info = stage_aqe(consumer)
+    assert info["broadcast"] == 1
+    joins = []
+
+    def walk(node):
+        if isinstance(node, HashJoinExec):
+            joins.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(consumer.plan)
+    assert len(joins) == 1
+    assert joins[0].partition_mode == COLLECT_LEFT
+    # probe side is the inlined scan subtree, not a shuffle read
+    assert not isinstance(joins[0].right, (ShuffleReaderExec, UnresolvedShuffleExec))
+
+
+def test_broadcast_skipped_once_probe_started():
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings=BROADCAST_ON,
+    )
+    g.revive()
+    held = [g.pop_next_task(EXEC1.id) for _ in range(2)]  # build side
+    probe_task = g.pop_next_task(EXEC1.id)  # probe side dispatches
+    assert probe_task.partition.stage_id != held[0].partition.stage_id
+    for t in held:
+        complete_task(g, t, EXEC1)  # build completes AFTER probe started
+    assert not replan_events(g)  # probe work paid for: no conversion
+    complete_task(g, probe_task, EXEC1)
+    drain(g)
+    assert g.status == COMPLETED
+
+
+def test_broadcast_inlined_probe_has_no_stale_locations():
+    """A Resolved-but-unstarted probe stage is inlined with its shuffle
+    reads rolled back to placeholders: the consumer stays Unresolved and
+    must re-resolve from LIVE locations, not executor paths baked in
+    before an executor loss."""
+    from arrow_ballista_tpu.scheduler.adaptive import try_broadcast
+
+    # broadcast OFF while driving, so the pre-conversion state is
+    # observable: probe exchange Running-but-unstarted (readers already
+    # materialized with EXEC1 locations), consumer still Unresolved
+    g = make_graph(
+        "select u.w, s.g from u join "
+        "(select g, k, sum(v) as v from t group by g, k) s on u.k = s.k",
+        partitions=4,
+    )
+    g.revive()
+    build_sid = next(
+        sid
+        for sid, st in g.stages.items()
+        if not st.inputs and st.output_links == [g.final_stage_id]
+    )
+    # pop BOTH leaf stages' tasks (2 each) before completing anything, so
+    # the probe exchange — resolved once the agg map completes — never
+    # has a task dispatched
+    leaf_sids = {sid for sid, st in g.stages.items() if not st.inputs}
+    tasks = [g.pop_next_task(EXEC1.id) for _ in range(4)]
+    assert {t.partition.stage_id for t in tasks} == leaf_sids
+    for t in tasks:
+        complete_task(g, t, EXEC1)
+    consumer = g.stages[g.final_stage_id]
+    assert isinstance(consumer, UnresolvedStage)
+    assert isinstance(g.stages[build_sid], CompletedStage)
+
+    g.aqe_policy = AqePolicy(
+        enabled=True, broadcast_enabled=True,
+        broadcast_threshold_bytes=1_000_000,
+    )
+    try_broadcast(g, build_sid)
+    (ev,) = replan_events(g)
+    assert ev["rewrite"] == "broadcast"
+    readers = []
+
+    def walk(node):
+        if isinstance(node, ShuffleReaderExec):
+            readers.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(consumer.plan)
+    assert not readers  # nothing baked: placeholders only
+    # the original executor dies; the map stages re-run elsewhere and the
+    # consumer resolves against the replacement locations
+    assert g.reset_stages(EXEC1.id)
+    drain(g, EXEC2)
+    assert g.status == COMPLETED
+
+
+def test_broadcast_pending_at_failover_replays_on_decode():
+    """A conversion skipped live because the probe had dispatched work
+    replays at decode: restart drops in-flight work anyway (Running
+    persists as Resolved), so the adopting scheduler re-decides."""
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings=BROADCAST_ON,
+    )
+    g.revive()
+    held = [g.pop_next_task(EXEC1.id) for _ in range(2)]  # build side
+    probe_task = g.pop_next_task(EXEC1.id)
+    assert probe_task.partition.stage_id != held[0].partition.stage_id
+    for t in held:
+        complete_task(g, t, EXEC1)
+    assert not replan_events(g)  # probe started: no live conversion
+    n_stages = len(g.stages)
+    restored = ExecutionGraph.decode(g.encode())
+    assert len(restored.stages) == n_stages - 1  # probe stage stripped
+    (ev,) = [
+        e for e in restored.pending_events if e["kind"] == "aqe_replan"
+    ]
+    assert ev["rewrite"] == "broadcast"
+    drain(restored, EXEC2)
+    assert restored.status == COMPLETED
+
+
+def test_broadcast_needs_opt_in():
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k", partitions=4
+    )
+    stages_before = len(g.stages)
+    drain(g)
+    assert g.status == COMPLETED
+    assert len(g.stages) == stages_before
+
+
+# ------------------------------------- rollback / persistence composition
+def test_post_coalesce_executor_loss_reresolves_rewritten_plan():
+    """ISSUE 8 satellite: a consumer rolled back to Unresolved after an
+    AQE rewrite must re-resolve with the REWRITTEN plan."""
+    g = make_graph("select g, sum(v) as s from t group by g")
+    g.revive()
+    # complete the map stage on EXEC1; the consumer resolves coalesced
+    for _ in range(2):
+        complete_task(g, g.pop_next_task(EXEC1.id), EXEC1)
+    g.revive()
+    consumer = g.stages[g.final_stage_id]
+    assert isinstance(consumer, RunningStage) and consumer.partitions == 1
+    assert len(replan_events(g)) == 1
+
+    # lose the executor holding every map partition
+    assert g.reset_stages(EXEC1.id)
+    rolled = g.stages[g.final_stage_id]
+    assert isinstance(rolled, UnresolvedStage)
+    from arrow_ballista_tpu.scheduler.planner import find_unresolved_shuffles
+
+    leaf = find_unresolved_shuffles(rolled.plan)[0]
+    assert leaf.selections is not None  # rewrite survived the rollback
+    assert rolled.aqe  # marker too: no double replan on re-resolve
+
+    drain(g, EXEC2)
+    assert g.status == COMPLETED
+    final = g.stages[g.final_stage_id]
+    assert final.partitions == 1
+    assert stage_aqe(final)["tasks_after"] == 1
+    # the rewrite journaled once; the rollback journaled the reset
+    assert len(replan_events(g)) == 1
+
+
+def test_persistence_replays_decisions():
+    """Mid-flight restart: decisions already made ride the stage plans;
+    the persisted policy re-plans stages that resolve afterwards."""
+    g = make_graph("select g, sum(v) as s from t group by g")
+    g.revive()
+    complete_task(g, g.pop_next_task(EXEC1.id), EXEC1)  # 1 of 2 map tasks
+    restored = ExecutionGraph.decode(g.encode())
+    assert restored.aqe_policy.enabled
+    assert restored.aqe_policy == g.aqe_policy
+    drain(restored, EXEC2)
+    assert restored.status == COMPLETED
+    assert stage_aqe(restored.stages[restored.final_stage_id])["tasks_after"] == 1
+
+
+def test_resolved_selections_survive_encode_decode():
+    g = make_graph("select g, sum(v) as s from t group by g")
+    g.revive()
+    for _ in range(2):
+        complete_task(g, g.pop_next_task(EXEC1.id), EXEC1)
+    g.revive()  # consumer now Running with a coalesced reader
+    restored = ExecutionGraph.decode(g.encode())  # Running persists Resolved
+    stage = restored.stages[restored.final_stage_id]
+    assert isinstance(stage, ResolvedStage)
+    readers = []
+
+    def walk(node):
+        if isinstance(node, ShuffleReaderExec):
+            readers.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(stage.plan)
+    assert readers and readers[0].selections is not None
+    assert readers[0].source_partition_count == 16
+    assert len(readers[0].partition) == 1
+    drain(restored)
+    assert restored.status == COMPLETED
+
+
+def test_inflight_aqe_summary_survives_restart():
+    """A stage rewritten but not yet completed keeps its replan record —
+    and its replanned-already marker — across encode/decode, so the
+    profile stays truthful and no second rewrite runs after failover."""
+    g = make_graph("select g, sum(v) as s from t group by g")
+    g.revive()
+    for _ in range(2):
+        complete_task(g, g.pop_next_task(EXEC1.id), EXEC1)
+    g.revive()  # consumer Running with its aqe summary stamped
+    assert stage_aqe(g.stages[g.final_stage_id])["tasks_after"] == 1
+    restored = ExecutionGraph.decode(g.encode())
+    stage = restored.stages[restored.final_stage_id]
+    assert isinstance(stage, ResolvedStage)
+    assert stage.aqe["tasks_after"] == 1
+    drain(restored, EXEC2)
+    assert restored.status == COMPLETED
+    final = restored.stages[restored.final_stage_id]
+    assert stage_aqe(final)["tasks_after"] == 1  # profile record kept
+
+
+def test_completed_stage_exposes_exact_partition_bytes():
+    """ISSUE 8 satellite: AQE reads the exact reduce-partition byte map
+    off CompletedStage, not a reconstruction from metric rollups."""
+    g = make_graph(
+        "select g, sum(v) as s from t group by g",
+        settings={"ballista.aqe.enabled": "false"},
+    )
+    sizes = {0: 7, 1: 500}
+    drain(g, bytes_for=lambda p: sizes.get(p, 33))
+    assert g.status == COMPLETED
+    producer = g.stages[1]
+    assert isinstance(producer, CompletedStage)
+    got = producer.output_partition_bytes()
+    # 2 map tasks each wrote every reduce partition
+    assert got[0] == 14 and got[1] == 1000
+    assert all(got[p] == 66 for p in range(2, 16))
+    rows = producer.output_partition_rows()
+    assert set(rows.values()) == {20}
+    # ...and the map survives persistence (task stats ride the proto)
+    again = ExecutionGraph.decode(g.encode()).stages[1]
+    assert again.output_partition_bytes() == got
+
+
+def test_skewed_partition_detected_from_observed_bytes():
+    """factor-based detection on a genuinely imbalanced distribution."""
+    g = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k",
+        partitions=4,
+        settings={
+            "ballista.aqe.skew_enabled": "true",
+            "ballista.aqe.skew_factor": "3",
+            "ballista.aqe.target_partition_bytes": "100",
+        },
+    )
+    # partition 0 is 100x the median on both sides
+    drain(g, bytes_for=lambda p: 10000 if p == 0 else 80)
+    assert g.status == COMPLETED
+    info = stage_aqe(g.stages[g.final_stage_id])
+    assert info["skewed_partitions"] == 1
+    assert info["skew_splits"] == 2  # bounded by 2 map fragments
+    (ev,) = replan_events(g)
+    assert ev["skewed_partitions"] == [0]
+
+
+# ----------------------------------------------------------- end-to-end
+def _rows(tbl: pa.Table):
+    return sorted(
+        tuple(round(x, 9) if isinstance(x, float) else x for x in r)
+        for r in zip(*[c.to_pylist() for c in tbl.columns])
+    )
+
+
+@pytest.fixture(scope="module")
+def skewed_parquet(tmp_path_factory):
+    d = tmp_path_factory.mktemp("aqe-data")
+    rng = np.random.default_rng(11)
+    n = 12000
+    keys = np.where(
+        rng.random(n) < 0.55, 3, rng.integers(0, 40, n)
+    ).astype(np.int64)
+    fact = pa.table(
+        {"k": keys, "v": rng.random(n), "g": [f"g{i % 7}" for i in range(n)]}
+    )
+    fd = d / "fact"
+    fd.mkdir()
+    third = n // 3
+    for i in range(3):
+        pq.write_table(
+            fact.slice(i * third, third if i < 2 else n - 2 * third),
+            str(fd / f"p{i}.parquet"),
+        )
+    dim = pa.table(
+        {
+            "k": pa.array(np.arange(40, dtype=np.int64)),
+            "w": [f"w{i}" for i in range(40)],
+        }
+    )
+    dd = d / "dim"
+    dd.mkdir()
+    pq.write_table(dim, str(dd / "p0.parquet"))
+    return str(fd), str(dd)
+
+
+def _run_cluster(
+    fact_dir,
+    dim_dir,
+    sql,
+    settings=None,
+    executors=2,
+    slots=2,
+    journal_dir="",
+):
+    from arrow_ballista_tpu.client import BallistaContext
+
+    cfg = dict(BASE_SETTINGS)
+    cfg["ballista.shuffle.partitions"] = "12"
+    cfg.update(settings or {})
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(cfg),
+        num_executors=executors,
+        concurrent_tasks=slots,
+        event_journal_dir=journal_dir,
+    )
+    ctx.register_parquet("fact", fact_dir)
+    ctx.register_parquet("dim", dim_dir)
+    try:
+        out = ctx.sql(sql).collect()
+        sched, _ = ctx._standalone_handles
+        tm = sched.server.state.task_manager
+        detail = tm.get_job_detail(next(iter(ctx._job_ids)))
+        return out, detail
+    finally:
+        ctx.close()
+
+
+def _journal_replans(journal_dir):
+    events = []
+    for name in sorted(os.listdir(journal_dir)):
+        with open(os.path.join(journal_dir, name), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    return [e for e in events if e.get("kind") == "aqe_replan"]
+
+
+def test_e2e_coalesce_identity_journal_and_profile(skewed_parquet, tmp_path):
+    from arrow_ballista_tpu.obs.export import job_profile
+
+    fact, dim = skewed_parquet
+    sql = "select g, sum(v) as s, count(*) as c from fact group by g"
+    off, _ = _run_cluster(
+        fact, dim, sql, {"ballista.aqe.enabled": "false"}
+    )
+    jd = str(tmp_path / "journal")
+    on, detail = _run_cluster(fact, dim, sql, journal_dir=jd)
+    assert _rows(off) == _rows(on)
+    replans = _journal_replans(jd)
+    assert replans and replans[0]["rewrite"] == "coalesce"
+    assert replans[0]["tasks_after"] < replans[0]["tasks_before"] == 12
+    aqe_rows = [
+        r for r in job_profile(detail, [])["stages"] if r.get("aqe")
+    ]
+    assert aqe_rows
+    assert (
+        aqe_rows[0]["aqe"]["tasks_after"] < aqe_rows[0]["aqe"]["tasks_before"]
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_e2e_skew_split_join_identity(skewed_parquet, tmp_path, seed):
+    fact, dim = skewed_parquet
+    # vary the probe predicate per seed so the matched subsets differ
+    sql = (
+        "select fact.k, dim.w, fact.v from fact join dim on fact.k = dim.k "
+        f"where fact.v < 0.{3 + seed}"
+    )
+    off, _ = _run_cluster(fact, dim, sql, {"ballista.aqe.enabled": "false"})
+    jd = str(tmp_path / f"journal{seed}")
+    on, detail = _run_cluster(fact, dim, sql, SKEW_ALL, journal_dir=jd)
+    assert _rows(off) == _rows(on)
+    replans = _journal_replans(jd)
+    assert any("skew_split" in e["rewrite"] for e in replans)
+
+
+def test_e2e_skew_split_agg_identity(skewed_parquet, tmp_path):
+    fact, dim = skewed_parquet
+    sql = (
+        "select g, sum(v) as s, count(*) as c, avg(v) as a, "
+        "min(v) as mn, max(v) as mx from fact group by g limit 100000"
+    )
+    off, _ = _run_cluster(fact, dim, sql, {"ballista.aqe.enabled": "false"})
+    jd = str(tmp_path / "journal")
+    on, _ = _run_cluster(fact, dim, sql, SKEW_ALL, journal_dir=jd)
+    assert _rows(off) == _rows(on)
+    replans = _journal_replans(jd)
+    assert any("skew_split" in e["rewrite"] for e in replans)
+
+
+def test_e2e_broadcast_identity(skewed_parquet, tmp_path):
+    fact, dim = skewed_parquet
+    # dim on the LEFT: the small build side completes before the probe
+    # producer starts (1 executor x 1 slot runs stages strictly in order)
+    sql = (
+        "select dim.w, fact.v from dim join fact on dim.k = fact.k "
+        "where fact.v < 0.25"
+    )
+    off, _ = _run_cluster(
+        fact, dim, sql, {"ballista.aqe.enabled": "false"},
+        executors=1, slots=1,
+    )
+    jd = str(tmp_path / "journal")
+    on, _ = _run_cluster(
+        fact, dim, sql, BROADCAST_ON, executors=1, slots=1, journal_dir=jd,
+    )
+    assert _rows(off) == _rows(on)
+    replans = _journal_replans(jd)
+    assert any(e["rewrite"] == "broadcast" for e in replans)

@@ -397,6 +397,30 @@ def test_reset_stages_rolls_back_completed_map_stage():
     assert graph.status == COMPLETED, graph.error
 
 
+def test_completed_producer_of_unresolved_consumer_reruns_on_loss():
+    """A producer that COMPLETED on the lost executor while its consumer
+    is still Unresolved (waiting on the other join side) must re-run —
+    the consumer has no Resolved/Running incarnation to nominate it, and
+    without a re-run it would wait forever on an incomplete input."""
+    graph = make_graph("select t.g, u.w from t join u on t.k = u.k")
+    graph.revive()
+    by_stage = {}
+    for _ in range(4):
+        task = graph.pop_next_task("exec-1")
+        by_stage.setdefault(task.partition.stage_id, []).append(task)
+    (sid_a, ts_a), (_, ts_b) = sorted(by_stage.items())
+    for t in ts_a:
+        complete_task(graph, t, EXEC1)  # side A completes on exec-1
+    complete_task(graph, ts_b[0], EXEC1)  # side B still mid-flight
+    assert isinstance(graph.stages[sid_a], CompletedStage)
+    assert isinstance(graph.stages[graph.final_stage_id], UnresolvedStage)
+
+    assert graph.reset_stages("exec-1")
+    assert isinstance(graph.stages[sid_a], RunningStage)  # re-running
+    drain(graph, EXEC2)
+    assert graph.status == COMPLETED, graph.error
+
+
 def test_second_executor_lost_during_rollback_does_not_double_reset():
     graph = make_graph("select g, sum(v) as s from t group by g")
     graph.revive()
