@@ -32,6 +32,7 @@ TPU_DTYPE = "ballista.tpu.dtype"
 TPU_MIN_ROWS = "ballista.tpu.min_rows"
 TPU_CACHE_COLUMNS = "ballista.tpu.cache_columns"
 TPU_HIGHCARD_MODE = "ballista.tpu.highcard_mode"
+TPU_DEVICE_ENCODE = "ballista.tpu.device_encode"
 TPU_KEYED_BUFFER_MB = "ballista.tpu.keyed_buffer_mb"
 TPU_READAHEAD = "ballista.tpu.readahead"
 MESH_ENABLE = "ballista.mesh.enable"
@@ -226,6 +227,18 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "path even at high cardinality (A/B: capacity must fit)",
             _parse_highcard_mode,
             "auto",
+        ),
+        ConfigEntry(
+            TPU_DEVICE_ENCODE,
+            "encode group keys ON DEVICE inside the fused keyed kernel "
+            "(raw key columns cross the bridge once; codes derive "
+            "bit-identically to the host encoders and the "
+            "encode→packed-u64-sort→segment-reduce pipeline runs as one "
+            "jitted dispatch); false pins the host-encode keyed path "
+            "(A/B baseline).  Keys without a device encoding (strings) "
+            "keep the host dictionary handoff either way",
+            _parse_bool,
+            "true",
         ),
         ConfigEntry(
             TPU_KEYED_BUFFER_MB,
@@ -685,6 +698,10 @@ class BallistaConfig:
     @property
     def tpu_highcard_mode(self) -> str:
         return self._get(TPU_HIGHCARD_MODE)
+
+    @property
+    def tpu_device_encode(self) -> bool:
+        return self._get(TPU_DEVICE_ENCODE)
 
     @property
     def tpu_keyed_buffer_mb(self) -> int:

@@ -331,6 +331,21 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
                 "compile_cache_hits": tpu.get("compile_cache_hits", 0),
                 "compile_cache_misses": tpu.get("compile_cache_misses", 0),
             }
+            # keyed device path: where the group encode ran and whether
+            # the encode→sort→segment-reduce pipeline fused into single
+            # dispatches (ISSUE 9) — next to the host encode time it
+            # eliminates
+            keyed = {
+                "key_encode_ms": round(
+                    tpu.get("key_encode_time_ns", 0) / _NS_PER_MS, 3
+                ),
+                "device_encode_batches": tpu.get("device_encode_batches", 0),
+                "fused_keyed_dispatches": tpu.get(
+                    "fused_keyed_dispatches", 0
+                ),
+            }
+            if any(keyed.values()):
+                row["tpu"].update(keyed)
         stages.append(row)
 
     out = {

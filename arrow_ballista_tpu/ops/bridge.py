@@ -258,11 +258,115 @@ class IdentityKeyEncoder:
         return pa.array(vals, t, mask=mask)
 
 
+class BoolKeyEncoder:
+    """Group-key encoder for bool columns: null → 0, False → 1, True → 2.
+
+    Identity-style (one astype, no dictionary hashing) and pure in the
+    VALUE, so the device twin (``kernels.device_encode_key("bool", …)``)
+    produces bit-identical codes and bool keys ride the fused keyed
+    path."""
+
+    def encode(self, arr) -> np.ndarray:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        values, validity = arrow_to_numpy(arr)
+        codes = values.astype(np.int64) + 1
+        if validity is not None:
+            codes = np.where(validity, codes, 0)
+        return codes
+
+    def decode(self, codes: np.ndarray, t: pa.DataType) -> pa.Array:
+        mask = codes == 0
+        return pa.array(np.maximum(codes - 1, 0).astype(bool), t, mask=mask)
+
+
+class FloatKeyEncoder:
+    """Group-key encoder for float columns: the code IS the raw bit
+    pattern (f32 → i32 bits, f64 → i64 bits).  Pure bit-pattern
+    grouping matches the CPU hash aggregate exactly — its
+    ``dictionary_encode`` distinguishes ``-0.0`` from ``+0.0`` and NaN
+    payloads from each other (measured), and the CPU-vs-TPU identity
+    contract follows the engine, not IEEE equality.  NULL takes ONE
+    reserved NaN pattern; data that contains that exact payload raises
+    ``ExecutionError`` (→ host-route fallback), the same escape hatch
+    the identity encoder uses for negative keys.  Pure in the value (no
+    dictionary state), so the device twin produces bit-identical codes;
+    codes can be negative, which the keyed sort handles but
+    ``GroupTable`` radix-combining does not — the gid route keeps its
+    dictionary encoder for floats, this encoder exists for the
+    device-encoded keyed route."""
+
+    def __init__(self, kind: str):  # "f32" | "f64"
+        self.kind = kind
+
+    def encode(self, arr) -> np.ndarray:
+        from . import kernels as K
+
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        values, validity = arrow_to_numpy(arr)
+        if self.kind == "f32":
+            v = values.astype(np.float32)
+            bits = v.view(np.int32).astype(np.int64)
+            null = K.FLOAT32_NULL_BITS
+        else:
+            v = values.astype(np.float64)
+            bits = v.view(np.int64).copy()
+            null = K.FLOAT64_NULL_BITS
+        if validity is not None:
+            hit = (bits == null) & validity
+            bits = np.where(validity, bits, null)
+        else:
+            hit = bits == null
+        if bool(np.any(hit)):
+            raise ExecutionError(
+                "float group key collides with the reserved null pattern"
+            )
+        return bits.astype(np.int64)
+
+    def decode(self, codes: np.ndarray, t: pa.DataType) -> pa.Array:
+        from . import kernels as K
+
+        if self.kind == "f32":
+            mask = codes == K.FLOAT32_NULL_BITS
+            vals = (
+                np.where(mask, 0, codes).astype(np.int32).view(np.float32)
+            )
+        else:
+            mask = codes == K.FLOAT64_NULL_BITS
+            vals = np.where(mask, 0, codes).astype(np.int64).view(np.float64)
+        arr = pa.array(vals.astype(np.float64), pa.float64(), mask=mask)
+        return arr if arr.type.equals(t) else arr.cast(t)
+
+
 def make_key_encoder(t: pa.DataType):
-    """Identity for int/date32 group keys, dictionary otherwise."""
+    """Identity for int/date32 group keys, bool codes for booleans,
+    dictionary otherwise."""
     if pa.types.is_integer(t) or pa.types.is_date32(t):
         return IdentityKeyEncoder()
+    if pa.types.is_boolean(t):
+        return BoolKeyEncoder()
     return DictEncoder()
+
+
+def device_key_encoder(t: pa.DataType, mode: str):
+    """(encoder, device-kind) for the device-encoded keyed route.
+
+    The kind names a :func:`kernels.device_encode_key` branch whose
+    device codes are bit-identical to ``encoder.encode``; ``None`` means
+    the key stays on the host dictionary handoff (strings, decimals —
+    and f64 in x32 mode, whose 64-bit pattern cannot ship).  Falls back
+    to :func:`make_key_encoder` for the ``None`` kinds so decode
+    behavior matches the host route exactly."""
+    if pa.types.is_integer(t) or pa.types.is_date32(t):
+        return IdentityKeyEncoder(), "ident"
+    if pa.types.is_boolean(t):
+        return BoolKeyEncoder(), "bool"
+    if pa.types.is_float32(t):
+        return FloatKeyEncoder("f32"), "f32"
+    if pa.types.is_float64(t) and mode != "x32":
+        return FloatKeyEncoder("f64"), "f64"
+    return make_key_encoder(t), None
 
 
 def coalesce_batches(source, target_rows: int, metrics=None):

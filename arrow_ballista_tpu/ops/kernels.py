@@ -831,11 +831,28 @@ def _lex_merge(a_hi, a_lo, b_hi, b_lo, is_min: bool):
 # Tests force a strategy via set_agg_algorithm to exercise the matmul path
 # on the CPU-mesh CI host.
 _AGG_ALGO: dict = {"force": None}
-_MATMUL_MAX_CAP = 8192
-# rows x capacity work bound: 8M x 8192 measured fine on v5e (XLA never
-# materializes the one-hot), but compute grows linearly with the product —
-# beyond this the scatter path wins anyway
-_MATMUL_MAX_ELEMS = 1 << 36
+# matmul FLOP bounds come from the generated routing table
+# (ops/routing.py: dev/analyze_grid.py --emit over KERNELBENCH grids;
+# builtin defaults 8192 / 2^36 are the pre-table chip-measured values).
+# A non-None module value overrides the table (tests).
+_MATMUL_MAX_CAP: Optional[int] = None
+_MATMUL_MAX_ELEMS: Optional[int] = None
+
+
+def _matmul_max_cap() -> int:
+    if _MATMUL_MAX_CAP is not None:
+        return _MATMUL_MAX_CAP
+    from . import routing
+
+    return routing.value("matmul_max_cap")
+
+
+def _matmul_max_elems() -> int:
+    if _MATMUL_MAX_ELEMS is not None:
+        return _MATMUL_MAX_ELEMS
+    from . import routing
+
+    return routing.value("matmul_max_elems")
 # Per-block MXU accumulation error grows ~sqrt(block)*eps relative to the
 # block sum; 16K-row blocks measured 9e-8 relative error on q1-scale data
 # (6M rows), an order inside the 1e-6 oracle tolerance.
@@ -861,17 +878,23 @@ def segment_algo(capacity: int, n_rows: Optional[int] = None) -> str:
         return _AGG_ALGO["force"]
     if jax.default_backend() == "cpu":
         return "scatter"
-    if capacity > _MATMUL_MAX_CAP:
+    if capacity > _matmul_max_cap():
         return "sort"
-    if n_rows is not None and n_rows * capacity > _MATMUL_MAX_ELEMS:
+    if n_rows is not None and n_rows * capacity > _matmul_max_elems():
         return "sort"
     return "matmul"
 
 
 def algo_cache_token() -> tuple:
     """Part of any compiled-kernel cache key: the strategy inputs that are
-    NOT visible in the kernel signature (forced algorithm, backend)."""
-    return (_AGG_ALGO["force"], jax.default_backend())
+    NOT visible in the kernel signature (forced algorithm, backend,
+    routing-table matmul bounds — tests swap tables mid-process)."""
+    return (
+        _AGG_ALGO["force"],
+        jax.default_backend(),
+        _matmul_max_cap(),
+        _matmul_max_elems(),
+    )
 
 
 def _blocked_onehot_agg(V, seg_ids, capacity, n_sum_cols):
@@ -1495,6 +1518,7 @@ def make_keyed_prep_kernel(
     flat_names: list[str],
     holder: dict,
     extra_names: tuple = (),
+    key_kinds: Optional[tuple] = None,
 ):
     """Per-batch half of the keyed aggregation.
 
@@ -1503,7 +1527,11 @@ def make_keyed_prep_kernel(
     :func:`make_join_kernel`, the device join) and emits masked
     scan-form columns that BUFFER in HBM until the final sort.  ``keys``
     is a tuple of per-key code arrays and passes through untouched (it
-    rides the ``seg_ids`` slot so the join wrapper composes unchanged).
+    rides the ``seg_ids`` slot so the join wrapper composes unchanged);
+    with ``key_kinds`` set, each entry is instead the operand tuple
+    :func:`device_encode_keys` expects and the group-code derivation
+    runs INSIDE this dispatch — the raw key column crosses the bridge
+    once and the host never encodes at all.
     ``extra_names`` are env arrays buffered RAW for post-sort passes
     (device median / count_distinct / corr).  ``holder`` captures the
     static ``kinds``/``plan`` during the first trace for the finish
@@ -1512,6 +1540,8 @@ def make_keyed_prep_kernel(
     mode = precision_mode()
 
     def fn(keys, valid, *arrays):
+        if key_kinds is not None:
+            keys = device_encode_keys(key_kinds, keys)
         env = dict(zip(flat_names, arrays))
         mask = valid
         if filter_closure is not None:
@@ -1688,6 +1718,88 @@ def packed_multikey_sort(keys: tuple, iota):
     return perm, sorted_keys
 
 
+# ------------------------------------------------------ device key encode
+# Device twin of the host group-key encoders (bridge.make_key_encoder /
+# device_key_encoder): the raw key column crosses the bridge ONCE as
+# (values, validity) and the jitted kernel derives the group code
+# bit-identically to the host encoder, so the keyed route pays no host
+# encode at all — the same host/device bit-identity pattern
+# make_partition_id_kernel proved for shuffle partition ids.  Kinds:
+#   "code"  — host-encoded codes pass through (dict/string handoff)
+#   "ident" — int/date32 identity codes: value + 1, null -> 0
+#             (bridge.IdentityKeyEncoder), computed in the shipped
+#             integer dtype (i32 when the host precheck narrowed)
+#   "bool"  — null -> 0, False -> 1, True -> 2 (bridge.BoolKeyEncoder)
+#   "f32"/"f64" — the RAW bit pattern as a signed integer, null -> a
+#             reserved NaN pattern (bridge.FloatKeyEncoder).  Pure
+#             bit-pattern grouping matches the CPU hash aggregate
+#             exactly (dictionary_encode distinguishes -0.0 from +0.0
+#             and NaN payloads from each other — measured, and the
+#             oracle identity contract follows IT, not IEEE equality);
+#             a host precheck falls back when data contains the one
+#             reserved payload
+FLOAT32_NULL_BITS = 0xFFC00001 - (1 << 32)  # as signed i32
+FLOAT64_NULL_BITS = 0xFFF8000000000001 - (1 << 64)  # as signed i64
+
+
+def device_encode_key(kind: str, vals, valid):
+    """Traceable group-code derivation for ONE key column (see the kind
+    table above).  ``vals``/``valid`` are the padded device arrays; pad
+    rows carry valid=False and encode to the null code — they are masked
+    out of every segment downstream, so their code value never matters.
+    """
+    if kind == "ident":
+        one = jnp.asarray(1, vals.dtype)
+        zero = jnp.zeros((), vals.dtype)
+        return jnp.where(valid, vals + one, zero)
+    if kind == "bool":
+        v = vals.astype(jnp.int32) + jnp.int32(1)
+        return jnp.where(valid, v, jnp.zeros((), jnp.int32))
+    if kind in ("f32", "f64"):
+        idt = jnp.int32 if kind == "f32" else jnp.int64
+        null = jnp.asarray(
+            FLOAT32_NULL_BITS if kind == "f32" else FLOAT64_NULL_BITS,
+            idt,
+        )
+        bits = jax.lax.bitcast_convert_type(vals, idt)
+        return jnp.where(valid, bits, null)
+    raise ExecutionError(f"device key-encode kind {kind}")
+
+
+def device_encode_keys(kinds: tuple, keys: tuple) -> tuple:
+    """Per-key codes from mixed operands: ``keys[k]`` is ``(codes,)`` for
+    kind "code" (host dictionary handoff) or ``(values, validity)`` for
+    a device-encoded kind."""
+    out = []
+    for kind, ops in zip(kinds, keys):
+        if kind == "code":
+            out.append(ops[0])
+        else:
+            out.append(device_encode_key(kind, *ops))
+    return tuple(out)
+
+
+_KEY_ENCODE_CACHE: dict = {}
+
+
+def make_key_encode_kernel(kinds: tuple):
+    """Jitted standalone ``fn(keys) -> code arrays`` (parity tests; the
+    production path traces :func:`device_encode_keys` INSIDE the fused
+    keyed prep kernel so encode shares the batch's single dispatch)."""
+    fn = _KEY_ENCODE_CACHE.get(kinds)
+    if fn is None:
+        fn = jax.jit(lambda keys: device_encode_keys(kinds, keys))
+        _KEY_ENCODE_CACHE[kinds] = fn
+    return fn
+
+
+def keyed_sort_body(n_keys: int):
+    """Traceable phase-1 body (see :func:`keyed_sort_kernel`): returned
+    uncompiled so the fused keyed runner can inline encode→sort into one
+    jitted dispatch."""
+    return _keyed_sort_fn(n_keys)
+
+
 def keyed_sort_kernel(n_keys: int):
     """Phase 1 of the keyed aggregation (cached per key count).
 
@@ -1701,7 +1813,12 @@ def keyed_sort_kernel(n_keys: int):
     fn = _KEYED_SORT_CACHE.get(n_keys)
     if fn is not None:
         return fn
+    fn = jax.jit(_keyed_sort_fn(n_keys))
+    _KEYED_SORT_CACHE[n_keys] = fn
+    return fn
 
+
+def _keyed_sort_fn(n_keys: int):
     def sort_fn(mask, *keys):
         n = mask.shape[0]
         iota = jnp.arange(n, dtype=jnp.int32)
@@ -1760,9 +1877,7 @@ def keyed_sort_kernel(n_keys: int):
         n_groups = jnp.sum(flag.astype(jnp.int32))
         return (s2, perm) + tuple(sk) + (n_groups,)
 
-    fn = jax.jit(sort_fn)
-    _KEYED_SORT_CACHE[n_keys] = fn
-    return fn
+    return sort_fn
 
 
 _KEYED_FINISH_CACHE: dict = {}

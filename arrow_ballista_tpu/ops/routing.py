@@ -1,0 +1,141 @@
+"""Measured routing table for the device operator paths.
+
+The r04/r05 verdict discipline — "routing constants must cite a measured
+artifact, not a guess" — becomes code here: every threshold that steers
+a batch between device strategies (matmul vs sort segment reduction, the
+groups~rows high-cardinality detector, whether ``auto`` routes keyed
+plans to the fused device-KEYED path) loads from a machine-readable
+artifact emitted by ``dev/analyze_grid.py --emit`` over KERNELBENCH
+grids.  ``arrow_ballista_tpu/ops/routing_table.json`` ships the table
+generated from the most recent grid capture; regenerate it with::
+
+    python dev/analyze_grid.py KERNELBENCH_rXX.json --emit \
+        arrow_ballista_tpu/ops/routing_table.json
+
+``BALLISTA_ROUTING_TABLE`` overrides the artifact path (empty string
+disables loading).  With no artifact present the BUILTIN defaults apply
+— the exact constants that lived in ``ops/kernels.py`` and
+``ops/stage_compiler.py`` before this table existed (their measurement
+provenance is recorded per field below), so behavior without an
+artifact is unchanged.
+
+Thresholds are PER PLATFORM (``jax.default_backend()``): the same
+kernel grid that says matmul wins to capacity 8192 on a v5e says
+scatter wins everywhere on the CPU backend.  A platform missing from
+the artifact falls back to the builtin defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+SCHEMA = "ballista.routing/v1"
+
+# Builtin defaults == the pre-table constants, with their provenance:
+#   matmul_max_cap / matmul_max_elems — r05 chip capture: MXU one-hot
+#     einsum beats the sort path while capacity <= 8192 and
+#     rows x capacity <= 2^36 (ops/kernels.py segment-strategy comment);
+#   highcard_min_groups / highcard_ratio — groups~rows detector bounds
+#     (heuristic pending a full chip kernel grid, BENCH_SUITE_r05);
+#   keyed_route_auto — whether 'auto' highcard mode routes groups~rows
+#     to the device-keyed fused path: False everywhere measured so far
+#     (KERNELBENCH_r05 segment_reduce: keyed 2.2M rows/s vs scatter
+#     140-240M on the cpu platform; BENCH_SUITE_r05 q3 SF10 keyed =
+#     0.036x CPU on chip).
+_DEFAULTS = {
+    "matmul_max_cap": 8192,
+    "matmul_max_elems": 1 << 36,
+    "highcard_min_groups": 1 << 16,
+    "highcard_ratio": 0.05,
+    "keyed_route_auto": False,
+}
+
+# the emitted per-platform section: exactly these keys (a unit test pins
+# the shape so regenerating from a new grid can't silently drift)
+PLATFORM_FIELDS = tuple(sorted(_DEFAULTS))
+
+
+@dataclass(frozen=True)
+class RoutingTable:
+    matmul_max_cap: int
+    matmul_max_elems: int
+    highcard_min_groups: int
+    highcard_ratio: float
+    keyed_route_auto: bool
+    source: str = "builtin defaults (pre-table ops/ constants)"
+
+
+_BUILTIN = RoutingTable(**_DEFAULTS)
+
+
+def default_artifact_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "routing_table.json")
+
+
+def _load_artifact() -> dict:
+    """platform -> RoutingTable from the artifact (empty on any problem:
+    routing must never break a query — the builtin defaults always
+    work)."""
+    path = os.environ.get("BALLISTA_ROUTING_TABLE")
+    if path == "":
+        return {}
+    if path is None:
+        path = default_artifact_path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") != SCHEMA:
+            return {}
+        out = {}
+        for platform, vals in (doc.get("platforms") or {}).items():
+            merged = dict(_DEFAULTS)
+            merged.update(
+                {k: vals[k] for k in PLATFORM_FIELDS if k in vals}
+            )
+            out[platform] = RoutingTable(
+                **merged, source=os.path.abspath(path)
+            )
+        return out
+    except (OSError, ValueError, TypeError):
+        return {}
+
+
+_TABLES: dict = _load_artifact()
+
+
+def reload(path: str | None = None) -> None:
+    """Re-read the artifact (tests; ``path`` overrides the env/default
+    resolution for this call)."""
+    global _TABLES
+    if path is not None:
+        old = os.environ.get("BALLISTA_ROUTING_TABLE")
+        os.environ["BALLISTA_ROUTING_TABLE"] = path
+        try:
+            _TABLES = _load_artifact()
+        finally:
+            if old is None:
+                del os.environ["BALLISTA_ROUTING_TABLE"]
+            else:
+                os.environ["BALLISTA_ROUTING_TABLE"] = old
+    else:
+        _TABLES = _load_artifact()
+
+
+def current() -> RoutingTable:
+    """The table for the active jax platform (resolved lazily — import
+    must not initialize a device backend)."""
+    import jax
+
+    return _TABLES.get(jax.default_backend(), _BUILTIN)
+
+
+def value(name: str):
+    """One threshold for the active platform (name is a RoutingTable
+    field)."""
+    return getattr(current(), name)
+
+
+def field_names() -> tuple:
+    return tuple(f.name for f in fields(RoutingTable) if f.name != "source")

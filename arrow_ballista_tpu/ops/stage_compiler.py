@@ -132,11 +132,28 @@ class _KeyedGroups:
 # (tests, chip A/B, and the r05 packed-sort rework whose chip numbers
 # are still pending — KERNELBENCH sort_operands will say whether the
 # 4.6-9x single-operand speedup moves the routing again).
-# The detector bounds themselves remain heuristic: the chip kernel grid
-# (capacity x rows x algo) is the tuning artifact for them once a
-# tunnel window allows a full capture.
-_HIGHCARD_MIN_GROUPS = 1 << 16
-_HIGHCARD_RATIO = 0.05
+# The detector bounds load from the generated routing table
+# (ops/routing.py; regenerate via dev/analyze_grid.py --emit).  A
+# non-None module value overrides the table (tests pin tiny detector
+# bounds to route small fixtures keyed).
+_HIGHCARD_MIN_GROUPS: Optional[int] = None
+_HIGHCARD_RATIO: Optional[float] = None
+
+
+def _highcard_min_groups() -> int:
+    if _HIGHCARD_MIN_GROUPS is not None:
+        return _HIGHCARD_MIN_GROUPS
+    from . import routing
+
+    return routing.value("highcard_min_groups")
+
+
+def _highcard_ratio() -> float:
+    if _HIGHCARD_RATIO is not None:
+        return _HIGHCARD_RATIO
+    from . import routing
+
+    return routing.value("highcard_ratio")
 # Build-key spans up to this many slots use the dense direct-probe join
 # table ([span] i32 = 256 MiB HBM at the cap) instead of searchsorted's
 # log2(m) sequential gather passes (BENCH_SUITE_r05 starjoin row).
@@ -172,14 +189,19 @@ def keyed_route_wanted(config) -> bool:
         return False
     if mode == "device":
         return True
-    return False
+    from . import routing
+
+    # 'auto' follows the measured routing table: True only on platforms
+    # whose KERNELBENCH grid shows the keyed reduction winning the
+    # high-cardinality cells (dev/analyze_grid.py --emit)
+    return bool(routing.value("keyed_route_auto"))
 
 
 def _highcard_detect(n_groups: int, n_rows: int) -> bool:
     """Raw groups~rows detector (first data batch), mode-independent."""
     return (
-        n_groups > _HIGHCARD_MIN_GROUPS
-        and n_groups > _HIGHCARD_RATIO * n_rows
+        n_groups > _highcard_min_groups()
+        and n_groups > _highcard_ratio() * n_rows
     )
 
 
@@ -954,6 +976,16 @@ class TpuStageExec(ExecutionPlan):
                 self._group_plan.append(("enc", slot))
                 slot += 1
         self._n_encoded_groups = slot
+        # group exprs at host-ENCODED positions, in slot order (the
+        # device key-encode path evaluates these raw and derives codes
+        # on device)
+        self._enc_group_exprs = [
+            g
+            for (g, _n), (kind, _s) in zip(
+                fused.group_exprs, self._group_plan
+            )
+            if kind == "enc"
+        ]
         self._jk_slot = self._jk_pos = None
         if fused.join is not None:
             pk = fused.join.probe_key
@@ -1345,6 +1377,15 @@ class TpuStageExec(ExecutionPlan):
                 n_pad = K.bucket_rows(n)
 
                 if fused.group_exprs:
+                    if acc is None and not entries:
+                        # pre-encode fast path: keyed-pinned stages with
+                        # device-encodable keys route to _run_keyed
+                        # BEFORE any host group encode — the raw key
+                        # columns cross the bridge inside the fused
+                        # dispatch and key_encode_time_ns stays ~0
+                        fast = self._keyed_fast_encoders(batch)
+                        if fast is not None:
+                            raise _KeyedRoute([(batch, None)], src, fast, ra)
                     with self.metrics.timer("key_encode_time_ns"):
                         codes = self._encode_codes(batch, key_encoders)
                     if acc is None and not entries:
@@ -1542,11 +1583,13 @@ class TpuStageExec(ExecutionPlan):
         return args, trivial_idx
 
     # ---------------------------------------------------- keyed aggregate
-    def _keyed_prep(self, dense: bool = False):
-        """(holder, jitted prep kernel) for the keyed path, cached with
-        the other compiled kernels on the stage signature."""
+    def _keyed_prep(self, dense: bool = False, key_kinds=None):
+        """(holder, raw kernel, jitted prep kernel) for the keyed path,
+        cached with the other compiled kernels on the stage signature.
+        The raw (untraced) kernel backs the fused single-dispatch runner;
+        ``key_kinds`` enables the in-kernel device key encode."""
         key = (
-            self._sig + ("keyed_prep",)
+            self._sig + ("keyed_prep", key_kinds)
             + (("dense",) if dense else ())
             + K.algo_cache_token()
         )
@@ -1563,6 +1606,7 @@ class TpuStageExec(ExecutionPlan):
                 self._flat_names,
                 holder,
                 extra_names=self._median_extra_names(),
+                key_kinds=key_kinds,
             )
             if self.fused.join is not None:
                 kernel = K.make_join_kernel(
@@ -1574,9 +1618,184 @@ class TpuStageExec(ExecutionPlan):
                 )
             else:
                 kernel = inner
-            cached = (holder, jax.jit(kernel))
+            cached = (holder, kernel, jax.jit(kernel))
             _KERNEL_CACHE[key] = cached
-        return cached[0], self._timed_jit(cached[1])
+        return cached[0], cached[1], self._timed_jit(cached[2])
+
+    def _key_kinds_for(self, key_encoders) -> tuple:
+        """Per-encoded-key device-encode kind ("code" = host encode /
+        dictionary handoff), derived from the encoder instances actually
+        in play so code spaces can never mix across batches."""
+        from .bridge import (
+            BoolKeyEncoder,
+            FloatKeyEncoder,
+            IdentityKeyEncoder,
+        )
+
+        if not self.config.tpu_device_encode:
+            return tuple("code" for _ in key_encoders)
+        kinds = []
+        for enc in key_encoders:
+            if isinstance(enc, IdentityKeyEncoder):
+                kinds.append("ident")
+            elif isinstance(enc, BoolKeyEncoder):
+                kinds.append("bool")
+            elif isinstance(enc, FloatKeyEncoder):
+                kinds.append(enc.kind)
+            else:
+                kinds.append("code")
+        return tuple(kinds)
+
+    def _keyed_fast_encoders(self, batch) -> Optional[list]:
+        """Encoder set for the PRE-ENCODE keyed fast path, or None when
+        this stage/batch must take the legacy host-encode routing.
+
+        The fast path fires when the stage is pinned keyed (median/corr
+        stages, or ``highcard_mode=device``), device encode is enabled,
+        and at least one key has a device encoding — the batch then
+        routes to :meth:`_run_keyed` with NO host group encode at all
+        (``key_encode_time_ns`` stays ~0; only dictionary keys still pay
+        the host handoff per batch).  A first-batch range precheck sends
+        identity keys the device cannot represent (negative values, or
+        past-i32 in x32 mode) back to the legacy routing, which lands on
+        the measured host fallbacks."""
+        cfg = self.config
+        if not cfg.tpu_device_encode:
+            return None
+        if not (self._needs_keyed or cfg.tpu_highcard_mode == "device"):
+            return None
+        from .bridge import arrow_to_numpy, device_key_encoder
+
+        encs: list = []
+        kinds: list = []
+        for pos, (kind, _s) in enumerate(self._group_plan):
+            if kind != "enc":
+                continue
+            enc, k = device_key_encoder(
+                self._schema.field(pos).type, self._mode
+            )
+            encs.append(enc)
+            kinds.append(k)
+        if not encs or all(k is None for k in kinds):
+            return None
+        for k, g in zip(kinds, self._enc_group_exprs):
+            if k != "ident":
+                continue
+            try:
+                vals, _valid = arrow_to_numpy(_eval_arr(g, batch))
+            except ExecutionError:
+                return None
+            v = vals.astype(np.int64, copy=False)
+            if len(v) and (
+                v.min() < 0
+                or (self._mode == "x32" and v.max() > (1 << 31) - 2)
+            ):
+                return None
+        return encs
+
+    def _keyed_key_ops(
+        self, batch, kinds, key_state: dict, key_encoders, codes,
+        n: int, n_pad: int,
+    ) -> tuple:
+        """Per-key prep-kernel operand tuples for one batch.
+
+        "code" kinds host-encode (dictionary handoff; ``codes`` reuses
+        the detection path's already-encoded first batch).  Device kinds
+        ship the RAW evaluated key column as (values, validity);
+        identity keys choose a target integer dtype on the first batch —
+        i32 when the range allows, unlocking the packed-u64 single-
+        operand sort even in x64 mode (measured 6.8x on the sort) — and
+        a later batch that overflows the choice raises ExecutionError:
+        the late-key-overflow host-route fallback the legacy path has."""
+        from .bridge import arrow_to_numpy
+
+        def note_range(slot: int, min_code, max_code) -> None:
+            """Track the running per-slot CODE range (None = the slot
+            has no non-negative bounded code space): the fused runner
+            folds min-rebased codes into one sort word using the exact
+            stream-wide spans."""
+            if max_code is None or key_state.get(("max", slot), 0) is None:
+                key_state[("max", slot)] = None
+                return
+            key_state[("max", slot)] = max(
+                key_state.get(("max", slot), 0), int(max_code)
+            )
+            cur_min = key_state.get(("min", slot))
+            key_state[("min", slot)] = (
+                int(min_code)
+                if cur_min is None
+                else min(cur_min, int(min_code))
+            )
+
+        ops: list = []
+        for slot, (kind, enc) in enumerate(zip(kinds, key_encoders)):
+            g = self._enc_group_exprs[slot]
+            if kind == "code":
+                if codes is not None and codes[slot] is not None:
+                    c = codes[slot]
+                else:
+                    with self.metrics.timer("key_encode_time_ns"):
+                        c = enc.encode(_eval_arr(g, batch))
+                note_range(slot, 0, c.max(initial=0))
+                ops.append((K._pad(K.coerce_host_values(c), n_pad),))
+                continue
+            vals, valid = arrow_to_numpy(_eval_arr(g, batch))
+            if valid is None:
+                valid = np.ones(n, dtype=bool)
+            if kind == "ident":
+                v = vals.astype(np.int64, copy=False)
+                if len(v) and v.min() < 0:
+                    raise ExecutionError(
+                        "negative group key in identity key encoder"
+                    )
+                # code = value + 1; null rows carry code 0, so any null
+                # in the batch pins the range floor there
+                note_range(
+                    slot,
+                    0 if (not len(v) or not valid.all())
+                    else int(v.min()) + 1,
+                    v.max(initial=0) + 1,
+                )
+                dt = key_state.get(("dtype", slot))
+                if dt is None:
+                    if int(v.max(initial=0)) <= (1 << 31) - 2:
+                        dt = np.int32
+                    elif self._mode == "x32":
+                        raise ExecutionError(
+                            "int64 group key exceeds i32 range in x32 mode"
+                        )
+                    else:
+                        dt = np.int64
+                    key_state[("dtype", slot)] = dt
+                elif dt is np.int32 and len(v) and (
+                    int(v.max(initial=0)) > (1 << 31) - 2
+                ):
+                    raise ExecutionError(
+                        "group key outgrew the i32 device encoding"
+                    )
+                vals = v.astype(dt, copy=False)
+            elif kind == "bool":
+                vals = np.asarray(vals, dtype=bool)
+                note_range(slot, 0, 2)
+            else:  # f32 / f64: raw bit-pattern codes
+                note_range(slot, 0, None)  # signed bits: no radix fold
+                if kind == "f32":
+                    vals = vals.astype(np.float32, copy=False)
+                    bits = vals.view(np.int32)
+                    null = K.FLOAT32_NULL_BITS
+                else:
+                    vals = vals.astype(np.float64, copy=False)
+                    bits = vals.view(np.int64)
+                    null = K.FLOAT64_NULL_BITS
+                if bool(np.any((bits == null) & valid)):
+                    # the one NaN payload reserved for NULL appears as
+                    # DATA: no device encoding — host-route fallback
+                    raise ExecutionError(
+                        "float group key collides with the reserved "
+                        "null pattern"
+                    )
+            ops.append((K._pad(vals, n_pad), K._pad(valid, n_pad)))
+        return tuple(ops)
 
     def _median_extra_names(self) -> tuple:
         """Env names of the median/corr argument leaves, buffered raw
@@ -1616,14 +1835,27 @@ class TpuStageExec(ExecutionPlan):
             # cached by the _execute_device run that raised _KeyedRoute
             # (an empty build side returns there, before any routing)
             build = self._prepare_build(ctx)
-        holder, prep = self._keyed_prep(
-            dense=build is not None and build[0] == "dense"
+        dense_join = build is not None and build[0] == "dense"
+        kinds = self._key_kinds_for(key_encoders)
+        use_kinds = (
+            kinds if any(k != "code" for k in kinds) else None
+        )
+        holder, _prep_raw, prep = self._keyed_prep(
+            dense=dense_join, key_kinds=use_kinds
         )
         n_keys = self._n_encoded_groups
         buf: list = []
         chunks: list = []  # flushed (states, key_codes, n_groups) blocks
         buffered = 0
         n_rows_in = 0
+        key_state: dict = {}
+        # single-dispatch fusion: batches accumulate HOST-side and the
+        # whole encode→sort pipeline runs as ONE jitted call at stream
+        # end; past the unroll cap or the HBM budget the accumulated
+        # entries drain through the per-batch streaming prep instead
+        pending: list = []  # (keys_ops, n_live, trivial_idx, args)
+        pending_bytes = 0
+        fuse = True
 
         def flush():
             # HBM budget reached: reduce the buffered block to its
@@ -1652,31 +1884,64 @@ class TpuStageExec(ExecutionPlan):
 
         import jax.numpy as jnp
 
-        def feed(batch, codes):
+        def dispatch_prep(keys_ops, n_live, trivial_idx, args):
             nonlocal buffered
+            n_pad = len(args[0]) if args else len(keys_ops[0][0])
+            with self.metrics.timer("device_time_ns"):
+                # device-built tail mask replaces the host validity ship,
+                # shared with every all-true leaf companion (see the
+                # gid-path device section)
+                tail = jnp.arange(n_pad, dtype=jnp.int32) < n_live
+                args = [
+                    tail if i in trivial_idx else a
+                    for i, a in enumerate(args)
+                ]
+                keys_in = (
+                    keys_ops
+                    if use_kinds is not None
+                    else tuple(k[0] for k in keys_ops)
+                )
+                out = prep(keys_in, tail, *args)
+            buf.append(out)
+            buffered += sum(int(a.nbytes) for a in out)
+            if self.keyed_buffer_bytes and buffered >= self.keyed_buffer_bytes:
+                flush()
+
+        def feed(batch, codes):
+            nonlocal pending_bytes, fuse
             n = batch.num_rows
             n_pad = K.bucket_rows(n)
-            keys = tuple(
-                K._pad(K.coerce_host_values(c), n_pad) for c in codes
+            keys_ops = self._keyed_key_ops(
+                batch, kinds, key_state, key_encoders, codes, n, n_pad
             )
             with self.metrics.timer("bridge_time_ns"):
                 args, trivial_idx = self._kernel_args(
                     batch, n, n_pad, build
                 )
-            with self.metrics.timer("device_time_ns"):
-                # device-built tail mask replaces the host validity ship,
-                # shared with every all-true leaf companion (see the
-                # gid-path device section)
-                tail = jnp.arange(n_pad, dtype=jnp.int32) < n
-                args = [
-                    tail if i in trivial_idx else a
-                    for i, a in enumerate(args)
-                ]
-                out = prep(keys, tail, *args)
-            buf.append(out)
-            buffered += sum(int(a.nbytes) for a in out)
-            if self.keyed_buffer_bytes and buffered >= self.keyed_buffer_bytes:
-                flush()
+            if use_kinds is not None:
+                self.metrics.add("device_encode_batches", 1)
+            if fuse:
+                # budget-account only the HOST arrays buffered per batch:
+                # device-resident join-build tensors ride every entry's
+                # args but are one shared allocation, not per-batch HBM
+                ebytes = sum(
+                    int(a.nbytes)
+                    for a in args
+                    if isinstance(a, np.ndarray)
+                ) + sum(int(o.nbytes) for op in keys_ops for o in op)
+                if len(pending) < _FUSED_MAX_ENTRIES and (
+                    not self.keyed_buffer_bytes
+                    or pending_bytes + ebytes < self.keyed_buffer_bytes
+                ):
+                    pending.append((keys_ops, n, trivial_idx, args))
+                    pending_bytes += ebytes
+                    return
+                # over the unroll cap / budget: drain into streaming mode
+                fuse = False
+                for entry in pending:
+                    dispatch_prep(*entry)
+                pending.clear()
+            dispatch_prep(keys_ops, n, trivial_idx, args)
 
         with self.metrics.timer("tpu_stage_time_ns"):
             for batch, codes in first:
@@ -1686,9 +1951,7 @@ class TpuStageExec(ExecutionPlan):
                 if batch.num_rows == 0:
                     continue
                 n_rows_in += batch.num_rows
-                with self.metrics.timer("key_encode_time_ns"):
-                    codes = self._encode_codes(batch, key_encoders)
-                feed(batch, codes)
+                feed(batch, None)
 
             if chunks:
                 flush()
@@ -1705,9 +1968,24 @@ class TpuStageExec(ExecutionPlan):
                     {"median": [], "corr": []},
                 )
 
-            states, key_codes, n_groups, post = self._keyed_reduce(
-                buf, holder, n_keys
-            )
+            if pending:
+                states, key_codes, n_groups, post = (
+                    self._keyed_reduce_fused(
+                        pending, holder, n_keys, use_kinds, dense_join,
+                        # the radix fold is part of the device-encode
+                        # feature; the knob-off leg stays the plain
+                        # host-encode baseline
+                        combine_bits=(
+                            _radix_combine_bits(key_state, n_keys)
+                            if use_kinds is not None
+                            else None
+                        ),
+                    )
+                )
+            else:
+                states, key_codes, n_groups, post = self._keyed_reduce(
+                    buf, holder, n_keys
+                )
             mask, keys, extras, s2, perm, cap = post
             per_corr = 3 if self._mode == "x32" else 2
             with self.metrics.timer("device_time_ns"):
@@ -1787,6 +2065,180 @@ class TpuStageExec(ExecutionPlan):
             self.specs, host, self._mode, n_keys
         )
         return states, key_codes, n_groups, (mask, keys, extras, s2, perm, cap)
+
+    def _keyed_reduce_fused(
+        self, pending: list, holder: dict, n_keys: int, use_kinds,
+        dense: bool, combine_bits=None,
+    ):
+        """Single-dispatch keyed reduction: every buffered batch's
+        (device key encode →) filter/join prep, the cross-batch
+        concatenate, and the packed-u64 sort run as ONE jitted call —
+        a keyed batch crosses the bridge exactly once, and the whole
+        stream costs two device dispatches (this one, then the
+        capacity-sized finish once ``n_groups`` is known — the one
+        scalar the host must sync on before it can fix the finish
+        kernel's static shapes).  Same return contract as
+        :meth:`_keyed_reduce`.
+        """
+        shapes = tuple(
+            len(e[3][0]) if e[3] else len(e[0][0][0]) for e in pending
+        )
+        key_ops_sig = tuple(len(op) for op in pending[0][0])
+        n_args = len(pending[0][3])
+        trivials = tuple(tuple(sorted(e[2])) for e in pending)
+        fn = self._keyed_fused_sort_for(
+            shapes, key_ops_sig, n_args, trivials, use_kinds, dense,
+            combine_bits,
+        )
+        flat: list = []
+        for keys_ops, n_live, _tidx, args in pending:
+            flat.append(np.int32(n_live))
+            for op in keys_ops:
+                flat.extend(op)
+            flat.extend(args)
+        with self.metrics.timer("device_time_ns"):
+            outs = fn(*flat)
+            self.metrics.add("fused_keyed_dispatches", 1)
+            n_sort = 2 + n_keys + 1  # s2, perm, sorted keys, n_groups
+            fields = outs[:-n_sort]
+            s2, perm = outs[-n_sort], outs[-n_sort + 1]
+            sk = outs[-n_sort + 2:-1]
+            # the scalar fetch is the one host sync before capacity is
+            # known (~one tunnel roundtrip)
+            n_groups = int(np.asarray(outs[-1]))
+        if n_groups > self.max_capacity:
+            raise _CapacityExceeded()
+        per_corr = 3 if self._mode == "x32" else 2
+        n_extras = 3 * len(self._median_cols) + per_corr * len(
+            self._corr_cols
+        )
+        mask = fields[0]
+        keys = fields[1:1 + n_keys]
+        flat_end = len(fields) - n_extras
+        flat_cols = fields[1 + n_keys:flat_end]
+        extras = fields[flat_end:]
+        cap = max(64, 1 << (max(n_groups, 1) - 1).bit_length())
+        finish = K.keyed_finish_kernel(
+            holder["kinds"], holder["plan"], self.specs, n_keys, cap,
+            self._mode,
+        )
+        with self.metrics.timer("device_time_ns"):
+            packed = finish(s2, perm, tuple(sk), tuple(flat_cols))
+            host = np.asarray(packed)
+        states, key_codes = K.unpack_keyed_host(
+            self.specs, host, self._mode, n_keys
+        )
+        return states, key_codes, n_groups, (mask, keys, extras, s2, perm, cap)
+
+    def _keyed_fused_sort_for(
+        self, shapes: tuple, key_ops_sig: tuple, n_args: int,
+        trivials: tuple, use_kinds, dense: bool, combine_bits=None,
+    ):
+        """Jitted (prep×entries → concat → sort) runner, cached on the
+        stage signature + per-entry row buckets and trivial-validity
+        layouts (both pow2/stable per stage in practice, so distinct
+        traces stay bounded like the join-free fused runner's).
+
+        ``combine_bits`` (per-key radix widths, exact because the fused
+        runner sees the WHOLE stream's code maxima before tracing)
+        folds every key's code into ONE non-negative i32 sort word —
+        multi-key plans then ride the u64x1 packed sort instead of
+        pairwise words, and the sorted per-key codes unpack back out by
+        shifts, so the finish kernel and decode see exactly the codes
+        they always did."""
+        key = (
+            self._sig
+            + ("keyedfused", shapes, key_ops_sig, n_args, trivials,
+               use_kinds, combine_bits)
+            + (("dense",) if dense else ())
+            + K.algo_cache_token()
+        )
+        cached = _KERNEL_CACHE.get(key)
+        self._note_kernel_cache(cached is not None)
+        if cached is None:
+            import jax
+            import jax.numpy as jnp
+
+            _holder, prep_raw, _ = self._keyed_prep(
+                dense=dense, key_kinds=use_kinds
+            )
+            n_keys = self._n_encoded_groups
+            sort_body = K.keyed_sort_body(
+                1 if combine_bits is not None else n_keys
+            )
+            n_key_flat = sum(key_ops_sig)
+            stride = 1 + n_key_flat + n_args
+            n_entries = len(shapes)
+            total = sum(shapes)
+            n2 = K.bucket_rows(total)
+
+            def fn(*flat):
+                prep_outs = []
+                for e in range(n_entries):
+                    base = e * stride
+                    n_live = flat[base]
+                    keys_ops = []
+                    o = base + 1
+                    for cnt in key_ops_sig:
+                        keys_ops.append(tuple(flat[o:o + cnt]))
+                        o += cnt
+                    args = list(flat[o:base + stride])
+                    tail = (
+                        jnp.arange(shapes[e], dtype=jnp.int32) < n_live
+                    )
+                    args = [
+                        tail if i in trivials[e] else a
+                        for i, a in enumerate(args)
+                    ]
+                    keys_in = (
+                        tuple(keys_ops)
+                        if use_kinds is not None
+                        else tuple(k[0] for k in keys_ops)
+                    )
+                    prep_outs.append(prep_raw(keys_in, tail, *args))
+                parts = list(zip(*prep_outs))
+                fields = [
+                    p[0] if len(p) == 1 else jnp.concatenate(p)
+                    for p in parts
+                ]
+                if n2 != total:
+                    # pad rows carry mask=False and sink past every
+                    # boundary in the sort — values never read
+                    fields = [
+                        jnp.pad(f, (0, n2 - total)) for f in fields
+                    ]
+                mask = fields[0]
+                keys_c = fields[1:1 + n_keys]
+                if combine_bits is None:
+                    sout = sort_body(mask, *keys_c)
+                    return tuple(fields) + tuple(sout)
+                # radix-combine: one i32 word carries every key's
+                # MIN-REBASED code (spans are exact stream-wide ranges,
+                # so the fold is injective and stays non-negative)
+                m0, _w0 = combine_bits[0]
+                comb = keys_c[0].astype(jnp.int32) - jnp.int32(m0)
+                for (mk, bk), kk in zip(combine_bits[1:], keys_c[1:]):
+                    comb = (comb << bk) | (
+                        kk.astype(jnp.int32) - jnp.int32(mk)
+                    )
+                sout = sort_body(mask, comb)
+                s2, perm, skc, n_groups = sout
+                sks = []
+                rem = skc
+                for mk, bk in reversed(combine_bits[1:]):
+                    sks.append(
+                        (rem & jnp.int32((1 << bk) - 1)) + jnp.int32(mk)
+                    )
+                    rem = rem >> bk
+                sks.append(rem + jnp.int32(combine_bits[0][0]))
+                sks.reverse()
+                return (
+                    tuple(fields) + (s2, perm) + tuple(sks) + (n_groups,)
+                )
+
+            cached = jax.jit(fn)
+            _KERNEL_CACHE[key] = cached
+        return self._timed_jit(cached)
 
     # ------------------------------------------------------- device join
     def _nojoin_stage(self) -> "TpuStageExec":
@@ -2316,6 +2768,37 @@ class TpuStageExec(ExecutionPlan):
                     ),
                 )
         yield out
+
+
+def _radix_combine_bits(key_state: dict, n_keys: int) -> Optional[tuple]:
+    """Per-key ``(min_code, width)`` plan when every key's MIN-REBASED
+    codes fold into one non-negative i32 sort word (None otherwise).
+    Ranges are the EXACT stream-wide code spans ``_keyed_key_ops``
+    tracked — the fused runner traces after the whole stream buffered,
+    so unlike the host ``GroupTable``'s growing radixes there is no
+    mid-stream regrow or overflow: the plan is right by construction.
+    Rebasing matters: q3's orderdate key spans ~121 distinct days but
+    its identity codes sit near 9000 — 7 bits after rebase vs 14 raw."""
+    if n_keys < 2:
+        return None
+    plan = []
+    total = 0
+    for slot in range(n_keys):
+        m = key_state.get(("max", slot), None)
+        if m is None:
+            return None  # float bit-pattern codes are signed: no fold
+        if int(m) > (1 << 31) - 2:
+            # the fold runs in i32: a key whose CODES exceed i32 (wide
+            # int64 values with a narrow span still ship as i64 arrays)
+            # must not reach the jnp.int32 casts — rebasing would wrap
+            return None
+        lo = key_state.get(("min", slot), 0) or 0
+        width = max(1, int(m - lo).bit_length())
+        plan.append((int(lo), width))
+        total += width
+    if total > 31:
+        return None
+    return tuple(plan)
 
 
 def _eval_arr(e: pe.PhysicalExpr, batch: pa.RecordBatch) -> pa.Array:

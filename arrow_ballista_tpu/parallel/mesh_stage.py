@@ -293,7 +293,7 @@ class MeshGangExec(ExecutionPlan):
         from . import mesh as M
 
         fused = tpu.fused
-        holder, prep = tpu._keyed_prep()
+        holder, _raw, prep = tpu._keyed_prep()
         key_encoders = [
             make_key_encoder(tpu._schema.field(pos).type)
             for pos, (kind, _s) in enumerate(tpu._group_plan)

@@ -100,6 +100,19 @@ async function showDetail(jobId) {
         (s.aqe.broadcast ? ' (broadcast)' : '') +
         (s.aqe.skew_splits ? ` (${s.aqe.skew_splits} skew splits)` : '')
       : '';
+    // keyed device-path badge: group keys encoded on device inside the
+    // fused encode→sort→segment-reduce dispatch (next to the
+    // key_encode_time_ns it eliminates in the generic metrics)
+    const tm = (s.metrics && Object.entries(s.metrics)
+      .filter(([op]) => op.startsWith('TpuStage'))
+      .reduce((acc, [, m]) => {
+        for (const [k, v] of Object.entries(m)) acc[k] = (acc[k] || 0) + v;
+        return acc;
+      }, {})) || {};
+    const keyed = (tm.device_encode_batches || tm.fused_keyed_dispatches)
+      ? `device-encode ${tm.device_encode_batches || 0} batch(es) · ` +
+        `${tm.fused_keyed_dispatches || 0} fused keyed dispatch(es)`
+      : '';
     const opMets = s.metrics
       ? esc(Object.entries(s.metrics)
           // __-prefixed operators are the skew-analytics payloads
@@ -109,7 +122,7 @@ async function showDetail(jobId) {
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
         ).join(' · '))
       : '';
-    const mets = [aqe, opMets].filter(Boolean).join(' · ') || '—';
+    const mets = [aqe, keyed, opMets].filter(Boolean).join(' · ') || '—';
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
             `<td>${done}</td>` +
             `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +

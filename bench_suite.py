@@ -23,7 +23,14 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   aggregate with ballista.aqe.enabled true vs false on identical
   inputs, reporting before/after reduce-task counts
 
-Usage: python bench_suite.py [q6|q3|starjoin|full22|window|h2o|shuffle|aqe|all]
+  plus the keyed device-path A/B (keyed_path_rows_per_sec /
+  keyed_starjoin_rows_per_sec): device-encoded fused
+  encode→sort→segment-reduce vs the host-encode keyed baseline
+  (ballista.tpu.device_encode knob) and the gid-table GroupTable route,
+  on identical inputs with a sha row-fingerprint identity check
+
+Usage: python bench_suite.py
+[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|all]
 (default all)
 """
 
@@ -630,6 +637,32 @@ def bench_aqe() -> None:
     _emit(run_aqe_tiny_agg(partitions=64))
 
 
+def bench_keyed() -> None:
+    """Keyed device-path A/B (ISSUE 9): q3-shaped keyed aggregate and
+    starjoin, fused device-encode vs the host-encode keyed baseline
+    (``ballista.tpu.device_encode``) vs the gid-table GroupTable route,
+    bit-identical results enforced per record."""
+    from benchmarks.keyed_path import (
+        run_keyed_agg_bench,
+        run_keyed_starjoin_bench,
+    )
+
+    _emit(
+        run_keyed_agg_bench(
+            n_rows=int(float(os.environ.get("BENCH_KEYED_ROWS", "2e6"))),
+            n_groups=int(
+                float(os.environ.get("BENCH_KEYED_GROUPS", "1e6"))
+            ),
+        )
+    )
+    _emit(
+        run_keyed_starjoin_bench(
+            n_fact=int(float(os.environ.get("BENCH_KEYED_FACT", "2e6"))),
+            n_dim=int(float(os.environ.get("BENCH_KEYED_DIM", "2e5"))),
+        )
+    )
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
@@ -657,6 +690,8 @@ def main() -> None:
         bench_shuffle_write()
     if which in ("aqe", "all"):
         bench_aqe()
+    if which in ("keyed", "all"):
+        bench_keyed()
 
 
 if __name__ == "__main__":

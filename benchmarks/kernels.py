@@ -6,13 +6,20 @@ Counterpart of the reference's criterion→conbench micro-bench bridge
 kernels via cargo-criterion, this grids the TPU segment-reduction
 strategies directly — strategy × capacity × rows — plus the host-side
 group-encode paths they compete against, emitting one JSON line per
-cell.  This is the tuning tool for the high-cardinality router
-(``stage_compiler._HIGHCARD_*``) and the segment-algorithm choice
-(``kernels.segment_algo``).
+cell.  This is the tuning tool for the ROUTING TABLE
+(``dev/analyze_grid.py --emit`` → ``ops/routing_table.json``: the
+high-cardinality detector, ``keyed_route_auto``, and the
+segment-algorithm bounds ``kernels.segment_algo`` reads).
+
+``keyed_fused`` is the ISSUE-9 production shape — prep (with in-kernel
+key encode) and the packed-u64 sort in ONE jitted dispatch — and is
+what ``keyed_route_auto`` evidence should come from on a chip capture;
+``keyed`` keeps the pre-fusion 3-dispatch form for comparison.
 
 Usage:
     python benchmarks/kernels.py [--rows 1e6,8e6] [--caps 1024,65536,1048576]
-        [--algos matmul,scatter,sort,keyed] [--iters 3] [--out FILE]
+        [--algos matmul,scatter,sort,keyed,keyed_fused] [--iters 3]
+        [--out FILE]
 
 Timing protocol: the packed device→host fetch is the only reliable sync
 on the tunnel-attached TPU, so every timed run ends in one — times
@@ -81,6 +88,40 @@ def bench_segment_reduce(rows: int, capacity: int, algo: str, iters: int):
                 holder["kinds"], holder["plan"], specs, 1, cap2, mode
             )
             packed = finish(s2, perm, tuple(sk), tuple(flat))
+            return np.asarray(packed)
+
+    elif algo == "keyed_fused":
+        # ISSUE-9 production shape: device key encode + prep + packed
+        # sort in ONE dispatch, then the capacity-sized finish — the
+        # two-dispatch pipeline stage_compiler._keyed_reduce_fused runs
+        holder: dict = {}
+        prep_raw = K.make_keyed_prep_kernel(
+            None, closures, specs, flat_names, holder,
+            key_kinds=("ident",),
+        )
+        sort_body = K.keyed_sort_body(1)
+
+        def fused(keys, valid_a, *args):
+            pre = prep_raw(keys, valid_a, *args)
+            return pre + sort_body(pre[0], pre[1])
+
+        ffn = jax.jit(fused)
+        # raw key values; identity codes (value+1) are the segment
+        # ids shifted by one — same cardinality, same sort shape
+        keys_d = jax.device_put(seg)
+        valid_d = jax.device_put(valid)
+        v_d = jax.device_put(v)
+
+        def run():
+            outs = ffn(((keys_d, valid_d),), valid_d, v_d, valid_d)
+            flat = outs[2:-4]
+            s2, perm, sk = outs[-4], outs[-3], (outs[-2],)
+            n_groups = int(np.asarray(outs[-1]))
+            cap2 = max(64, 1 << (max(n_groups, 1) - 1).bit_length())
+            finish = K.keyed_finish_kernel(
+                holder["kinds"], holder["plan"], specs, 1, cap2, mode
+            )
+            packed = finish(s2, perm, sk, tuple(flat))
             return np.asarray(packed)
 
     else:
@@ -216,7 +257,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", default="1e6,8e6")
     ap.add_argument("--caps", default="1024,65536,1048576")
-    ap.add_argument("--algos", default="matmul,scatter,sort,keyed")
+    ap.add_argument(
+        "--algos", default="matmul,scatter,sort,keyed,keyed_fused"
+    )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--out", default=None)
     ap.add_argument(
@@ -247,8 +290,8 @@ def main() -> None:
             for algo in algos:
                 if (
                     algo == "matmul"
-                    and (cap > K._MATMUL_MAX_CAP
-                         or rows * cap > K._MATMUL_MAX_ELEMS)
+                    and (cap > K._matmul_max_cap()
+                         or rows * cap > K._matmul_max_elems())
                 ):
                     continue  # outside the strategy's own applicability
                 try:

@@ -5,21 +5,33 @@ artifact, not a guess.  This reads one or more KERNELBENCH_*.json files
 and prints, per platform found in the rows:
 
 * the matmul->sort capacity crossover per row count (tunes
-  kernels._MATMUL_MAX_CAP / _MATMUL_MAX_ELEMS);
+  routing ``matmul_max_cap`` / ``matmul_max_elems``);
 * the scatter/sort/keyed winner per (rows, capacity) cell (tunes
   segment_algo and the highcard route);
 * sort cost vs operand count + the packed-u64 ratio (validates the
   packed-sort rework);
 * dispatch/fetch latency floors (the q6 economics).
 
+``--emit <path>`` additionally writes the recommendations as the
+machine-readable routing table ``arrow_ballista_tpu/ops/routing.py``
+loads at import (schema ``ballista.routing/v1``; the emit schema is
+pinned by tests/test_routing_table.py).  Fields the grid has no
+evidence for keep the builtin defaults, with the per-field basis
+recorded under ``evidence`` so the artifact documents exactly what was
+measured vs inherited.
+
 Usage: python dev/analyze_grid.py KERNELBENCH_r05.json [more.json ...]
+           [--emit arrow_ballista_tpu/ops/routing_table.json]
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load(paths):
@@ -33,9 +45,109 @@ def load(paths):
     return rows
 
 
+def emit_routing_table(rows, inputs) -> dict:
+    """Routing-table document (ballista.routing/v1) from grid rows.
+
+    Per platform present in the rows:
+
+    * ``matmul_max_cap`` / ``matmul_max_elems`` — largest capacity (and
+      rows x capacity product) where the matmul segment reduction beat
+      BOTH sort and scatter; default when the grid never shows matmul
+      winning (the cpu platform) since ``segment_algo`` never picks
+      matmul there anyway.
+    * ``keyed_route_auto`` — True only when the keyed reduction (the
+      fused ``keyed_fused`` cell when the grid has it, else the
+      pre-fusion ``keyed`` cell) beats every alternative at the
+      high-cardinality cells (capacity >= highcard_min_groups); this is
+      what lets ``auto`` route groups~rows plans to the fused keyed
+      path on platforms where the measurement supports it.
+    * detector bounds (``highcard_min_groups`` / ``highcard_ratio``)
+      keep the builtin defaults — no grid bench measures the detector
+      itself yet.
+    """
+    from arrow_ballista_tpu.ops import routing
+
+    by_platform = defaultdict(list)
+    for r in rows:
+        by_platform[r.get("device_platform", "?")].append(r)
+
+    platforms = {}
+    for platform, rs in sorted(by_platform.items()):
+        vals = dict(routing._DEFAULTS)
+        evidence = {
+            k: "builtin default (no grid evidence)" for k in vals
+        }
+        cells = defaultdict(dict)
+        for r in rs:
+            if r.get("bench") == "segment_reduce" and "rows_per_sec" in r:
+                cells[(r["rows"], r["capacity"])][r["algo"]] = r[
+                    "rows_per_sec"
+                ]
+        mm_cap = mm_elems = None
+        for (n, cap), algos in sorted(cells.items()):
+            others = [v for a, v in algos.items() if a != "matmul"]
+            if "matmul" in algos and others and algos["matmul"] > max(
+                others
+            ):
+                mm_cap = max(mm_cap or 0, cap)
+                mm_elems = max(mm_elems or 0, n * cap)
+        if mm_cap is not None:
+            vals["matmul_max_cap"] = mm_cap
+            vals["matmul_max_elems"] = mm_elems
+            evidence["matmul_max_cap"] = evidence["matmul_max_elems"] = (
+                "largest segment_reduce cell where matmul beat "
+                "sort+scatter"
+            )
+        else:
+            evidence["matmul_max_cap"] = evidence["matmul_max_elems"] = (
+                "builtin default: matmul won no measured cell on this "
+                "platform"
+            )
+        highcard = [
+            (k, algos)
+            for k, algos in cells.items()
+            if k[1] >= vals["highcard_min_groups"] and len(algos) > 1
+        ]
+        if highcard:
+
+            def keyed_best(algos: dict) -> bool:
+                # the fused cell is the production shape; the pre-fusion
+                # 'keyed' cell stands in on grids captured before it
+                kv = algos.get("keyed_fused", algos.get("keyed"))
+                return kv is not None and kv == max(algos.values())
+
+            keyed_wins = all(keyed_best(algos) for _k, algos in highcard)
+            vals["keyed_route_auto"] = bool(keyed_wins)
+            evidence["keyed_route_auto"] = (
+                "keyed(_fused) %s every alternative at the %d "
+                "high-cardinality segment_reduce cell(s)"
+                % ("beat" if keyed_wins else "lost to", len(highcard))
+            )
+        platforms[platform] = {**vals, "evidence": evidence}
+
+    return {
+        "schema": routing.SCHEMA,
+        "generated_by": "dev/analyze_grid.py --emit",
+        "inputs": [os.path.basename(p) for p in inputs],
+        "platforms": platforms,
+    }
+
+
 def main() -> None:
-    paths = sys.argv[1:] or ["KERNELBENCH_r05.json"]
+    args = sys.argv[1:]
+    emit_path = None
+    if "--emit" in args:
+        i = args.index("--emit")
+        emit_path = args[i + 1]
+        args = args[:i] + args[i + 2:]
+    paths = args or ["KERNELBENCH_r05.json"]
     rows = load(paths)
+    if emit_path:
+        doc = emit_routing_table(rows, paths)
+        with open(emit_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote routing table -> {emit_path}")
     by_platform = defaultdict(list)
     for r in rows:
         by_platform[r.get("device_platform", "?")].append(r)

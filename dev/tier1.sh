@@ -59,6 +59,16 @@ print(json.dumps({"bench_smoke": "aqe", **run_aqe_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.keyed_path import run_keyed_smoke
+
+# keyed device-path A/B on tiny inputs: all legs bit-identical, the
+# fused leg device-encodes with zero host group encode
+print(json.dumps({"bench_smoke": "keyed_path", **run_keyed_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi

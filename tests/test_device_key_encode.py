@@ -1,0 +1,442 @@
+"""Host/device group-key encode parity (ISSUE 9).
+
+The fused keyed path derives group codes ON DEVICE
+(``kernels.device_encode_key``) from the raw key columns; correctness
+rests on those codes being BIT-identical to the host encoders
+(``bridge.IdentityKeyEncoder`` / ``BoolKeyEncoder`` / ``FloatKeyEncoder``
+/ the dictionary handoff), nulls included — the same host/device
+bit-identity contract the PR-4 partition-id kernel established.
+
+Randomized property tests per supported key dtype, plus the overflow
+cases that must DIVERT to the host route (negative identity keys,
+past-i32 keys in x32 mode) with exact results.  No ORDER BY anywhere
+(pyarrow sort is broken in this container) — comparisons go through
+python-level row sorts.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.errors import ExecutionError
+from arrow_ballista_tpu.ops import kernels as K
+from arrow_ballista_tpu.ops import stage_compiler as SC
+from arrow_ballista_tpu.ops.bridge import (
+    BoolKeyEncoder,
+    DictEncoder,
+    FloatKeyEncoder,
+    IdentityKeyEncoder,
+    device_key_encoder,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_precision():
+    yield
+    K.set_precision(None)
+
+
+def _device_codes(kind: str, vals: np.ndarray, valid: np.ndarray):
+    fn = K.make_key_encode_kernel((kind,))
+    (codes,) = fn(((vals, valid),))
+    return np.asarray(codes).astype(np.int64)
+
+
+def _arrow(vals, valid, t):
+    return pa.array(vals, t, mask=~valid)
+
+
+# ------------------------------------------------------------- identity
+@pytest.mark.parametrize("dtype", [np.int64, np.int32, np.int16, np.uint32])
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_ident_parity_random(dtype, seed):
+    rng = np.random.default_rng(seed)
+    n = 4096
+    hi = min(np.iinfo(dtype).max, (1 << 31) - 2)
+    vals = rng.integers(0, hi, n, endpoint=True).astype(dtype)
+    valid = rng.uniform(size=n) > 0.1
+    host = IdentityKeyEncoder().encode(_arrow(vals, valid, None or {
+        np.int64: pa.int64(), np.int32: pa.int32(),
+        np.int16: pa.int16(), np.uint32: pa.uint32(),
+    }[dtype]))
+    # device ships i32 when the range allows (the packed-sort narrowing)
+    ship = vals.astype(np.int32) if hi <= (1 << 31) - 2 else vals
+    dev = _device_codes("ident", ship, valid)
+    assert np.array_equal(host.astype(np.int64), dev)
+
+
+def test_ident_parity_date32():
+    rng = np.random.default_rng(5)
+    n = 2048
+    days = rng.integers(0, 30000, n).astype(np.int32)
+    valid = rng.uniform(size=n) > 0.2
+    arr = pa.array(days.astype("datetime64[D]"), pa.date32(), mask=~valid)
+    host = IdentityKeyEncoder().encode(arr)
+    dev = _device_codes("ident", days, valid)
+    assert np.array_equal(host.astype(np.int64), dev)
+
+
+def test_ident_parity_i64_wide_keys():
+    """Keys past i32 stay encodable in x64 mode: the device adds 1 in
+    int64 exactly like the host."""
+    rng = np.random.default_rng(11)
+    n = 1024
+    vals = (rng.integers(0, 1 << 40, n)).astype(np.int64)
+    valid = rng.uniform(size=n) > 0.1
+    host = IdentityKeyEncoder().encode(_arrow(vals, valid, pa.int64()))
+    dev = _device_codes("ident", vals, valid)
+    assert np.array_equal(host.astype(np.int64), dev)
+
+
+def test_ident_negative_keys_raise_like_host():
+    """Negative identity keys have NO device encoding; the host encoder
+    raises and the fast-path precheck must refuse the route."""
+    vals = np.array([3, -1, 7], np.int64)
+    with pytest.raises(ExecutionError):
+        IdentityKeyEncoder().encode(pa.array(vals, pa.int64()))
+
+
+# ----------------------------------------------------------------- bool
+@pytest.mark.parametrize("seed", [1, 2])
+def test_bool_parity_random(seed):
+    rng = np.random.default_rng(seed)
+    n = 4096
+    vals = rng.uniform(size=n) > 0.5
+    valid = rng.uniform(size=n) > 0.15
+    host = BoolKeyEncoder().encode(_arrow(vals, valid, pa.bool_()))
+    dev = _device_codes("bool", vals, valid)
+    assert np.array_equal(host.astype(np.int64), dev)
+    # codes are GroupTable-safe and decode back to bool
+    assert host.min() >= 0
+    dec = BoolKeyEncoder().decode(np.array([0, 1, 2]), pa.bool_())
+    assert dec.to_pylist() == [None, False, True]
+
+
+# ---------------------------------------------------------------- float
+def _float_fixture(rng, n, f64: bool):
+    dt = np.float64 if f64 else np.float32
+    idt = np.int64 if f64 else np.int32
+    vals = rng.uniform(-1e6, 1e6, n).astype(dt)
+    # the satellite cases: -0.0, +0.0, NaN payload variants, infinities
+    vals[: n // 8] = dt(-0.0)
+    vals[n // 8: n // 4] = dt(0.0)
+    vals[n // 4: n // 3] = np.nan
+    # a NEGATIVE NaN payload (sign bit set) — its own group, like the
+    # CPU hash aggregate's dictionary_encode treats it
+    neg_nan = np.array([np.nan], dt)
+    neg_nan.view(idt)[0] |= idt(1) << idt(63 if f64 else 31)
+    vals[n // 3: n // 2] = neg_nan[0]
+    vals[n // 2: n // 2 + 4] = [np.inf, -np.inf, 1.5, -1.5]
+    valid = rng.uniform(size=n) > 0.1
+    return vals, valid
+
+
+@pytest.mark.parametrize("f64", [False, True])
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_float_parity_random(f64, seed):
+    rng = np.random.default_rng(seed)
+    n = 4096
+    vals, valid = _float_fixture(rng, n, f64)
+    kind = "f64" if f64 else "f32"
+    idt = np.int64 if f64 else np.int32
+    enc = FloatKeyEncoder(kind)
+    t = pa.float64() if f64 else pa.float32()
+    host = enc.encode(_arrow(vals, valid, t))
+    dev = _device_codes(kind, vals, valid)
+    assert np.array_equal(host, dev)
+    # codes ARE the bit patterns: -0.0 distinct from +0.0 and each NaN
+    # payload its own group — exactly the CPU hash aggregate's grouping
+    assert np.array_equal(
+        host[valid], vals.view(idt)[valid].astype(np.int64)
+    )
+    # null code is reserved: nulls map to it, nothing else does
+    null_code = K.FLOAT64_NULL_BITS if f64 else K.FLOAT32_NULL_BITS
+    assert not np.any(host[valid] == null_code)
+    assert np.all(host[~valid] == null_code)
+    # decode round-trips bitwise (NaN payloads and -0.0 included)
+    dt = np.float64 if f64 else np.float32
+    dec = enc.decode(host, t)
+    back = np.asarray(dec.cast(t).fill_null(12345.0)).view(idt)
+    want = np.where(
+        valid, vals.view(idt), np.array([12345.0], dt).view(idt)[0]
+    )
+    assert np.array_equal(back, want)
+
+
+def test_float_reserved_null_pattern_collision_raises():
+    """Data containing the ONE reserved NaN payload cannot device-encode
+    — the host encoder raises (→ host-route fallback), it must never
+    silently alias a value with NULL."""
+    bad = np.array([np.int64(K.FLOAT64_NULL_BITS)]).view(np.float64)
+    arr = pa.array([1.0, bad[0], None], pa.float64())
+    with pytest.raises(ExecutionError):
+        FloatKeyEncoder("f64").encode(arr)
+
+
+# ----------------------------------------------------- dictionary handoff
+def test_dictionary_keys_keep_host_handoff():
+    """Strings have no device encoding: device_key_encoder hands back
+    the dictionary encoder with kind None, and the "code" kernel slot
+    passes host codes through untouched."""
+    enc, kind = device_key_encoder(pa.string(), "x64")
+    assert kind is None and isinstance(enc, DictEncoder)
+    codes = enc.encode(pa.array(["a", "b", "a", None]))
+    fn = K.make_key_encode_kernel(("code",))
+    (out,) = fn(((codes,),))
+    assert np.array_equal(np.asarray(out), codes)
+
+
+def test_device_key_encoder_selection():
+    assert device_key_encoder(pa.int64(), "x64")[1] == "ident"
+    assert device_key_encoder(pa.date32(), "x32")[1] == "ident"
+    assert device_key_encoder(pa.bool_(), "x64")[1] == "bool"
+    assert device_key_encoder(pa.float32(), "x32")[1] == "f32"
+    assert device_key_encoder(pa.float64(), "x64")[1] == "f64"
+    # f64 bit patterns cannot ship in x32 — host dictionary handoff
+    enc, kind = device_key_encoder(pa.float64(), "x32")
+    assert kind is None and isinstance(enc, DictEncoder)
+
+
+# ------------------------------------------------------------------ obs
+def test_profile_surfaces_keyed_device_metrics():
+    """device_encode_batches / fused_keyed_dispatches thread into the
+    per-stage /api/jobs/{id}/profile rollup next to key_encode_ms."""
+    from arrow_ballista_tpu.obs.export import job_profile
+
+    detail = {
+        "job_id": "j", "state": "Completed",
+        "stages": [
+            {"stage_id": 1, "state": "Completed", "partitions": 1,
+             "output_links": [],
+             "metrics": {"TpuStageExec": {
+                 "key_encode_time_ns": 2_000_000,
+                 "device_encode_batches": 3,
+                 "fused_keyed_dispatches": 1,
+             }}},
+        ],
+    }
+    row = job_profile(detail, [])["stages"][0]
+    assert row["tpu"]["device_encode_batches"] == 3
+    assert row["tpu"]["fused_keyed_dispatches"] == 1
+    assert row["tpu"]["key_encode_ms"] == 2.0
+
+
+# ------------------------------------------------------------ end-to-end
+def _ctx(tpu: bool, **extra) -> SessionContext:
+    settings = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.tpu.min_rows": "0",
+        "ballista.mesh.enable": "false",
+        "ballista.tpu.highcard_mode": "device",
+    }
+    settings.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(settings))
+
+
+def _metrics(plan) -> dict:
+    agg: dict = {}
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, SC.TpuStageExec):
+            for k, v in n.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(n.children())
+    return agg
+
+
+def _rows(tbl: pa.Table):
+    def norm(x):
+        # None sorts in its own band so null keys never compare against
+        # values (pyarrow sort is broken in this container; python-level
+        # row sort instead)
+        if x is None:
+            return (0, 0)
+        return (1, round(x, 6) if isinstance(x, float) else x)
+
+    return sorted(
+        (tuple(norm(x) for x in r)
+         for r in zip(*[c.to_pylist() for c in tbl.columns])),
+    )
+
+
+def _oracle_vs_device(sql, tables, mode, **extra):
+    K.set_precision(None)
+    cpu = _ctx(False)
+    for name, t in tables.items():
+        cpu.register_table(name, MemoryTable.from_table(t, 1))
+    want = cpu.sql(sql).collect()
+
+    K.set_precision(mode)
+    dev = _ctx(True, **extra)
+    for name, t in tables.items():
+        dev.register_table(name, MemoryTable.from_table(t, 1))
+    plan = dev.sql(sql).physical_plan()
+    got = dev.execute(plan)
+    return want, got, _metrics(plan)
+
+
+@pytest.mark.parametrize("mode", ["x32", "x64"])
+def test_e2e_float_and_bool_keys_device_encoded(mode, monkeypatch):
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 16)
+    rng = np.random.default_rng(3)
+    n = 4000
+    f = rng.integers(0, 400, n).astype(np.float64) / 4.0
+    f[: n // 16] = -0.0  # must group WITH +0.0
+    fmask = rng.uniform(size=n) < 0.05
+    t = pa.table(
+        {
+            "fk": pa.array(
+                f.astype(np.float32), pa.float32(), mask=fmask
+            ),
+            "b": pa.array(rng.uniform(size=n) > 0.5, pa.bool_()),
+            "v": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    want, got, m = _oracle_vs_device(
+        "select fk, b, sum(v) as s, count(*) as c from t group by fk, b",
+        {"t": t},
+        mode,
+    )
+    assert m.get("device_encode_batches", 0) >= 1, m
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("key_encode_time_ns", 0) == 0, m
+
+    def canon(tbl):
+        # float keys order by BIT pattern so -0.0 and +0.0 stay
+        # distinct rows (nulls surface as NaN bits — no NaN in data)
+        fk = tbl.column("fk").to_numpy(zero_copy_only=False)
+        fkb = fk.astype(np.float64).view(np.int64)
+        b = tbl.column("b").to_numpy(zero_copy_only=False).astype(bool)
+        s = tbl.column("s").to_numpy(zero_copy_only=False)
+        c = tbl.column("c").to_numpy(zero_copy_only=False)
+        order = np.lexsort((b, fkb))
+        return fkb[order], b[order], s[order], c[order]
+
+    wfk, wb, ws, wc = canon(want)
+    gfk, gb, gs, gc = canon(got)
+    assert np.array_equal(wfk, gfk)
+    assert np.array_equal(wb, gb)
+    assert np.array_equal(wc, gc)
+    rel = 1e-5 if mode == "x32" else 1e-9
+    assert np.allclose(ws, gs, rtol=rel, atol=0)
+
+
+def test_e2e_negative_int_keys_fall_back_exact(monkeypatch):
+    """The overflow case: negative identity keys prove the host-fallback
+    route still fires — the stage lands on the CPU operator path with
+    exact results and never claims the keyed route."""
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 16)
+    rng = np.random.default_rng(9)
+    n = 3000
+    t = pa.table(
+        {
+            "k": pa.array(
+                (rng.integers(0, 500, n) - 250).astype(np.int64)
+            ),
+            "v": pa.array(rng.uniform(0, 10, n)),
+        }
+    )
+    want, got, m = _oracle_vs_device(
+        "select k, sum(v) as s, count(*) as c from t group by k",
+        {"t": t},
+        "x64",
+    )
+    assert "keyed_path" not in m, m
+    assert m.get("tpu_fallback", 0) >= 1, m
+    assert _rows(want) == _rows(got)
+
+
+def test_e2e_x32_key_overflow_falls_back_exact(monkeypatch):
+    """Past-i32 keys in x32 mode: the fast-path precheck refuses, the
+    legacy routing diverts to the hash aggregate, results exact."""
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 16)
+    rng = np.random.default_rng(13)
+    n = 2000
+    t = pa.table(
+        {
+            "k": pa.array(
+                (rng.integers(0, 400, n) + (1 << 40)).astype(np.int64)
+            ),
+            "v": pa.array(np.ones(n)),
+        }
+    )
+    want, got, m = _oracle_vs_device(
+        "select k, sum(v) as s from t group by k",
+        {"t": t},
+        "x32",
+    )
+    assert "keyed_path" not in m, m
+    assert "device_encode_batches" not in m, m
+    assert _rows(want) == _rows(got)
+
+
+def test_radix_fold_declines_past_i32_codes():
+    """Regression: a wide int64 key with a NARROW span (width fits 31
+    bits, values do not fit i32) must not reach the fold's i32 casts —
+    rebasing there would overflow/wrap."""
+    from arrow_ballista_tpu.ops.stage_compiler import _radix_combine_bits
+
+    ks = {
+        ("max", 0): (1 << 40) + 100, ("min", 0): 1 << 40,  # narrow span
+        ("max", 1): 7, ("min", 1): 1,
+    }
+    assert _radix_combine_bits(ks, 2) is None
+    ks[("max", 0)], ks[("min", 0)] = 1000, 1
+    assert _radix_combine_bits(ks, 2) is not None
+
+
+def test_e2e_wide_i64_multikey_stays_exact(monkeypatch):
+    """Regression for the fold guard end-to-end: two device-encoded keys
+    where one carries values past i32 with a narrow span — the keyed
+    route must answer exactly (fold declined, i64 sort), not crash or
+    corrupt group keys."""
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 16)
+    rng = np.random.default_rng(21)
+    n = 4000
+    t = pa.table(
+        {
+            "k": pa.array(
+                ((1 << 40) + rng.integers(0, 100, n)).astype(np.int64)
+            ),
+            "p": pa.array(rng.integers(0, 5, n).astype(np.int64)),
+            "v": pa.array(np.ones(n)),
+        }
+    )
+    want, got, m = _oracle_vs_device(
+        "select k, p, count(*) as c, sum(v) as s from t group by k, p",
+        {"t": t},
+        "x64",
+    )
+    assert m.get("keyed_path", 0) >= 1, m
+    assert m.get("tpu_fallback", 0) == 0, m
+    assert _rows(want) == _rows(got)
+
+
+def test_e2e_late_key_growth_past_i32_falls_back_exact(monkeypatch):
+    """Late key overflow: batch 1 fits the narrowed i32 encoding, a
+    later batch does not — the keyed route must abandon to the host
+    route mid-stream with exact results."""
+    monkeypatch.setattr(SC, "_HIGHCARD_MIN_GROUPS", 16)
+    rng = np.random.default_rng(17)
+    n = 6000
+    k = rng.integers(0, 800, n).astype(np.int64)
+    k[n // 2:] += 1 << 40  # second half outgrows i32
+    t = pa.table({"k": pa.array(k), "v": pa.array(np.ones(n))})
+    batches = t.to_batches(max_chunksize=2000)
+
+    K.set_precision(None)
+    cpu = _ctx(False)
+    cpu.register_table("t", MemoryTable([batches], t.schema))
+    want = cpu.sql("select k, count(*) as c from t group by k").collect()
+
+    K.set_precision("x64")
+    dev = _ctx(True)
+    dev.register_table("t", MemoryTable([batches], t.schema))
+    plan = dev.sql("select k, count(*) as c from t group by k").physical_plan()
+    got = dev.execute(plan)
+    m = _metrics(plan)
+    assert m.get("tpu_fallback", 0) >= 1, m
+    assert _rows(want) == _rows(got)

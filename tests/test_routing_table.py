@@ -1,0 +1,173 @@
+"""Generated routing table: emit schema pin + loader semantics (ISSUE 9).
+
+Routing constants in ``ops/`` must cite a measured artifact: the table
+``dev/analyze_grid.py --emit`` writes and ``ops/routing.py`` loads.  The
+emit SCHEMA is pinned here so regenerating from a new KERNELBENCH grid
+cannot silently change shape, and the no-artifact defaults are pinned to
+the exact constants that used to live in the code — behavior with no
+artifact present must be unchanged.
+"""
+
+import json
+
+import pytest
+
+from arrow_ballista_tpu.ops import routing
+
+from dev.analyze_grid import emit_routing_table
+
+
+GRID_ROWS = [
+    # matmul wins this cell → crossover evidence
+    {"device_platform": "tpu", "bench": "segment_reduce", "algo": "matmul",
+     "rows": 1_000_000, "capacity": 4096, "rows_per_sec": 300e6},
+    {"device_platform": "tpu", "bench": "segment_reduce", "algo": "sort",
+     "rows": 1_000_000, "capacity": 4096, "rows_per_sec": 50e6},
+    {"device_platform": "tpu", "bench": "segment_reduce", "algo": "scatter",
+     "rows": 1_000_000, "capacity": 4096, "rows_per_sec": 40e6},
+    # high-cardinality cell where keyed WINS → keyed_route_auto evidence
+    {"device_platform": "tpu", "bench": "segment_reduce", "algo": "keyed",
+     "rows": 1_000_000, "capacity": 1 << 20, "rows_per_sec": 80e6},
+    {"device_platform": "tpu", "bench": "segment_reduce", "algo": "sort",
+     "rows": 1_000_000, "capacity": 1 << 20, "rows_per_sec": 30e6},
+    # cpu platform: keyed loses its high-cardinality cell
+    {"device_platform": "cpu", "bench": "segment_reduce", "algo": "keyed",
+     "rows": 1_000_000, "capacity": 1 << 20, "rows_per_sec": 2e6},
+    {"device_platform": "cpu", "bench": "segment_reduce", "algo": "scatter",
+     "rows": 1_000_000, "capacity": 1 << 20, "rows_per_sec": 140e6},
+]
+
+
+def test_emit_schema_is_pinned():
+    doc = emit_routing_table(GRID_ROWS, ["KERNELBENCH_test.json"])
+    # top-level shape: exactly these keys
+    assert sorted(doc) == ["generated_by", "inputs", "platforms", "schema"]
+    assert doc["schema"] == "ballista.routing/v1"
+    assert doc["inputs"] == ["KERNELBENCH_test.json"]
+    assert sorted(doc["platforms"]) == ["cpu", "tpu"]
+    for vals in doc["platforms"].values():
+        # per-platform shape: the routing fields + per-field evidence
+        assert sorted(vals) == sorted(
+            routing.PLATFORM_FIELDS + ("evidence",)
+        )
+        assert sorted(vals["evidence"]) == sorted(routing.PLATFORM_FIELDS)
+        assert isinstance(vals["matmul_max_cap"], int)
+        assert isinstance(vals["matmul_max_elems"], int)
+        assert isinstance(vals["highcard_min_groups"], int)
+        assert isinstance(vals["highcard_ratio"], float)
+        assert isinstance(vals["keyed_route_auto"], bool)
+    # the document round-trips through JSON unchanged
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_emit_derives_measured_values():
+    doc = emit_routing_table(GRID_ROWS, ["g.json"])
+    tpu = doc["platforms"]["tpu"]
+    assert tpu["matmul_max_cap"] == 4096
+    assert tpu["matmul_max_elems"] == 1_000_000 * 4096
+    assert tpu["keyed_route_auto"] is True
+    cpu = doc["platforms"]["cpu"]
+    # matmul never won on cpu → builtin default retained
+    assert cpu["matmul_max_cap"] == routing._DEFAULTS["matmul_max_cap"]
+    assert cpu["keyed_route_auto"] is False
+
+
+def test_builtin_defaults_are_the_pre_table_constants():
+    """No artifact → the exact constants that used to be hand-edited
+    literals in ops/kernels.py and ops/stage_compiler.py."""
+    d = routing._DEFAULTS
+    assert d["matmul_max_cap"] == 8192
+    assert d["matmul_max_elems"] == 1 << 36
+    assert d["highcard_min_groups"] == 1 << 16
+    assert d["highcard_ratio"] == 0.05
+    assert d["keyed_route_auto"] is False
+
+
+def test_loader_roundtrip_and_fallbacks(tmp_path, monkeypatch):
+    doc = emit_routing_table(GRID_ROWS, ["g.json"])
+    p = tmp_path / "routing_table.json"
+    p.write_text(json.dumps(doc))
+    try:
+        routing.reload(str(p))
+        assert "cpu" in routing._TABLES and "tpu" in routing._TABLES
+        assert routing._TABLES["tpu"].matmul_max_cap == 4096
+        assert routing._TABLES["tpu"].keyed_route_auto is True
+        assert routing._TABLES["cpu"].keyed_route_auto is False
+        # a platform missing from the artifact → builtin defaults
+        assert routing._TABLES.get("gpu") is None
+
+        # unreadable / wrong-schema artifacts degrade to builtins
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        routing.reload(str(bad))
+        assert routing._TABLES == {}
+        wrong = tmp_path / "wrong.json"
+        wrong.write_text(json.dumps({"schema": "other/v9", "platforms": {}}))
+        routing.reload(str(wrong))
+        assert routing._TABLES == {}
+
+        # empty env var disables loading entirely
+        monkeypatch.setenv("BALLISTA_ROUTING_TABLE", "")
+        routing.reload()
+        assert routing._TABLES == {}
+    finally:
+        monkeypatch.delenv("BALLISTA_ROUTING_TABLE", raising=False)
+        routing.reload()
+
+
+def test_keyed_route_auto_steers_auto_mode(tmp_path):
+    """'auto' highcard mode consults the table: a platform whose grid
+    shows the keyed reduction winning routes groups~rows keyed."""
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.ops.stage_compiler import keyed_route_wanted
+
+    auto_cfg = BallistaConfig({"ballista.tpu.highcard_mode": "auto"})
+    try:
+        assert keyed_route_wanted(auto_cfg) is False  # builtin default
+        rows = [
+            {"device_platform": "cpu", "bench": "segment_reduce",
+             "algo": "keyed", "rows": 1_000_000, "capacity": 1 << 20,
+             "rows_per_sec": 100e6},
+            {"device_platform": "cpu", "bench": "segment_reduce",
+             "algo": "scatter", "rows": 1_000_000, "capacity": 1 << 20,
+             "rows_per_sec": 10e6},
+        ]
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(emit_routing_table(rows, ["g.json"])))
+        routing.reload(str(p))
+        assert keyed_route_wanted(auto_cfg) is True
+        # explicit pins always beat the table
+        assert keyed_route_wanted(
+            BallistaConfig({"ballista.tpu.highcard_mode": "cpu"})
+        ) is False
+    finally:
+        routing.reload()
+
+
+def test_shipped_artifact_matches_loader_and_grid():
+    """The committed artifact is a faithful emit over the checked-in
+    KERNELBENCH grid and loads cleanly."""
+    import os
+
+    path = routing.default_artifact_path()
+    assert os.path.exists(path), (
+        "ops/routing_table.json missing — regenerate with "
+        "python dev/analyze_grid.py KERNELBENCH_r05.json --emit "
+        "arrow_ballista_tpu/ops/routing_table.json"
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == routing.SCHEMA
+    from dev.analyze_grid import load
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    inputs = [os.path.join(repo, p) for p in doc["inputs"]]
+    if all(os.path.exists(p) for p in inputs):
+        regen = emit_routing_table(load(inputs), inputs)
+        assert regen["platforms"] == doc["platforms"], (
+            "artifact drifted from its grid — regenerate via --emit"
+        )
+    # the committed artifact must not flip cpu-platform routing away
+    # from the measured defaults (keyed loses on cpu in r05)
+    if "cpu" in doc["platforms"]:
+        assert doc["platforms"]["cpu"]["keyed_route_auto"] is False
