@@ -53,6 +53,11 @@ SHUFFLE_COMPRESSION = "ballista.shuffle.compression"
 SHUFFLE_STORE = "ballista.shuffle.store"
 SHUFFLE_REPLICATION = "ballista.shuffle.replication"
 SHUFFLE_EXTERNAL_PATH = "ballista.shuffle.external_path"
+# Locality-aware data plane (docs/user-guide/shuffle.md "Data plane")
+SHUFFLE_LOCAL_TRANSPORT = "ballista.shuffle.local_transport"
+SHUFFLE_FETCH_BATCHED = "ballista.shuffle.fetch_batched"
+SHUFFLE_LOCALITY_ENABLED = "ballista.shuffle.locality_enabled"
+SHUFFLE_LOCALITY_WAIT_S = "ballista.shuffle.locality_wait_seconds"
 # Adaptive query execution (see docs/user-guide/aqe.md)
 AQE_ENABLED = "ballista.aqe.enabled"
 AQE_COALESCE_ENABLED = "ballista.aqe.coalesce_enabled"
@@ -121,6 +126,13 @@ def _parse_replication(v: str) -> str:
     mode = v.lower()
     if mode not in ("none", "async", "sync"):
         raise ValueError(f"replication must be none|async|sync, got {v!r}")
+    return mode
+
+
+def _parse_local_transport(v: str) -> str:
+    mode = v.lower()
+    if mode not in ("auto", "off"):
+        raise ValueError(f"local_transport must be auto|off, got {v!r}")
     return mode
 
 
@@ -404,6 +416,51 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "every executor and the scheduler",
             str,
             "",
+        ),
+        ConfigEntry(
+            SHUFFLE_LOCAL_TRANSPORT,
+            "same-host zero-copy shuffle transport: 'auto' serves a "
+            "partition via pa.memory_map (zero-copy, no gRPC) whenever "
+            "the serving executor's HOST IDENTITY matches this process's "
+            "registered executors (never a bare path-existence probe — "
+            "on a multi-host cluster a coincidentally-existing path must "
+            "not be read as shuffle input); 'off' forces every "
+            "non-memory fetch over Flight (the forced-remote A/B leg of "
+            "benchmarks/shuffle_locality.py)",
+            _parse_local_transport,
+            "auto",
+        ),
+        ConfigEntry(
+            SHUFFLE_FETCH_BATCHED,
+            "fetch many map partitions per Flight round trip: locations "
+            "on one remote executor group into a single multi-partition "
+            "DoGet (ticket lists the paths; the server interleaves "
+            "mmap-backed streams, tagging batches with their partition "
+            "index) instead of one round trip per location; false "
+            "restores per-partition DoGets",
+            _parse_bool,
+            "true",
+        ),
+        ConfigEntry(
+            SHUFFLE_LOCALITY_ENABLED,
+            "locality-aware reduce-task placement: prefer executors on "
+            "the hosts holding the most bytes of each reduce task's "
+            "input partitions (exact per-partition sizes from the "
+            "map-side write stats), waiting up to "
+            "ballista.shuffle.locality_wait_seconds for a preferred "
+            "slot before falling back to any host — makes the same-host "
+            "zero-copy transport the common case on multi-executor "
+            "clusters.  Off by default: placement is unchanged",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            SHUFFLE_LOCALITY_WAIT_S,
+            "how long a reduce task may hold out for a slot on its "
+            "preferred host before any executor may take it (the soft "
+            "half of locality placement; 0 = prefer but never wait)",
+            float,
+            "1.0",
         ),
         ConfigEntry(
             AQE_ENABLED,
@@ -782,6 +839,22 @@ class BallistaConfig:
     @property
     def shuffle_external_path(self) -> str:
         return self._get(SHUFFLE_EXTERNAL_PATH)
+
+    @property
+    def shuffle_local_transport(self) -> str:
+        return self._get(SHUFFLE_LOCAL_TRANSPORT)
+
+    @property
+    def shuffle_fetch_batched(self) -> bool:
+        return self._get(SHUFFLE_FETCH_BATCHED)
+
+    @property
+    def shuffle_locality_enabled(self) -> bool:
+        return self._get(SHUFFLE_LOCALITY_ENABLED)
+
+    @property
+    def shuffle_locality_wait_seconds(self) -> float:
+        return self._get(SHUFFLE_LOCALITY_WAIT_S)
 
     @property
     def aqe_enabled(self) -> bool:

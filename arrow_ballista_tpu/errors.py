@@ -32,6 +32,15 @@ class SerdeError(BallistaError):
     """Plan (de)serialization failure."""
 
 
+class BatchedFetchProtocolError(ExecutionError):
+    """The multi-partition shuffle stream broke the batched-fetch
+    protocol (partition index out of range, batch without an index tag —
+    e.g. a mixed-version server ignoring ``FetchPartitionTicket.paths``).
+    Deterministic: retrying the same stream cannot succeed, so the
+    fetcher degrades straight to per-location DoGets instead of burning
+    the retry/backoff budget first."""
+
+
 class ShuffleFetchFailed(ExecutionError):
     """A shuffle reader exhausted its per-location fetch retries: the map
     output it needs is gone (wiped work_dir, evicted memory partition,

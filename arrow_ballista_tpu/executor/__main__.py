@@ -268,7 +268,7 @@ def main(argv=None) -> None:
             server.stop()
         if janitor is not None:
             janitor.stop(final_sweep=True)
-        executor.shutdown_workers()
+        executor.close()
         flight.shutdown()
 
 

@@ -57,7 +57,13 @@ class LoggingMetricsCollector:
 class _ProcessWorker:
     """One persistent task-runner subprocess (see ``task_runner.py``)."""
 
-    def __init__(self, executor_id: str, work_dir: str, plugin_dir: str = ""):
+    def __init__(
+        self,
+        executor_id: str,
+        work_dir: str,
+        plugin_dir: str = "",
+        host: str = "",
+    ):
         import os
         import subprocess
         import sys
@@ -66,6 +72,10 @@ class _ProcessWorker:
             sys.executable, "-m", "arrow_ballista_tpu.executor.task_runner",
             "--executor-id", executor_id, "--work-dir", work_dir,
         ]
+        if host:
+            # the worker inherits the parent's advertised host so its
+            # local-transport identity matches (shuffle/transport.py)
+            args += ["--host", host]
         if plugin_dir:
             args += ["--plugin-dir", plugin_dir]
         repo_root = os.path.dirname(
@@ -149,6 +159,14 @@ class Executor:
         self.metadata = metadata
         self.work_dir = work_dir
         self.concurrent_tasks = concurrent_tasks
+        # local-transport identity (shuffle/transport.py): fetches of
+        # partitions served by THIS executor — or any executor advertising
+        # the same host — go zero-copy through the filesystem instead of
+        # Flight.  Registered here so every executor shape (push, pull,
+        # standalone, process-isolated task runner) participates.
+        from ..shuffle import transport
+
+        transport.register_local_executor(metadata.id, metadata.host)
         self.metrics_collector = metrics_collector or LoggingMetricsCollector()
         self.task_isolation = task_isolation
         self.plugin_dir = plugin_dir
@@ -352,7 +370,10 @@ class Executor:
                 self._idle_workers.pop() if self._idle_workers else None
             )
         if worker is None or not worker.alive():
-            worker = _ProcessWorker(self.id, self.work_dir, self.plugin_dir)
+            worker = _ProcessWorker(
+                self.id, self.work_dir, self.plugin_dir,
+                host=self.metadata.host,
+            )
         abort = _WorkerAbort(worker)
         with self._abort_lock:
             self._abort_handles.setdefault(pid, {})[task.attempt] = abort
@@ -400,10 +421,24 @@ class Executor:
                     )
 
     def shutdown_workers(self) -> None:
+        # worker-pool teardown ONLY — full executor teardown is close(),
+        # which also drops the local-transport identity.  A caller that
+        # stops here leaves the identity registered; later fetches then
+        # warn and fall back to Flight per miss instead of going zero-copy
+        # (self-healing, but noisy — prefer close()).
         with self._worker_lock:
             workers, self._idle_workers = self._idle_workers, []
         for w in workers:
             w.close()
+
+    def close(self) -> None:
+        """Full teardown: drop this executor's local-transport identity
+        (a later fetch in this process must not treat its dead work_dir
+        as servable) and stop the worker pool."""
+        from ..shuffle import transport
+
+        transport.unregister_local_executor(self.metadata.id)
+        self.shutdown_workers()
 
     # --------------------------------------------------------------- abort
     def _drop_abort_handle(self, pid: PartitionId, attempt: int) -> None:

@@ -48,7 +48,7 @@ class StandaloneExecutor:
             self.poll_loop.stop()
         if self.server is not None:
             self.server.stop()
-        self.executor.shutdown_workers()
+        self.executor.close()
         self.flight.shutdown()
 
 

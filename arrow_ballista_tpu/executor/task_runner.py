@@ -43,6 +43,9 @@ def main() -> None:
     parser.add_argument("--executor-id", required=True)
     parser.add_argument("--work-dir", required=True)
     parser.add_argument("--plugin-dir", default="")
+    # the parent executor's advertised host: the worker shares its
+    # filesystem, so it inherits the local-transport identity
+    parser.add_argument("--host", default="127.0.0.1")
     args = parser.parse_args()
 
     # never the device: a second process must not try to claim the chip
@@ -66,7 +69,7 @@ def main() -> None:
     if args.plugin_dir:
         load_udf_plugins(args.plugin_dir)
     metadata = ExecutorMetadata(
-        args.executor_id, "127.0.0.1", 0, 0, ExecutorSpecification(1)
+        args.executor_id, args.host, 0, 0, ExecutorSpecification(1)
     )
     ex = Executor(metadata, args.work_dir, concurrent_tasks=1)
 

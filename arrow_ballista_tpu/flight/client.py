@@ -71,6 +71,25 @@ class BallistaClient:
             if c is not None and (instance is None or c is instance):
                 del cls._cache[(host, port)]
 
+    def _do_get(self, ticket: flight.Ticket, headers: list = None):
+        """The one DoGet call site: positional options only when headers
+        ride along, so test/client doubles with a plain ``do_get(ticket)``
+        signature keep working untraced."""
+        if headers:
+            return self._client.do_get(
+                ticket, flight.FlightCallOptions(headers=headers)
+            )
+        return self._client.do_get(ticket)
+
+    def _fetch_error(self, what: str, e: BaseException) -> ExecutionError:
+        """Invalidate this cached connection and wrap the Flight error so
+        a retry reconnects instead of reusing a dead channel."""
+        type(self).invalidate(self.host, self.port, self)
+        return ExecutionError(
+            f"flight fetch of {what} from {self.host}:{self.port} "
+            f"failed: {e}"
+        )
+
     def fetch_partition(
         self,
         job_id: str,
@@ -97,6 +116,7 @@ class BallistaClient:
 
         ``headers`` (list of (bytes, bytes) pairs) ride the DoGet as gRPC
         metadata — the trace-context hop for stitched shuffle traces."""
+        what = f"{job_id}/{stage_id}/{partition_id}"
         ticket_proto = pb.FetchPartitionTicket(
             job_id=job_id,
             stage_id=stage_id,
@@ -105,31 +125,76 @@ class BallistaClient:
         )
         ticket = flight.Ticket(ticket_proto.SerializeToString())
         try:
-            # positional options only when headers ride along: test/client
-            # doubles with a plain do_get(ticket) signature keep working
-            if headers:
-                reader = self._client.do_get(
-                    ticket, flight.FlightCallOptions(headers=headers)
-                )
-            else:
-                reader = self._client.do_get(ticket)
+            reader = self._do_get(ticket, headers)
             schema = reader.schema
         except flight.FlightError as e:
-            type(self).invalidate(self.host, self.port, self)
-            raise ExecutionError(
-                f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
-                f"{self.host}:{self.port} failed: {e}"
-            ) from e
+            raise self._fetch_error(what, e) from e
 
         def gen() -> Iterator[pa.RecordBatch]:
             try:
                 for chunk in reader:
                     yield chunk.data
             except flight.FlightError as e:
-                type(self).invalidate(self.host, self.port, self)
-                raise ExecutionError(
-                    f"flight fetch of {job_id}/{stage_id}/{partition_id} from "
-                    f"{self.host}:{self.port} failed: {e}"
-                ) from e
+                raise self._fetch_error(what, e) from e
+
+        return schema, gen()
+
+    def fetch_partitions(
+        self,
+        job_id: str,
+        stage_id: int,
+        parts: list,
+        headers: list = None,
+    ) -> tuple[pa.Schema, Iterator[tuple[int, pa.RecordBatch]]]:
+        """One DoGet streaming SEVERAL partitions of one stage
+        (``parts`` = [(partition_id, path), ...]): the batched
+        cross-host fetch leg — N per-partition round trips collapse into
+        one multi-partition stream the server interleaves from its
+        mmap-backed readers.
+
+        Yields ``(index, batch)`` where ``index`` is the position in
+        ``parts`` the batch belongs to (carried per batch as Flight
+        ``app_metadata``), so the caller tracks per-partition delivery
+        for mid-stream resume.  Serving order is deterministic: ticket
+        path order, IPC batch order within each partition."""
+        what = f"{job_id}/{stage_id}/[{len(parts)} partitions]"
+        ticket_proto = pb.FetchPartitionTicket(
+            job_id=job_id,
+            stage_id=stage_id,
+            partition_id=parts[0][0] if parts else 0,
+            path="",
+            paths=[p for _, p in parts],
+        )
+        ticket = flight.Ticket(ticket_proto.SerializeToString())
+        try:
+            reader = self._do_get(ticket, headers)
+            schema = reader.schema
+        except flight.FlightError as e:
+            raise self._fetch_error(what, e) from e
+
+        def gen() -> Iterator[tuple[int, pa.RecordBatch]]:
+            from ..errors import BatchedFetchProtocolError
+
+            try:
+                for chunk in reader:
+                    meta = chunk.app_metadata
+                    if meta is None:
+                        raise BatchedFetchProtocolError(
+                            f"flight fetch of {what}: server sent a batch "
+                            "without a partition index (mixed-version "
+                            "cluster?)"
+                        )
+                    try:
+                        idx = int(bytes(meta))
+                    except ValueError as e:
+                        # malformed tag is just as deterministic as a
+                        # missing one: same skip-the-retry-budget verdict
+                        raise BatchedFetchProtocolError(
+                            f"flight fetch of {what}: unparsable partition "
+                            f"index tag {bytes(meta)!r}"
+                        ) from e
+                    yield idx, chunk.data
+            except flight.FlightError as e:
+                raise self._fetch_error(what, e) from e
 
         return schema, gen()

@@ -68,13 +68,17 @@ class ShuffleFlightService(flight.FlightServerBase):
     @staticmethod
     def _traced_stream(batches, trace_id: str, parent: str, path: str):
         """Wrap a batch stream so the serving window is one span in the
-        CALLER's trace (closed when the stream drains or breaks)."""
+        CALLER's trace (closed when the stream drains or breaks).
+        Items may be bare RecordBatches or ``(batch, app_metadata)``
+        tuples (the multi-partition stream tags each batch with its
+        partition index)."""
         t0_unix, t0_mono = time.time_ns(), time.monotonic_ns()
         nbytes = 0
         error = ""
         try:
             for b in batches:
-                nbytes += int(getattr(b, "nbytes", 0) or 0)
+                data = b[0] if isinstance(b, tuple) else b
+                nbytes += int(getattr(data, "nbytes", 0) or 0)
                 yield b
         except BaseException as e:
             error = f"{type(e).__name__}: {e}"
@@ -93,38 +97,20 @@ class ShuffleFlightService(flight.FlightServerBase):
                 **attrs,
             )
 
-    def do_get(self, context, ticket: flight.Ticket):
-        msg = pb.FetchPartitionTicket()
-        try:
-            msg.ParseFromString(ticket.ticket)
-        except Exception as e:
-            raise flight.FlightServerError(f"invalid ticket: {e}")
-        trace_id, parent = self._trace_ctx(context)
-        from ..shuffle import memory_store
-
-        if msg.path.startswith(memory_store.SCHEME):
-            hit = memory_store.get(msg.path)
-            if hit is None:
-                raise flight.FlightServerError(
-                    f"no such memory partition {msg.path!r}"
-                )
-            schema, batches = hit
-            stream = iter(batches)
-            if trace_id and trace.is_enabled():
-                stream = self._traced_stream(
-                    stream, trace_id, parent, msg.path
-                )
-            return flight.GeneratorStream(schema, stream)
-        path = os.path.abspath(msg.path)
+    # ------------------------------------------------------------ sources
+    def _open_file_reader(self, raw_path: str):
+        """(mmap source, IPC file reader) for one on-disk partition —
+        path-validated against the work dir, memory-mapped so served
+        batches are zero-copy views of the page cache (Zerrow property:
+        the Arrow data plane never copies on the serving side); OSFile
+        fallback for filesystems without mmap."""
+        path = os.path.abspath(raw_path)
         # only serve files inside the work dir (the ticket's path originates
         # from this executor's own shuffle-write stats, but never trust it)
         if not path.startswith(self.work_dir + os.sep):
             raise flight.FlightServerError(f"path {path!r} outside work dir")
         if not os.path.exists(path):
             raise flight.FlightServerError(f"no such partition file {path!r}")
-        # memory-map so served batches are zero-copy views of the page
-        # cache (Zerrow property: the Arrow data plane never copies on the
-        # serving side); OSFile fallback for filesystems without mmap
         try:
             source = pa.memory_map(path, "rb")
         except Exception:
@@ -138,6 +124,79 @@ class ShuffleFlightService(flight.FlightServerBase):
             raise flight.FlightServerError(
                 f"unreadable partition file {path!r}: {e}"
             )
+        return source, reader
+
+    @staticmethod
+    def _mem_buffer(path: str):
+        """The already-serialized IPC stream buffer of one memory-store
+        partition: the slab writer's bytes go to the wire as zero-copy
+        views, never re-materialized as a batch list first."""
+        from ..shuffle import memory_store
+
+        buf = memory_store.get_buffer(path)
+        if buf is None:
+            raise flight.FlightServerError(
+                f"no such memory partition {path!r}"
+            )
+        return buf
+
+    def _source_schema(self, path: str) -> pa.Schema:
+        from ..shuffle import memory_store
+
+        if path.startswith(memory_store.SCHEME):
+            with pa.ipc.open_stream(self._mem_buffer(path)) as r:
+                return r.schema
+        source, reader = self._open_file_reader(path)
+        try:
+            return reader.schema
+        finally:
+            source.close()
+
+    def _iter_source(self, path: str):
+        """Lazily stream one partition's batches (mem buffer or mmap)."""
+        from ..shuffle import memory_store
+
+        if path.startswith(memory_store.SCHEME):
+            with pa.ipc.open_stream(self._mem_buffer(path)) as r:
+                yield from r
+            return
+        source, reader = self._open_file_reader(path)
+        try:
+            for i in range(reader.num_record_batches):
+                yield reader.get_batch(i)
+        finally:
+            source.close()
+
+    # -------------------------------------------------------------- serve
+    def do_get(self, context, ticket: flight.Ticket):
+        msg = pb.FetchPartitionTicket()
+        try:
+            msg.ParseFromString(ticket.ticket)
+        except Exception as e:
+            raise flight.FlightServerError(f"invalid ticket: {e}")
+        trace_id, parent = self._trace_ctx(context)
+        if msg.paths:
+            return self._do_get_multi(list(msg.paths), trace_id, parent)
+        from ..shuffle import memory_store
+
+        if msg.path.startswith(memory_store.SCHEME):
+            buf = self._mem_buffer(msg.path)
+            with pa.ipc.open_stream(buf) as r:
+                schema = r.schema
+
+            def mem_gen():
+                # reopen lazily: batches are zero-copy views of the
+                # stored buffer, emitted straight onto the wire
+                with pa.ipc.open_stream(buf) as reader:
+                    yield from reader
+
+            stream = mem_gen()
+            if trace_id and trace.is_enabled():
+                stream = self._traced_stream(
+                    stream, trace_id, parent, msg.path
+                )
+            return flight.GeneratorStream(schema, stream)
+        source, reader = self._open_file_reader(msg.path)
 
         def gen():
             try:
@@ -150,6 +209,32 @@ class ShuffleFlightService(flight.FlightServerBase):
         if trace_id and trace.is_enabled():
             stream = self._traced_stream(stream, trace_id, parent, msg.path)
         return flight.GeneratorStream(reader.schema, stream)
+
+    def _do_get_multi(self, paths, trace_id: str, parent: str):
+        """Multi-partition ticket (``FetchPartitionTicket.paths``): ONE
+        stream interleaving every requested partition in ticket order,
+        each batch tagged with its partition index as ``app_metadata``
+        so the client tracks per-partition delivery for mid-stream
+        resume.  Replaces N per-partition DoGet round trips per
+        (stage, host) pair."""
+        if not paths:
+            raise flight.FlightServerError("empty multi-partition ticket")
+        # schema up front (from the first partition — one stage, one
+        # schema) so zero-batch partitions still stream cleanly
+        schema = self._source_schema(paths[0])
+
+        def gen():
+            for i, path in enumerate(paths):
+                tag = str(i).encode()
+                for batch in self._iter_source(path):
+                    yield batch, tag
+
+        stream = gen()
+        if trace_id and trace.is_enabled():
+            stream = self._traced_stream(
+                stream, trace_id, parent, f"[{len(paths)} partitions]"
+            )
+        return flight.GeneratorStream(schema, stream)
 
 
 class FlightServerHandle:

@@ -46,9 +46,12 @@ TASK_BYTES_RAW_OP = "__task_bytes_raw__"
 # coalesced_groups, skew_splits, broadcast} — persisted through the same
 # stage-metrics proto path, lifted into row["aqe"] by job_profile
 AQE_OP = "__aqe__"
+# Locality placement rollup (ISSUE 10): {"local": tasks dispatched on
+# their preferred host, "any": elsewhere} — lifted into row["locality"]
+LOCALITY_OP = "__locality_placement__"
 _SYNTHETIC_OPS = (
     STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
-    AQE_OP,
+    AQE_OP, LOCALITY_OP,
 )
 
 
@@ -225,6 +228,12 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
         shuffle_bytes = 0
         replica_fetches = 0
         write = {}
+        fetch_locality = {
+            "local_fetches": 0,
+            "remote_fetches": 0,
+            "local_bytes": 0,
+            "fetch_round_trips": 0,
+        }
         for op, vals in metrics.items():
             if op in _SYNTHETIC_OPS:
                 continue  # skew analytics, surfaced as row["skew"] below
@@ -233,6 +242,8 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
                     tpu[k] = tpu.get(k, 0) + v
             shuffle_bytes += vals.get("bytes_fetched", 0)
             replica_fetches += vals.get("replica_fetches", 0)
+            for k in fetch_locality:
+                fetch_locality[k] += vals.get(k, 0)
             for k in (
                 "bytes_written_raw",
                 "bytes_written_wire",
@@ -259,6 +270,16 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             # reads this stage served from an external-store replica
             # after its primary's executor went away
             row["replica_fetches"] = replica_fetches
+        if any(fetch_locality.values()):
+            # transport split of this stage's shuffle reads: zero-copy
+            # local (bytes that never crossed the wire) vs Flight, plus
+            # the DoGet round trips the remote legs actually paid
+            row["locality"] = dict(fetch_locality)
+        placement = metrics.get(LOCALITY_OP)
+        if placement:
+            # scheduler-side placement outcome: tasks that landed on
+            # their preferred (most-input-bytes) host vs anywhere else
+            row.setdefault("locality", {})["placement"] = dict(placement)
         skew = _skew_block(metrics)
         if skew is not None:
             # stage-completion partition skew (runtime + written bytes):

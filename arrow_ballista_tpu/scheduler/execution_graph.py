@@ -111,6 +111,13 @@ class ExecutionGraph:
         # In-memory only — a restarted scheduler re-derives nothing here
         # (Running stages persist as Resolved, so timing state is gone).
         self._init_speculation_policy(config)
+        # locality-aware placement (ballista.shuffle.locality_*): prefer
+        # putting reduce tasks on the hosts holding the most bytes of
+        # their input partitions, waiting up to locality_wait_s before
+        # any host may take them.  In-memory only, like speculation — a
+        # recovered graph re-dispatches location-blind until its stages
+        # re-resolve.
+        self._init_locality_policy(config)
         # adaptive query execution (scheduler/adaptive.py): persisted in
         # the graph proto so restart/HA adoption replays decisions for
         # stages that resolve after the failover
@@ -166,6 +173,14 @@ class ExecutionGraph:
             self.spec_min_runtime_s = 1.0
             self.spec_max_copies_per_stage = 2
             self.task_timeout_s = 0.0
+
+    def _init_locality_policy(self, config) -> None:
+        if config is not None:
+            self.locality_enabled = config.shuffle_locality_enabled
+            self.locality_wait_s = config.shuffle_locality_wait_seconds
+        else:
+            self.locality_enabled = False
+            self.locality_wait_s = 0.0
 
     def take_pending_cancels(self) -> List[tuple]:
         out, self.pending_cancels = self.pending_cancels, []
@@ -239,7 +254,16 @@ class ExecutionGraph:
                 changed = True
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, ResolvedStage):
-                self.stages[sid] = stage.to_running()
+                running = stage.to_running()
+                if self.locality_enabled:
+                    # per-task preferred hosts from the resolved readers'
+                    # exact input-partition sizes; computed only under
+                    # the knob so knob-off dispatch stays the untouched
+                    # baseline
+                    running.task_preferred_host = preferred_hosts_of(
+                        running.plan, running.partitions
+                    )
+                self.stages[sid] = running
                 changed = True
         if changed and self.status == QUEUED:
             self.status = RUNNING
@@ -280,7 +304,10 @@ class ExecutionGraph:
 
     # ----------------------------------------------------------- dispatch
     def pop_next_task(
-        self, executor_id: str, allow_excluded: bool = False
+        self,
+        executor_id: str,
+        allow_excluded: bool = False,
+        executor_host: Optional[str] = None,
     ) -> Optional[Task]:
         """Find a Running stage with an unclaimed partition, mark it
         running on ``executor_id`` and return it
@@ -291,9 +318,29 @@ class ExecutionGraph:
         ``allow_excluded`` — the liveness escape hatch when no other
         executor exists (``task_manager.fill_reservations``).
 
+        With locality placement on (``ballista.shuffle.locality_*``) and
+        ``executor_host`` known, the scan walks partitions in order but
+        DEFERS any task preferring a different host — leaving it for a
+        preferred executor — until the stage has been running for
+        ``locality_wait_s``, after which any host may take it (soft
+        preference: data locality is worth waiting for, never starving
+        for).  Preference-less tasks are taken whenever reached — they
+        are not reordered behind this host's preferred ones (in practice
+        a reduce stage's partitions either all carry preferences or none
+        do, so a second prioritizing scan would buy nothing).  Callers
+        that do not pass a host — or pass an empty one (metadata lookup
+        failed) — keep baseline behavior: an UNKNOWN host must degrade
+        to location-blind dispatch, never defer every preferred task
+        against it.
+
         Unclaimed partitions are served first; pending speculation
         requests (straggler duplicates flagged by the scan) come second
         and only ever land on an executor OTHER than the primary's."""
+        from ..shuffle.transport import normalize_host
+
+        locality = self.locality_enabled and bool(executor_host)
+        host_n = normalize_host(executor_host) if locality else ""
+        now = time.monotonic() if locality else 0.0
         for sid in sorted(self.stages):
             stage = self.stages[sid]
             if not isinstance(stage, RunningStage):
@@ -306,6 +353,29 @@ class ExecutionGraph:
                     and stage.task_exclusions.get(p) == executor_id
                 ):
                     continue
+                pref = (
+                    stage.task_preferred_host.get(p) if locality else None
+                )
+                if (
+                    pref
+                    and pref != host_n
+                    and now
+                    < stage.running_since_mono + self.locality_wait_s
+                ):
+                    # hold out for the host that already has the bytes;
+                    # the flag keeps the push-mode safety tick re-minting
+                    # a reservation for the turned-away slot
+                    stage.locality_deferred = True
+                    continue
+                if locality:
+                    stage.locality_deferred = False
+                if pref:
+                    stage.locality_stats["local" if pref == host_n else "any"] = (
+                        stage.locality_stats.get(
+                            "local" if pref == host_n else "any", 0
+                        )
+                        + 1
+                    )
                 attempt = stage.task_attempts.get(p, 0)
                 pid = PartitionId(self.job_id, sid, p)
                 stage.task_statuses[p] = TaskInfo(
@@ -325,6 +395,25 @@ class ExecutionGraph:
             if task is not None:
                 return task
         return None
+
+    def preferred_hosts(self) -> Dict[str, int]:
+        """Pending-task demand per preferred host (normalized) across
+        Running stages — the ordering hint for
+        ``ExecutorManager.reserve_slots`` so cluster-wide reservations
+        land where the shuffle bytes already are.  Empty when locality
+        placement is off."""
+        out: Dict[str, int] = {}
+        if not self.locality_enabled:
+            return out
+        for stage in self.stages.values():
+            if not isinstance(stage, RunningStage):
+                continue
+            for p, t in enumerate(stage.task_statuses):
+                if t is None:
+                    h = stage.task_preferred_host.get(p)
+                    if h:
+                        out[h] = out.get(h, 0) + 1
+        return out
 
     def _pop_speculative(
         self, sid: int, stage: RunningStage, executor_id: str
@@ -1533,8 +1622,10 @@ class ExecutionGraph:
         )
         # speculation/deadline policy is session-config derived and not
         # persisted: a recovered/adopted graph runs without it until its
-        # stages complete (timing anchors are gone anyway)
+        # stages complete (timing anchors are gone anyway); locality
+        # placement likewise (preferred hosts re-derive on re-resolve)
         self._init_speculation_policy(None)
+        self._init_locality_policy(None)
         # AQE policy IS persisted: stats and already-made decisions live
         # in the stage protos, so a restarted scheduler replays the same
         # rewrites for stages that resolve after the failover
@@ -1674,6 +1765,44 @@ def _decode_inputs(msgs) -> Dict[int, StageInput]:
             inp.add_partition(PartitionLocation.from_proto(l))
         out[m.stage_id] = inp
     return out
+
+
+def preferred_hosts_of(plan, n_tasks: int) -> Dict[int, str]:
+    """task index -> normalized host holding the most input bytes, from
+    the resolved plan's ShuffleReaderExec location lists (exact
+    per-partition wire sizes recorded at shuffle-write time).  Tasks
+    whose inputs carry no sized, host-addressed location (external-store
+    sentinel, empty partitions) get no preference."""
+    from ..shuffle.execution_plans import ShuffleReaderExec
+    from ..shuffle.transport import normalize_host
+
+    by_task: Dict[int, Dict[str, int]] = {}
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ShuffleReaderExec):
+            for p, locs in enumerate(node.partition):
+                if p >= n_tasks:
+                    break
+                for l in locs:
+                    host = normalize_host(
+                        getattr(l.executor_meta, "host", "") or ""
+                    )
+                    if not host:
+                        continue
+                    nb = int(
+                        getattr(l.partition_stats, "num_bytes", 0) or 0
+                    )
+                    if nb <= 0:
+                        continue
+                    hosts = by_task.setdefault(p, {})
+                    hosts[host] = hosts.get(host, 0) + nb
+        stack.extend(node.children())
+    return {
+        # deterministic argmax: bytes desc, then host name
+        p: max(sorted(hosts), key=lambda h: hosts[h])
+        for p, hosts in by_task.items()
+    }
 
 
 def _locations_of(stage: UnresolvedStage, executor_id: str) -> int:

@@ -17,6 +17,7 @@ map-side partition locations accumulated so far.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -235,6 +236,24 @@ class RunningStage:
     task_bytes: Dict[int, Dict[str, int]] = field(default_factory=dict)
     # AQE decision summary (see UnresolvedStage.aqe)
     aqe: Dict[str, int] = field(default_factory=dict)
+    # ---- locality-aware placement (ISSUE 10; populated by
+    # ExecutionGraph.revive only when ballista.shuffle.locality_enabled,
+    # so knob-off placement is byte-identical to the baseline) ----
+    # partition -> normalized host holding the most bytes of its input
+    # shuffle partitions (exact sizes from the map-side write stats)
+    task_preferred_host: Dict[int, str] = field(default_factory=dict)
+    # dispatch rollup: {"local": popped on the preferred host, "any":
+    # popped elsewhere after/without the locality wait}
+    locality_stats: Dict[str, int] = field(default_factory=dict)
+    # wait anchor: tasks may hold out for their preferred host until
+    # running_since_mono + locality_wait_s
+    running_since_mono: float = field(default_factory=time.monotonic)
+    # set when a pop DEFERRED a task for its preferred host (cleared on
+    # the next successful pop): the push-mode 1s tick re-mints
+    # reservations ONLY for stages that actually turned a slot away —
+    # otherwise the timer would double-book slots the event-driven flow
+    # already covers, every second
+    locality_deferred: bool = False
 
     @property
     def partitions(self) -> int:
@@ -331,6 +350,13 @@ class RunningStage:
             # the replan decision rides the same persistence path as the
             # skew analytics: visible in the profile after eviction/restart
             metrics[AQE_OP] = dict(self.aqe)
+        if self.locality_stats:
+            # placement hit-rate persists alongside the data-plane
+            # local/remote fetch counters (which live in the reader
+            # operator's own metrics)
+            from ..obs.export import LOCALITY_OP
+
+            metrics[LOCALITY_OP] = dict(self.locality_stats)
         return CompletedStage(
             self.stage_id,
             self.plan,

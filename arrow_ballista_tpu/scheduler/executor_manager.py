@@ -464,11 +464,39 @@ class ExecutorManager:
             )
 
     # -------------------------------------------------------------- slots
+    def _host_weights(
+        self, preferred_hosts: Optional[Dict[str, int]]
+    ) -> Optional[Dict[str, int]]:
+        """executor id -> demand weight of its (normalized) host, for the
+        reserve_slots locality ordering; None when no preference."""
+        if not preferred_hosts:
+            return None
+        from ..shuffle.transport import normalize_host
+
+        wanted = {normalize_host(h): w for h, w in preferred_hosts.items()}
+        out: Dict[str, int] = {}
+        for eid, raw in self.backend.scan(Keyspace.Executors):
+            try:
+                meta = pb.ExecutorMetadata.FromString(raw)
+                out[eid] = wanted.get(normalize_host(meta.host), 0)
+            except Exception:  # noqa: BLE001 - unparsable: no preference
+                out[eid] = 0
+        return out
+
     def reserve_slots(
-        self, n: int, job_id: Optional[str] = None
+        self,
+        n: int,
+        job_id: Optional[str] = None,
+        preferred_hosts: Optional[Dict[str, int]] = None,
     ) -> List[ExecutorReservation]:
         """Atomically grab up to ``n`` slots across alive executors
-        (reference: executor_manager.rs:121-167)."""
+        (reference: executor_manager.rs:121-167).
+
+        ``preferred_hosts`` ({host: pending-task demand}, from
+        locality-aware graphs) SOFT-orders the scan: slots on hosts that
+        already hold the shuffle bytes are taken first, everything else
+        fills the remainder — the reservation-side half of locality
+        placement (pop_next_task's wait is the task-side half)."""
         if n <= 0:
             return []
         alive = self.get_alive_executors()
@@ -478,6 +506,7 @@ class ExecutorManager:
             alive.discard(eid)
         for eid in self.draining_executors():
             alive.discard(eid)
+        weights = self._host_weights(preferred_hosts)
         # on LeaseFenced nothing was applied: re-scan and retry once
         # under a fresh grant (the counts may have changed meanwhile)
         for attempt in (0, 1):
@@ -486,7 +515,13 @@ class ExecutorManager:
             try:
                 with lk:
                     txn = []
-                    for eid, raw in self.backend.scan(Keyspace.Slots):
+                    entries = list(self.backend.scan(Keyspace.Slots))
+                    if weights is not None:
+                        # stable: equal-weight executors keep scan order
+                        entries.sort(
+                            key=lambda kv: -weights.get(kv[0], 0)
+                        )
+                    for eid, raw in entries:
                         if eid not in alive:
                             continue
                         avail = _slots_from(raw)

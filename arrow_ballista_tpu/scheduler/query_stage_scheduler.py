@@ -199,8 +199,11 @@ class QueryStageScheduler(EventAction):
             )
             if retried:
                 reservations = list(reservations)
+                _pending, hosts = self.state.task_manager.locality_pending()
                 reservations.extend(
-                    self.state.executor_manager.reserve_slots(retried)
+                    self.state.executor_manager.reserve_slots(
+                        retried, preferred_hosts=hosts or None
+                    )
                 )
         if reservations:
             sender.post(ReservationOffering(reservations))
@@ -229,6 +232,20 @@ class QueryStageScheduler(EventAction):
             )
             if reservations:
                 sender.post(ReservationOffering(reservations))
+        if self.state.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            # locality liveness: a task deferred for its preferred host
+            # gave its slot back; this periodic tick (the same 1s timer
+            # driving the scan) re-mints reservations — host-ordered —
+            # so the task dispatches the moment a preferred slot frees
+            # or its locality wait expires.  locality_pending() is empty
+            # unless some job opted into ballista.shuffle.locality_*.
+            pending, hosts = self.state.task_manager.locality_pending()
+            if pending > 0:
+                reservations = self.state.executor_manager.reserve_slots(
+                    pending, preferred_hosts=hosts or None
+                )
+                if reservations:
+                    sender.post(ReservationOffering(reservations))
 
     def _drain_expulsions(self, sender: EventSender) -> None:
         """Executors whose repeated launch failures crossed the threshold

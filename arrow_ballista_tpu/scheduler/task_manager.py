@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from ..errors import SchedulerError
 from ..exec.operators import ExecutionPlan
 from ..obs import trace
-from ..obs.export import AQE_OP
+from ..obs.export import AQE_OP, LOCALITY_OP
 from ..obs.recorder import trace_store
 from ..obs.registry import MetricsRegistry
 from ..proto import pb
@@ -411,6 +411,13 @@ class TaskManager:
                 # adaptive re-plan outcome (tasks before/after, rewrite
                 # counts) — also persisted inside stage_metrics[__aqe__]
                 row["aqe"] = dict(aqe)
+            placement = getattr(stage, "locality_stats", None) or (
+                getattr(stage, "stage_metrics", None) or {}
+            ).get(LOCALITY_OP)
+            if placement:
+                # locality placement outcome: tasks dispatched on their
+                # preferred (most-input-bytes) host vs anywhere else
+                row["locality_placement"] = dict(placement)
             failures = getattr(stage, "task_failures", None)
             if failures:
                 row["failures"] = {p: list(h) for p, h in failures.items()}
@@ -672,6 +679,21 @@ class TaskManager:
         def _allow_excluded(executor_id: str) -> bool:
             return not (alive - {executor_id})
 
+        # executor host per reservation (memoized): locality-aware
+        # pop_next_task prefers tasks whose input bytes live on the
+        # popping executor's host
+        hosts: Dict[str, str] = {}
+
+        def _host_of(executor_id: str) -> str:
+            h = hosts.get(executor_id)
+            if h is None:
+                try:
+                    h = em.get_executor_metadata(executor_id).host
+                except Exception:  # noqa: BLE001 - host unknown: no pref
+                    h = ""
+                hosts[executor_id] = h
+            return h
+
         with self._cache_lock:
             job_ids = list(self._cache.keys())
 
@@ -692,6 +714,7 @@ class TaskManager:
                     task = graph.pop_next_task(
                         r.executor_id,
                         allow_excluded=_allow_excluded(r.executor_id),
+                        executor_host=_host_of(r.executor_id),
                     )
                     if task is None:
                         still_free.append(r)
@@ -979,6 +1002,45 @@ class TaskManager:
     def active_job_ids(self) -> List[str]:
         with self._cache_lock:
             return list(self._cache.keys())
+
+    def locality_pending(self) -> Tuple[int, Dict[str, int]]:
+        """(deferred-pending tasks, per-host demand) across cached jobs
+        with LOCALITY PLACEMENT ON — the periodic re-offer input keeping
+        locality-deferred tasks live in push mode (a deferred task's
+        slot was cancelled; somebody must mint new reservations once the
+        wait expires).  Counts ONLY stages whose last pop actually
+        turned a slot away (``stage.locality_deferred``): pending tasks
+        the event-driven flow already covers must not be double-booked
+        every tick.  Jobs without the knob contribute nothing, so
+        knob-off scheduling is untouched."""
+        from .execution_stage import RunningStage
+
+        pending = 0
+        hosts: Dict[str, int] = {}
+        with self._cache_lock:
+            entries = list(self._cache.values())
+        for entry in entries:
+            with entry.lock:
+                graph = entry.graph
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                if not getattr(graph, "locality_enabled", False):
+                    continue
+                deferred = 0
+                for stage in graph.stages.values():
+                    if (
+                        isinstance(stage, RunningStage)
+                        and stage.locality_deferred
+                    ):
+                        deferred += sum(
+                            1 for t in stage.task_statuses if t is None
+                        )
+                if not deferred:
+                    continue
+                pending += deferred
+                for h, n in graph.preferred_hosts().items():
+                    hosts[h] = hosts.get(h, 0) + n
+        return pending, hosts
 
     def task_counts(self) -> Tuple[int, int]:
         """(pending, running) task totals across cached active jobs —

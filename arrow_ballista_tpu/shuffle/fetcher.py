@@ -66,6 +66,15 @@ class _TeeMetrics:
         "fetch_queue_full_ns": "shuffle_fetch_queue_full_ns_total",
         "fetch_wait_time_ns": "shuffle_fetch_wait_ns_total",
         "replica_fetches": "shuffle_replica_fetches_total",
+        # locality-aware data plane: how many locations were served
+        # zero-copy off the local filesystem/memory store vs over Flight,
+        # how many local bytes never crossed the wire, and how many DoGet
+        # round trips the remote legs actually paid (batched multi-
+        # partition fetch collapses N per-partition calls into few)
+        "local_fetches": "shuffle_local_fetches_total",
+        "remote_fetches": "shuffle_remote_fetches_total",
+        "local_bytes": "shuffle_local_bytes_total",
+        "fetch_round_trips": "shuffle_fetch_round_trips_total",
     }
     _counters: dict = {}
     _counters_lock = threading.Lock()
@@ -126,12 +135,19 @@ def staging_bytes() -> int:
 
 @dataclass(frozen=True)
 class FetchPolicy:
-    """Reader-side fetch knobs (see ``ballista.shuffle.fetch_*``)."""
+    """Reader-side fetch knobs (see ``ballista.shuffle.fetch_*`` and
+    ``ballista.shuffle.local_transport``)."""
 
     concurrency: int = 8
     prefetch_bytes: int = 64 << 20
     retries: int = 3
     backoff_s: float = 0.05
+    # same-host zero-copy transport: "auto" (executor host-identity
+    # gated) or "off" (always Flight — the forced-remote A/B leg)
+    local_transport: str = "auto"
+    # one multi-partition DoGet per (host, chunk) instead of one round
+    # trip per location (ballista.shuffle.fetch_batched)
+    batched: bool = True
 
     @staticmethod
     def from_config(config) -> "FetchPolicy":
@@ -140,25 +156,59 @@ class FetchPolicy:
             prefetch_bytes=config.shuffle_prefetch_bytes,
             retries=config.shuffle_fetch_retries,
             backoff_s=config.shuffle_fetch_backoff_ms / 1000.0,
+            local_transport=config.shuffle_local_transport,
+            batched=config.shuffle_fetch_batched,
         )
 
 
-def fetch_location(loc) -> Iterator[pa.RecordBatch]:
-    """Stream one map-side partition: external store, memory-store fast
-    path, local IPC file, Arrow Flight otherwise — the single
-    source-dispatch behind every shuffle read."""
-    from . import memory_store, store
+def _count(metrics, name: str, v: int = 1) -> None:
+    if metrics is not None and v:
+        metrics.add(name, v)
 
+
+def _counted_local(batches, metrics) -> Iterator[pa.RecordBatch]:
+    """Yield a local zero-copy stream, accounting the bytes that never
+    crossed the wire.  Like the transport-split counters generally,
+    ``local_bytes`` counts per fetch ATTEMPT (a rare mid-stream retry of
+    a local read re-counts the prefix it re-reads) — ``bytes_fetched``
+    remains the exact delivered-bytes number."""
+    for b in batches:
+        _count(metrics, "local_bytes", int(getattr(b, "nbytes", 0) or 0))
+        yield b
+
+
+def fetch_location(
+    loc, policy: Optional[FetchPolicy] = None, metrics=None
+) -> Iterator[pa.RecordBatch]:
+    """Stream one map-side partition: external store, memory-store fast
+    path, same-host zero-copy mmap, Arrow Flight otherwise — the single
+    source-dispatch behind every shuffle read.
+
+    The local-vs-Flight choice for file partitions is a DELIBERATE
+    transport decision (``shuffle/transport.py``): executor host
+    identity, not the old accidental ``os.path.exists`` probe — on a
+    multi-host deployment a coincidentally-existing foreign path must
+    never be read as shuffle input.  ``policy.local_transport="off"``
+    forces Flight (the A/B baseline); ``metrics`` (optional) receives
+    the ``local_fetches``/``remote_fetches``/``local_bytes``/
+    ``fetch_round_trips`` accounting."""
+    from . import memory_store, store, transport
+
+    local_transport = policy.local_transport if policy is not None else "auto"
     if store.is_external_location(loc):
         # external-store partition (replica failover or store=external):
         # read the shared path directly; there is no Flight endpoint to
         # fall back to, so a missing file fails fast into the retry loop
+        _count(metrics, "remote_fetches")
         yield from store.read_batches(loc.path)
         return
     if loc.path and loc.path.startswith(memory_store.SCHEME):
-        hit = memory_store.get(loc.path)
-        if hit is not None:
-            yield from hit[1]
+        buf = memory_store.get_buffer(loc.path)
+        if buf is not None:
+            # zero-copy: batches are views over the stored IPC buffer
+            _count(metrics, "local_fetches")
+            with pa.ipc.open_stream(buf) as reader:
+                yield from _counted_local(reader, metrics)
             return
         # A miss here is either janitor eviction or a partition produced
         # by ANOTHER executor (whose Flight service serves mem:// paths
@@ -171,12 +221,26 @@ def fetch_location(loc) -> Iterator[pa.RecordBatch]:
             loc.executor_meta.host,
             loc.executor_meta.flight_port,
         )
-    elif loc.path and os.path.exists(loc.path):
-        with pa.OSFile(loc.path, "rb") as f:
-            reader = pa.ipc.open_file(f)
-            for i in range(reader.num_record_batches):
-                yield reader.get_batch(i)
-        return
+    elif loc.path and transport.decide(loc, local_transport) == transport.LOCAL:
+        if os.path.exists(loc.path):
+            _count(metrics, "local_fetches")
+            yield from _counted_local(
+                transport.read_local_batches(loc.path), metrics
+            )
+            return
+        # identity said local but the file is not visible here: two
+        # co-hosted executors may run on ISOLATED filesystems (separate
+        # containers/volumes advertising one IP) — degrade to Flight,
+        # which serves from the producer's own filesystem, exactly like
+        # the mem:// miss above.  A genuinely lost partition fails over
+        # Flight too and lands in the same retry/recovery machinery.
+        log.warning(
+            "host-matched shuffle partition %s is not visible on this "
+            "filesystem; falling back to Flight from %s:%s",
+            loc.path,
+            loc.executor_meta.host,
+            loc.executor_meta.flight_port,
+        )
     from ..flight.client import BallistaClient
 
     client = BallistaClient.get(
@@ -186,7 +250,9 @@ def fetch_location(loc) -> Iterator[pa.RecordBatch]:
     # SERVING executor's do_get span stitches into this job's trace;
     # the kwarg is only passed when tracing — client doubles without it
     # keep working untraced
-    headers = obs_trace.propagation_headers()
+    headers = obs_trace.propagation_headers() or None
+    _count(metrics, "remote_fetches")
+    _count(metrics, "fetch_round_trips")
     if headers:
         yield from client.fetch_partition(
             loc.partition_id.job_id,
@@ -241,6 +307,7 @@ def retrying_fetch(
     metrics,
     fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
     stop_event: Optional[threading.Event] = None,
+    delivered_hint: int = 0,
 ) -> Iterator[pa.RecordBatch]:
     """Stream one location with retry + exponential backoff and replica
     failover.
@@ -253,13 +320,30 @@ def retrying_fetch(
     partition the serving order is deterministic: IPC file order — the
     replica is a byte copy of the primary), so failures never duplicate
     rows.  ``stop_event`` cuts a backoff wait short (the original error
-    re-raises).
+    re-raises).  ``delivered_hint`` pre-counts batches the CALLER already
+    delivered for this location (the batched-fetch fallback hands a
+    partially-streamed location here), so the first attempt skips them
+    instead of duplicating.
     """
     from ..errors import Cancelled
     from ..testing.faults import fault_point
 
-    fetch = fetch_fn or fetch_location
-    delivered = 0
+    if fetch_fn is not None:
+        fetch = fetch_fn
+    else:
+
+        def fetch(l):
+            # late-bound module global so monkeypatched doubles win; a
+            # single-arg double raises TypeError at GENERATOR CREATION
+            # (argument binding, before any body runs), so the fallback
+            # call is safe and keeps the old fetch_location(loc) contract
+            fl = fetch_location
+            try:
+                return fl(l, policy=policy, metrics=metrics)
+            except TypeError:
+                return fl(l)
+
+    delivered = max(0, delivered_hint)
     last_error: Optional[BaseException] = None
     candidates = fetch_candidates(loc)
     for ci, cand in enumerate(candidates):
@@ -334,6 +418,74 @@ def _exhausted(loc, error: BaseException) -> BaseException:
         getattr(meta, "id", ""),
         detail=f"{type(error).__name__}: {error}",
     )
+
+
+def _classify_unit(loc, policy: FetchPolicy):
+    """Batched-fetch grouping key for one location: ``"single"`` when it
+    is served without a per-partition Flight call (external store, local
+    memory-store hit, same-host zero-copy file), else the Flight
+    endpoint ``(host, flight_port)`` it must be streamed from."""
+    from . import memory_store, store, transport
+
+    if store.is_external_location(loc):
+        return "single"
+    path = getattr(loc, "path", "") or ""
+    meta = getattr(loc, "executor_meta", None)
+    if path.startswith(memory_store.SCHEME):
+        if memory_store.get_buffer(path) is not None:
+            return "single"
+    elif (
+        transport.decide(loc, policy.local_transport) == transport.LOCAL
+        and os.path.exists(path)
+    ):
+        # existence-checked: an identity-matched but filesystem-invisible
+        # partition (isolated co-hosted executors) rides the Flight batch
+        return "single"
+    host = getattr(meta, "host", "") if meta is not None else ""
+    port = getattr(meta, "flight_port", 0) if meta is not None else 0
+    if not host or not port:
+        return "single"  # nothing to dial: let the single path error out
+    return (host, port)
+
+
+def plan_fetch_units(
+    locations: list, policy: FetchPolicy, allow_batched: bool = True
+) -> list:
+    """Partition a reader's locations into fetch units (each a list of
+    locations a worker claims atomically).
+
+    Local/external/memory locations stay one-per-unit.  Remote Flight
+    locations group by serving endpoint, and each endpoint's group splits
+    into at most ``concurrency // n_endpoints`` chunks — so a 64-location
+    single-host stage pays ~``concurrency`` multi-partition round trips
+    (streams still overlap) instead of 64 per-partition DoGets, and a
+    many-host stage keeps one stream per host."""
+    if not allow_batched or not policy.batched or len(locations) <= 1:
+        return [[l] for l in locations]
+    units: list = []
+    groups: dict = {}
+    order: list = []  # deterministic unit order: first-seen endpoint
+    for l in locations:
+        key = _classify_unit(l, policy)
+        if key == "single":
+            units.append([l])
+            continue
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(l)
+    n_hosts = max(1, len(groups))
+    chunks_per_host = max(1, policy.concurrency // n_hosts)
+    for key in order:
+        group = groups[key]
+        # at least 2 locations per chunk (else batching buys nothing):
+        # round trips at most halve even when concurrency >> group size
+        n_chunks = min(chunks_per_host, (len(group) + 1) // 2)
+        n_chunks = max(1, n_chunks)
+        size = (len(group) + n_chunks - 1) // n_chunks
+        for lo in range(0, len(group), size):
+            units.append(group[lo : lo + size])
+    return units
 
 
 class _Closed(Exception):
@@ -471,11 +623,18 @@ class ShuffleFetcher:
         self._locations = list(locations)
         self._policy = policy
         self._metrics = _TeeMetrics(metrics)
+        # batched multi-partition fetch only applies to the REAL location
+        # dispatch: an injected fetch_fn is per-location by contract
+        self._units = plan_fetch_units(
+            self._locations, policy, allow_batched=fetch_fn is None
+        )
         # explicit parent for per-location spans: fetch workers run on
         # their own threads, so thread-local context can't propagate
         self._trace_parent = trace_parent
         self._cancel = cancel_event
-        self._fetch_fn = fetch_fn or fetch_location
+        # None → retrying_fetch builds the policy/metrics-aware
+        # fetch_location default (transport decision + locality counters)
+        self._fetch_fn = fetch_fn
         self._q = _PrefetchQueue(policy.prefetch_bytes, self._metrics)
         self._cursor = 0
         self._cursor_lock = threading.Lock()
@@ -498,7 +657,7 @@ class ShuffleFetcher:
         return self._iterate()
 
     def _iterate(self) -> Iterator[pa.RecordBatch]:
-        n_workers = max(1, min(self._policy.concurrency, len(self._locations)))
+        n_workers = max(1, min(self._policy.concurrency, len(self._units)))
         with _active_lock:
             _active.add(self)
         try:
@@ -546,7 +705,7 @@ class ShuffleFetcher:
     # ------------------------------------------------------------ producers
     def _next_index(self) -> Optional[int]:
         with self._cursor_lock:
-            if self._cursor >= len(self._locations):
+            if self._cursor >= len(self._units):
                 return None
             i = self._cursor
             self._cursor += 1
@@ -558,7 +717,11 @@ class ShuffleFetcher:
                 idx = self._next_index()
                 if idx is None:
                     break
-                self._fetch_one(self._locations[idx])
+                unit = self._units[idx]
+                if len(unit) == 1:
+                    self._fetch_one(unit[0])
+                else:
+                    self._fetch_unit(unit)
         except _Closed:
             pass
         except BaseException as e:  # first error wins; tears the pipe down
@@ -627,8 +790,187 @@ class ShuffleFetcher:
         finally:
             self._exit_location()
 
+    def _fetch_unit(self, locs: list) -> None:
+        """Stream one BATCHED unit (several same-endpoint locations) over
+        a single multi-partition DoGet, with retry + mid-stream resume;
+        a unit that exhausts its retry budget degrades to the
+        per-location path (which adds replica failover) for whatever it
+        had not finished."""
+        from ..errors import Cancelled
+
+        t0 = time.monotonic_ns()
+        self._enter_location()
+        try:
+            if self._cancel is not None and self._cancel.is_set():
+                raise _cancelled()
+            span_cm = (
+                obs_trace.span(
+                    "shuffle.fetch.batched",
+                    parent=self._trace_parent,
+                    host=getattr(locs[0].executor_meta, "host", ""),
+                    locations=len(locs),
+                )
+                if self._trace_parent is not None
+                else obs_trace.NOOP
+            )
+            with span_cm as sp:
+                delivered = [0] * len(locs)
+                # frontier: locations BELOW it were fully streamed by
+                # some attempt (serving order is deterministic — seeing
+                # index j proves every i < j completed), so the fallback
+                # never re-fetches their bytes
+                frontier = [0]
+                try:
+                    total = self._stream_batched(locs, delivered, frontier)
+                except (Cancelled, _Closed):
+                    raise
+                except Exception as e:  # noqa: BLE001 - degrade, see below
+                    log.warning(
+                        "batched fetch of %d partition(s) from %s failed "
+                        "(%s); falling back to per-location fetch from "
+                        "location %d",
+                        len(locs),
+                        getattr(locs[0].executor_meta, "host", ""),
+                        e,
+                        frontier[0],
+                    )
+                    total = self._fallback_per_location(
+                        locs, delivered, frontier[0]
+                    )
+                sp.set_attr("bytes", total)
+            self._metrics.add("fetch_time_ns", time.monotonic_ns() - t0)
+        finally:
+            self._exit_location()
+
+    def _stream_batched(
+        self, locs: list, delivered: list, frontier: list
+    ) -> int:
+        """One multi-partition stream with bounded retries; ``delivered``
+        (per-location committed batch counts) persists across attempts so
+        a mid-stream retry resumes without duplicating rows (the server's
+        serving order is deterministic: ticket path order, IPC batch
+        order within each partition).  ``frontier`` (1-elem list) records
+        the highest partition index ever seen: every lower index is
+        proven complete.  Protocol violations
+        (:class:`BatchedFetchProtocolError`) are deterministic and skip
+        the retry budget entirely — the caller degrades straight to
+        per-location DoGets."""
+        from ..errors import BatchedFetchProtocolError
+        from ..flight.client import BallistaClient
+        from ..testing.faults import fault_point
+
+        meta = locs[0].executor_meta
+        pid0 = locs[0].partition_id
+        parts = [
+            (getattr(l.partition_id, "partition_id", 0), l.path) for l in locs
+        ]
+        attempt = 0
+        total = 0
+        while True:
+            try:
+                fault_point(
+                    "shuffle.fetch",
+                    path=getattr(locs[0], "path", ""),
+                    attempt=attempt,
+                )
+                client = BallistaClient.get(meta.host, meta.flight_port)
+                headers = obs_trace.propagation_headers() or None
+                self._metrics.add("fetch_round_trips", 1)
+                _schema, stream = client.fetch_partitions(
+                    pid0.job_id, pid0.stage_id, parts, headers=headers
+                )
+                seen = [0] * len(locs)
+                n_streamed = 0
+                for idx, batch in stream:
+                    fault_point(
+                        "shuffle.fetch.batched",
+                        host=meta.host,
+                        attempt=attempt,
+                        batches=n_streamed,
+                    )
+                    n_streamed += 1
+                    if not (0 <= idx < len(locs)):
+                        raise _protocol_error(idx, len(locs))
+                    frontier[0] = max(frontier[0], idx)
+                    seen[idx] += 1
+                    if seen[idx] <= delivered[idx]:
+                        continue  # resume: already delivered pre-failure
+                    nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                    self._q.put(batch, nbytes)
+                    self._metrics.add("bytes_fetched", nbytes)
+                    total += nbytes
+                    delivered[idx] += 1
+                self._metrics.add("remote_fetches", len(locs))
+                self._metrics.add("locations_fetched", len(locs))
+                return total
+            except Exception as e:
+                from ..errors import Cancelled
+
+                if isinstance(
+                    e, (Cancelled, _Closed, BatchedFetchProtocolError)
+                ):
+                    raise
+                attempt += 1
+                if attempt > self._policy.retries:
+                    raise
+                self._metrics.add("fetch_retries", 1)
+                delay = self._policy.backoff_s * (2 ** (attempt - 1))
+                log.warning(
+                    "batched shuffle fetch from %s:%s failed "
+                    "(attempt %d/%d): %s; retrying in %.0fms",
+                    meta.host,
+                    meta.flight_port,
+                    attempt,
+                    self._policy.retries,
+                    e,
+                    delay * 1e3,
+                )
+                if self._stop.wait(delay):
+                    raise
+
+    def _fallback_per_location(
+        self, locs: list, delivered: list, frontier: int = 0
+    ) -> int:
+        """Finish a failed batched unit location by location: each gets a
+        fresh per-copy retry budget PLUS external-replica failover, with
+        ``delivered_hint`` skipping what the batched stream already
+        committed.  Locations below ``frontier`` were FULLY streamed
+        (deterministic serving order proved it) — they are not
+        re-fetched at all, so a unit that died on its last partition
+        never re-pays the wire cost of the completed ones."""
+        total = 0
+        for i, loc in enumerate(locs):
+            if i < frontier:
+                # these WERE wire-served (by the failed batched stream):
+                # the transport split must still count them remote
+                self._metrics.add("locations_fetched", 1)
+                self._metrics.add("remote_fetches", 1)
+                continue
+            for batch in retrying_fetch(
+                loc,
+                self._policy,
+                self._metrics,
+                stop_event=self._stop,
+                delivered_hint=delivered[i],
+            ):
+                nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                self._q.put(batch, nbytes)
+                self._metrics.add("bytes_fetched", nbytes)
+                total += nbytes
+            self._metrics.add("locations_fetched", 1)
+        return total
+
 
 def _cancelled():
     from ..errors import Cancelled
 
     return Cancelled("task cancelled")
+
+
+def _protocol_error(idx, n):
+    from ..errors import BatchedFetchProtocolError
+
+    return BatchedFetchProtocolError(
+        f"batched shuffle fetch: server sent partition index {idx} "
+        f"outside the requested range [0, {n})"
+    )

@@ -161,12 +161,21 @@ def put_size(path: str) -> int:
     return buf.size if buf is not None else 0
 
 
-def get(path: str) -> Optional[Tuple[pa.Schema, List[pa.RecordBatch]]]:
+def get_buffer(path: str) -> Optional[pa.Buffer]:
+    """The stored partition's raw serialized IPC stream buffer (None on
+    miss).  The zero-copy read path: consumers reopen it with
+    ``pa.ipc.open_stream`` and every batch is a view over these bytes —
+    and the Flight service hands the same buffer to the wire without
+    materializing a batch list first."""
     key = parse_path(path)
     if key is None:
         return None
     with _lock:
-        buf = _store.get(key)
+        return _store.get(key)
+
+
+def get(path: str) -> Optional[Tuple[pa.Schema, List[pa.RecordBatch]]]:
+    buf = get_buffer(path)
     if buf is None:
         return None
     with pa.ipc.open_stream(buf) as reader:

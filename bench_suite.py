@@ -14,9 +14,13 @@ Each config emits one JSON line (same shape as bench.py) and everything
 is appended to BENCH_SUITE_r05.json so the results ship with the repo.
 
   plus shuffle data-plane micro-benches: shuffle_fetch_mb_per_sec
-  (pipelined vs sequential reduce-side read) and shuffle_write_mb_per_sec
+  (pipelined vs sequential reduce-side read), shuffle_write_mb_per_sec
   (slab-buffered async map-side write vs the synchronous baseline, with
-  the zstd wire-compression ratio)
+  the zstd wire-compression ratio), and the locality A/B
+  (shuffle_local_fetch_mb_per_sec: identity-gated same-host zero-copy
+  vs forced-remote Flight loopback on identical inputs, sha-fingerprint
+  identity enforced; shuffle_batched_fetch_round_trips: the batched
+  multi-partition DoGet leg)
 
   plus an AQE A/B leg (aqe_starjoin_rows_per_sec /
   aqe_tiny_agg_rows_per_sec): skewed star join + tiny-partition
@@ -619,6 +623,45 @@ def bench_shuffle_write() -> None:
     )
 
 
+def bench_shuffle_locality() -> None:
+    """Config #8: shuffle data-plane locality A/B (ISSUE 10) — same-host
+    zero-copy (identity-gated pa.memory_map) vs forced-remote Flight
+    loopback on identical inputs (sha row-fingerprint identity enforced
+    inside the bench), plus the batched multi-partition DoGet leg
+    (fewer round trips at no MB/s regression)."""
+    from benchmarks.shuffle_locality import run_locality_bench
+
+    rec = run_locality_bench(
+        n_locations=int(os.environ.get("BENCH_SHUFFLE_LOCATIONS", "16")),
+        mb_per_location=float(os.environ.get("BENCH_SHUFFLE_MB_PER_LOC", "4")),
+        concurrency=int(os.environ.get("BENCH_SHUFFLE_CONCURRENCY", "8")),
+    )
+    _emit(
+        {
+            "metric": "shuffle_local_fetch_mb_per_sec",
+            "value": rec["local_mb_per_sec"],
+            "unit": "MB/s",
+            # acceptance: >= 2x the Flight-loopback fetch throughput
+            "vs_baseline": rec["local_vs_remote"],
+            **rec,
+        }
+    )
+    _emit(
+        {
+            "metric": "shuffle_batched_fetch_round_trips",
+            "value": rec["batched_round_trips"],
+            "unit": "round trips",
+            "vs_baseline": round(
+                rec["unbatched_round_trips"]
+                / max(1, rec["batched_round_trips"]),
+                3,
+            ),
+            "batched_mb_per_sec": rec["remote_batched_mb_per_sec"],
+            "unbatched_mb_per_sec": rec["remote_unbatched_mb_per_sec"],
+        }
+    )
+
+
 def bench_aqe() -> None:
     """Adaptive query execution A/B (ISSUE 8): a skewed star join and a
     tiny-partition aggregate, each measured with ballista.aqe.enabled
@@ -688,6 +731,7 @@ def main() -> None:
     if which in ("shuffle", "all"):
         bench_shuffle_fetch()
         bench_shuffle_write()
+        bench_shuffle_locality()
     if which in ("aqe", "all"):
         bench_aqe()
     if which in ("keyed", "all"):

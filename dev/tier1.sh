@@ -49,6 +49,17 @@ print(json.dumps({"bench_smoke": "shuffle_write",
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.shuffle_locality import run_locality_smoke
+
+# locality A/B on tiny inputs: all three transports bit-identical, the
+# local leg zero-copy, the batched leg fewer round trips
+print(json.dumps({"bench_smoke": "shuffle_locality",
+                  **run_locality_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
 import json
 from benchmarks.aqe_starjoin import run_aqe_smoke
