@@ -241,17 +241,33 @@ class BallistaContext:
 
     def wait_for_job(self, job_id: str, timeout_s: float = 300.0) -> dict:
         """Poll GetJobStatus until terminal (reference:
-        distributed_query.rs:232-309)."""
-        from ..scheduler.task_status import job_status_from_proto
+        distributed_query.rs:232-309).
+
+        Queue-aware: a job held by admission control reports QUEUED with
+        its pool + queue position, and a timeout message splits the
+        deadline into time-spent-queued vs time-spent-running — a job
+        that starved in a saturated queue reads differently from one
+        that wedged mid-execution."""
+        from ..scheduler.task_status import (
+            job_status_from_proto,
+            poll_timeout_breakdown,
+        )
 
         # monotonic deadline: immune to wall-clock jumps mid-poll
-        deadline = time.monotonic() + timeout_s
+        start = time.monotonic()
+        deadline = start + timeout_s
+        running_since: Optional[float] = None
+        last_queued: dict = {}
         while True:
             result = self.stub.GetJobStatus(
                 pb.GetJobStatusParams(job_id=job_id), timeout=20
             )
             status = job_status_from_proto(result.status)
             state = status["state"]
+            if state == "queued":
+                last_queued = status
+            elif running_since is None:
+                running_since = time.monotonic()
             if state == "completed":
                 return status
             if state == "failed":
@@ -259,7 +275,10 @@ class BallistaContext:
                     f"job {job_id} failed: {status.get('error', 'unknown error')}"
                 )
             if time.monotonic() > deadline:
-                raise ExecutionError(f"job {job_id} timed out after {timeout_s}s")
+                raise ExecutionError(
+                    f"job {job_id} timed out after {timeout_s}s"
+                    + poll_timeout_breakdown(start, running_since, last_queued)
+                )
             time.sleep(JOB_POLL_INTERVAL_S)
 
     def fetch_job_output(self, status: dict) -> pa.Table:

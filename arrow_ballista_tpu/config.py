@@ -84,6 +84,18 @@ EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
 EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
 EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
 CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
+# Multi-tenant admission control (see docs/user-guide/multi-tenancy.md)
+TENANT_ID = "ballista.tenant.id"
+TENANT_PRIORITY = "ballista.tenant.priority"
+TENANT_WEIGHT = "ballista.tenant.weight"
+TENANT_MAX_RUNNING_JOBS = "ballista.tenant.max_running_jobs"
+ADMISSION_ENABLED = "ballista.admission.enabled"
+ADMISSION_MAX_RUNNING_JOBS = "ballista.admission.max_running_jobs"
+ADMISSION_MAX_QUEUED_JOBS = "ballista.admission.max_queued_jobs"
+ADMISSION_MAX_QUEUE_WAIT_S = "ballista.admission.max_queue_wait_seconds"
+ADMISSION_SHED_POLICY = "ballista.admission.shed_policy"
+ADMISSION_MAX_INTERACTIVE_BYPASS = "ballista.admission.max_interactive_bypass"
+ADMISSION_INTERACTIVE_HEADROOM = "ballista.admission.interactive_headroom"
 # Observability (see docs/user-guide/observability.md)
 OBS_ENABLED = "ballista.obs.enabled"
 OBS_SAMPLE_RATE = "ballista.obs.sample_rate"
@@ -134,6 +146,27 @@ def _parse_local_transport(v: str) -> str:
     if mode not in ("auto", "off"):
         raise ValueError(f"local_transport must be auto|off, got {v!r}")
     return mode
+
+
+def _parse_priority(v: str) -> str:
+    lane = v.lower()
+    if lane not in ("interactive", "batch"):
+        raise ValueError(f"tenant priority must be interactive|batch, got {v!r}")
+    return lane
+
+
+def _parse_shed_policy(v: str) -> str:
+    policy = v.lower()
+    if policy not in ("reject", "oldest"):
+        raise ValueError(f"shed policy must be reject|oldest, got {v!r}")
+    return policy
+
+
+def _parse_weight(v: str) -> float:
+    w = float(v)
+    if w <= 0:
+        raise ValueError(f"tenant weight must be > 0, got {v!r}")
+    return w
 
 
 def _parse_highcard_mode(v: str) -> str:
@@ -646,6 +679,107 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "300",
         ),
         ConfigEntry(
+            TENANT_ID,
+            "tenant pool this session's jobs belong to for admission "
+            "control and weighted fair scheduling; empty = the shared "
+            "'default' pool",
+            str,
+            "",
+        ),
+        ConfigEntry(
+            TENANT_PRIORITY,
+            "admission lane for this session's jobs: 'interactive' jobs "
+            "release ahead of batch work across every pool (bounded by "
+            "ballista.admission.max_interactive_bypass so batch is "
+            "delayed, never starved) and dispatch first among running "
+            "jobs; 'batch' is the default lane",
+            _parse_priority,
+            "batch",
+        ),
+        ConfigEntry(
+            TENANT_WEIGHT,
+            "fair-share weight of this session's tenant pool: queued "
+            "jobs release by deficit-weighted round robin, so pools "
+            "with weights 2:1 admit 2:1 whenever both have work queued",
+            _parse_weight,
+            "1",
+        ),
+        ConfigEntry(
+            TENANT_MAX_RUNNING_JOBS,
+            "cap on concurrently admitted jobs of this tenant pool "
+            "(0 = bounded only by the cluster-wide admission gate)",
+            int,
+            "0",
+        ),
+        ConfigEntry(
+            ADMISSION_ENABLED,
+            "multi-tenant admission control: jobs past the cluster's "
+            "running-job capacity wait PRE-PLANNING in a bounded "
+            "per-pool queue (no ExecutionGraph built, no memory "
+            "pinned) and release by weighted fair share as capacity "
+            "frees; past the queue bounds the scheduler sheds with a "
+            "structured, retryable ClusterSaturated error.  false "
+            "(default) keeps submit/dispatch byte-identical to the "
+            "pre-admission scheduler",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            ADMISSION_MAX_RUNNING_JOBS,
+            "cluster-wide cap on concurrently admitted jobs; 0 derives "
+            "one admitted job per task slot across alive executors",
+            int,
+            "0",
+        ),
+        ConfigEntry(
+            ADMISSION_MAX_QUEUED_JOBS,
+            "admission queue bound across all pools; a submission past "
+            "it sheds per ballista.admission.shed_policy (0 = "
+            "unbounded — every admission transits the queue, so the "
+            "bound can never mean 'no queue')",
+            int,
+            "100",
+        ),
+        ConfigEntry(
+            ADMISSION_MAX_QUEUE_WAIT_S,
+            "a job queued longer than this sheds with ClusterSaturated "
+            "instead of waiting forever (0 = unbounded wait)",
+            float,
+            "0",
+        ),
+        ConfigEntry(
+            ADMISSION_SHED_POLICY,
+            "which job pays when the admission queue is full: 'reject' "
+            "sheds the NEWEST submission (the one arriving now), "
+            "'oldest' sheds the longest-queued job and queues the "
+            "newcomer — both with the structured ClusterSaturated error",
+            _parse_shed_policy,
+            "reject",
+        ),
+        ConfigEntry(
+            ADMISSION_MAX_INTERACTIVE_BYPASS,
+            "consecutive interactive-lane releases allowed to jump a "
+            "waiting batch job before the batch head must go (bounded "
+            "bypass: interactive is fast, batch never starves)",
+            int,
+            "4",
+        ),
+        ConfigEntry(
+            ADMISSION_INTERACTIVE_HEADROOM,
+            "bounded express lane: up to this many interactive jobs may "
+            "run ABOVE the cluster's admission cap, so a short "
+            "interactive query never waits a whole long batch job's "
+            "completion for its admission slot (job-granular admission "
+            "would otherwise make it SLOWER than task-granular FIFO); "
+            "their tasks then dispatch first among running jobs.  "
+            "Running interactive jobs charge this headroom BEFORE they "
+            "count against base capacity, so express traffic never "
+            "consumes batch's share.  0 makes interactive queue like "
+            "everything else",
+            int,
+            "2",
+        ),
+        ConfigEntry(
             OBS_ENABLED,
             "distributed tracing + span recording for this session's jobs "
             "(scheduler, executors and shuffle fetch stitch under one "
@@ -947,6 +1081,50 @@ class BallistaConfig:
     @property
     def client_job_timeout_seconds(self) -> float:
         return self._get(CLIENT_JOB_TIMEOUT_S)
+
+    @property
+    def tenant_id(self) -> str:
+        return self._get(TENANT_ID)
+
+    @property
+    def tenant_priority(self) -> str:
+        return self._get(TENANT_PRIORITY)
+
+    @property
+    def tenant_weight(self) -> float:
+        return self._get(TENANT_WEIGHT)
+
+    @property
+    def tenant_max_running_jobs(self) -> int:
+        return self._get(TENANT_MAX_RUNNING_JOBS)
+
+    @property
+    def admission_enabled(self) -> bool:
+        return self._get(ADMISSION_ENABLED)
+
+    @property
+    def admission_max_running_jobs(self) -> int:
+        return self._get(ADMISSION_MAX_RUNNING_JOBS)
+
+    @property
+    def admission_max_queued_jobs(self) -> int:
+        return self._get(ADMISSION_MAX_QUEUED_JOBS)
+
+    @property
+    def admission_max_queue_wait_seconds(self) -> float:
+        return self._get(ADMISSION_MAX_QUEUE_WAIT_S)
+
+    @property
+    def admission_shed_policy(self) -> str:
+        return self._get(ADMISSION_SHED_POLICY)
+
+    @property
+    def admission_max_interactive_bypass(self) -> int:
+        return self._get(ADMISSION_MAX_INTERACTIVE_BYPASS)
+
+    @property
+    def admission_interactive_headroom(self) -> int:
+        return self._get(ADMISSION_INTERACTIVE_HEADROOM)
 
     @property
     def obs_enabled(self) -> bool:

@@ -7,6 +7,8 @@ Python exception tree instead of a Rust enum.
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class BallistaError(Exception):
     """Base class for all framework errors."""
@@ -73,6 +75,39 @@ class ShuffleFetchFailed(ExecutionError):
 
 class SchedulerError(BallistaError):
     """Scheduler-side state machine failure."""
+
+
+class ClusterSaturated(SchedulerError):
+    """Admission-control backpressure: the cluster is saturated and this
+    job was shed instead of queued (queue full, displaced by
+    ``shed_policy=oldest``, or queued past ``max_queue_wait_seconds``).
+    RETRYABLE by design — nothing about the job itself is wrong, and the
+    running set was never touched.  The message keeps a stable
+    ``ClusterSaturated:`` prefix with ``key=value`` coordinates so
+    clients and benches can recognize sheds across the string-only
+    status wire."""
+
+    def __init__(
+        self,
+        reason: str,
+        pool: str = "",
+        queued: int = 0,
+        policy: str = "",
+        queue_wait_s: Optional[float] = None,
+    ):
+        self.pool = pool
+        self.queued = queued
+        self.policy = policy
+        self.queue_wait_s = queue_wait_s
+        parts = [f"pool={pool or '<none>'}", f"queued={queued}"]
+        if policy:
+            parts.append(f"policy={policy}")
+        if queue_wait_s is not None:
+            parts.append(f"queue_wait_s={queue_wait_s:.3f}")
+        super().__init__(
+            f"ClusterSaturated: {reason} ({' '.join(parts)}); "
+            "backpressure — safe to retry later"
+        )
 
 
 class ConfigError(BallistaError):

@@ -44,6 +44,8 @@ CONFIG_KEYS = {
     "task_timeout_seconds": (float, 0.0, "reap running tasks older than this for every session (0 = off; sessions can set ballista.task.timeout_seconds)"),
     "drain_timeout_seconds": (float, 30.0, "graceful-decommission budget handed to a draining executor (DecommissionExecutor RPC / POST /api/executors/{id}/decommission)"),
     "aqe_enabled": (int, 0, "1 = adaptive query execution (re-plan stages from observed shuffle stats) as the cluster-wide default; an explicit session ballista.aqe.* setting wins"),
+    "admission_enabled": (int, 0, "1 = multi-tenant admission control (queue, weighted fair release, ClusterSaturated shed) as the cluster-wide default; an explicit session ballista.admission.* setting wins unless pinned via --admission-defaults"),
+    "admission_defaults": (str, "", "comma-separated ballista.admission.* key=value pairs PINNED cluster-wide (e.g. 'ballista.admission.max_queued_jobs=200,ballista.admission.shed_policy=oldest'); pinned limits ignore session settings so no tenant can rewrite another tenant's gates"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "event_journal_dir": (str, "", "directory for the append-only structured event journal (empty = disabled; see /api/jobs/{id}/events and /api/events/tail)"),
     "event_journal_rotate_bytes": (int, 4 << 20, "rotate the active journal segment past this size"),
@@ -98,6 +100,23 @@ def init_logging(cfg: dict, prefix_key: str = "log_file_name_prefix") -> None:
         handlers=handlers,
         force=True,
     )
+
+
+def _parse_admission_defaults(raw: str) -> dict:
+    """``k=v,k=v`` → dict of operator-pinned ballista.admission.* keys;
+    validation (key names, value types) happens in SchedulerState."""
+    out = {}
+    for pair in (raw or "").split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, sep, value = pair.partition("=")
+        if not sep:
+            raise SystemExit(
+                f"--admission-defaults entry {pair!r} is not key=value"
+            )
+        out[key.strip()] = value.strip()
+    return out
 
 
 def make_backend(cfg: dict):
@@ -164,6 +183,8 @@ def main(argv=None) -> None:
         speculation_force_enabled=bool(cfg["speculation_enabled"]),
         task_timeout_force_s=cfg["task_timeout_seconds"],
         aqe_force_enabled=bool(cfg["aqe_enabled"]),
+        admission_force_enabled=bool(cfg["admission_enabled"]),
+        admission_defaults=_parse_admission_defaults(cfg["admission_defaults"]),
         drain_timeout_s=cfg["drain_timeout_seconds"],
         telemetry_sample_s=cfg["telemetry_sample_seconds"],
         event_journal_dir=cfg["event_journal_dir"],

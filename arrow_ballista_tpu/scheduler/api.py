@@ -18,7 +18,9 @@ the job's stitched spans), ``/api/jobs/{id}/profile``
 + cluster aggregates + SLO), ``/api/cluster/timeseries?metric=…``
 (bounded downsampled history), ``/api/jobs/{id}/events`` and
 ``/api/events/tail`` (structured event journal) — see
-docs/user-guide/observability.md.
+docs/user-guide/observability.md — and ``/api/tenants`` (multi-tenant
+admission pools: weights, lanes, queue depth, shed counts; see
+docs/user-guide/multi-tenancy.md).
 """
 
 from __future__ import annotations
@@ -74,7 +76,10 @@ async function showDetail(jobId) {
   const d = await fetch('/api/job/' + encodeURIComponent(jobId)).then(r => r.json());
   openJobTerminal = d.state === 'completed' || d.state === 'failed';
   if (!d.stages) {  // 404 payload; d.error on a FAILED job still has stages
-    document.getElementById('detail').textContent = d.error || 'no such job';
+    document.getElementById('detail').textContent = d.error ||
+      (d.state === 'queued'
+        ? `queued in pool '${d.pool}' at position ${d.queue_position}`
+        : 'no such job');
     return;
   }
   let html = `<h2>Job ${esc(jobId)} — ${esc(d.state)}` +
@@ -209,6 +214,7 @@ async function refresh() {
       `${metrics.active_jobs} active job(s) · ` +
       `${metrics.task_retries || 0} task retr${metrics.task_retries === 1 ? 'y' : 'ies'} · ` +
       `${metrics.executors_quarantined || 0} quarantined · ` +
+      `${metrics.admission_queued_jobs || 0} queued · ` +
       `spec ${metrics.speculative_wins || 0}/${metrics.speculative_launched || 0} won · ` +
       `${metrics.task_timeouts_total || 0} reaped`;
     const etb = document.querySelector('#executors tbody');
@@ -333,6 +339,12 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
         if path == "/api/cluster/health":
             self._cluster_health(srv)
             return
+        if path == "/api/tenants":
+            # multi-tenant admission view (scheduler/admission.py):
+            # per-pool weights, lanes, queue depth, running share and
+            # lifetime admitted/shed counters
+            self._json(srv.state.admission.snapshot())
+            return
         if path == "/api/cluster/timeseries":
             self._cluster_timeseries(srv)
             return
@@ -456,6 +468,7 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
                     "executors_draining": len(draining),
                 },
                 "slo": state.slo.snapshot(),
+                "admission": state.admission.health_summary(),
                 "events": state.events.stats(),
             }
         )

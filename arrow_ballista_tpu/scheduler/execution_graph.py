@@ -118,6 +118,12 @@ class ExecutionGraph:
         # recovered graph re-dispatches location-blind until its stages
         # re-resolve.
         self._init_locality_policy(config)
+        # multi-tenant admission (scheduler/admission.py): the pool and
+        # lane this job belongs to.  Persisted (tenant_json) so restart
+        # and HA adoption re-register the job with the admission
+        # controller's per-pool concurrency accounting, and so
+        # fill_reservations can keep ordering dispatch by fair share.
+        self._init_tenant(config)
         # adaptive query execution (scheduler/adaptive.py): persisted in
         # the graph proto so restart/HA adoption replays decisions for
         # stages that resolve after the failover
@@ -181,6 +187,16 @@ class ExecutionGraph:
         else:
             self.locality_enabled = False
             self.locality_wait_s = 0.0
+
+    def _init_tenant(self, config) -> None:
+        if config is not None:
+            self.admission_enabled = config.admission_enabled
+            self.tenant_pool = (config.tenant_id or "").strip() or "default"
+            self.tenant_priority = config.tenant_priority
+        else:
+            self.admission_enabled = False
+            self.tenant_pool = "default"
+            self.tenant_priority = "batch"
 
     def take_pending_cancels(self) -> List[tuple]:
         out, self.pending_cancels = self.pending_cancels, []
@@ -1521,6 +1537,10 @@ class ExecutionGraph:
         g.external_shuffle_path = self.external_shuffle_path
         if self.aqe_policy.enabled:
             g.aqe_settings_json = self.aqe_policy.to_json()
+        if self.admission_enabled:
+            g.tenant_json = json.dumps(
+                {"pool": self.tenant_pool, "priority": self.tenant_priority}
+            )
         for sid in sorted(self.stage_reset_counts):
             g.stage_reset_ids.append(sid)
             g.stage_reset_counts.append(self.stage_reset_counts[sid])
@@ -1626,6 +1646,17 @@ class ExecutionGraph:
         # placement likewise (preferred hosts re-derive on re-resolve)
         self._init_speculation_policy(None)
         self._init_locality_policy(None)
+        # tenant identity IS persisted: pool concurrency accounting and
+        # fair dispatch ordering must survive restart / HA adoption
+        self._init_tenant(None)
+        if g.tenant_json:
+            try:
+                tenant = json.loads(g.tenant_json)
+                self.admission_enabled = True
+                self.tenant_pool = tenant.get("pool") or "default"
+                self.tenant_priority = tenant.get("priority") or "batch"
+            except (ValueError, TypeError, AttributeError):
+                pass
         # AQE policy IS persisted: stats and already-made decisions live
         # in the stage protos, so a restarted scheduler replays the same
         # rewrites for stages that resolve after the failover

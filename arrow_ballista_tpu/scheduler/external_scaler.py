@@ -45,8 +45,12 @@ class ExternalScalerService:
         )
 
     def GetMetrics(self, request, context) -> keda_pb.GetMetricsResponse:
+        # jobs held in the admission queue are demand the cluster could
+        # not absorb — exactly what an autoscaler must see as inflight
+        # (ROADMAP item 2 pairs with the admission front door here)
         active = self.scheduler.state.task_manager.active_job_ids()
-        value = MAX_INFLIGHT if active else 0
+        queued = self.scheduler.state.admission.queued_count()
+        value = MAX_INFLIGHT if (active or queued) else 0
         return keda_pb.GetMetricsResponse(
             metricValues=[
                 keda_pb.MetricValue(

@@ -96,11 +96,18 @@ class FlightSqlService(flight.FlightServerBase):
         """Poll until terminal (reference: check_job flight_sql.rs:99-139)."""
         # monotonic deadline: a wall-clock jump must neither cut a
         # running statement short nor extend it
-        deadline = time.monotonic() + self._job_timeout_s()
+        start = time.monotonic()
+        deadline = start + self._job_timeout_s()
+        running_since = None
+        last_queued: dict = {}
         tm = self.scheduler.state.task_manager
         while True:
             status = tm.get_job_status(job_id)
             if status is not None:
+                if status["state"] == "queued":
+                    last_queued = status
+                elif running_since is None:
+                    running_since = time.monotonic()
                 if status["state"] == "completed":
                     return list(status.get("locations", []))
                 if status["state"] == "failed":
@@ -108,7 +115,14 @@ class FlightSqlService(flight.FlightServerBase):
                         f"job {job_id} failed: {status.get('error', 'unknown')}"
                     )
             if time.monotonic() > deadline:
-                raise flight.FlightServerError(f"job {job_id} timed out")
+                from .task_status import poll_timeout_breakdown
+
+                # an admission-starved statement reads differently from
+                # a wedged one
+                raise flight.FlightServerError(
+                    f"job {job_id} timed out"
+                    + poll_timeout_breakdown(start, running_since, last_queued)
+                )
             time.sleep(JOB_POLL_INTERVAL_S)
 
     # ------------------------------------------------------------- flight

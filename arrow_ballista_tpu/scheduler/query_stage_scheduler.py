@@ -86,6 +86,15 @@ class SpeculationScan:
     mutations stay on the single-thread discipline)."""
 
 
+@dataclass
+class AdmissionPulse:
+    """Periodic tick (same 1s timer) while the admission queue is
+    non-empty: shed jobs queued past max_queue_wait_seconds and retry
+    the release scan — the catch-up path for capacity that freed
+    without a job event (an executor registering, a cancel from a gRPC
+    thread)."""
+
+
 def post_job_events(state: SchedulerState, sender, events) -> None:
     """Map task-manager job events onto scheduler events; shared by the
     event-loop TaskUpdating handler and the pull-mode poll_work path."""
@@ -132,11 +141,18 @@ class QueryStageScheduler(EventAction):
         elif isinstance(event, JobPlanningFailed):
             log.error("job %s planning failed: %s", event.job_id, event.error)
             self.state.task_manager.fail_job(event.job_id, event.error)
+            self._admit_released(sender)
         elif isinstance(event, JobFinished):
             self.state.task_manager.complete_job(event.job_id)
+            # the finished job freed an admission slot: queued jobs with
+            # capacity now release by deficit-weighted round robin
+            self._admit_released(sender)
         elif isinstance(event, JobRunningFailed):
             log.error("job %s failed: %s", event.job_id, event.error)
             self.state.task_manager.fail_job(event.job_id, event.error)
+            self._admit_released(sender)
+        elif isinstance(event, AdmissionPulse):
+            self._on_admission_pulse(sender)
         elif isinstance(event, JobUpdated):
             self.state.task_manager.update_job(event.job_id)
         elif isinstance(event, TaskUpdating):
@@ -159,14 +175,62 @@ class QueryStageScheduler(EventAction):
             )
             return
         try:
-            self.state.submit_job(event.job_id, session_ctx, event.plan)
+            outcome = self.state.submit_job(event.job_id, session_ctx, event.plan)
         except BallistaError as e:
             sender.post(JobPlanningFailed(event.job_id, str(e)))
             return
         except Exception as e:  # noqa: BLE001 - planning bugs must fail the job
             sender.post(JobPlanningFailed(event.job_id, f"internal error: {e}"))
             return
+        if outcome == "queued":
+            # admission-managed: the job sits in the queue pre-planning;
+            # the release scan (run now, and again as capacity frees)
+            # plans whichever jobs fair share admits — possibly this one
+            self._admit_released(sender)
+            return
         sender.post(JobSubmitted(event.job_id))
+
+    def _admit_released(self, sender: EventSender) -> None:
+        """Plan + submit every job the admission controller releases at
+        current capacity (deficit-weighted round robin across pools).
+        Runs on the event-loop thread, so queued-job planning keeps the
+        same single-thread discipline as direct submits."""
+        state = self.state
+        for qj in state.admission.release():
+            if state.admission.take_cancel_intent(qj.job_id):
+                # cancel arrived while the job was queued/mid-release:
+                # fail instead of planning (the slot frees immediately)
+                state.admission.job_finished(qj.job_id)
+                state.task_manager.fail_job(
+                    qj.job_id, "job cancelled by user"
+                )
+                continue
+            session_ctx = state.session_manager.get_session(qj.session_id)
+            if session_ctx is None:
+                sender.post(
+                    JobPlanningFailed(
+                        qj.job_id, f"unknown session {qj.session_id}"
+                    )
+                )
+                continue
+            try:
+                state.submit_admitted_job(qj.job_id, session_ctx, qj.plan)
+            except BallistaError as e:
+                sender.post(JobPlanningFailed(qj.job_id, str(e)))
+                continue
+            except Exception as e:  # noqa: BLE001 - planning bugs fail the job
+                sender.post(
+                    JobPlanningFailed(qj.job_id, f"internal error: {e}")
+                )
+                continue
+            sender.post(JobSubmitted(qj.job_id))
+
+    def _on_admission_pulse(self, sender: EventSender) -> None:
+        """Shed overdue queued jobs, then retry the release scan (the
+        1s catch-up for capacity freed outside job events)."""
+        for qj, error in self.state.admission.expire_overdue():
+            self.state.task_manager.fail_job(qj.job_id, error)
+        self._admit_released(sender)
 
     def _on_job_submitted(self, event: JobSubmitted, sender: EventSender) -> None:
         if self.state.policy != TaskSchedulingPolicy.PUSH_STAGED:

@@ -67,6 +67,8 @@ class SchedulerServer:
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
         aqe_force_enabled: bool = False,
+        admission_force_enabled: bool = False,
+        admission_defaults: Optional[Dict[str, str]] = None,
         drain_timeout_s: float = 30.0,
         telemetry_sample_s: float = 5.0,
         event_journal_dir: str = "",
@@ -89,6 +91,8 @@ class SchedulerServer:
             speculation_force_enabled=speculation_force_enabled,
             task_timeout_force_s=task_timeout_force_s,
             aqe_force_enabled=aqe_force_enabled,
+            admission_force_enabled=admission_force_enabled,
+            admission_defaults=admission_defaults,
             event_journal_dir=event_journal_dir,
             event_journal_rotate_bytes=event_journal_rotate_bytes,
             event_journal_segments=event_journal_segments,
@@ -308,13 +312,18 @@ class SchedulerServer:
         """Periodically post a SpeculationScan onto the event loop — the
         straggler/deadline scan itself runs on the event-loop thread, so
         every graph mutation keeps the single-thread discipline.  Idle
-        schedulers (no active jobs) skip the post entirely."""
-        from .query_stage_scheduler import SpeculationScan
+        schedulers (no active jobs) skip the post entirely.  The same
+        timer drives the AdmissionPulse while the admission queue is
+        non-empty (queue-wait expiry + the release catch-up for
+        capacity freed outside job events, e.g. a new executor)."""
+        from .query_stage_scheduler import AdmissionPulse, SpeculationScan
 
         while not self._stop.wait(max(0.05, self.speculation_interval_s)):
             try:
                 if self.state.task_manager.active_job_ids():
                     self.event_loop.get_sender().post(SpeculationScan())
+                if self.state.admission.queued_count():
+                    self.event_loop.get_sender().post(AdmissionPulse())
             except Exception:  # noqa: BLE001 - timer must never die
                 log.exception("speculation timer iteration failed")
 
@@ -343,6 +352,7 @@ class SchedulerServer:
             "active_jobs": len(state.task_manager.active_job_ids()),
             "executors_quarantined": len(em.quarantined_executors()),
             "executors_draining": len(em.draining_executors()),
+            "admission_queued_jobs": state.admission.queued_count(),
             # shuffle backlog: queued-but-unmoved bytes + pending replica
             # uploads summed over the latest executor snapshots
             "shuffle_queue_bytes": sum(
@@ -466,6 +476,12 @@ class SchedulerServer:
         of a fresh handshake per fan-out (reference: grpc.rs CancelJob →
         task_manager.rs:225-303)."""
         running = self.state.task_manager.cancel_job(job_id)
+        if self.state.admission.queued_count():
+            # a cancelled running job freed an admission slot from this
+            # gRPC thread; queued-job release must run on the event loop
+            from .query_stage_scheduler import AdmissionPulse
+
+            self.event_loop.get_sender().post(AdmissionPulse())
         from ..proto.rpc import executor_stub
 
         for meta, pids in running:

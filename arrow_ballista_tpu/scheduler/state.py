@@ -50,6 +50,8 @@ class SchedulerState:
         speculation_force_enabled: bool = False,
         task_timeout_force_s: float = 0.0,
         aqe_force_enabled: bool = False,
+        admission_force_enabled: bool = False,
+        admission_defaults: Optional[Dict[str, str]] = None,
         event_journal_dir: str = "",
         event_journal_rotate_bytes: Optional[int] = None,
         event_journal_segments: Optional[int] = None,
@@ -115,16 +117,38 @@ class SchedulerState:
             registry=self.metrics,
             events=self.events,
         )
+        # scheduler flags seed cluster-wide defaults that an EXPLICIT
+        # session setting still wins over (session settings ship sparse)
+        overrides: Dict[str, str] = dict(admission_defaults or {})
+        if overrides:
+            BallistaConfig(overrides)  # fail fast on a bad operator knob
+        if aqe_force_enabled:
+            overrides["ballista.aqe.enabled"] = "true"
+        if admission_force_enabled:
+            overrides["ballista.admission.enabled"] = "true"
+        # multi-tenant front door (ISSUE 12): the admission queue +
+        # weighted fair release.  Always constructed; it only ever acts
+        # on jobs whose merged config has ballista.admission.enabled, so
+        # the default-off path is byte-identical to a scheduler without
+        # it.  Release/planning of queued jobs runs on the query-stage
+        # event loop (query_stage_scheduler._admit_released).  Any
+        # ballista.admission.* key the operator set is PINNED: cluster
+        # limits then ignore whatever the submitting session says.
+        from .admission import AdmissionController
+
+        self.admission = AdmissionController(
+            self.executor_manager,
+            registry=self.metrics,
+            events=self.events,
+            pinned_settings=overrides,
+        )
         self.task_manager = TaskManager(
             backend, self.executor_manager, scheduler_id, launcher, work_dir,
             registry=self.metrics,
             events=self.events,
             slo=self.slo,
-            # --aqe-enabled seeds the cluster-wide default; an explicit
-            # session ballista.aqe.* setting still wins (A/B toggles)
-            config_overrides=(
-                {"ballista.aqe.enabled": "true"} if aqe_force_enabled else None
-            ),
+            config_overrides=overrides or None,
+            admission=self.admission,
         )
         self.session_manager = SessionManager(backend, session_builder)
         # straggler mitigation: the periodic scan body (invoked on the
@@ -200,7 +224,49 @@ class SchedulerState:
         job_id: str,
         session_ctx: SessionContext,
         plan: lp.LogicalPlan,
+    ) -> str:
+        """The scheduler's front door.  With admission enabled for this
+        job (``ballista.admission.enabled`` — session setting or the
+        ``--admission-enabled`` cluster default) the LOGICAL plan is
+        offered to the admission controller FIRST: a saturated cluster
+        holds the job queued pre-planning (no ExecutionGraph built, no
+        memory pinned — returns ``"queued"``) or sheds it with a
+        structured :class:`ClusterSaturated` raise.  The caller runs the
+        release scan right after, so an uncontended job passes straight
+        through.  Returns ``"submitted"`` once planned + submitted."""
+        cfg = self._admission_config(session_ctx)
+        if cfg.admission_enabled:
+            decision = self.admission.offer(
+                job_id, session_ctx.session_id, plan, cfg
+            )
+            for displaced, error in decision.displaced:
+                # shed_policy=oldest displaced another session's queued
+                # job to make room: fail it with the structured error
+                self.task_manager.fail_job(displaced.job_id, error)
+            if decision.error is not None:
+                raise decision.error
+            return "queued"
+        self.submit_admitted_job(job_id, session_ctx, plan)
+        return "submitted"
+
+    def _admission_config(self, session_ctx: SessionContext) -> BallistaConfig:
+        """Session settings over scheduler-flag defaults — the same
+        merge TaskManager.submit_job applies at planning time."""
+        settings = dict(self.task_manager.config_overrides)
+        config = getattr(session_ctx, "config", None)
+        if config is not None:
+            settings.update(config.to_dict())
+        return BallistaConfig(settings)
+
+    def submit_admitted_job(
+        self,
+        job_id: str,
+        session_ctx: SessionContext,
+        plan: lp.LogicalPlan,
     ) -> None:
+        """Plan + submit one job PAST the admission gate (direct path
+        for admission-off jobs; the event loop's release handler for
+        jobs coming off the queue)."""
         trace_id = self._maybe_start_trace(job_id, session_ctx)
         if trace_id:
             with trace.activate(trace_id), trace.span("job.plan", job=job_id):

@@ -99,10 +99,15 @@ class TaskManager:
         events=None,
         slo=None,
         config_overrides: Optional[Dict[str, str]] = None,
+        admission=None,
     ):
         from ..obs.events import EventJournal
 
         self.backend = backend
+        # multi-tenant admission controller (scheduler/admission.py);
+        # None for bare TaskManagers in tests — every admission touch
+        # point below is a no-op then
+        self.admission = admission
         self.executor_manager = executor_manager
         self.scheduler_id = scheduler_id
         self.launcher = launcher or GrpcLauncher()
@@ -234,8 +239,20 @@ class TaskManager:
                     continue
                 graph.revive()
                 self._persist(graph)
+                self._admission_adopt(graph)
                 out.append(job_id)
         return out
+
+    def _admission_adopt(self, graph: ExecutionGraph) -> None:
+        """Restart/HA adoption: re-register a recovered admission-managed
+        job with the controller so pool concurrency accounting survives
+        (the admission queue itself does NOT survive a restart — queued
+        jobs were never planned or persisted, and their clients' retries
+        re-enter the front door)."""
+        if self.admission is not None and graph.admission_enabled:
+            self.admission.adopt_running(
+                graph.job_id, graph.tenant_pool, graph.tenant_priority
+            )
 
     def take_over_jobs(self, dead_scheduler_id: str) -> List[str]:
         """HA failover: adopt every active job CURATED by a dead peer
@@ -271,6 +288,7 @@ class TaskManager:
                     except Exception:
                         entry.graph = None  # store refused: reload
                         raise
+                    self._admission_adopt(graph)
                     out.append(job_id)
         return out
 
@@ -294,6 +312,13 @@ class TaskManager:
         if self.config_overrides:
             settings = {**self.config_overrides, **settings}
         config = BallistaConfig(settings)
+        if self.admission is not None and self.admission.take_cancel_intent(
+            job_id
+        ):
+            # cancel raced the admission release: the user gave up while
+            # the job sat queued — fail it instead of building a graph
+            self.admission.job_finished(job_id)
+            raise SchedulerError("job cancelled by user while queued")
         graph = ExecutionGraph(
             self.scheduler_id, job_id, session_id, plan, self.work_dir, config
         )
@@ -331,6 +356,13 @@ class TaskManager:
         is polled long after complete_job() evicted it, and a stray entry
         would make active_job_ids() (and the KEDA scaler's inflight metric)
         report the job forever."""
+        if self.admission is not None:
+            # a job held in the admission queue has no graph anywhere:
+            # report QUEUED with its pool + position so clients can tell
+            # a waiting job from a wedged one
+            qs = self.admission.queued_status(job_id)
+            if qs is not None:
+                return qs
         return self._with_graph(job_id, self._status_of)
 
     @staticmethod
@@ -369,6 +401,10 @@ class TaskManager:
         QueriesList row expansion, ``ballista/ui/scheduler/src/components/
         QueriesList.tsx``): stage state machine position, task progress
         and merged operator metrics per stage."""
+        if self.admission is not None:
+            qs = self.admission.queued_status(job_id)
+            if qs is not None:
+                return qs
         return self._with_graph(job_id, self._detail_of)
 
     def _detail_of(self, graph: ExecutionGraph) -> dict:
@@ -696,6 +732,12 @@ class TaskManager:
 
         with self._cache_lock:
             job_ids = list(self._cache.keys())
+        # weighted fair dispatch (scheduler/admission.py): when any
+        # cached job is admission-managed, walk jobs in fair-share order
+        # instead of submit FIFO — interactive lane first, then by the
+        # pool's weighted running-task share.  With no admission-managed
+        # job this returns the list untouched (byte-identical A/B).
+        job_ids = self._admission_order(job_ids)
 
         for job_id in job_ids:
             if not free:
@@ -745,6 +787,67 @@ class TaskManager:
                         del assignments[start:]
                         free = free_before
         return assignments, free + sidelined, pending
+
+    def _admission_order(self, job_ids: List[str]) -> List[str]:
+        """Fair-share walk order for ``fill_reservations``: interactive
+        jobs before batch, then pools with the smallest weighted
+        running-task share first (a freed slot goes to whoever is
+        furthest under their share), submit order as the tie-break.
+        Jobs without admission (or not yet cached) keep their relative
+        submit order, interleaved as weight-1 batch work with zero
+        share.  Returns the input list unchanged when no cached job is
+        admission-managed, so the default-off path stays byte-identical."""
+        if self.admission is None or len(job_ids) < 2:
+            return job_ids
+        rows = []
+        managed = False
+        for i, jid in enumerate(job_ids):
+            with self._cache_lock:
+                entry = self._cache.get(jid)
+            if entry is None:
+                rows.append((jid, i, None, 0))
+                continue
+            # one read of everything under the entry lock: the graph can
+            # be evicted (entry.graph = None) by a concurrent failover
+            # or persist failure between unlocked reads
+            with entry.lock:
+                graph = entry.graph
+                if (
+                    graph is None
+                    or not getattr(graph, "admission_enabled", False)
+                    or graph.status in (COMPLETED, FAILED)
+                ):
+                    rows.append((jid, i, None, 0))
+                    continue
+                managed = True
+                rows.append(
+                    (
+                        jid,
+                        i,
+                        (graph.tenant_pool, graph.tenant_priority),
+                        graph.running_tasks(),
+                    )
+                )
+        if not managed:
+            return job_ids
+        pool_running: Dict[str, int] = {}
+        for _jid, _i, info, running in rows:
+            if info is not None:
+                pool_running[info[0]] = pool_running.get(info[0], 0) + running
+        weights = self.admission.pool_weights()
+
+        def key(row):
+            _jid, i, info, _running = row
+            if info is None:
+                return (1, 0.0, i)
+            pool, priority = info
+            share = pool_running.get(pool, 0) / max(
+                weights.get(pool, 1.0), 1e-3
+            )
+            return (0 if priority == "interactive" else 1, share, i)
+
+        rows.sort(key=key)
+        return [row[0] for row in rows]
 
     def prepare_task_definition(self, task: Task) -> pb.TaskDefinition:
         td = pb.TaskDefinition()
@@ -856,7 +959,16 @@ class TaskManager:
             stages=len(graph.stages),
         )
 
+    def _admission_finished(self, job_id: str) -> None:
+        """Free the job's admission concurrency slot on any terminal
+        transition (no-op for jobs admission never tracked).  The
+        event-loop handler that drove the transition runs the release
+        scan right after, so freed capacity admits queued jobs."""
+        if self.admission is not None:
+            self.admission.job_finished(job_id)
+
     def complete_job(self, job_id: str) -> None:
+        self._admission_finished(job_id)
         entry = self._entry(job_id)
         with entry.lock:
             graph = self._load(job_id, entry)
@@ -902,6 +1014,7 @@ class TaskManager:
         )
 
     def fail_job(self, job_id: str, error: str) -> None:
+        self._admission_finished(job_id)
         entry = self._entry(job_id)
         with entry.lock:
             graph = self._load(job_id, entry)
@@ -950,12 +1063,45 @@ class TaskManager:
 
     def cancel_job(self, job_id: str) -> List[Tuple[ExecutorMetadata, List[PartitionId]]]:
         """Fail the job; return the running tasks per executor so the caller
-        can issue CancelTasks RPCs (reference: task_manager.rs:225-303)."""
+        can issue CancelTasks RPCs (reference: task_manager.rs:225-303).
+
+        A job still sitting in the ADMISSION queue has no graph and no
+        running tasks: cancelling it dequeues it (it will never plan)
+        and journals ``job_cancelled``.  A cancel racing the admit
+        window (released from the queue but no graph cached yet) leaves
+        a bounded cancel intent the submit path consumes — the job fails
+        instead of running either way."""
+        if self.admission is not None:
+            qj = self.admission.cancel(job_id)
+            if qj is not None:
+                self.events.emit(
+                    "job_cancelled",
+                    job=job_id,
+                    pool=qj.pool,
+                    queued=True,
+                    queue_wait_s=round(
+                        time.monotonic() - qj.enqueued_mono, 4
+                    ),
+                )
+                self.fail_job(job_id, "job cancelled by user")
+                return []
         entry = self._entry(job_id)
         running: Dict[str, List[PartitionId]] = {}
         with entry.lock:
             graph = self._load(job_id, entry)
             if graph is None:
+                if self.admission is not None and not any(
+                    self.backend.get(ks, job_id) is not None
+                    for ks in (
+                        Keyspace.ActiveJobs,
+                        Keyspace.CompletedJobs,
+                        Keyspace.FailedJobs,
+                    )
+                ):
+                    # nothing queued, nothing persisted: the job is in
+                    # the release→plan window (or the id is bogus) —
+                    # the intent makes the submit path fail it
+                    self.admission.mark_cancel_intent(job_id)
                 return []
             from .execution_stage import RunningStage
 
@@ -971,6 +1117,7 @@ class TaskManager:
                         running.setdefault(si.executor_id, []).append(
                             si.partition_id
                         )
+        self.events.emit("job_cancelled", job=job_id, queued=False)
         self.fail_job(job_id, "job cancelled by user")
         out = []
         for eid, pids in running.items():
@@ -1065,6 +1212,10 @@ class TaskManager:
         the scheduler UI's job dashboard)."""
         out: List[dict] = []
         seen: set = set()
+        if self.admission is not None:
+            for row in self.admission.queued_jobs_brief():
+                out.append({**row, "state": "queued"})
+                seen.add(row["job_id"])
         for job_id in self.active_job_ids():
             st = self.get_job_status(job_id)
             if st is not None:

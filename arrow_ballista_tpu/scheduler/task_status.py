@@ -103,6 +103,14 @@ def job_status_to_proto(status: dict) -> pb.JobStatus:
     state = status.get("state")
     if state == "queued":
         msg.queued.SetInParent()
+        # admission-queue coordinates (scheduler/admission.py): the
+        # client poll loop distinguishes queued wait from running time
+        if status.get("queue_position"):
+            msg.queued.queue_position = int(status["queue_position"])
+        if status.get("pool"):
+            msg.queued.pool = status["pool"]
+        if status.get("queued_seconds"):
+            msg.queued.queued_seconds = float(status["queued_seconds"])
     elif state == "running":
         msg.running.SetInParent()
     elif state == "failed":
@@ -129,7 +137,40 @@ def job_status_from_proto(msg: pb.JobStatus) -> dict:
                 for p in msg.completed.partition_location
             ],
         }
+    if which == "queued":
+        out = {"state": "queued"}
+        if msg.queued.queue_position:
+            out["queue_position"] = msg.queued.queue_position
+        if msg.queued.pool:
+            out["pool"] = msg.queued.pool
+        if msg.queued.queued_seconds:
+            out["queued_seconds"] = msg.queued.queued_seconds
+        return out
     return {"state": which or "queued"}
+
+
+def poll_timeout_breakdown(
+    start_mono: float, running_since_mono, last_queued: dict
+) -> str:
+    """``(spent Xs queued in pool 'p' (last position n) and Ys
+    running)`` — shared by the client poll loop and the FlightSQL
+    front-end so an admission-starved job reads differently from a
+    wedged one in both timeout messages."""
+    import time
+
+    now = time.monotonic()
+    queued_s = (
+        running_since_mono if running_since_mono is not None else now
+    ) - start_mono
+    running_s = (
+        now - running_since_mono if running_since_mono is not None else 0.0
+    )
+    msg = f" (spent {queued_s:.1f}s queued"
+    if last_queued.get("pool"):
+        msg += f" in pool {last_queued['pool']!r}"
+    if last_queued.get("queue_position"):
+        msg += f" (last position {last_queued['queue_position']})"
+    return msg + f" and {running_s:.1f}s running)"
 
 
 def collect_plan_metrics(plan) -> List[tuple]:

@@ -33,8 +33,17 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   (ballista.tpu.device_encode knob) and the gid-table GroupTable route,
   on identical inputs with a sha row-fingerprint identity check
 
+  plus the multi-tenant concurrency leg
+  (concurrent_interactive_p99_s / concurrent_weighted_throughput_ratio
+  / concurrent_shed_jobs): N open-loop clients of mixed priority
+  against one standalone cluster at >=4x slot oversubscription,
+  admission control A/B'd via ballista.admission.enabled — interactive
+  p99 with priority lanes vs the FIFO free-for-all, two tenants at
+  weights 2:1 vs the 2:1 completed-throughput target, and a burst past
+  max_queued_jobs shedding with structured ClusterSaturated errors
+
 Usage: python bench_suite.py
-[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|all]
+[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|all]
 (default all)
 """
 
@@ -706,6 +715,19 @@ def bench_keyed() -> None:
     )
 
 
+def bench_concurrent() -> None:
+    """Concurrency leg (ISSUE 12): N open-loop clients of mixed
+    priority against one standalone cluster at >=4x slot
+    oversubscription — admission-on vs admission-off interactive p99,
+    two tenants at weights 2:1 vs the 2:1 completed-throughput target,
+    and a burst past max_queued_jobs shedding with structured
+    ClusterSaturated errors while every admitted job completes."""
+    from benchmarks.concurrent_clients import run_concurrency_bench
+
+    for rec in run_concurrency_bench():
+        _emit(rec)
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if os.path.exists(OUT_PATH) and which == "all":
@@ -736,6 +758,8 @@ def main() -> None:
         bench_aqe()
     if which in ("keyed", "all"):
         bench_keyed()
+    if which in ("concurrent", "all"):
+        bench_concurrent()
 
 
 if __name__ == "__main__":

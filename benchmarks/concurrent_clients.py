@@ -1,0 +1,569 @@
+"""Concurrency benchmark: N clients of mixed priority against one
+standalone cluster (ISSUE 12 — the bench leg of multi-tenant admission).
+
+Everything the suite measured before ran one job at a time; "millions of
+users" means many concurrent queries contending for the same slots, the
+way the Flight benchmarking literature measures many parallel DoGets
+against one data plane.  Three legs, all over the real gRPC/Flight wire:
+
+* **latency** — a closed-loop batch herd keeping the cluster at >=4x
+  slot oversubscription plus an open-loop interactive trickle
+  (submission clock independent of completions), measured A/B with
+  admission off (FIFO free-for-all) vs on (priority lanes + fair
+  release).  Reports p50/p99 job latency per lane, scheduler
+  event-loop throughput, failures.  Acceptance: admission-on
+  interactive p99 <= 0.5x the admission-off p99 (or admission-off
+  failed jobs where admission-on completed them).
+* **weighted** — two tenants with weights 2:1, closed-loop saturation;
+  completed-job throughput must land within 25% of the 2:1 target.
+* **shed** — a burst far past ``max_queued_jobs``: the overflow sheds
+  with structured ClusterSaturated errors while every admitted job
+  completes — zero non-shed failures.
+
+``run_admission_smoke()`` is the tiny-N CI variant wired into
+``dev/tier1.sh --bench-smoke``: saturate 2 slots with 6 jobs from two
+weighted pools and assert fair-share ordering, zero failures and
+``job_queued`` journal events.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BASE_SETTINGS = {
+    "ballista.tpu.enable": "false",
+    "ballista.shuffle.partitions": "2",
+    "ballista.client.job_timeout_seconds": "240",
+}
+
+# the batch shape is deliberately heavy (high-cardinality group by,
+# several aggregates): service time must dominate scheduling overhead
+# or the queue never forms and there is nothing to arbitrate
+BATCH_SQL = (
+    "select g, sum(v) as s, count(v) as c, min(w) as mn, max(w) as mx, "
+    "avg(v) as av from big group by g"
+)
+INTERACTIVE_SQL = "select g, sum(v) as s from small group by g"
+# the weighted leg wants MANY completions (the 2:1 ratio is measured in
+# whole jobs), so it runs a lighter single-aggregate shape
+WEIGHTED_SQL = "select g, sum(v) as s from big group by g"
+
+
+def _gen_data(root: str, batch_rows: int, interactive_rows: int) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    for name, rows in (("big", batch_rows), ("small", interactive_rows)):
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        n_parts = 2
+        per = rows // n_parts
+        cardinality = max(2, min(500_000, rows // 3))
+        for i in range(n_parts):
+            tbl = pa.table(
+                {
+                    "g": pa.array(
+                        rng.integers(0, cardinality, size=per), pa.int64()
+                    ),
+                    "v": pa.array(rng.random(per), pa.float64()),
+                    "w": pa.array(rng.random(per), pa.float64()),
+                }
+            )
+            pq.write_table(tbl, os.path.join(d, f"part-{i}.parquet"))
+
+
+def _make_cluster(slots: int, journal_dir: str = ""):
+    from arrow_ballista_tpu.client import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+
+    return BallistaContext.standalone(
+        config=BallistaConfig(dict(BASE_SETTINGS)),
+        num_executors=1,
+        concurrent_tasks=slots,
+        event_journal_dir=journal_dir,
+    )
+
+
+def _remote(primary, settings: Dict[str, str], data_dir: str):
+    """A fresh client session against the primary's scheduler, with the
+    bench tables registered client-side."""
+    from arrow_ballista_tpu.client import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+
+    ctx = BallistaContext.remote(
+        primary.host, primary.port,
+        BallistaConfig({**BASE_SETTINGS, **settings}),
+    )
+    ctx.register_parquet("big", os.path.join(data_dir, "big"))
+    ctx.register_parquet("small", os.path.join(data_dir, "small"))
+    return ctx
+
+
+class _LaneResults:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: Dict[str, List[float]] = {}
+        self.failures: Dict[str, List[str]] = {}
+
+    def record(self, lane: str, latency_s: float, error: Optional[str]):
+        with self.lock:
+            if error is None:
+                self.latencies.setdefault(lane, []).append(latency_s)
+            else:
+                self.failures.setdefault(lane, []).append(error)
+
+    def pct(self, lane: str, q: float) -> float:
+        vals = sorted(self.latencies.get(lane, []))
+        if not vals:
+            return float("nan")
+        idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+        return vals[idx]
+
+
+def _submit_closed_loop(
+    ctx, sql: str, lane: str, results: _LaneResults, duration_s: float,
+    timeout_s: float,
+) -> int:
+    """One closed-loop client: submit, wait, repeat — keeps exactly one
+    job in flight, the standard sustained-background-load generator."""
+    plan = ctx.sql(sql).logical_plan()
+    t_end = time.monotonic() + duration_s
+    n = 0
+    while time.monotonic() < t_end:
+        t0 = time.monotonic()
+        try:
+            job_id = ctx.execute_logical_plan(plan)
+            ctx.wait_for_job(job_id, timeout_s=timeout_s)
+            results.record(lane, time.monotonic() - t0, None)
+            n += 1
+        except Exception as e:  # noqa: BLE001
+            results.record(lane, time.monotonic() - t0, str(e))
+    return n
+
+
+def _submit_open_loop(
+    ctx, sql: str, lane: str, results: _LaneResults,
+    interval_s: float, duration_s: float, waiters: List[threading.Thread],
+    timeout_s: float,
+) -> int:
+    """One open-loop client: submit on a fixed clock regardless of
+    completions; a waiter thread per job observes its terminal state so
+    latency is measured at completion, not at collection time."""
+    plan = ctx.sql(sql).logical_plan()
+    t_end = time.monotonic() + duration_s
+    n = 0
+    while True:
+        tick = time.monotonic()
+        if tick >= t_end:
+            break
+        t0 = time.monotonic()
+        try:
+            job_id = ctx.execute_logical_plan(plan)
+        except Exception as e:  # noqa: BLE001 - submission refused counts too
+            results.record(lane, time.monotonic() - t0, f"submit: {e}")
+            job_id = None
+        if job_id:
+            n += 1
+
+            def wait(job_id=job_id, t0=t0):
+                try:
+                    ctx.wait_for_job(job_id, timeout_s=timeout_s)
+                    results.record(lane, time.monotonic() - t0, None)
+                except Exception as e:  # noqa: BLE001
+                    results.record(lane, time.monotonic() - t0, str(e))
+
+            w = threading.Thread(target=wait, daemon=True)
+            w.start()
+            waiters.append(w)
+        sleep = interval_s - (time.monotonic() - tick)
+        if sleep > 0:
+            time.sleep(sleep)
+    return n
+
+
+def _event_loop_stats(primary) -> Dict[str, float]:
+    server = primary._standalone_handles[0].server
+    snap = server.state.metrics.snapshot()
+    hist = snap.get("scheduler_event_handle_seconds") or {}
+    return {
+        "events_total": float(snap.get("scheduler_events_total", 0)),
+        "handle_sum_s": float(hist.get("sum", 0.0)),
+        "handle_count": float(hist.get("count", 0)),
+    }
+
+
+def _run_latency_leg(
+    admission: bool,
+    slots: int,
+    batch_clients: int,
+    interactive_clients: int,
+    duration_s: float,
+    interactive_interval_s: float,
+    data_dir: str,
+) -> dict:
+    primary = _make_cluster(slots)
+    try:
+        adm = {"ballista.admission.enabled": "true"} if admission else {}
+        batch_ctxs = [
+            _remote(primary, {**adm, "ballista.tenant.id": "batch"}, data_dir)
+            for _ in range(batch_clients)
+        ]
+        inter_ctxs = [
+            _remote(
+                primary,
+                {
+                    **adm,
+                    "ballista.tenant.id": "interactive",
+                    **(
+                        {"ballista.tenant.priority": "interactive"}
+                        if admission
+                        else {}
+                    ),
+                },
+                data_dir,
+            )
+            for _ in range(interactive_clients)
+        ]
+        results = _LaneResults()
+        waiters: List[threading.Thread] = []
+        ev0 = _event_loop_stats(primary)
+        t0 = time.monotonic()
+        # batch: closed-loop herd (one job each always in flight —
+        # sustained oversubscription); interactive: open-loop trickle
+        # (arrival clock independent of completions)
+        clients = [
+            threading.Thread(
+                target=_submit_closed_loop,
+                args=(ctx, BATCH_SQL, "batch", results, duration_s, 240.0),
+            )
+            for ctx in batch_ctxs
+        ] + [
+            threading.Thread(
+                target=_submit_open_loop,
+                args=(ctx, INTERACTIVE_SQL, "interactive", results,
+                      interactive_interval_s, duration_s, waiters, 240.0),
+            )
+            for ctx in inter_ctxs
+        ]
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join()
+        for w in list(waiters):
+            w.join(300)
+        wall = time.monotonic() - t0
+        ev1 = _event_loop_stats(primary)
+        events = ev1["events_total"] - ev0["events_total"]
+        out = {
+            "admission": admission,
+            "wall_s": round(wall, 2),
+            "scheduler_events_per_sec": round(events / max(wall, 1e-9), 1),
+            "failures": {
+                lane: len(errs) for lane, errs in results.failures.items()
+            },
+        }
+        for lane in ("interactive", "batch"):
+            out[f"{lane}_jobs"] = len(results.latencies.get(lane, []))
+            out[f"{lane}_p50_s"] = round(results.pct(lane, 0.50), 3)
+            out[f"{lane}_p99_s"] = round(results.pct(lane, 0.99), 3)
+        for ctx in batch_ctxs + inter_ctxs:
+            ctx._standalone_handles = None  # only the primary owns the cluster
+            ctx.close()
+        return out
+    finally:
+        primary.close()
+
+
+def run_latency_ab(
+    slots: int = 2,
+    batch_clients: int = 8,
+    interactive_clients: int = 2,
+    duration_s: float = 12.0,
+    data_dir: Optional[str] = None,
+    batch_rows: int = 1_500_000,
+    interactive_rows: int = 2_000,
+) -> dict:
+    """The A/B latency leg at >= 4x slot oversubscription (default:
+    10 clients against 2 slots — 8 closed-loop batch + 2 open-loop
+    interactive)."""
+    own = data_dir is None
+    if own:
+        data_dir = tempfile.mkdtemp(prefix="abt-conc-")
+        _gen_data(data_dir, batch_rows, interactive_rows)
+    kw = dict(
+        slots=slots,
+        batch_clients=batch_clients,
+        interactive_clients=interactive_clients,
+        duration_s=duration_s,
+        interactive_interval_s=1.0,
+        data_dir=data_dir,
+    )
+    off = _run_latency_leg(admission=False, **kw)
+    on = _run_latency_leg(admission=True, **kw)
+    off_p99 = off["interactive_p99_s"]
+    on_p99 = on["interactive_p99_s"]
+    off_failed = sum(off["failures"].values())
+    on_failed = sum(on["failures"].values())
+    accepted = bool(
+        (on_p99 == on_p99 and off_p99 == off_p99 and on_p99 <= 0.5 * off_p99)
+        or (off_failed > 0 and on_failed == 0)
+    )
+    return {
+        "metric": "concurrent_interactive_p99_s",
+        "value": on_p99,
+        "unit": "s",
+        "vs_baseline": round(off_p99 / on_p99, 3) if on_p99 else None,
+        "oversubscription_x": round(
+            (batch_clients + interactive_clients) / slots, 1
+        ),
+        "admission_on": on,
+        "admission_off": off,
+        "accepted": accepted,
+    }
+
+
+def run_weighted_leg(
+    slots: int = 2,
+    workers_per_pool: int = 4,
+    duration_s: float = 12.0,
+    data_dir: Optional[str] = None,
+) -> dict:
+    """Two tenants, weights 2:1, closed-loop saturation: completed-job
+    throughput must land within 25% of the 2:1 target.  The admission
+    gate is pinned to one running job so completions track the
+    deficit-weighted release order exactly (enough workers per pool
+    keep both queues non-empty throughout)."""
+    own = data_dir is None
+    if own:
+        data_dir = tempfile.mkdtemp(prefix="abt-conc-")
+        _gen_data(data_dir, 60_000, 2_000)
+    primary = _make_cluster(slots)
+    try:
+        completed = {"a": 0, "b": 0}
+        lock = threading.Lock()
+        stop = time.monotonic() + duration_s
+
+        def worker(pool: str, weight: str):
+            ctx = _remote(
+                primary,
+                {
+                    "ballista.admission.enabled": "true",
+                    "ballista.admission.max_running_jobs": "1",
+                    "ballista.tenant.id": pool,
+                    "ballista.tenant.weight": weight,
+                },
+                data_dir,
+            )
+            plan = ctx.sql(WEIGHTED_SQL).logical_plan()
+            while time.monotonic() < stop:
+                try:
+                    job_id = ctx.execute_logical_plan(plan)
+                    ctx.wait_for_job(job_id, timeout_s=240)
+                except Exception:  # noqa: BLE001 - counted as non-completion
+                    continue
+                with lock:
+                    completed[pool] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(pool, weight))
+            for pool, weight in (("a", "2"), ("b", "1"))
+            for _ in range(workers_per_pool)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        a, b = completed["a"], completed["b"]
+        ratio = a / b if b else float("inf")
+        return {
+            "metric": "concurrent_weighted_throughput_ratio",
+            "value": round(ratio, 3),
+            "unit": "a:b completions (weights 2:1)",
+            "completed_a": a,
+            "completed_b": b,
+            "target": 2.0,
+            # within 25% of the 2:1 target
+            "accepted": bool(b and 1.5 <= ratio <= 2.5),
+        }
+    finally:
+        primary.close()
+
+
+def run_shed_leg(
+    slots: int = 2,
+    burst: int = 12,
+    max_queued: int = 3,
+    data_dir: Optional[str] = None,
+) -> dict:
+    """Burst far past max_queued_jobs: the overflow sheds with
+    structured ClusterSaturated errors, every admitted job completes,
+    zero non-shed failures."""
+    own = data_dir is None
+    if own:
+        data_dir = tempfile.mkdtemp(prefix="abt-conc-")
+        _gen_data(data_dir, 60_000, 2_000)
+    primary = _make_cluster(slots)
+    try:
+        ctx = _remote(
+            primary,
+            {
+                "ballista.admission.enabled": "true",
+                "ballista.admission.max_running_jobs": "1",
+                "ballista.admission.max_queued_jobs": str(max_queued),
+            },
+            data_dir,
+        )
+        plan = ctx.sql(BATCH_SQL).logical_plan()
+        outcomes: List[str] = []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                job_id = ctx.execute_logical_plan(plan)
+                ctx.wait_for_job(job_id, timeout_s=240)
+                result = "completed"
+            except Exception as e:  # noqa: BLE001
+                result = (
+                    "shed" if "ClusterSaturated" in str(e) else f"failed: {e}"
+                )
+            with lock:
+                outcomes.append(result)
+
+        threads = [threading.Thread(target=one) for _ in range(burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        done = outcomes.count("completed")
+        shed = outcomes.count("shed")
+        other = [o for o in outcomes if o not in ("completed", "shed")]
+        return {
+            "metric": "concurrent_shed_jobs",
+            "value": shed,
+            "unit": "jobs shed of %d burst" % burst,
+            "completed": done,
+            "non_shed_failures": len(other),
+            "non_shed_failure_samples": other[:3],
+            # graceful degradation: overflow sheds, admitted work all
+            # completes, nothing fails for any other reason
+            "accepted": bool(shed > 0 and done > 0 and not other),
+        }
+    finally:
+        primary.close()
+
+
+def run_concurrency_bench(**kw) -> List[dict]:
+    """All three legs on one shared data set (the bench_suite entry)."""
+    data_dir = tempfile.mkdtemp(prefix="abt-conc-")
+    _gen_data(
+        data_dir,
+        int(os.environ.get("BENCH_CONC_BATCH_ROWS", "1500000")),
+        int(os.environ.get("BENCH_CONC_INTERACTIVE_ROWS", "2000")),
+    )
+    duration = float(os.environ.get("BENCH_CONC_DURATION_S", "12"))
+    return [
+        run_latency_ab(duration_s=duration, data_dir=data_dir, **kw),
+        run_weighted_leg(duration_s=duration, data_dir=data_dir),
+        run_shed_leg(data_dir=data_dir),
+    ]
+
+
+def run_admission_smoke() -> dict:
+    """Tiny-N CI smoke (dev/tier1.sh --bench-smoke): saturate 2 slots
+    with 6 jobs from two weighted pools; assert fair-share ordering,
+    zero failures and job_queued journal events."""
+    data_dir = tempfile.mkdtemp(prefix="abt-adm-smoke-")
+    _gen_data(data_dir, 24_000, 2_000)
+    journal_dir = tempfile.mkdtemp(prefix="abt-adm-smoke-journal-")
+    primary = _make_cluster(slots=2, journal_dir=journal_dir)
+    try:
+        ctx_a = _remote(
+            primary,
+            {
+                "ballista.admission.enabled": "true",
+                "ballista.admission.max_running_jobs": "1",
+                "ballista.tenant.id": "a",
+                "ballista.tenant.weight": "2",
+            },
+            data_dir,
+        )
+        ctx_b = _remote(
+            primary,
+            {
+                "ballista.admission.enabled": "true",
+                "ballista.admission.max_running_jobs": "1",
+                "ballista.tenant.id": "b",
+                "ballista.tenant.weight": "1",
+            },
+            data_dir,
+        )
+        outcomes: List[str] = []
+        lock = threading.Lock()
+
+        def one(ctx):
+            plan = ctx.sql(BATCH_SQL).logical_plan()
+            try:
+                job_id = ctx.execute_logical_plan(plan)
+                ctx.wait_for_job(job_id, timeout_s=240)
+                result = "completed"
+            except Exception as e:  # noqa: BLE001
+                result = f"failed: {e}"
+            with lock:
+                outcomes.append(result)
+
+        threads = [
+            threading.Thread(target=one, args=(ctx,))
+            for ctx in ([ctx_a] * 4 + [ctx_b] * 2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert outcomes.count("completed") == 6, outcomes
+        journal = primary._standalone_handles[0].server.state.events
+        queued = journal.tail(1000, kind="job_queued")
+        admitted = journal.tail(1000, kind="job_admitted")
+        # max_running_jobs=1: at least 5 of the 6 burst jobs queued
+        assert len(queued) >= 5, queued
+        assert len(admitted) == len(queued), (queued, admitted)
+        by_pool = {"a": 0, "b": 0}
+        for e in admitted:
+            by_pool[e["pool"]] = by_pool.get(e["pool"], 0) + 1
+        # fair share: every submitted job of both pools was admitted,
+        # and the weight-1 pool was not starved behind the weight-2
+        # pool's whole backlog (DRR interleaves it into the first three
+        # releases whenever both pools had work queued)
+        assert by_pool["a"] == 4 and by_pool["b"] == 2, admitted
+        first_b = next(
+            i for i, e in enumerate(admitted) if e["pool"] == "b"
+        )
+        assert first_b <= 3, [e["pool"] for e in admitted]
+        snapshot = primary._standalone_handles[0].server.state.admission.snapshot()
+        return {
+            "jobs": 6,
+            "completed": outcomes.count("completed"),
+            "queued_events": len(queued),
+            "admitted_by_pool": by_pool,
+            "first_b_admission_index": first_b,
+            "pools": sorted(snapshot["pools"]),
+        }
+    finally:
+        primary.close()
+
+
+if __name__ == "__main__":
+    import json
+
+    for rec in run_concurrency_bench():
+        print(json.dumps(rec))

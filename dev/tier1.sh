@@ -80,6 +80,17 @@ print(json.dumps({"bench_smoke": "keyed_path", **run_keyed_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.concurrent_clients import run_admission_smoke
+
+# admission smoke: saturate 2 slots with 6 jobs from two weighted pools
+# over the real wire — fair-share release order, zero failures, and
+# job_queued/job_admitted journal events asserted inside
+print(json.dumps({"bench_smoke": "admission", **run_admission_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi

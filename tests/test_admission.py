@@ -1,0 +1,920 @@
+"""Multi-tenant admission control tests (ISSUE 12).
+
+Unit-level: the AdmissionController's queue discipline in isolation —
+deficit-weighted round robin across pools, bounded interactive bypass,
+per-pool caps, shed policies, queue-wait expiry, cancellation races.
+
+State-level: the full scheduler event loop with a NoopLauncher and a
+hand-driven fake executor (the test_scheduler_state.py pattern): jobs
+queue pre-planning, release by fair share as capacity frees, surface
+QUEUED status with queue position, journal their lifecycle, and shed
+with the structured ClusterSaturated error.  Plus the satellite
+regressions: cancel-before-admit / cancel-race-with-admit, the
+concurrent-submit reconciliation hammer, and the default-off A/B
+(admission disabled leaves dispatch order untouched).
+"""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.errors import ClusterSaturated, SchedulerError
+from arrow_ballista_tpu.obs.events import EventJournal
+from arrow_ballista_tpu.scheduler.admission import AdmissionController
+from arrow_ballista_tpu.scheduler.backend import Keyspace, MemoryBackend
+from arrow_ballista_tpu.scheduler.event_loop import EventLoop
+from arrow_ballista_tpu.scheduler.execution_stage import TaskInfo
+from arrow_ballista_tpu.scheduler.query_stage_scheduler import (
+    AdmissionPulse,
+    JobQueued,
+    QueryStageScheduler,
+    TaskUpdating,
+)
+from arrow_ballista_tpu.scheduler.state import SchedulerState
+from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ExecutorSpecification,
+    ShuffleWritePartition,
+)
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052, ExecutorSpecification(4))
+
+
+class FakeExecutorManager:
+    """Just enough surface for the controller's slot-derived capacity."""
+
+    def __init__(self, slots: int = 2):
+        self.slots = slots
+
+    def get_alive_executors(self):
+        return {"e1"}
+
+    def executors(self):
+        return [
+            ExecutorMetadata(
+                "e1", "h", 1, 2, ExecutorSpecification(self.slots)
+            )
+        ]
+
+
+def _cfg(**settings) -> BallistaConfig:
+    base = {"ballista.admission.enabled": "true"}
+    base.update({k: str(v) for k, v in settings.items()})
+    return BallistaConfig(base)
+
+
+def _controller(slots: int = 2, **kw) -> AdmissionController:
+    return AdmissionController(FakeExecutorManager(slots), **kw)
+
+
+# ------------------------------------------------------------------ unit
+def test_offer_queues_and_release_admits_to_capacity():
+    adm = _controller(slots=2)
+    cfg = _cfg()
+    for i in range(5):
+        d = adm.offer(f"j{i}", "s", object(), cfg)
+        assert d.queued and d.error is None
+    released = adm.release()
+    # derived capacity = 2 slots -> 2 admitted, 3 still queued
+    assert [q.job_id for q in released] == ["j0", "j1"]
+    assert adm.queued_count() == 3
+    assert adm.release() == []  # no capacity freed
+    assert adm.job_finished("j0")
+    assert [q.job_id for q in adm.release()] == ["j2"]
+    # status of a queued job carries pool + 1-based position
+    st = adm.queued_status("j4")
+    assert st["state"] == "queued"
+    assert st["pool"] == "default"
+    assert st["queue_position"] == 2
+    assert adm.queued_status("j2") is None  # released jobs left the queue
+
+
+def test_weighted_release_is_deficit_round_robin_2_to_1():
+    adm = _controller()
+    cfg_a = _cfg(**{"ballista.tenant.id": "a", "ballista.tenant.weight": "2",
+                    "ballista.admission.max_running_jobs": "1",
+                    "ballista.admission.max_queued_jobs": "100"})
+    cfg_b = _cfg(**{"ballista.tenant.id": "b", "ballista.tenant.weight": "1",
+                    "ballista.admission.max_running_jobs": "1",
+                    "ballista.admission.max_queued_jobs": "100"})
+    for i in range(30):
+        adm.offer(f"a{i}", "sa", object(), cfg_a)
+        adm.offer(f"b{i}", "sb", object(), cfg_b)
+    # occupy the single running slot, then release one at a time
+    order = []
+    first = adm.release()
+    assert len(first) == 1
+    order.extend(q.pool for q in first)
+    for _ in range(29):
+        # free the slot held by the last admitted job
+        adm.job_finished(_last_running(adm))
+        got = adm.release()
+        assert len(got) == 1
+        order.append(got[0].pool)
+    a, b = order.count("a"), order.count("b")
+    # 30 admissions at weights 2:1 -> 20/10 exactly under DRR
+    assert (a, b) == (20, 10), order
+
+
+def _last_running(adm: AdmissionController) -> str:
+    with adm._lock:
+        return next(reversed(adm._running))
+
+
+def test_interactive_jumps_batch_with_bounded_bypass():
+    adm = _controller()
+    common = {
+        "ballista.admission.max_running_jobs": "1",
+        "ballista.admission.max_interactive_bypass": "2",
+        # pure lane alternation: no express-lane overshoot in this test
+        "ballista.admission.interactive_headroom": "0",
+    }
+    cfg_batch = _cfg(**common)
+    cfg_inter = _cfg(**{**common, "ballista.tenant.priority": "interactive"})
+    adm.offer("hold", "s", object(), cfg_batch)
+    assert [q.job_id for q in adm.release()] == ["hold"]
+    for i in range(4):
+        adm.offer(f"b{i}", "s", object(), cfg_batch)
+    for i in range(6):
+        adm.offer(f"i{i}", "s", object(), cfg_inter)
+    order = []
+    for _ in range(10):
+        adm.job_finished(_last_running(adm))
+        got = adm.release()
+        assert len(got) == 1
+        order.append(got[0].job_id)
+    # interactive jumps ahead, but after 2 consecutive bypasses the
+    # batch head must go: i i b i i b ... -> batch is delayed, never
+    # starved, and every batch job still runs
+    assert order[:3] == ["i0", "i1", "b0"]
+    assert order[3:6] == ["i2", "i3", "b1"]
+    assert set(order) == {f"b{i}" for i in range(4)} | {f"i{i}" for i in range(6)}
+
+
+def test_interactive_headroom_express_lane():
+    """A short interactive job must not wait a long batch job's
+    completion: with the base capacity full, interactive admits through
+    the bounded headroom while batch stays queued."""
+    adm = _controller()
+    common = {"ballista.admission.max_running_jobs": "1",
+              "ballista.admission.interactive_headroom": "2"}
+    cfg_batch = _cfg(**common)
+    cfg_inter = _cfg(**{**common, "ballista.tenant.priority": "interactive"})
+    adm.offer("long-batch", "s", object(), cfg_batch)
+    assert [q.job_id for q in adm.release()] == ["long-batch"]
+    adm.offer("b1", "s", object(), cfg_batch)
+    adm.offer("i1", "s", object(), cfg_inter)
+    adm.offer("i2", "s", object(), cfg_inter)
+    adm.offer("i3", "s", object(), cfg_inter)
+    released = [q.job_id for q in adm.release()]
+    # base cap (1) is full: interactive overshoots by the headroom (2),
+    # batch waits, the third interactive waits too (headroom exhausted)
+    assert released == ["i1", "i2"]
+    assert adm.queued_status("b1")["state"] == "queued"
+    assert adm.queued_status("i3")["state"] == "queued"
+    # a finished interactive job replenishes the headroom
+    adm.job_finished("i1")
+    assert [q.job_id for q in adm.release()] == ["i3"]
+    # only once the base capacity frees does batch admit
+    adm.job_finished("i2")
+    adm.job_finished("i3")
+    adm.job_finished("long-batch")
+    assert [q.job_id for q in adm.release()] == ["b1"]
+
+
+def test_headroom_admissions_preserve_the_bypass_streak():
+    """Review regression: a headroom-funded interactive admission must
+    neither count as a bypass nor FORGIVE past bypasses while batch
+    still waits — otherwise steady interactive traffic resets the
+    counter forever and batch starves despite the bound."""
+    adm = _controller()
+    common = {"ballista.admission.max_running_jobs": "1",
+              "ballista.admission.max_interactive_bypass": "1",
+              "ballista.admission.interactive_headroom": "1"}
+    cfg_batch = _cfg(**common)
+    cfg_inter = _cfg(**{**common, "ballista.tenant.priority": "interactive"})
+    adm.offer("base", "s", object(), cfg_batch)
+    assert [q.job_id for q in adm.release()] == ["base"]
+    adm.offer("b1", "s", object(), cfg_batch)
+    adm.offer("i1", "s", object(), cfg_inter)
+    adm.offer("i2", "s", object(), cfg_inter)
+    # base capacity full: i1 admits via headroom (not a bypass — batch
+    # never owned that slot); b1 must stay queued
+    assert [q.job_id for q in adm.release()] == ["i1"]
+    # the base slot frees: interactive may bypass batch ONCE (max=1)
+    adm.job_finished("base")
+    assert [q.job_id for q in adm.release()] == ["i2"]
+    adm.offer("i3", "s", object(), cfg_inter)
+    # i1's finish frees base capacity (i2 still covers the headroom):
+    # the bypass budget is spent, so the waiting batch job goes — the
+    # streak was NOT forgiven by the interim headroom admission
+    adm.job_finished("i1")
+    assert [q.job_id for q in adm.release()] == ["b1"]
+    # batch running holds base capacity; interactive still flows
+    # through the freed headroom — neither lane starves the other
+    adm.job_finished("i2")
+    assert [q.job_id for q in adm.release()] == ["i3"]
+
+
+def test_max_queued_zero_means_unbounded():
+    """Review regression: 0 must not reject every job on an idle
+    cluster (all admissions transit the queue)."""
+    adm = _controller()
+    cfg = _cfg(**{"ballista.admission.max_running_jobs": "1",
+                  "ballista.admission.max_queued_jobs": "0"})
+    for i in range(10):
+        d = adm.offer(f"j{i}", "s", object(), cfg)
+        assert d.queued and d.error is None
+    assert adm.queued_count() == 10
+    assert [q.job_id for q in adm.release()] == ["j0"]
+
+
+def test_pinned_cluster_limits_ignore_session_settings():
+    """Review regression: one tenant's session must not rewrite the
+    cluster-wide gates (queue bound, shed policy) other tenants depend
+    on when the operator pinned them."""
+    adm = AdmissionController(
+        FakeExecutorManager(2),
+        pinned_settings={
+            "ballista.admission.max_queued_jobs": "5",
+            "ballista.admission.shed_policy": "reject",
+            # tenant.* keys are per-pool by design: never pinned
+            "ballista.tenant.weight": "9",
+        },
+    )
+    hostile = _cfg(**{"ballista.admission.max_running_jobs": "1",
+                      "ballista.admission.max_queued_jobs": "1",
+                      "ballista.admission.shed_policy": "oldest"})
+    for i in range(4):
+        d = adm.offer(f"j{i}", "s", object(), hostile)
+        assert d.queued and not d.displaced and d.error is None, (i, d)
+    snap = adm.snapshot()
+    assert snap["max_queued_jobs"] == 5
+    assert snap["shed_policy"] == "reject"
+    # the pool weight followed the session (pin filter excludes tenant.*)
+    assert snap["pools"]["default"]["weight"] == 1.0
+
+
+def test_pool_concurrency_cap():
+    adm = _controller(slots=8)
+    cfg = _cfg(**{"ballista.tenant.id": "capped",
+                  "ballista.tenant.max_running_jobs": "1"})
+    for i in range(3):
+        adm.offer(f"j{i}", "s", object(), cfg)
+    assert [q.job_id for q in adm.release()] == ["j0"]  # pool cap, not slots
+    adm.job_finished("j0")
+    assert [q.job_id for q in adm.release()] == ["j1"]
+
+
+def test_shed_reject_fails_the_newest():
+    adm = _controller()
+    events = []
+    adm.events = _CapturingJournal(events)
+    cfg = _cfg(**{"ballista.admission.max_queued_jobs": "2",
+                  "ballista.admission.max_running_jobs": "1"})
+    assert adm.offer("j0", "s", object(), cfg).queued
+    assert adm.offer("j1", "s", object(), cfg).queued
+    d = adm.offer("j2", "s", object(), cfg)
+    assert not d.queued and isinstance(d.error, ClusterSaturated)
+    assert str(d.error).startswith("ClusterSaturated:")
+    assert "policy=reject" in str(d.error)
+    assert adm.queued_count() == 2  # the queue itself is untouched
+    assert [e["kind"] for e in events].count("job_shed") == 1
+
+
+def test_shed_oldest_displaces_and_queues_newcomer():
+    adm = _controller()
+    cfg = _cfg(**{"ballista.admission.max_queued_jobs": "2",
+                  "ballista.admission.max_running_jobs": "1",
+                  "ballista.admission.shed_policy": "oldest"})
+    adm.offer("old", "s", object(), cfg)
+    adm.offer("mid", "s", object(), cfg)
+    d = adm.offer("new", "s", object(), cfg)
+    assert d.queued and d.error is None
+    assert len(d.displaced) == 1
+    displaced, err = d.displaced[0]
+    assert displaced.job_id == "old"
+    assert err.startswith("ClusterSaturated:")
+    assert adm.queued_status("old") is None
+    assert adm.queued_status("new")["queue_position"] == 2
+
+
+def test_queue_wait_expiry_sheds():
+    adm = _controller()
+    cfg = _cfg(**{"ballista.admission.max_running_jobs": "1",
+                  "ballista.admission.max_queue_wait_seconds": "0.05"})
+    adm.offer("run", "s", object(), cfg)
+    adm.release()
+    adm.offer("wait", "s", object(), cfg)
+    assert adm.expire_overdue() == []
+    time.sleep(0.08)
+    shed = adm.expire_overdue()
+    assert [q.job_id for q, _ in shed] == ["wait"]
+    assert "max_queue_wait_seconds" in shed[0][1]
+    assert adm.queued_count() == 0
+
+
+def test_cancel_queued_and_cancel_intent():
+    adm = _controller()
+    cfg = _cfg(**{"ballista.admission.max_running_jobs": "1"})
+    adm.offer("run", "s", object(), cfg)
+    adm.release()
+    adm.offer("q1", "s", object(), cfg)
+    qj = adm.cancel("q1")
+    assert qj is not None and qj.job_id == "q1"
+    assert adm.cancel("q1") is None  # idempotent
+    assert adm.queued_count() == 0
+    adm.job_finished("run")
+    assert adm.release() == []  # the cancelled job must never admit
+    # intent: consumed exactly once
+    adm.mark_cancel_intent("raced")
+    assert adm.take_cancel_intent("raced")
+    assert not adm.take_cancel_intent("raced")
+
+
+def test_snapshot_shape():
+    adm = _controller(slots=3)
+    cfg = _cfg(**{"ballista.tenant.id": "a", "ballista.tenant.weight": "3"})
+    adm.offer("j0", "s", object(), cfg)
+    adm.release()
+    snap = adm.snapshot()
+    assert snap["running_jobs"] == 1
+    assert snap["max_running_jobs"] == 3  # derived from fake slots
+    pool = snap["pools"]["a"]
+    assert pool["weight"] == 3.0
+    assert pool["running"] == 1 and pool["admitted_total"] == 1
+    assert 0 < pool["share_target"] <= 1
+
+
+class _CapturingJournal(EventJournal):
+    def __init__(self, sink):
+        super().__init__("")  # disabled on disk
+        self._sink = sink
+
+    def emit(self, kind, job="", trace="", **fields):
+        self._sink.append({"kind": kind, "job": job, **fields})
+
+
+# --------------------------------------------------------------- proto
+def test_queued_status_proto_roundtrip():
+    from arrow_ballista_tpu.scheduler.task_status import (
+        job_status_from_proto,
+        job_status_to_proto,
+    )
+
+    msg = job_status_to_proto(
+        {"state": "queued", "queue_position": 3, "pool": "analytics",
+         "queued_seconds": 1.5}
+    )
+    back = job_status_from_proto(msg)
+    assert back == {"state": "queued", "queue_position": 3,
+                    "pool": "analytics", "queued_seconds": 1.5}
+    # plain queued (pre-planning) stays a bare dict
+    assert job_status_from_proto(job_status_to_proto({"state": "queued"})) == {
+        "state": "queued"
+    }
+
+
+def test_graph_tenant_identity_survives_encode_decode():
+    from arrow_ballista_tpu.context import SessionContext
+    from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+
+    cfg = BallistaConfig({
+        "ballista.admission.enabled": "true",
+        "ballista.tenant.id": "team-x",
+        "ballista.tenant.priority": "interactive",
+        "ballista.shuffle.partitions": "2",
+        "ballista.tpu.enable": "false",
+    })
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table(
+        "t", pa.table({"v": pa.array([1.0, 2.0])}), partitions=1
+    )
+    plan = ctx.sql("select sum(v) as s from t").logical_plan()
+    from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+    from arrow_ballista_tpu.plan.optimizer import optimize
+
+    physical = PhysicalPlanner(cfg).create_physical_plan(optimize(plan))
+    g = ExecutionGraph("sched", "job-t", "sess", physical, "/tmp/abt-adm", cfg)
+    assert g.admission_enabled and g.tenant_pool == "team-x"
+    back = ExecutionGraph.decode(g.encode(), "/tmp/abt-adm")
+    assert back.admission_enabled
+    assert back.tenant_pool == "team-x"
+    assert back.tenant_priority == "interactive"
+
+
+# ------------------------------------------------------- client surface
+class _FakeStub:
+    """GetJobStatus stub that reports queued-with-coordinates forever."""
+
+    def GetJobStatus(self, params, timeout=0):
+        from arrow_ballista_tpu.proto import pb
+
+        result = pb.GetJobStatusResult()
+        result.status.queued.queue_position = 4
+        result.status.queued.pool = "batch-pool"
+        result.status.queued.queued_seconds = 0.2
+        return result
+
+
+def test_client_timeout_distinguishes_queued_from_running():
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.errors import ExecutionError
+
+    ctx = BallistaContext.__new__(BallistaContext)
+    ctx.stub = _FakeStub()
+    with pytest.raises(ExecutionError) as ei:
+        ctx.wait_for_job("j-queued", timeout_s=0.25)
+    msg = str(ei.value)
+    assert "queued" in msg and "batch-pool" in msg and "position 4" in msg
+    assert "0.0s running" in msg
+
+
+# ------------------------------------------------------------ state level
+class AdmissionFixture:
+    """Scheduler state + event loop + hand-driven fake executor, with a
+    real on-disk event journal (the test_scheduler_state.py pattern)."""
+
+    def __init__(self, journal_dir="", slots=4):
+        self.backend = MemoryBackend()
+        self.launcher = NoopLauncher()
+        self.state = SchedulerState(
+            self.backend,
+            "sched-adm",
+            TaskSchedulingPolicy.PULL_STAGED,
+            launcher=self.launcher,
+            work_dir="/tmp/abt-adm-test",
+            event_journal_dir=journal_dir,
+        )
+        self.loop = EventLoop("qss-adm", 10000, QueryStageScheduler(self.state))
+        self.loop.start()
+        self.sender = self.loop.get_sender()
+        self.state.executor_manager.register_executor(
+            ExecutorMetadata(
+                "exec-1", "127.0.0.1", 50051, 50052,
+                ExecutorSpecification(slots),
+            )
+        )
+
+    def make_session(self, **settings):
+        base = {
+            "ballista.shuffle.partitions": "2",
+            "ballista.tpu.enable": "false",
+        }
+        base.update({k: str(v) for k, v in settings.items()})
+        ctx = self.state.session_manager.create_session(base)
+        ctx.register_arrow_table(
+            "t",
+            pa.table(
+                {
+                    "g": pa.array(["a", "b", "a", "c"], pa.string()),
+                    "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+                }
+            ),
+            partitions=2,
+        )
+        return ctx
+
+    def submit(self, ctx, job_id, sql="select g, sum(v) as s from t group by g"):
+        plan = ctx.sql(sql).logical_plan()
+        self.sender.post(JobQueued(job_id, ctx.session_id, plan))
+        assert self.loop.drain(5.0)
+        return job_id
+
+    def run_one_task(self, executor_id="exec-1"):
+        """Pop + complete exactly one task through the real state
+        machine; returns False when nothing was runnable."""
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        assignments, _free, _pending = self.state.task_manager.fill_reservations(
+            [ExecutorReservation(executor_id)]
+        )
+        if not assignments:
+            return False
+        _, task = assignments[0]
+        part = task.output_partitioning
+        n_out = part.n if part is not None else 1
+        partitions = [
+            ShuffleWritePartition(p, f"/fake/{task.partition}/{p}", 1, 5, 50)
+            for p in range(n_out)
+        ]
+        info = TaskInfo(
+            task.partition, "completed", executor_id, partitions=partitions
+        )
+        meta = ExecutorMetadata(
+            executor_id, "127.0.0.1", 50051, 50052, ExecutorSpecification(4)
+        )
+        self.sender.post(TaskUpdating(meta, [info]))
+        assert self.loop.drain(5.0)
+        return True
+
+    def run_until_done(self, max_rounds=200):
+        idle = 0
+        for _ in range(max_rounds):
+            if self.run_one_task():
+                idle = 0
+                continue
+            idle += 1
+            if idle >= 3 and not self.state.task_manager.active_job_ids():
+                return
+            time.sleep(0.01)
+
+    def status(self, job_id):
+        return self.state.task_manager.get_job_status(job_id)
+
+    def stop(self):
+        self.loop.stop()
+        self.state.executor_manager.close()
+        self.state.events.close()
+
+
+ADMISSION_ON = {
+    "ballista.admission.enabled": "true",
+    "ballista.admission.max_running_jobs": "1",
+}
+
+
+def test_jobs_queue_preplanning_and_release_in_order(tmp_path):
+    f = AdmissionFixture(journal_dir=str(tmp_path / "journal"))
+    try:
+        ctx = f.make_session(**ADMISSION_ON)
+        f.submit(ctx, "job-1")
+        f.submit(ctx, "job-2")
+        f.submit(ctx, "job-3")
+        assert f.status("job-1")["state"] == "running"
+        # queued jobs: NO graph exists anywhere (pre-planning hold)
+        for jid, pos in (("job-2", 1), ("job-3", 2)):
+            st = f.status(jid)
+            assert st["state"] == "queued"
+            assert st["queue_position"] == pos
+            assert st["pool"] == "default"
+            assert f.backend.get(Keyspace.ActiveJobs, jid) is None
+        # job table shows the queued jobs too
+        states = {r["job_id"]: r["state"]
+                  for r in f.state.task_manager.list_jobs()}
+        assert states == {"job-1": "running", "job-2": "queued",
+                          "job-3": "queued"}
+        f.run_until_done()
+        for jid in ("job-1", "job-2", "job-3"):
+            assert f.status(jid)["state"] == "completed", jid
+        # journal: queued/admitted with queue-wait durations
+        kinds = [e["kind"] for e in f.state.events.tail(1000)]
+        assert kinds.count("job_queued") == 3
+        assert kinds.count("job_admitted") == 3
+        admitted = f.state.events.tail(1000, kind="job_admitted")
+        assert all("queue_wait_s" in e for e in admitted)
+        # metrics surfaced through the scheduler registry
+        snap = f.state.metrics.snapshot()
+        assert snap["jobs_queued_total"] == 3
+        assert snap["jobs_admitted_total"] == 3
+        assert snap["admission_queue_wait_seconds"]["count"] == 3
+    finally:
+        f.stop()
+
+
+def test_shed_error_reaches_job_status():
+    f = AdmissionFixture()
+    try:
+        ctx = f.make_session(
+            **{**ADMISSION_ON, "ballista.admission.max_queued_jobs": "1"}
+        )
+        f.submit(ctx, "job-1")  # admitted
+        f.submit(ctx, "job-2")  # queued (1/1)
+        f.submit(ctx, "job-3")  # shed: queue full, policy=reject
+        st = f.status("job-3")
+        assert st["state"] == "failed"
+        assert st["error"].startswith("ClusterSaturated:")
+        assert "queue full" in st["error"]
+        # the running job and the queued job are untouched
+        assert f.status("job-1")["state"] == "running"
+        assert f.status("job-2")["state"] == "queued"
+        f.run_until_done()
+        assert f.status("job-1")["state"] == "completed"
+        assert f.status("job-2")["state"] == "completed"
+        assert f.state.metrics.snapshot()["jobs_shed_total"] == 1
+    finally:
+        f.stop()
+
+
+def test_queue_wait_expiry_fails_job_via_pulse():
+    f = AdmissionFixture()
+    try:
+        ctx = f.make_session(
+            **{**ADMISSION_ON,
+               "ballista.admission.max_queue_wait_seconds": "0.05"}
+        )
+        f.submit(ctx, "job-1")
+        f.submit(ctx, "job-2")
+        time.sleep(0.1)
+        f.sender.post(AdmissionPulse())
+        assert f.loop.drain(5.0)
+        st = f.status("job-2")
+        assert st["state"] == "failed"
+        assert "max_queue_wait_seconds" in st["error"]
+        assert st["error"].startswith("ClusterSaturated:")
+    finally:
+        f.stop()
+
+
+def test_cancel_before_admit_dequeues_and_journals(tmp_path):
+    f = AdmissionFixture(journal_dir=str(tmp_path / "journal"))
+    try:
+        ctx = f.make_session(**ADMISSION_ON)
+        f.submit(ctx, "job-1")
+        f.submit(ctx, "job-2")
+        assert f.status("job-2")["state"] == "queued"
+        assert f.state.task_manager.cancel_job("job-2") == []
+        st = f.status("job-2")
+        assert st["state"] == "failed" and "cancelled" in st["error"]
+        cancelled = f.state.events.tail(100, kind="job_cancelled")
+        assert len(cancelled) == 1 and cancelled[0]["queued"] is True
+        # the cancelled job never runs; the rest of the world moves on
+        f.run_until_done()
+        assert f.status("job-1")["state"] == "completed"
+        assert f.state.admission.queued_count() == 0
+    finally:
+        f.stop()
+
+
+def test_cancel_race_with_admit_fails_instead_of_running():
+    """Cancel lands between queue release and graph creation: the
+    submit path consumes the intent and refuses to build the graph."""
+    f = AdmissionFixture()
+    try:
+        ctx = f.make_session(**ADMISSION_ON)
+        tm = f.state.task_manager
+        # cancel an id the scheduler has never seen -> intent parked
+        assert tm.cancel_job("job-raced") == []
+        plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
+        from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+        from arrow_ballista_tpu.plan.optimizer import optimize
+
+        physical = PhysicalPlanner(ctx.config).create_physical_plan(
+            optimize(plan)
+        )
+        with pytest.raises(SchedulerError, match="cancelled"):
+            tm.submit_job("job-raced", ctx.session_id, physical)
+        assert tm.get_job_status("job-raced") is None  # no graph built
+    finally:
+        f.stop()
+
+
+def test_weighted_fair_dispatch_order():
+    """fill_reservations walks admission-managed jobs by weighted
+    running-task share instead of submit FIFO: with job A already
+    holding a running task, the next freed slot goes to pool B."""
+    f = AdmissionFixture()
+    try:
+        settings = {"ballista.admission.enabled": "true",
+                    "ballista.admission.max_running_jobs": "8"}
+        ctx_a = f.make_session(
+            **{**settings, "ballista.tenant.id": "a",
+               "ballista.tenant.weight": "2"}
+        )
+        ctx_b = f.make_session(
+            **{**settings, "ballista.tenant.id": "b",
+               "ballista.tenant.weight": "1"}
+        )
+        f.submit(ctx_a, "job-a")
+        f.submit(ctx_b, "job-b")
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        tm = f.state.task_manager
+        first, _, _ = tm.fill_reservations([ExecutorReservation("exec-1")])
+        second, _, _ = tm.fill_reservations([ExecutorReservation("exec-1")])
+        jobs = [t.partition.job_id for _, t in first + second]
+        # FIFO would drain job-a first; fair share alternates pools
+        assert set(jobs) == {"job-a", "job-b"}
+    finally:
+        f.stop()
+
+
+def test_interactive_lane_dispatches_first():
+    f = AdmissionFixture()
+    try:
+        settings = {"ballista.admission.enabled": "true",
+                    "ballista.admission.max_running_jobs": "8"}
+        ctx_batch = f.make_session(**settings)
+        ctx_inter = f.make_session(
+            **{**settings, "ballista.tenant.id": "fast",
+               "ballista.tenant.priority": "interactive"}
+        )
+        f.submit(ctx_batch, "job-batch")
+        f.submit(ctx_inter, "job-inter")
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        assignments, _, _ = f.state.task_manager.fill_reservations(
+            [ExecutorReservation("exec-1")]
+        )
+        assert assignments[0][1].partition.job_id == "job-inter"
+    finally:
+        f.stop()
+
+
+def test_admission_off_keeps_fifo_dispatch():
+    """The default-off A/B: without the knob, fill_reservations keeps
+    submit order exactly (job-1 drains before job-2)."""
+    f = AdmissionFixture()
+    try:
+        ctx = f.make_session()  # no admission settings at all
+        f.submit(ctx, "job-1")
+        f.submit(ctx, "job-2")
+        assert f.status("job-1")["state"] == "running"
+        assert f.status("job-2")["state"] == "running"  # nobody queued
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        tm = f.state.task_manager
+        jobs = []
+        for _ in range(2):
+            a, _, _ = tm.fill_reservations([ExecutorReservation("exec-1")])
+            jobs.append(a[0][1].partition.job_id)
+        assert jobs == ["job-1", "job-1"]
+        assert f.state.admission.queued_count() == 0
+        assert f.state.metrics.snapshot()["jobs_queued_total"] == 0
+    finally:
+        f.stop()
+
+
+def test_recovered_job_reregisters_pool_accounting():
+    """Scheduler restart: an admission-managed running job re-adopts
+    into its pool, so the concurrency gate still counts it."""
+    f = AdmissionFixture()
+    try:
+        ctx = f.make_session(
+            **{**ADMISSION_ON, "ballista.tenant.id": "team-r"}
+        )
+        f.submit(ctx, "job-r")
+        assert f.status("job-r")["state"] == "running"
+        # a fresh state over the same backend (the restart)
+        state2 = SchedulerState(
+            f.backend, "sched-2", TaskSchedulingPolicy.PULL_STAGED,
+            launcher=NoopLauncher(), work_dir="/tmp/abt-adm-test",
+        )
+        try:
+            recovered = state2.task_manager.recover_active_jobs()
+            assert "job-r" in recovered
+            snap = state2.admission.snapshot()
+            assert snap["pools"]["team-r"]["running"] == 1
+            assert snap["running_jobs"] == 1
+        finally:
+            state2.executor_manager.close()
+    finally:
+        f.stop()
+
+
+# --------------------------------------------------------- wire-level e2e
+def test_admission_end_to_end_over_grpc(tmp_path):
+    """Real standalone cluster over gRPC/Flight: a burst past the
+    running-job cap queues (visible to the polling client via the
+    QueuedJob proto fields), releases in fair order and completes with
+    zero failures; the journal records the whole lifecycle."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from arrow_ballista_tpu.client import BallistaContext
+
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "g": pa.array([i % 50 for i in range(20_000)], pa.int64()),
+                "v": pa.array([float(i) for i in range(20_000)], pa.float64()),
+            }
+        ),
+        str(d / "part-0.parquet"),
+    )
+    journal_dir = str(tmp_path / "journal")
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(
+            {
+                "ballista.tpu.enable": "false",
+                "ballista.shuffle.partitions": "2",
+                "ballista.admission.enabled": "true",
+                "ballista.admission.max_running_jobs": "1",
+            }
+        ),
+        num_executors=1,
+        concurrent_tasks=2,
+        event_journal_dir=journal_dir,
+    )
+    try:
+        ctx.register_parquet("t", str(d))
+        plan = ctx.sql("select g, sum(v) as s from t group by g").logical_plan()
+        outcomes = []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                job_id = ctx.execute_logical_plan(plan)
+                ctx.wait_for_job(job_id, timeout_s=120)
+                result = "completed"
+            except Exception as e:  # noqa: BLE001
+                result = f"failed: {e}"
+            with lock:
+                outcomes.append(result)
+
+        threads = [threading.Thread(target=one) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert outcomes == ["completed"] * 3, outcomes
+        journal = ctx._standalone_handles[0].server.state.events
+        assert len(journal.tail(100, kind="job_queued")) >= 2
+        admitted = journal.tail(100, kind="job_admitted")
+        assert len(admitted) == len(journal.tail(100, kind="job_queued"))
+        snap = ctx._standalone_handles[0].server.state.admission.snapshot()
+        assert snap["queued_jobs"] == 0 and snap["running_jobs"] == 0
+        assert snap["pools"]["default"]["admitted_total"] == len(admitted)
+    finally:
+        ctx.close()
+
+
+# ------------------------------------------ satellite: concurrent submits
+def test_concurrent_submits_reconcile_exactly(tmp_path):
+    """Hammer TaskManager.submit_job / task_counts() / the SLO tracker
+    from many threads: counters, /api/metrics snapshots and journal
+    event counts must reconcile exactly — no lost or double-counted
+    jobs under the job-entry lock."""
+    f = AdmissionFixture(journal_dir=str(tmp_path / "journal"), slots=8)
+    try:
+        ctx = f.make_session()
+        from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+        from arrow_ballista_tpu.plan.optimizer import optimize
+
+        logical = ctx.sql(
+            "select g, sum(v) as s from t group by g"
+        ).logical_plan()
+        n_jobs = 24
+        plans = [
+            PhysicalPlanner(ctx.config).create_physical_plan(optimize(logical))
+            for _ in range(n_jobs)
+        ]
+        tm = f.state.task_manager
+        errors = []
+        stop_probes = threading.Event()
+
+        def submit(i):
+            try:
+                tm.submit_job(f"cj-{i}", ctx.session_id, plans[i])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def probe():
+            while not stop_probes.is_set():
+                pending, running = tm.task_counts()
+                assert pending >= 0 and running >= 0
+                snap = f.state.metrics.snapshot()
+                assert snap["active_jobs"] >= 0
+                f.state.slo.snapshot()
+                time.sleep(0.001)
+
+        probers = [threading.Thread(target=probe) for _ in range(3)]
+        for t in probers:
+            t.start()
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(n_jobs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        stop_probes.set()
+        for t in probers:
+            t.join(5)
+        assert not errors, errors
+        assert sorted(tm.active_job_ids()) == sorted(
+            f"cj-{i}" for i in range(n_jobs)
+        )
+        # every job persisted exactly once, journal agrees exactly
+        persisted = sorted(f.backend.scan_keys(Keyspace.ActiveJobs))
+        assert persisted == sorted(f"cj-{i}" for i in range(n_jobs))
+        submitted = f.state.events.tail(10_000, kind="job_submitted")
+        assert sorted(e["job"] for e in submitted) == sorted(
+            f"cj-{i}" for i in range(n_jobs)
+        )
+        # drive everything to completion; completion counters reconcile
+        f.run_until_done(max_rounds=1000)
+        snap = f.state.metrics.snapshot()
+        assert snap["jobs_completed_total"] == n_jobs
+        assert snap["jobs_failed_total"] == 0
+        completed = f.state.events.tail(10_000, kind="job_completed")
+        assert len(completed) == n_jobs
+        pending, running = tm.task_counts()
+        assert (pending, running) == (0, 0)
+    finally:
+        f.stop()
