@@ -36,7 +36,6 @@ from ..serde.scheduler_types import PartitionLocation
 
 log = logging.getLogger(__name__)
 
-JOB_POLL_INTERVAL_S = 0.1  # reference: distributed_query.rs:268
 
 
 class BallistaDataFrame(DataFrame):
@@ -239,20 +238,43 @@ class BallistaContext:
             ) from e
         return result.job_id
 
-    def wait_for_job(self, job_id: str, timeout_s: float = 300.0) -> dict:
+    def wait_for_job(
+        self,
+        job_id: str,
+        timeout_s: float = 300.0,
+        progress=None,
+    ) -> dict:
         """Poll GetJobStatus until terminal (reference:
         distributed_query.rs:232-309).
+
+        Polling starts at ``ballista.client.poll_interval_seconds`` and
+        backs off exponentially with jitter (capped at
+        ``ballista.client.poll_max_interval_seconds``), resetting on the
+        queued→running transition — hundreds of concurrent waiting
+        clients must not hammer the scheduler in lockstep.
+
+        ``progress``, if given, is called with the live progress
+        snapshot (per-stage done/running/pending task counts, bytes,
+        ETA — the ``/api/jobs/{id}/progress`` shape) on every poll that
+        returns one.
 
         Queue-aware: a job held by admission control reports QUEUED with
         its pool + queue position, and a timeout message splits the
         deadline into time-spent-queued vs time-spent-running — a job
         that starved in a saturated queue reads differently from one
         that wedged mid-execution."""
+        import json
+
         from ..scheduler.task_status import (
+            PollBackoff,
             job_status_from_proto,
             poll_timeout_breakdown,
         )
 
+        backoff = PollBackoff(
+            self.config.client_poll_interval_seconds,
+            self.config.client_poll_max_interval_seconds,
+        )
         # monotonic deadline: immune to wall-clock jumps mid-poll
         start = time.monotonic()
         deadline = start + timeout_s
@@ -260,7 +282,10 @@ class BallistaContext:
         last_queued: dict = {}
         while True:
             result = self.stub.GetJobStatus(
-                pb.GetJobStatusParams(job_id=job_id), timeout=20
+                pb.GetJobStatusParams(
+                    job_id=job_id, include_progress=progress is not None
+                ),
+                timeout=20,
             )
             status = job_status_from_proto(result.status)
             state = status["state"]
@@ -268,6 +293,15 @@ class BallistaContext:
                 last_queued = status
             elif running_since is None:
                 running_since = time.monotonic()
+                # the job just left the queue: poll tightly again
+                backoff.reset()
+            if progress is not None and result.progress_json:
+                try:
+                    progress(json.loads(result.progress_json.decode()))
+                except ExecutionError:
+                    raise
+                except Exception:  # noqa: BLE001 - observer must not kill the wait
+                    log.debug("progress callback failed", exc_info=True)
             if state == "completed":
                 return status
             if state == "failed":
@@ -279,7 +313,32 @@ class BallistaContext:
                     f"job {job_id} timed out after {timeout_s}s"
                     + poll_timeout_breakdown(start, running_since, last_queued)
                 )
-            time.sleep(JOB_POLL_INTERVAL_S)
+            backoff.sleep(deadline)
+
+    def job_report(self, job_id: str) -> dict:
+        """The scheduler's diagnosis bundle for a job this session ran:
+        ``{"profile", "critical_path", "doctor"}`` — the same numbers
+        ``/api/jobs/{id}/profile`` and ``/critical_path`` serve."""
+        import json
+
+        result = self.stub.GetJobStatus(
+            pb.GetJobStatusParams(job_id=job_id, include_profile=True),
+            timeout=20,
+        )
+        if not result.profile_json:
+            raise BallistaError(
+                f"no profile available for job {job_id!r} (unknown job, "
+                "or still queued)"
+            )
+        return json.loads(result.profile_json.decode())
+
+    def explain_analyze(self, job_id: str) -> str:
+        """EXPLAIN-ANALYZE-style text tree for a finished (or running)
+        job: wall-clock breakdown, critical path, doctor findings and
+        per-stage stats.  Print it."""
+        from ..obs.doctor import render_explain_analyze
+
+        return render_explain_analyze(self.job_report(job_id))
 
     def fetch_job_output(self, status: dict) -> pa.Table:
         """Fetch completed partitions (reference:

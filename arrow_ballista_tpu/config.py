@@ -84,6 +84,8 @@ EXECUTOR_QUARANTINE_THRESHOLD = "ballista.executor.quarantine_threshold"
 EXECUTOR_QUARANTINE_WINDOW_S = "ballista.executor.quarantine_window_seconds"
 EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
 CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
+CLIENT_POLL_INTERVAL_S = "ballista.client.poll_interval_seconds"
+CLIENT_POLL_MAX_INTERVAL_S = "ballista.client.poll_max_interval_seconds"
 # Multi-tenant admission control (see docs/user-guide/multi-tenancy.md)
 TENANT_ID = "ballista.tenant.id"
 TENANT_PRIORITY = "ballista.tenant.priority"
@@ -679,6 +681,23 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "300",
         ),
         ConfigEntry(
+            CLIENT_POLL_INTERVAL_S,
+            "initial GetJobStatus poll interval (seconds); subsequent "
+            "polls back off exponentially with jitter so hundreds of "
+            "concurrent waiting clients stop hammering the scheduler in "
+            "lockstep",
+            float,
+            "0.1",
+        ),
+        ConfigEntry(
+            CLIENT_POLL_MAX_INTERVAL_S,
+            "cap (seconds) of the jittered exponential poll backoff — "
+            "the worst-case extra latency a client adds to noticing its "
+            "job finished",
+            float,
+            "2.0",
+        ),
+        ConfigEntry(
             TENANT_ID,
             "tenant pool this session's jobs belong to for admission "
             "control and weighted fair scheduling; empty = the shared "
@@ -1081,6 +1100,14 @@ class BallistaConfig:
     @property
     def client_job_timeout_seconds(self) -> float:
         return self._get(CLIENT_JOB_TIMEOUT_S)
+
+    @property
+    def client_poll_interval_seconds(self) -> float:
+        return self._get(CLIENT_POLL_INTERVAL_S)
+
+    @property
+    def client_poll_max_interval_seconds(self) -> float:
+        return self._get(CLIENT_POLL_MAX_INTERVAL_S)
 
     @property
     def tenant_id(self) -> str:

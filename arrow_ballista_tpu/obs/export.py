@@ -49,10 +49,55 @@ AQE_OP = "__aqe__"
 # Locality placement rollup (ISSUE 10): {"local": tasks dispatched on
 # their preferred host, "any": elsewhere} — lifted into row["locality"]
 LOCALITY_OP = "__locality_placement__"
+# Stage/task wall-clock anchors (ISSUE 13, query doctor): epoch
+# MICROsecond timestamps recorded scheduler-side (one clock for the
+# whole job, so critical-path segments subtract cleanly) and persisted
+# through the same stage-metrics proto path as the skew analytics:
+#
+#   __stage_timing__      {ready_us, first_dispatch_us, first_finish_us,
+#                          completed_us, partitions}
+#   __task_dispatch_us__  {str(partition): epoch_us at dispatch}
+#   __task_finish_us__    {str(partition): epoch_us at commit}
+#
+# obs/critical_path.py joins these (with the graph-level
+# submitted_unix_us/planning_us proto fields) into the per-job time
+# breakdown and the critical path; they survive cache eviction/restart
+# like every other synthetic op.
+STAGE_TIMING_OP = "__stage_timing__"
+TASK_DISPATCH_OP = "__task_dispatch_us__"
+TASK_FINISH_OP = "__task_finish_us__"
 _SYNTHETIC_OPS = (
     STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
-    AQE_OP, LOCALITY_OP,
+    AQE_OP, LOCALITY_OP, STAGE_TIMING_OP, TASK_DISPATCH_OP, TASK_FINISH_OP,
 )
+
+
+def stage_timing_metrics(
+    ready_unix_ns: int,
+    task_dispatch_unix_ns: Dict[int, int],
+    task_finish_unix_ns: Dict[int, int],
+) -> Dict[str, Dict[str, int]]:
+    """Reduce a completing stage's timestamp anchors into the synthetic
+    timing operators above; {} when nothing was recorded (decoded
+    graphs, stages completed before this PR's scheduler)."""
+    out: Dict[str, Dict[str, int]] = {}
+    summary: Dict[str, int] = {}
+    if ready_unix_ns:
+        summary["ready_us"] = ready_unix_ns // 1000
+    if task_dispatch_unix_ns:
+        disp = {p: ns // 1000 for p, ns in task_dispatch_unix_ns.items()}
+        summary["first_dispatch_us"] = min(disp.values())
+        summary["partitions"] = len(disp)
+        out[TASK_DISPATCH_OP] = {str(p): v for p, v in disp.items()}
+    if task_finish_unix_ns:
+        fin = {p: ns // 1000 for p, ns in task_finish_unix_ns.items()}
+        summary["first_finish_us"] = min(fin.values())
+        summary["completed_us"] = max(fin.values())
+        summary.setdefault("partitions", len(fin))
+        out[TASK_FINISH_OP] = {str(p): v for p, v in fin.items()}
+    if summary:
+        out[STAGE_TIMING_OP] = summary
+    return out
 
 
 def percentile(values: List[float], q: float) -> float:
@@ -138,9 +183,28 @@ def _skew_block(metrics: Dict[str, Dict[str, int]]) -> Optional[dict]:
     return out
 
 
+# spans that get a Perfetto flow arrow from their parent slice — the
+# shuffle-fetch → serving-side do_get stitch is the one the data plane
+# produces (trace ctx forwarded over Flight gRPC metadata; obs/trace.py
+# propagation_headers).  Emitted whenever the parent span is present:
+# usually cross-process, but a loopback Flight fetch (standalone, or
+# zero-copy off) still crosses threads and reads better linked.
+_FLOW_SPAN_NAMES = ("flight.do_get",)
+
+
 def chrome_trace(spans: List[dict], job_id: str = "") -> dict:
-    """Spans (recorder dicts) → Chrome trace JSON object."""
+    """Spans (recorder dicts) → Chrome trace JSON object.
+
+    Beyond the raw slices: per-process ``process_name`` and per-thread
+    ``thread_name`` metadata (named after the first span recorded on the
+    thread, so executor task workers read as "task.execute" lanes), and
+    flow events (``ph: "s"``/``"f"``) linking a caller's
+    ``shuffle.fetch`` span to the serving executor's ``flight.do_get``
+    span — Perfetto then renders cross-process arrows instead of
+    disconnected tracks."""
     pids: Dict[str, int] = {}
+    thread_names: Dict[tuple, str] = {}
+    by_span: Dict[str, dict] = {}
     events: List[dict] = []
     for s in spans:
         proc = s.get("proc", "proc")
@@ -156,6 +220,20 @@ def chrome_trace(spans: List[dict], job_id: str = "") -> dict:
                     "args": {"name": proc},
                 }
             )
+        tid = s.get("tid", 0)
+        if (pid, tid) not in thread_names:
+            thread_names[(pid, tid)] = s.get("name", "span")
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": s.get("name", "span")},
+                }
+            )
+        if s.get("span"):
+            by_span[s["span"]] = s
         args = dict(s.get("attrs") or {})
         args["span_id"] = s.get("span", "")
         if s.get("parent"):
@@ -166,11 +244,46 @@ def chrome_trace(spans: List[dict], job_id: str = "") -> dict:
                 "cat": s.get("trace", ""),
                 "ph": "X",
                 "pid": pid,
-                "tid": s.get("tid", 0),
+                "tid": tid,
                 # Chrome trace timestamps are MICROseconds
                 "ts": s.get("ts", 0) / 1000.0,
                 "dur": max(s.get("dur", 0), 1) / 1000.0,
                 "args": args,
+            }
+        )
+    # flow arrows: serving-side span linked back to its caller's slice
+    for s in spans:
+        if s.get("name") not in _FLOW_SPAN_NAMES:
+            continue
+        parent = by_span.get(s.get("parent", ""))
+        if parent is None:
+            continue
+        flow = {
+            "name": f"{parent.get('name', 'span')}→{s.get('name')}",
+            "cat": "flow",
+            "id": s.get("span", ""),
+        }
+        # the start step must sit INSIDE the parent slice for Perfetto
+        # to bind the arrow; clamp to its window
+        p_ts, p_dur = parent.get("ts", 0), parent.get("dur", 0)
+        start_ts = min(max(s.get("ts", 0), p_ts), p_ts + p_dur)
+        events.append(
+            {
+                **flow,
+                "ph": "s",
+                "pid": pids.get(parent.get("proc", "proc"), 0),
+                "tid": parent.get("tid", 0),
+                "ts": start_ts / 1000.0,
+            }
+        )
+        events.append(
+            {
+                **flow,
+                "ph": "f",
+                "bp": "e",
+                "pid": pids.get(s.get("proc", "proc"), 0),
+                "tid": s.get("tid", 0),
+                "ts": s.get("ts", 0) / 1000.0,
             }
         )
     out = {"traceEvents": events, "displayTimeUnit": "ms"}

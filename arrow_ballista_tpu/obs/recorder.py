@@ -181,3 +181,20 @@ def get_recorder() -> SpanRecorder:
 
 def trace_store() -> TraceStore:
     return _store
+
+
+def spans_for_job(job_id: str) -> list:
+    """Every span recorded for ``job_id``: the scheduler-side TraceStore
+    first, falling back to the process ring buffer (scheduler spans not
+    yet forwarded — the forward hook installs on the first obs-enabled
+    submit).  The ONE span-collection rule, shared by the REST trace/
+    profile handlers and the gRPC ``include_profile`` path so every
+    surface reads identical spans."""
+    spans = _store.for_job(job_id)
+    if not spans:
+        spans = [
+            s
+            for s in _recorder.snapshot()
+            if (s.get("attrs") or {}).get("job") == job_id
+        ]
+    return spans

@@ -13,7 +13,10 @@ Extra endpoints beyond the reference: ``/api/jobs`` (job table),
 ``/api/metrics/prometheus`` (text exposition, also served at
 ``/metrics``), ``/api/jobs/{id}/trace`` (Chrome-trace/Perfetto JSON of
 the job's stitched spans), ``/api/jobs/{id}/profile``
-(EXPLAIN-ANALYZE-style per-stage rollup incl. skew coefficients),
+(EXPLAIN-ANALYZE-style per-stage rollup incl. skew coefficients, doctor
+findings and the wall-clock breakdown), ``/api/jobs/{id}/critical_path``
+(critical-path attribution + time breakdown + doctor findings),
+``/api/jobs/{id}/progress`` (live per-stage task progress + ETA),
 ``/api/cluster/health`` (live executors with slot/queue/resource gauges
 + cluster aggregates + SLO), ``/api/cluster/timeseries?metric=…``
 (bounded downsampled history), ``/api/jobs/{id}/events`` and
@@ -82,9 +85,45 @@ async function showDetail(jobId) {
         : 'no such job');
     return;
   }
+  // query doctor (ISSUE 13): findings + wall-clock breakdown ride the
+  // profile; live ETA rides /progress while the job runs
+  let prof = null, prog = null;
+  try {
+    prof = await fetch('/api/jobs/' + encodeURIComponent(jobId) + '/profile')
+      .then(r => r.json());
+  } catch (e) { /* diagnosis is optional decoration */ }
+  if (d.state === 'running') {
+    try {
+      prog = await fetch('/api/jobs/' + encodeURIComponent(jobId) + '/progress')
+        .then(r => r.json());
+    } catch (e) { /* ditto */ }
+  }
   let html = `<h2>Job ${esc(jobId)} — ${esc(d.state)}` +
-    ` <a href="/api/job/${encodeURIComponent(jobId)}/dot">[dot]</a></h2>`;
+    ` <a href="/api/job/${encodeURIComponent(jobId)}/dot">[dot]</a>` +
+    ` <a href="/api/jobs/${encodeURIComponent(jobId)}/critical_path">[critical path]</a></h2>`;
   if (d.error) html += `<p class="dead">${esc(d.error)}</p>`;
+  if (prog && prog.tasks_total) {
+    html += `<p>${prog.tasks_done}/${prog.tasks_total} tasks done · ` +
+      `${prog.tasks_running} running` +
+      (prog.eta_s != null ? ` · ~${prog.eta_s}s left` : '') + `</p>`;
+  }
+  if (prof && prof.breakdown) {
+    const parts = Object.entries(prof.breakdown)
+      .filter(([, v]) => v > 0.05).sort((a, b) => b[1] - a[1])
+      .map(([k, v]) => `${k.replace(/_ms$/, '').replace(/_/g, ' ')} ` +
+        `${v >= 1000 ? (v / 1000).toFixed(2) + 's' : v.toFixed(1) + 'ms'}`);
+    if (parts.length) html += `<p>time went to: ${esc(parts.join(' · '))}</p>`;
+  }
+  if (prof && prof.doctor && prof.doctor.length) {
+    html += '<h2>Doctor</h2><ul>';
+    for (const f of prof.doctor) {
+      html += `<li class="${f.severity === 'warn' ? 'dead' : ''}">` +
+        `[${esc(f.severity)}] ${esc(f.code)}` +
+        (f.stage_id !== undefined ? ` (stage ${f.stage_id})` : '') +
+        `: ${esc(f.summary)}</li>`;
+    }
+    html += '</ul>';
+  }
   html += dagSvg(d.stages);
   const hist = d.attempt_histogram || {};
   const retried = Object.entries(hist).filter(([a]) => a > 0)
@@ -386,6 +425,17 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
         if rest.endswith("/profile"):
             self._job_profile(srv, rest[: -len("/profile")])
             return
+        if rest.endswith("/critical_path"):
+            self._job_critical_path(srv, rest[: -len("/critical_path")])
+            return
+        if rest.endswith("/progress"):
+            job_id = rest[: -len("/progress")]
+            progress = tm.get_job_progress(job_id)
+            if progress is None:
+                self._json({"error": "no such job"}, 404)
+                return
+            self._json(progress)
+            return
         if rest.endswith("/events"):
             job_id = rest[: -len("/events")]
             journal = srv.state.events
@@ -523,18 +573,9 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _job_spans(self, srv, job_id: str) -> list:
-        from ..obs.recorder import get_recorder, trace_store
+        from ..obs.recorder import spans_for_job
 
-        spans = trace_store().for_job(job_id)
-        if not spans:
-            # scheduler spans not yet forwarded (forward hook installs on
-            # the first obs-enabled submit): fall back to the ring buffer
-            spans = [
-                s
-                for s in get_recorder().snapshot()
-                if (s.get("attrs") or {}).get("job") == job_id
-            ]
-        return spans
+        return spans_for_job(job_id)
 
     def _job_trace(self, srv, job_id: str) -> None:
         from ..obs.export import chrome_trace
@@ -549,14 +590,41 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             return
         self._json(chrome_trace(spans, job_id))
 
+    def _job_events(self, srv, job_id: str) -> list:
+        journal = srv.state.events
+        return journal.for_job(job_id) if journal.enabled else []
+
     def _job_profile(self, srv, job_id: str) -> None:
-        from ..obs.export import job_profile
+        from ..obs.doctor import job_report
+
+        detail = srv.state.task_manager.get_job_detail(job_id)
+        if detail is None or "stages" not in detail:
+            self._json(detail or {"error": "no such job"}, 404 if detail is None else 200)
+            return
+        report = job_report(
+            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id)
+        )
+        self._json(report["profile"])
+
+    def _job_critical_path(self, srv, job_id: str) -> None:
+        """Critical path + wall-clock breakdown + doctor findings — the
+        (b)+(c) surface of the query doctor (ISSUE 13)."""
+        from ..obs.doctor import job_report
 
         detail = srv.state.task_manager.get_job_detail(job_id)
         if detail is None:
             self._json({"error": "no such job"}, 404)
             return
-        self._json(job_profile(detail, self._job_spans(srv, job_id)))
+        if "stages" not in detail:
+            # admission-queued: no graph yet — report the queue state
+            self._json(detail)
+            return
+        report = job_report(
+            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id)
+        )
+        payload = report["critical_path"]
+        payload["doctor"] = report["doctor"]
+        self._json(payload)
 
 
 def make_api_server(

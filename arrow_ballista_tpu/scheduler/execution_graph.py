@@ -157,6 +157,13 @@ class ExecutionGraph:
         self._final_stage_id = stage_plans[-1].stage_id
         self.output_partitions = stage_plans[-1].output_partitioning().n
         self.stages = _build_stages(stage_plans)
+        # query-doctor anchors (ISSUE 13): distributed-planning duration,
+        # and leaf stages are dispatchable the moment the graph exists
+        self.planning_ns = time.monotonic_ns() - self.submitted_mono_ns
+        now_ns = time.time_ns()
+        for stage in self.stages.values():
+            if isinstance(stage, ResolvedStage):
+                stage.ready_unix_ns = now_ns
 
     def _init_speculation_policy(self, config) -> None:
         if config is not None:
@@ -266,7 +273,12 @@ class ExecutionGraph:
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, UnresolvedStage) and stage.resolvable():
                 self._maybe_replan(stage)
-                self.stages[sid] = stage.to_resolved()
+                resolved = stage.to_resolved()
+                # scheduling-delay anchor: resolvable (every input
+                # committed) → first dispatch is the scheduler's own
+                # latency, measured from here
+                resolved.ready_unix_ns = time.time_ns()
+                self.stages[sid] = resolved
                 changed = True
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, ResolvedStage):
@@ -398,6 +410,9 @@ class ExecutionGraph:
                     pid, "running", executor_id, attempt=attempt
                 )
                 stage.task_started_mono[p] = time.monotonic()
+                # critical-path anchor: re-dispatches overwrite, so the
+                # breakdown reflects the attempt that ends up committing
+                stage.task_dispatch_unix_ns[p] = time.time_ns()
                 return Task(
                     self.session_id,
                     pid,
@@ -458,6 +473,7 @@ class ExecutionGraph:
                 pid, "running", executor_id, attempt=attempt, speculative=True
             )
             stage.spec_started_mono[p] = time.monotonic()
+            stage.spec_dispatch_unix_ns[p] = time.time_ns()
             stage.bump_spec_stat("launched")
             stage.speculation_requests.pop(p, None)
             self._journal(
@@ -528,6 +544,7 @@ class ExecutionGraph:
             for p, t in enumerate(stage.task_statuses):
                 if t is not None and t.state == "running" and t.executor_id == executor_id:
                     spec_started = stage.spec_started_mono.get(p)
+                    spec_dispatch = stage.spec_dispatch_unix_ns.get(p)
                     shadow = stage.drop_speculative(p)
                     if shadow is not None:
                         stage.task_statuses[p] = shadow
@@ -535,6 +552,8 @@ class ExecutionGraph:
                             stage.task_started_mono[p] = spec_started
                         else:
                             stage.task_started_mono.pop(p, None)
+                        if spec_dispatch is not None:
+                            stage.task_dispatch_unix_ns[p] = spec_dispatch
                         # the quarantined host's copy is superseded: abort
                         # it (best-effort) — its late reports are dropped
                         # by the superseded-copy guard either way
@@ -670,8 +689,14 @@ class ExecutionGraph:
         cur = stage.task_statuses[p]
         started = stage.task_started_mono.get(p)
         shadow_started = stage.spec_started_mono.get(p)
+        shadow_dispatch = stage.spec_dispatch_unix_ns.get(p)
         shadow = stage.drop_speculative(p)
         if info.speculative:
+            # the committed attempt is the DUPLICATE: its dispatch anchor
+            # replaces the straggler's, so the breakdown window excludes
+            # the straggler's dead time
+            if shadow_dispatch is not None:
+                stage.task_dispatch_unix_ns[p] = shadow_dispatch
             # the duplicate beat the straggler: the still-running primary
             # is the loser — cancel it; its late status will hit the
             # committed-partition guard
@@ -708,6 +733,7 @@ class ExecutionGraph:
                 executor=shadow.executor_id,
             )
         stage.task_started_mono.pop(p, None)
+        stage.task_finish_unix_ns[p] = time.time_ns()
         if started is not None:
             runtime = max(0.0, time.monotonic() - started)
             stage.completed_runtime_s.append(runtime)
@@ -794,12 +820,15 @@ class ExecutionGraph:
             # the primary died but its duplicate races on: promote it in
             # place (same attempt number) instead of re-queueing
             spec_started = stage.spec_started_mono.get(p)
+            spec_dispatch = stage.spec_dispatch_unix_ns.get(p)
             promoted = stage.drop_speculative(p)
             stage.task_statuses[p] = promoted
             if spec_started is not None:
                 stage.task_started_mono[p] = spec_started
             else:
                 stage.task_started_mono.pop(p, None)
+            if spec_dispatch is not None:
+                stage.task_dispatch_unix_ns[p] = spec_dispatch
             stage.task_failures.setdefault(p, []).append(
                 f"attempt {current} on {info.executor_id or '<unknown>'}: "
                 f"{error} (duplicate attempt promoted)"
@@ -1067,6 +1096,7 @@ class ExecutionGraph:
             self.pending_cancels.append((t.executor_id, pid))
             out["timeouts"] += 1
             spec_started = stage.spec_started_mono.get(p)
+            spec_dispatch = stage.spec_dispatch_unix_ns.get(p)
             shadow = stage.drop_speculative(p)
             if shadow is not None:
                 # a healthy duplicate takes over in place (same attempt)
@@ -1075,6 +1105,8 @@ class ExecutionGraph:
                     stage.task_started_mono[p] = spec_started
                 else:
                     stage.task_started_mono.pop(p, None)
+                if spec_dispatch is not None:
+                    stage.task_dispatch_unix_ns[p] = spec_dispatch
                 out["events"].append("job_updated")
                 continue
             cur = stage.task_attempts.get(p, 0)
@@ -1535,6 +1567,11 @@ class ExecutionGraph:
         g.stage_max_attempts = self.stage_max_attempts
         g.task_retries = self.task_retries
         g.external_shuffle_path = self.external_shuffle_path
+        # job-level timeline anchors: the original submit wall-clock and
+        # planning duration must survive eviction/restart or every
+        # relative timestamp in the breakdown shifts to decode time
+        g.submitted_unix_us = self.submitted_unix_ns // 1000
+        g.planning_us = getattr(self, "planning_ns", 0) // 1000
         if self.aqe_policy.enabled:
             g.aqe_settings_json = self.aqe_policy.to_json()
         if self.admission_enabled:
@@ -1626,8 +1663,14 @@ class ExecutionGraph:
         self.job_id = g.job_id
         self.session_id = g.session_id
         self.trace_id = ""  # traces don't survive restart/adoption
-        self.submitted_unix_ns = time.time_ns()
+        # the WALL submit anchor is persisted (timeline attribution must
+        # not shift to decode time); the monotonic one cannot be — live
+        # elapsed/SLO math restarts from adoption
+        self.submitted_unix_ns = (
+            g.submitted_unix_us * 1000 if g.submitted_unix_us else time.time_ns()
+        )
         self.submitted_mono_ns = time.monotonic_ns()
+        self.planning_ns = g.planning_us * 1000
         self.output_partitions = g.output_partitions
         self.output_locations = []
         self.error = ""

@@ -160,6 +160,10 @@ class ResolvedStage:
     output_links: List[int] = field(default_factory=list)
     inputs: Dict[int, StageInput] = field(default_factory=dict)
     aqe: Dict[str, int] = field(default_factory=dict)
+    # query-doctor anchor (ISSUE 13): wall-clock ns when this stage became
+    # dispatchable (every producer committed; graph build for leaves).
+    # 0 = unknown (decoded graphs) — attribution degrades, never fails.
+    ready_unix_ns: int = 0
 
     @property
     def partitions(self) -> int:
@@ -173,6 +177,7 @@ class ResolvedStage:
             dict(self.inputs),
             [None] * self.partitions,
             aqe=dict(self.aqe),
+            ready_unix_ns=self.ready_unix_ns,
         )
 
     def to_unresolved(self) -> UnresolvedStage:
@@ -254,6 +259,24 @@ class RunningStage:
     # otherwise the timer would double-book slots the event-driven flow
     # already covers, every second
     locality_deferred: bool = False
+    # ---- query-doctor timeline anchors (ISSUE 13): everything below is
+    # wall-clock (epoch ns) because critical-path attribution subtracts
+    # anchors recorded at different points in the job's life and must
+    # align with the journal's timestamps; all recorded on the scheduler
+    # so one clock serves the whole job.  Reduced to the __stage_timing__
+    # / __task_*_us__ synthetic metrics at to_completed (persist past
+    # eviction/restart like the skew analytics).
+    ready_unix_ns: int = 0
+    # partition -> dispatch anchor of the CURRENT attempt (reset with the
+    # attempt, so a retry's breakdown reflects the attempt that committed)
+    task_dispatch_unix_ns: Dict[int, int] = field(default_factory=dict)
+    # ...and of the partition's racing speculative duplicate: when the
+    # duplicate wins (or is promoted in place), ITS dispatch anchor
+    # replaces the straggler's, so the committed attempt's window never
+    # includes the straggler's dead time
+    spec_dispatch_unix_ns: Dict[int, int] = field(default_factory=dict)
+    # partition -> commit anchor (the winner's completion report)
+    task_finish_unix_ns: Dict[int, int] = field(default_factory=dict)
 
     @property
     def partitions(self) -> int:
@@ -272,8 +295,12 @@ class RunningStage:
 
     def drop_speculative(self, p: int) -> Optional["TaskInfo"]:
         """Forget partition ``p``'s duplicate attempt (loser/failed/reset);
-        returns the dropped TaskInfo so the caller can cancel it."""
+        returns the dropped TaskInfo so the caller can cancel it.
+        Promotion sites that need the duplicate's timing anchors read
+        ``spec_started_mono`` / ``spec_dispatch_unix_ns`` BEFORE calling
+        this."""
         self.spec_started_mono.pop(p, None)
+        self.spec_dispatch_unix_ns.pop(p, None)
         self.speculation_requests.pop(p, None)
         return self.speculative_statuses.pop(p, None)
 
@@ -325,6 +352,7 @@ class RunningStage:
                 shadow = None
                 if t.state == "running":
                     spec_started = self.spec_started_mono.get(i)
+                    spec_dispatch = self.spec_dispatch_unix_ns.get(i)
                     shadow = self.drop_speculative(i)
                 if shadow is not None:
                     self.task_statuses[i] = shadow
@@ -332,6 +360,8 @@ class RunningStage:
                         self.task_started_mono[i] = spec_started
                     else:
                         self.task_started_mono.pop(i, None)
+                    if spec_dispatch is not None:
+                        self.task_dispatch_unix_ns[i] = spec_dispatch
                 else:
                     self.task_statuses[i] = None
                     self.task_started_mono.pop(i, None)
@@ -339,13 +369,26 @@ class RunningStage:
         return n
 
     def to_completed(self) -> "CompletedStage":
-        from ..obs.export import AQE_OP, stage_skew_metrics
+        from ..obs.export import (
+            AQE_OP,
+            stage_skew_metrics,
+            stage_timing_metrics,
+        )
 
         # reduce the per-partition runtime/bytes distributions to skew
         # coefficients NOW — stage_metrics persist in the graph proto, so
         # the profile keeps its skew column after cache eviction/restart
         metrics = dict(self.stage_metrics)
         metrics.update(stage_skew_metrics(self.task_runtime_s, self.task_bytes))
+        # ...and the critical-path timeline anchors (ready/dispatch/commit
+        # per partition) ride the same persistence path
+        metrics.update(
+            stage_timing_metrics(
+                self.ready_unix_ns,
+                self.task_dispatch_unix_ns,
+                self.task_finish_unix_ns,
+            )
+        )
         if self.aqe:
             # the replan decision rides the same persistence path as the
             # skew analytics: visible in the profile after eviction/restart
@@ -383,6 +426,7 @@ class RunningStage:
         return ResolvedStage(
             self.stage_id, self.plan, list(self.output_links),
             dict(self.inputs), aqe=dict(self.aqe),
+            ready_unix_ns=self.ready_unix_ns,
         )
 
 
@@ -437,8 +481,11 @@ class CompletedStage:
         """Re-run after its shuffle files were lost with an executor."""
         from ..obs.export import (
             AQE_OP,
+            STAGE_TIMING_OP,
             TASK_BYTES_RAW_OP,
             TASK_BYTES_WIRE_OP,
+            TASK_DISPATCH_OP,
+            TASK_FINISH_OP,
             TASK_RUNTIME_OP,
         )
 
@@ -474,6 +521,22 @@ class CompletedStage:
             task_runtime_s=runtime_s,
             task_bytes=task_bytes,
             aqe=dict(self.stage_metrics.get(AQE_OP, {})),
+            # seed the timeline anchors back from the persisted maps so a
+            # partial re-run re-reduces the FULL timing distribution (the
+            # same rule the skew seeds follow); re-run partitions simply
+            # overwrite their own entries at re-dispatch/re-commit
+            ready_unix_ns=self.stage_metrics.get(STAGE_TIMING_OP, {}).get(
+                "ready_us", 0
+            )
+            * 1000,
+            task_dispatch_unix_ns={
+                int(p): int(v) * 1000
+                for p, v in self.stage_metrics.get(TASK_DISPATCH_OP, {}).items()
+            },
+            task_finish_unix_ns={
+                int(p): int(v) * 1000
+                for p, v in self.stage_metrics.get(TASK_FINISH_OP, {}).items()
+            },
         )
 
     def reset_tasks(self, executor_id: str) -> int:

@@ -36,7 +36,6 @@ from ..serde.scheduler_types import PartitionLocation
 
 log = logging.getLogger(__name__)
 
-JOB_POLL_INTERVAL_S = 0.1
 # fallback when the session config is unavailable; the live value comes
 # from ballista.client.job_timeout_seconds (SET-able per session)
 JOB_TIMEOUT_S = 300.0
@@ -93,7 +92,10 @@ class FlightSqlService(flight.FlightServerBase):
             return JOB_TIMEOUT_S
 
     def _check_job(self, job_id: str) -> list[PartitionLocation]:
-        """Poll until terminal (reference: check_job flight_sql.rs:99-139)."""
+        """Poll until terminal (reference: check_job flight_sql.rs:99-139).
+        Rides the same jittered exponential backoff schedule as the
+        client poll loop (``task_status.PollBackoff``) so a fleet of
+        FlightSQL statements doesn't poll in lockstep either."""
         # monotonic deadline: a wall-clock jump must neither cut a
         # running statement short nor extend it
         start = time.monotonic()
@@ -101,6 +103,7 @@ class FlightSqlService(flight.FlightServerBase):
         running_since = None
         last_queued: dict = {}
         tm = self.scheduler.state.task_manager
+        backoff = self._poll_backoff()
         while True:
             status = tm.get_job_status(job_id)
             if status is not None:
@@ -108,6 +111,7 @@ class FlightSqlService(flight.FlightServerBase):
                     last_queued = status
                 elif running_since is None:
                     running_since = time.monotonic()
+                    backoff.reset()  # left the queue: poll tightly again
                 if status["state"] == "completed":
                     return list(status.get("locations", []))
                 if status["state"] == "failed":
@@ -123,7 +127,21 @@ class FlightSqlService(flight.FlightServerBase):
                     f"job {job_id} timed out"
                     + poll_timeout_breakdown(start, running_since, last_queued)
                 )
-            time.sleep(JOB_POLL_INTERVAL_S)
+            backoff.sleep(deadline)
+
+    def _poll_backoff(self):
+        """Backoff schedule from the shared session's knobs, read per
+        statement so ``SET`` takes effect immediately; broken settings
+        degrade to the defaults rather than hanging DoGet."""
+        from .task_status import PollBackoff
+
+        try:
+            return PollBackoff(
+                self.session_ctx.config.client_poll_interval_seconds,
+                self.session_ctx.config.client_poll_max_interval_seconds,
+            )
+        except Exception:  # noqa: BLE001
+            return PollBackoff()
 
     # ------------------------------------------------------------- flight
     def get_flight_info(self, context, descriptor: flight.FlightDescriptor):

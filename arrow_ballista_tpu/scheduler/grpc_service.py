@@ -17,6 +17,7 @@ Counterpart of the reference's ``scheduler/src/scheduler_server/grpc.rs``:
 
 from __future__ import annotations
 
+import json
 import logging
 
 import grpc
@@ -176,14 +177,43 @@ class SchedulerGrpcService:
     def GetJobStatus(
         self, request: pb.GetJobStatusParams, context
     ) -> pb.GetJobStatusResult:
-        status = self.server.state.task_manager.get_job_status(request.job_id)
+        tm = self.server.state.task_manager
+        status = tm.get_job_status(request.job_id)
         result = pb.GetJobStatusResult()
         if status is None:
             # unknown job: surface as queued (it may still be planning)
             result.status.queued.SetInParent()
         else:
             result.status.CopyFrom(job_status_to_proto(status))
+        if request.include_progress and status is not None:
+            # live progress piggybacks on the poll the client already
+            # pays for (query doctor, ISSUE 13)
+            progress = tm.get_job_progress(request.job_id)
+            if progress is not None:
+                result.progress_json = json.dumps(
+                    progress, default=str
+                ).encode()
+        if request.include_profile and status is not None:
+            report = self._job_report(request.job_id)
+            if report is not None:
+                result.profile_json = json.dumps(
+                    report, default=str
+                ).encode()
         return result
+
+    def _job_report(self, job_id: str) -> dict | None:
+        """Diagnosis bundle for ``include_profile`` — the same
+        ``obs.doctor.job_report`` the REST profile/critical_path routes
+        serve, so explain_analyze reads identical numbers."""
+        from ..obs.doctor import job_report
+        from ..obs.recorder import spans_for_job
+
+        detail = self.server.state.task_manager.get_job_detail(job_id)
+        if detail is None or "stages" not in detail:
+            return None
+        journal = self.server.state.events
+        events = journal.for_job(job_id) if journal.enabled else []
+        return job_report(detail, spans_for_job(job_id), events)
 
     # ------------------------------------------------------------ lifecycle
     def ExecutorStopped(

@@ -28,7 +28,7 @@ from ..proto import pb
 from ..serde import BallistaCodec, partitioning_to_proto
 from ..serde.scheduler_types import ExecutorMetadata, PartitionId
 from .backend import Keyspace, StateBackend
-from .execution_graph import COMPLETED, FAILED, ExecutionGraph, Task
+from .execution_graph import COMPLETED, FAILED, RUNNING, ExecutionGraph, Task
 from .execution_stage import TaskInfo
 from .executor_manager import ExecutorManager, ExecutorReservation
 
@@ -408,9 +408,16 @@ class TaskManager:
         return self._with_graph(job_id, self._detail_of)
 
     def _detail_of(self, graph: ExecutionGraph) -> dict:
+        from ..obs.critical_path import stage_timing_of
+
         detail = self._status_of(graph)
         detail["task_retries"] = graph.task_retries
         detail["stage_resets"] = dict(graph.stage_reset_counts)
+        # job-level timeline anchors for critical-path attribution
+        # (persisted with the graph, so a decoded copy keeps the
+        # ORIGINAL submit anchor)
+        detail["submitted_us"] = graph.submitted_unix_ns // 1000
+        detail["planning_us"] = getattr(graph, "planning_ns", 0) // 1000
         # per-job attempt histogram: {attempts_consumed: n_tasks}; tasks
         # that never failed land in bucket 0
         histogram: Dict[int, int] = {}
@@ -465,6 +472,12 @@ class TaskManager:
             err = getattr(stage, "error", "")
             if err:
                 row["error"] = err
+            # critical-path timeline anchors (live attrs on
+            # Resolved/Running stages, persisted synthetic metrics on
+            # Completed ones) — obs/critical_path.py's input
+            timing = stage_timing_of(stage)
+            if timing:
+                row["timing"] = timing
             # DAG edges + operator tree for the dashboard's SVG plan view
             # (the reference UI renders the stage graph; QueriesList.tsx)
             row["output_links"] = list(getattr(stage, "output_links", []))
@@ -484,6 +497,126 @@ class TaskManager:
         from ..utils.diagram import produce_diagram
 
         return self._with_graph(job_id, produce_diagram)
+
+    def get_job_progress(self, job_id: str) -> Optional[dict]:
+        """Live progress snapshot (``GET /api/jobs/{id}/progress`` and
+        the gRPC ``include_progress`` poll): per-stage
+        done/running/pending task counts and written bytes, plus a job
+        ETA extrapolated from the observed median task runtime and the
+        current dispatch width.  Cheap by design — the client poll loop
+        may request it every interval."""
+        if self.admission is not None:
+            qs = self.admission.queued_status(job_id)
+            if qs is not None:
+                # still in the admission queue: no graph, no stages —
+                # progress is the queue coordinates
+                return {
+                    **qs,
+                    "stages": [],
+                    "tasks_total": 0,
+                    "tasks_done": 0,
+                    "tasks_running": 0,
+                    "eta_s": None,
+                }
+        return self._with_graph(job_id, self._progress_of)
+
+    @staticmethod
+    def _progress_of(graph: ExecutionGraph) -> dict:
+        import statistics
+
+        from .execution_stage import CompletedStage, RunningStage
+
+        out = {
+            "job_id": graph.job_id,
+            "state": graph.status,
+            "stages": [],
+        }
+        if graph.status == FAILED:
+            out["error"] = graph.error
+        total = done = running_now = 0
+        runtimes: List[float] = []
+        for sid in sorted(graph.stages):
+            stage = graph.stages[sid]
+            n = stage.partitions
+            total += n
+            row = {
+                "stage_id": sid,
+                "state": type(stage).__name__.replace("Stage", ""),
+                "partitions": n,
+                "completed": 0,
+                "running": 0,
+                "pending": n,
+            }
+            if isinstance(stage, (RunningStage, CompletedStage)):
+                completed = stage.completed_tasks()
+                row["completed"] = completed
+                done += completed
+                if isinstance(stage, RunningStage):
+                    active = sum(
+                        1
+                        for t in stage.task_statuses
+                        if t is not None and t.state == "running"
+                    )
+                    row["running"] = active
+                    running_now += active
+                    runtimes.extend(stage.completed_runtime_s)
+                    bytes_wire = sum(
+                        b.get("wire", 0) for b in stage.task_bytes.values()
+                    )
+                else:
+                    from ..obs.export import TASK_RUNTIME_OP
+
+                    ms = stage.stage_metrics.get(TASK_RUNTIME_OP, {})
+                    runtimes.extend(v / 1e3 for v in ms.values())
+                    bytes_wire = sum(
+                        stage.output_partition_bytes().values()
+                    )
+                row["pending"] = max(0, n - row["completed"] - row["running"])
+                if bytes_wire:
+                    row["bytes_wire"] = bytes_wire
+            out["stages"].append(row)
+        out["tasks_total"] = total
+        out["tasks_done"] = done
+        out["tasks_running"] = running_now
+        if graph.status in (COMPLETED, FAILED):
+            # a decoded (evicted) graph re-stamps its monotonic anchor,
+            # so terminal elapsed comes from the persisted wall anchors:
+            # submit (graph proto) → the last task commit anywhere (a
+            # FAILED job has no final-stage completion, but its finished
+            # stages persist __stage_timing__ too)
+            from ..obs.export import STAGE_TIMING_OP
+
+            submitted = graph.submitted_unix_ns // 1000
+            end = 0
+            for stage in graph.stages.values():
+                metrics = getattr(stage, "stage_metrics", None) or {}
+                end = max(
+                    end,
+                    metrics.get(STAGE_TIMING_OP, {}).get("completed_us", 0),
+                )
+                fin = getattr(stage, "task_finish_unix_ns", None)
+                if fin:
+                    end = max(end, max(fin.values()) // 1000)
+            out["elapsed_s"] = (
+                round((end - submitted) / 1e6, 3) if end > submitted else None
+            )
+        else:
+            out["elapsed_s"] = round(
+                (time.monotonic_ns() - graph.submitted_mono_ns) / 1e9, 3
+            )
+        remaining = total - done
+        if graph.status == RUNNING and remaining > 0 and runtimes:
+            # optimistic-but-useful ETA: remaining waves at the observed
+            # median task runtime over the current dispatch width
+            import math
+
+            width = max(1, running_now)
+            out["eta_s"] = round(
+                statistics.median(runtimes) * math.ceil(remaining / width), 3
+            )
+        else:
+            out["eta_s"] = None if graph.status == RUNNING else 0.0
+        return out
 
     # ------------------------------------------------------------- updates
     def update_task_statuses(

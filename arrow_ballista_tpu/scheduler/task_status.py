@@ -8,6 +8,8 @@ executor-side Result → protobuf mapping) and the scheduler-side decode in
 from __future__ import annotations
 
 import json
+import random
+import time
 from typing import List
 
 from ..proto import pb
@@ -149,6 +151,50 @@ def job_status_from_proto(msg: pb.JobStatus) -> dict:
     return {"state": which or "queued"}
 
 
+class PollBackoff:
+    """Jittered exponential poll-interval schedule, shared by the client
+    ``wait_for_job`` loop and the FlightSQL front-end (the same module
+    rule as :func:`poll_timeout_breakdown`): hundreds of concurrent
+    waiting clients polling a fixed interval hit the scheduler in
+    lockstep waves — backing each client off geometrically (x1.6 per
+    poll, capped) with ±25% jitter spreads the load while keeping the
+    first polls tight so short queries stay snappy.
+
+    ``next_delay()`` returns the seconds to sleep before the next poll
+    and advances the schedule; ``reset()`` snaps back to the base (used
+    on a state transition — a job that just started running deserves
+    tight polling again)."""
+
+    GROWTH = 1.6
+    JITTER = 0.25
+
+    def __init__(self, base_s: float = 0.1, cap_s: float = 2.0):
+        self.base_s = max(1e-3, float(base_s))
+        self.cap_s = max(self.base_s, float(cap_s))
+        self._current = self.base_s
+
+    def reset(self) -> None:
+        self._current = self.base_s
+
+    def next_delay(self) -> float:
+        jitter = 1.0 + self.JITTER * (2.0 * random.random() - 1.0)
+        delay = self._current * jitter
+        self._current = min(self._current * self.GROWTH, self.cap_s)
+        return delay
+
+    def sleep(self, deadline_mono: float) -> None:
+        """Sleep the next backed-off interval, clamped to the remaining
+        monotonic deadline (+10ms so the expiry check runs right after):
+        a capped 2s+jitter interval must not make a timeout fire seconds
+        late.  The one sleep rule for both poll loops."""
+        time.sleep(
+            min(
+                self.next_delay(),
+                max(0.0, deadline_mono - time.monotonic()) + 0.01,
+            )
+        )
+
+
 def poll_timeout_breakdown(
     start_mono: float, running_since_mono, last_queued: dict
 ) -> str:
@@ -156,8 +202,6 @@ def poll_timeout_breakdown(
     running)`` — shared by the client poll loop and the FlightSQL
     front-end so an admission-starved job reads differently from a
     wedged one in both timeout messages."""
-    import time
-
     now = time.monotonic()
     queued_s = (
         running_since_mono if running_since_mono is not None else now

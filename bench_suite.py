@@ -42,8 +42,14 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   weights 2:1 vs the 2:1 completed-throughput target, and a burst past
   max_queued_jobs shedding with structured ClusterSaturated errors
 
+  plus the obs leg (obs_overhead_pct): disabled-path span-API +
+  timestamp-anchor cost and the enabled-path query-doctor attribution
+  pass, both priced against the shuffle leg (PR 3 methodology,
+  acceptance < 2%), with the measured job's wall-clock category
+  breakdown riding the record
+
 Usage: python bench_suite.py
-[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|all]
+[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|obs|all]
 (default all)
 """
 
@@ -715,6 +721,16 @@ def bench_keyed() -> None:
     )
 
 
+def bench_obs() -> None:
+    """Obs leg (ISSUE 13): disabled-path + enabled-path overhead with
+    the query-doctor attribution pass in the picture (PR 3 methodology —
+    priced against the shuffle leg, acceptance < 2%), plus the measured
+    job's wall-clock category breakdown riding the record."""
+    from benchmarks.obs_doctor import run_obs_bench
+
+    _emit(run_obs_bench())
+
+
 def bench_concurrent() -> None:
     """Concurrency leg (ISSUE 12): N open-loop clients of mixed
     priority against one standalone cluster at >=4x slot
@@ -760,6 +776,8 @@ def main() -> None:
         bench_keyed()
     if which in ("concurrent", "all"):
         bench_concurrent()
+    if which in ("obs", "all"):
+        bench_obs()
 
 
 if __name__ == "__main__":

@@ -111,6 +111,29 @@ def _fmt_value(rec: Optional[dict]) -> str:
     before, after = rec.get("tasks_before"), rec.get("tasks_after")
     if isinstance(before, int) and isinstance(after, int):
         s += f" [{before}→{after} tasks]"
+    # wall-clock attribution: the obs leg's record carries the measured
+    # job's category breakdown — show where the time went, top two
+    breakdown = rec.get("breakdown")
+    if isinstance(breakdown, dict):
+        top = sorted(
+            (
+                (k, v)
+                for k, v in breakdown.items()
+                if isinstance(v, (int, float)) and v > 0
+            ),
+            key=lambda kv: -kv[1],
+        )[:2]
+        if top:
+            total = sum(
+                v for v in breakdown.values() if isinstance(v, (int, float))
+            )
+            parts = [
+                f"{k[:-3].replace('_', ' ')} {100 * v / total:.0f}%"
+                for k, v in top
+                if total
+            ]
+            if parts:
+                s += f" [{', '.join(parts)}]"
     return s
 
 

@@ -71,6 +71,20 @@ NEW_FIELDS = [
     ("QueuedJob", "pool", 2, F.TYPE_STRING, F.LABEL_OPTIONAL),
     ("QueuedJob", "queued_seconds", 3, F.TYPE_DOUBLE, F.LABEL_OPTIONAL),
     ("ExecutionGraphProto", "tenant_json", 16, F.TYPE_STRING, F.LABEL_OPTIONAL),
+    # query doctor (ISSUE 13): the status poll can piggyback live
+    # progress (per-stage done/running/pending + ETA) and, on demand,
+    # the full diagnosis bundle (profile + critical path + findings) so
+    # pure-gRPC clients get explain_analyze without a REST round trip
+    ("GetJobStatusParams", "include_progress", 2, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+    ("GetJobStatusParams", "include_profile", 3, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+    ("GetJobStatusResult", "progress_json", 2, F.TYPE_BYTES, F.LABEL_OPTIONAL),
+    ("GetJobStatusResult", "profile_json", 3, F.TYPE_BYTES, F.LABEL_OPTIONAL),
+    # ...and the job-level timeline anchors persist with the graph, so a
+    # decoded (evicted/adopted) job's breakdown keeps the ORIGINAL
+    # submit anchor — including failed jobs, which never complete a
+    # final stage to stash it in
+    ("ExecutionGraphProto", "submitted_unix_us", 17, F.TYPE_UINT64, F.LABEL_OPTIONAL),
+    ("ExecutionGraphProto", "planning_us", 18, F.TYPE_UINT64, F.LABEL_OPTIONAL),
 ]
 
 HEADER = '''# -*- coding: utf-8 -*-

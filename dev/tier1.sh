@@ -91,6 +91,18 @@ print(json.dumps({"bench_smoke": "admission", **run_admission_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.obs_doctor import run_doctor_smoke
+
+# query-doctor smoke: tiny standalone job with a manufactured straggler
+# — the critical_path endpoint's category sum must land within
+# tolerance of wall-clock and the doctor must fire skewed_stage with
+# evidence naming the real stage/partition (asserted inside)
+print(json.dumps({"bench_smoke": "doctor", **run_doctor_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi

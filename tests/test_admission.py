@@ -427,6 +427,7 @@ def test_client_timeout_distinguishes_queued_from_running():
 
     ctx = BallistaContext.__new__(BallistaContext)
     ctx.stub = _FakeStub()
+    ctx.config = BallistaConfig()  # wait_for_job reads the poll-backoff knobs
     with pytest.raises(ExecutionError) as ei:
         ctx.wait_for_job("j-queued", timeout_s=0.25)
     msg = str(ei.value)

@@ -1,0 +1,781 @@
+"""Query doctor tests (ISSUE 13): critical-path attribution, live
+progress, automated bottleneck diagnosis.
+
+Unit-level: the breakdown's partition property (categories sum to
+wall-clock by construction), chain selection through the last-finishing
+producer, degradation without timing anchors, each doctor rule on
+synthetic evidence, the jittered poll backoff, and the Chrome-trace
+flow/thread_name satellite.
+
+E2E (standalone cluster, CPU operator path — same constraints as
+test_obs.py): category sum within 5% of wall-clock with nonzero
+barrier-wait and scheduling-delay on a multi-stage shuffle query; the
+doctor fires on three manufactured scenarios (skew via a task.run delay
+fault, fetch-bound via a shuffle.fetch delay fault, admission-queued via
+the PR 12 queue) with evidence pointing at real stage ids; and the
+sampling-off degradation contract — NO spans at all must still yield a
+complete breakdown from the scheduler-side anchors + persisted stage
+metrics (profile span columns null, pinned here).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.obs import doctor as doc
+from arrow_ballista_tpu.obs import trace
+from arrow_ballista_tpu.obs.critical_path import (
+    admission_wait_ms,
+    compute_critical_path,
+)
+from arrow_ballista_tpu.obs.export import (
+    STAGE_TIMING_OP,
+    TASK_DISPATCH_OP,
+    TASK_FINISH_OP,
+    TASK_RUNTIME_OP,
+    chrome_trace,
+    stage_timing_metrics,
+)
+from arrow_ballista_tpu.obs.recorder import get_recorder
+from arrow_ballista_tpu.scheduler.task_status import PollBackoff
+from arrow_ballista_tpu.testing import faults
+
+pytestmark = pytest.mark.obs
+
+# CPU-only operator path for cluster tests (this environment's jax lacks
+# shard_map; the pyarrow sort kernel is broken at seed) — mirrors
+# test_obs.py's OBS_CONFIG
+CLUSTER_CONFIG = {
+    "ballista.obs.enabled": "true",
+    "ballista.mesh.enable": "false",
+    "ballista.shuffle.partitions": "2",
+    "ballista.tpu.min_rows": "0",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    get_recorder().set_forward(None)
+    get_recorder().drain()
+    yield
+    faults.clear()
+    trace.configure(enabled=False, sample_rate=1.0)
+    get_recorder().set_forward(None)
+    get_recorder().drain()
+
+
+# =====================================================================
+# synthetic details
+# =====================================================================
+US = 1000  # µs per ms, for readable synthetic anchors
+
+def _stage(sid, links, ready_ms, disp, fin, metrics=None, partitions=None):
+    """Detail row with timing anchors given in ms-since-epoch-0."""
+    row = {
+        "stage_id": sid,
+        "state": "Completed",
+        "partitions": partitions or len(disp),
+        "output_links": links,
+        "timing": {
+            "ready_us": ready_ms * US,
+            "dispatch_us": {p: v * US for p, v in disp.items()},
+            "finish_us": {p: v * US for p, v in fin.items()},
+        },
+    }
+    if metrics:
+        row["metrics"] = metrics
+    return row
+
+
+def _detail(stages, submitted_ms=0, planning_ms=5, state="completed"):
+    return {
+        "job_id": "synthetic",
+        "state": state,
+        "submitted_us": submitted_ms * US,
+        "planning_us": planning_ms * US,
+        "stages": stages,
+    }
+
+
+def test_breakdown_partitions_wall_clock_exactly():
+    """Two leaf producers feed a final stage; the chain must go through
+    the LATER-finishing producer and the categories must sum to
+    wall-clock exactly (the partition property)."""
+    detail = _detail(
+        [
+            _stage(1, [3], 5, {0: 10, 1: 12}, {0: 100, 1: 220}),
+            _stage(2, [3], 5, {0: 10}, {0: 60}),  # earlier: off the path
+            _stage(3, [], 221, {0: 230, 1: 231}, {0: 300, 1: 310}),
+        ],
+        planning_ms=5,
+    )
+    cp = compute_critical_path(detail)
+    assert [r["stage_id"] for r in cp["critical_path"]] == [1, 3]
+    assert cp["complete"] is True
+    assert cp["wall_clock_ms"] == pytest.approx(310.0)
+    assert cp["breakdown_total_ms"] == pytest.approx(cp["wall_clock_ms"])
+    assert cp["coverage"] == pytest.approx(1.0)
+    b = cp["breakdown"]
+    assert b["planning_ms"] == pytest.approx(5.0)
+    # producer: dispatch 10 after ready 5 (sched 5 from cursor);
+    # first finish 100, last 220 -> barrier tail 120
+    assert b["barrier_wait_ms"] == pytest.approx(120.0)
+    assert cp["pipelining_upside_ms"] == pytest.approx(120.0)
+    # final stage has no barrier tail (no consumer to hold back)
+    final_seg = cp["critical_path"][-1]["segments"]
+    assert final_seg["barrier_wait_ms"] == 0.0
+    # scheduling: 10-5 (stage 1) + 230-220 (stage 3)
+    assert b["scheduling_delay_ms"] == pytest.approx(15.0)
+
+
+def test_breakdown_splits_window_by_operator_metrics():
+    """The active window splits proportionally to the stage's summed
+    fetch/compile/execute/write metrics; residual is compute."""
+    # one task, runs 100ms: 40% fetch wait, 20% compile, 10% write
+    metrics = {
+        "ShuffleReaderExec": {"fetch_wait_time_ns": 40 * 10**6},
+        "TpuStageExec": {"tpu_compile_ns": 20 * 10**6},
+        "ShuffleWriterExec": {"write_time_ns": 10 * 10**6},
+        "__stage_skew__": {"runtime_ms_max": 999999},  # synthetic: ignored
+    }
+    detail = _detail(
+        [_stage(1, [], 0, {0: 0}, {0: 100}, metrics=metrics)], planning_ms=0
+    )
+    cp = compute_critical_path(detail)
+    b = cp["breakdown"]
+    assert b["fetch_wait_ms"] == pytest.approx(40.0)
+    assert b["tpu_compile_ms"] == pytest.approx(20.0)
+    assert b["shuffle_write_ms"] == pytest.approx(10.0)
+    assert b["compute_ms"] == pytest.approx(30.0)
+    assert cp["breakdown_total_ms"] == pytest.approx(cp["wall_clock_ms"])
+
+
+def test_anchorless_chain_stage_charges_other_not_scheduling():
+    """Regression: a critical-path stage with NO anchors (pre-upgrade
+    stage, restart mid-job) must degrade its runtime to UNATTRIBUTED
+    time (other_ms), never leak it into the next stage's
+    scheduling_delay_ms — that number feeds the autoscaler."""
+    producer = {
+        "stage_id": 1, "state": "Completed", "partitions": 2,
+        "output_links": [2],  # multi-second runtime, zero anchors
+    }
+    consumer = _stage(2, [], 5000, {0: 5010}, {0: 5100})
+    cp = compute_critical_path(_detail([producer, consumer], planning_ms=5))
+    assert cp["complete"] is False  # degraded, flagged
+    b = cp["breakdown"]
+    # the producer's ~5s lands in other_ms; scheduling stays the real
+    # ready→dispatch gap (10ms)
+    assert b["other_ms"] == pytest.approx(4995.0)
+    assert b["scheduling_delay_ms"] == pytest.approx(10.0)
+    assert cp["breakdown_total_ms"] == pytest.approx(cp["wall_clock_ms"])
+
+
+def test_critical_path_degrades_without_timing():
+    """Stages with no anchors (pre-PR graphs, restart) must not raise —
+    they flag the result incomplete."""
+    detail = _detail(
+        [
+            {"stage_id": 1, "state": "Completed", "partitions": 2,
+             "output_links": []},
+        ],
+    )
+    cp = compute_critical_path(detail)
+    assert cp["complete"] is False
+    assert cp["critical_path"] == []
+    # admission-only wall when nothing else is known
+    cp2 = compute_critical_path(
+        detail, events=[{"kind": "job_admitted", "queue_wait_s": 0.5}]
+    )
+    assert cp2["breakdown"]["admission_queue_wait_ms"] == pytest.approx(500.0)
+
+
+def test_admission_wait_from_events():
+    assert admission_wait_ms(None) == 0.0
+    assert admission_wait_ms([{"kind": "job_queued"}]) == 0.0
+    assert admission_wait_ms(
+        [{"kind": "job_queued"}, {"kind": "job_admitted", "queue_wait_s": 1.25}]
+    ) == pytest.approx(1250.0)
+    assert admission_wait_ms([{"kind": "job_admitted", "queue_wait_s": "x"}]) == 0.0
+
+
+def test_stage_timing_metrics_roundtrip():
+    out = stage_timing_metrics(
+        7_000_000, {0: 10_000_000, 1: 12_000_000}, {0: 90_000_000, 1: 110_000_000}
+    )
+    s = out[STAGE_TIMING_OP]
+    assert s["ready_us"] == 7_000
+    assert s["first_dispatch_us"] == 10_000
+    assert s["first_finish_us"] == 90_000
+    assert s["completed_us"] == 110_000
+    assert s["partitions"] == 2
+    assert out[TASK_DISPATCH_OP] == {"0": 10_000, "1": 12_000}
+    assert out[TASK_FINISH_OP] == {"0": 90_000, "1": 110_000}
+    assert stage_timing_metrics(0, {}, {}) == {}
+
+
+# =====================================================================
+# doctor rules
+# =====================================================================
+def _cp_with(breakdown=None, stages=None, wall=1000.0):
+    b = {c: 0.0 for c in (
+        "admission_queue_wait_ms", "planning_ms", "scheduling_delay_ms",
+        "fetch_wait_ms", "tpu_compile_ms", "tpu_execute_ms", "compute_ms",
+        "shuffle_write_ms", "barrier_wait_ms", "other_ms",
+    )}
+    b.update(breakdown or {})
+    return {
+        "wall_clock_ms": wall,
+        "breakdown": b,
+        "stages": stages or {},
+        "critical_path": [],
+        "complete": True,
+    }
+
+
+def test_doctor_skewed_stage_rule():
+    detail = {"stages": [
+        {"stage_id": 4, "metrics": {TASK_RUNTIME_OP: {"0": 40, "1": 900}}},
+    ]}
+    profile = {"stages": [
+        {"stage_id": 4,
+         "skew": {"partitions": 2,
+                  "runtime_ms": {"p50": 40, "p99": 900, "max": 900,
+                                 "max_over_median": 22.5}}},
+    ]}
+    findings = doc.diagnose(detail, profile, _cp_with())
+    skew = [f for f in findings if f["code"] == "skewed_stage"]
+    assert len(skew) == 1
+    assert skew[0]["stage_id"] == 4
+    assert skew[0]["severity"] == "warn"
+    assert skew[0]["evidence"]["slowest_partition"] == 1
+    assert skew[0]["evidence"]["max_over_median"] == 22.5
+    # balanced stage: quiet
+    profile["stages"][0]["skew"]["runtime_ms"]["max_over_median"] = 1.2
+    assert not [
+        f
+        for f in doc.diagnose(detail, profile, _cp_with())
+        if f["code"] == "skewed_stage"
+    ]
+
+
+def test_doctor_fetch_bound_and_compile_rules():
+    cp = _cp_with(stages={
+        2: {"stage_id": 2, "task_time_ms": 1000.0, "fetch_wait_ms": 600.0,
+            "tpu_compile_ms": 0.0, "tpu_execute_ms": 0.0},
+        3: {"stage_id": 3, "task_time_ms": 500.0, "fetch_wait_ms": 0.0,
+            "tpu_compile_ms": 400.0, "tpu_execute_ms": 50.0},
+    })
+    findings = doc.diagnose({}, {"stages": []}, cp)
+    codes = {f["code"]: f for f in findings}
+    assert codes["fetch_bound_stage"]["stage_id"] == 2
+    assert codes["fetch_bound_stage"]["evidence"]["fetch_wait_ms"] == 600.0
+    assert codes["compile_dominated_stage"]["stage_id"] == 3
+    # warn sorts before info
+    assert findings[0]["code"] == "fetch_bound_stage"
+
+
+def test_doctor_barrier_and_admission_rules():
+    cp = _cp_with(
+        breakdown={"barrier_wait_ms": 400.0, "admission_queue_wait_ms": 300.0},
+        wall=1000.0,
+    )
+    findings = doc.diagnose(
+        {}, {"stages": []}, cp,
+        events=[{"kind": "job_admitted", "queue_wait_s": 0.3, "pool": "p1"}],
+    )
+    codes = {f["code"]: f for f in findings}
+    assert codes["barrier_dominated_job"]["evidence"]["pipelining_upside_ms"] == 400.0
+    assert codes["admission_queued_job"]["evidence"]["pool"] == "p1"
+    # below thresholds: quiet
+    quiet = doc.diagnose(
+        {}, {"stages": []},
+        _cp_with(breakdown={"barrier_wait_ms": 10.0,
+                            "admission_queue_wait_ms": 10.0}),
+    )
+    assert not quiet
+
+
+def test_doctor_locality_and_speculation_rules():
+    profile = {"stages": [
+        {"stage_id": 2,
+         "locality": {"placement": {"local": 1, "any": 5},
+                      "remote_fetches": 9},
+         "speculation": {"launched": 2, "wins": 1, "wasted": 1}},
+    ]}
+    findings = doc.diagnose({}, profile, _cp_with())
+    codes = {f["code"]: f for f in findings}
+    assert codes["locality_miss_stage"]["evidence"]["placed_any"] == 5
+    assert codes["speculation_saved_straggler"]["evidence"]["wins"] == 1
+
+
+def test_render_explain_analyze_smoke():
+    detail = _detail(
+        [
+            _stage(1, [2], 5, {0: 10, 1: 12}, {0: 100, 1: 220}),
+            _stage(2, [], 221, {0: 230}, {0: 300}),
+        ]
+    )
+    cp = compute_critical_path(detail)
+    profile = {
+        "job_id": "synthetic", "state": "completed",
+        "stages": [
+            {"stage_id": 1, "state": "Completed", "partitions": 2,
+             "shuffle_write": {"bytes_wire": 1234}},
+            {"stage_id": 2, "state": "Completed", "partitions": 1,
+             "shuffle_bytes_fetched": 99},
+        ],
+    }
+    findings = doc.diagnose(detail, profile, cp)
+    text = doc.render_explain_analyze(
+        {"profile": profile, "critical_path": cp, "doctor": findings}
+    )
+    assert "Job synthetic" in text
+    assert "where it went:" in text
+    assert "critical path:" in text
+    assert "stage 1" in text and "stage 2" in text
+    assert "barrier" in text  # 120ms barrier tail from stage 1
+
+
+# =====================================================================
+# poll backoff (satellite)
+# =====================================================================
+def test_poll_backoff_growth_cap_jitter_reset():
+    b = PollBackoff(0.1, 2.0)
+    raw = []
+    for _ in range(20):
+        raw.append(b.next_delay())
+    # jitter bounded: every delay within ±25% of the un-jittered schedule
+    expect = 0.1
+    for d in raw:
+        assert 0.74 * expect <= d <= 1.26 * expect
+        expect = min(expect * PollBackoff.GROWTH, 2.0)
+    # capped: the tail never exceeds cap + jitter
+    assert max(raw[-5:]) <= 2.0 * 1.26
+    # grows: later delays are on a higher schedule than the first
+    assert sum(raw[-3:]) > sum(raw[:3])
+    b.reset()
+    assert b.next_delay() <= 0.1 * 1.26
+    # degenerate config stays sane
+    tight = PollBackoff(0.0, 0.0)
+    assert 0 < tight.next_delay() < 0.1
+
+
+def test_flight_sql_uses_shared_backoff():
+    """The FlightSQL front-end builds the SAME schedule from the session
+    knobs (the shared-path satellite)."""
+    from arrow_ballista_tpu.scheduler.flight_sql import FlightSqlService
+
+    class _Sess:
+        config = BallistaConfig(
+            {"ballista.client.poll_interval_seconds": "0.25",
+             "ballista.client.poll_max_interval_seconds": "3.0"}
+        )
+
+    svc = FlightSqlService.__new__(FlightSqlService)
+    svc.session_ctx = _Sess()
+    b = svc._poll_backoff()
+    assert isinstance(b, PollBackoff)
+    assert b.base_s == 0.25 and b.cap_s == 3.0
+
+    class _Broken:
+        @property
+        def config(self):
+            raise RuntimeError("no session")
+
+    svc.session_ctx = _Broken()
+    b = svc._poll_backoff()
+    assert b.base_s == pytest.approx(0.1)
+
+
+# =====================================================================
+# chrome-trace flow events + thread names (satellite)
+# =====================================================================
+def test_chrome_trace_flow_events_and_thread_names():
+    fetch = {
+        "name": "shuffle.fetch", "trace": "t1", "span": "aaa", "parent": "root",
+        "proc": "executor:e1", "tid": 7, "ts": 1_000_000, "dur": 5_000_000,
+        "attrs": {},
+    }
+    serve = {
+        "name": "flight.do_get", "trace": "t1", "span": "bbb", "parent": "aaa",
+        "proc": "executor:e2", "tid": 9, "ts": 2_000_000, "dur": 1_000_000,
+        "attrs": {},
+    }
+    orphan = {  # parent span missing (ring overflow): no flow arrow
+        "name": "flight.do_get", "trace": "t1", "span": "ccc", "parent": "zzz",
+        "proc": "executor:e1", "tid": 7, "ts": 2_500_000, "dur": 100_000,
+        "attrs": {},
+    }
+    out = chrome_trace([fetch, serve, orphan], "j1")
+    events = out["traceEvents"]
+    thread_meta = [e for e in events if e["name"] == "thread_name"]
+    assert {(e["pid"], e["tid"]) for e in thread_meta} == {(1, 7), (2, 9)}
+    # the fetch thread is named after its first span
+    by_tid = {(e["pid"], e["tid"]): e["args"]["name"] for e in thread_meta}
+    assert by_tid[(1, 7)] == "shuffle.fetch"
+    flows = [e for e in events if e.get("cat") == "flow"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    (start,) = [e for e in flows if e["ph"] == "s"]
+    (finish,) = [e for e in flows if e["ph"] == "f"]
+    assert start["id"] == finish["id"] == "bbb"
+    # the start step sits inside the parent (fetch) slice, on its track
+    assert start["pid"] == 1 and start["tid"] == 7
+    assert 1_000 <= start["ts"] <= 6_000  # µs, within [fetch.ts, +dur]
+    assert finish["pid"] == 2 and finish["bp"] == "e"
+    # only ONE arrow: the orphaned child produced none
+    assert len(flows) == 2
+
+
+# =====================================================================
+# e2e: standalone cluster
+# =====================================================================
+def _mk_cluster(extra_config=None, **kw):
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    cfg = dict(CLUSTER_CONFIG)
+    cfg.update(extra_config or {})
+    return BallistaContext.standalone(
+        config=BallistaConfig(cfg), num_executors=2, concurrent_tasks=2, **kw
+    )
+
+
+def _register_t(ctx, n=500):
+    from arrow_ballista_tpu.context import MemoryTable
+
+    ctx.register_table(
+        "t",
+        MemoryTable.from_table(
+            pa.table(
+                {"g": ["a", "b", "c", "d"] * n, "x": [1.0, 2.0, 3.0, 4.0] * n}
+            ),
+            2,
+        ),
+    )
+
+
+def _critical_path_http(scheduler, job_id):
+    from arrow_ballista_tpu.scheduler.api import ApiServerHandle
+
+    api = ApiServerHandle(scheduler.server, "127.0.0.1", 0).start()
+    try:
+        base = f"http://127.0.0.1:{api.port}"
+        cp = json.load(
+            urllib.request.urlopen(f"{base}/api/jobs/{job_id}/critical_path")
+        )
+        prof = json.load(
+            urllib.request.urlopen(f"{base}/api/jobs/{job_id}/profile")
+        )
+        prog = json.load(
+            urllib.request.urlopen(f"{base}/api/jobs/{job_id}/progress")
+        )
+        return cp, prof, prog
+    finally:
+        api.stop()
+
+
+def test_e2e_critical_path_sums_to_wall_clock():
+    """Acceptance: on a real multi-stage shuffle query the category
+    breakdown sums to job wall-clock within 5%, with nonzero
+    barrier-wait and scheduling-delay; live progress flows through the
+    wait_for_job callback; explain_analyze renders client-side."""
+    snapshots = []
+    ctx = _mk_cluster()
+    try:
+        _register_t(ctx)
+        job_id = ctx.execute_logical_plan(
+            ctx.sql("select g, sum(x) as s, count(x) as n from t group by g").plan
+        )
+        ctx._job_ids.add(job_id)
+        status = ctx.wait_for_job(job_id, progress=snapshots.append)
+        out = ctx.fetch_job_output(status)
+        assert out.num_rows == 4
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+
+        # live progress: the callback saw the canonical shape
+        assert snapshots, "no progress snapshots delivered"
+        for snap in snapshots:
+            assert snap["tasks_total"] >= snap["tasks_done"]
+            assert {"stages", "tasks_running", "eta_s"} <= set(snap)
+        cp, prof, prog = _critical_path_http(scheduler, job_id)
+        assert cp["complete"] is True
+        wall = cp["wall_clock_ms"]
+        assert wall > 0
+        # the acceptance tolerance: categories sum to wall within 5%
+        assert abs(cp["breakdown_total_ms"] - wall) <= 0.05 * wall
+        assert len(cp["critical_path"]) >= 2, "multi-stage path expected"
+        b = cp["breakdown"]
+        assert b["scheduling_delay_ms"] > 0
+        assert b["barrier_wait_ms"] > 0
+        assert b["compute_ms"] > 0
+        # profile surfaces the doctor + breakdown (same numbers)
+        assert prof["breakdown"] == cp["breakdown"]
+        assert isinstance(prof["doctor"], list)
+        # terminal progress: everything done, ETA 0
+        assert prog["tasks_done"] == prog["tasks_total"] > 0
+        assert prog["eta_s"] == 0.0
+        assert all(s["pending"] == 0 for s in prog["stages"])
+        # client-side explain_analyze renders the same bundle over gRPC
+        text = ctx.explain_analyze(job_id)
+        assert "where it went:" in text and "critical path:" in text
+    finally:
+        ctx.close()
+
+
+def test_e2e_doctor_fires_on_manufactured_skew():
+    """Scenario 1: one straggler task (task.run delay fault) →
+    skewed_stage with evidence naming the real stage and partition."""
+    ctx = _mk_cluster()
+    try:
+        _register_t(ctx)
+        # the delay must dominate the fast task's runtime INCLUDING its
+        # first-run XLA compile (~300ms on this box), or max/median can
+        # land under the 2.0 coefficient and the test flakes
+        faults.arm(
+            "task.run",
+            times=1,
+            action="delay",
+            delay_ms=1500,
+            match=lambda partition_id=0, speculative=False, **_:
+                partition_id == 1 and not speculative,
+        )
+        ctx.sql("select g, sum(x) as s from t group by g").collect()
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        cp, prof, _ = _critical_path_http(scheduler, job_id)
+        skew = [f for f in cp["doctor"] if f["code"] == "skewed_stage"]
+        assert skew, f"no skew finding in {cp['doctor']}"
+        f = skew[0]
+        stage_ids = {s["stage_id"] for s in prof["stages"]}
+        assert f["stage_id"] in stage_ids
+        assert f["evidence"]["slowest_partition"] == 1
+        assert f["evidence"]["runtime_ms_max"] >= 1200
+        assert f["evidence"]["max_over_median"] >= doc.SKEW_COEFFICIENT
+        # the straggler also IS the barrier tail: upside reported
+        assert cp["pipelining_upside_ms"] >= 1000
+    finally:
+        ctx.close()
+
+
+def test_e2e_doctor_fires_on_fetch_bound_stage():
+    """Scenario 2: delayed shuffle fetches (faults delay on the
+    shuffle.fetch point) → fetch_bound_stage naming the reduce stage."""
+    ctx = _mk_cluster()
+    try:
+        _register_t(ctx, n=250)
+        faults.arm(
+            "shuffle.fetch", times=-1, action="delay", delay_ms=250
+        )
+        ctx.sql("select g, sum(x) as s from t group by g").collect()
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        faults.clear()
+        cp, prof, _ = _critical_path_http(scheduler, job_id)
+        fetch = [f for f in cp["doctor"] if f["code"] == "fetch_bound_stage"]
+        assert fetch, f"no fetch-bound finding in {cp['doctor']}"
+        f = fetch[0]
+        # evidence points at a real stage that actually fetched bytes
+        row = {s["stage_id"]: s for s in prof["stages"]}[f["stage_id"]]
+        assert row["shuffle_bytes_fetched"] > 0
+        assert f["evidence"]["fetch_wait_ms"] >= 200
+        assert (
+            f["evidence"]["fetch_wait_ms"]
+            >= doc.FETCH_FRACTION * f["evidence"]["task_time_ms"]
+        )
+    finally:
+        faults.clear()
+        ctx.close()
+
+
+def test_e2e_doctor_fires_on_admission_queued_job(tmp_path):
+    """Scenario 3: a job held by the PR 12 admission queue →
+    admission_queued_job with the journal's queue-wait evidence.  Runs
+    at state level (the test_admission.py fixture pattern) with a real
+    on-disk journal."""
+    from arrow_ballista_tpu.obs.doctor import job_report
+    from arrow_ballista_tpu.scheduler.backend import MemoryBackend
+    from arrow_ballista_tpu.scheduler.event_loop import EventLoop
+    from arrow_ballista_tpu.scheduler.execution_stage import TaskInfo
+    from arrow_ballista_tpu.scheduler.query_stage_scheduler import (
+        JobQueued,
+        QueryStageScheduler,
+        TaskUpdating,
+    )
+    from arrow_ballista_tpu.scheduler.state import SchedulerState
+    from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
+    from arrow_ballista_tpu.serde.scheduler_types import (
+        ExecutorMetadata,
+        ExecutorSpecification,
+        ShuffleWritePartition,
+    )
+
+    state = SchedulerState(
+        MemoryBackend(),
+        "sched-doc",
+        TaskSchedulingPolicy.PULL_STAGED,
+        launcher=NoopLauncher(),
+        work_dir=str(tmp_path / "work"),
+        event_journal_dir=str(tmp_path / "journal"),
+    )
+    loop = EventLoop("qss-doc", 10000, QueryStageScheduler(state))
+    loop.start()
+    meta = ExecutorMetadata(
+        "exec-1", "127.0.0.1", 50051, 50052, ExecutorSpecification(4)
+    )
+    state.executor_manager.register_executor(meta)
+
+    def run_one_task() -> bool:
+        from arrow_ballista_tpu.scheduler.executor_manager import (
+            ExecutorReservation,
+        )
+
+        assignments, _f, _p = state.task_manager.fill_reservations(
+            [ExecutorReservation("exec-1")]
+        )
+        if not assignments:
+            return False
+        _, task = assignments[0]
+        part = task.output_partitioning
+        n_out = part.n if part is not None else 1
+        partitions = [
+            ShuffleWritePartition(p, f"/fake/{task.partition}/{p}", 1, 5, 50)
+            for p in range(n_out)
+        ]
+        loop.get_sender().post(
+            TaskUpdating(
+                meta,
+                [TaskInfo(task.partition, "completed", "exec-1",
+                          partitions=partitions)],
+            )
+        )
+        assert loop.drain(5.0)
+        return True
+
+    try:
+        session = state.session_manager.create_session(
+            {
+                "ballista.shuffle.partitions": "2",
+                "ballista.tpu.enable": "false",
+                "ballista.admission.enabled": "true",
+                "ballista.admission.max_running_jobs": "1",
+                "ballista.tenant.id": "doc-pool",
+            }
+        )
+        session.register_arrow_table(
+            "t",
+            pa.table({"g": ["a", "b", "a", "c"], "v": [1.0, 2.0, 3.0, 4.0]}),
+            partitions=2,
+        )
+        plan = session.sql("select g, sum(v) as s from t group by g").logical_plan()
+        loop.get_sender().post(JobQueued("job-a", session.session_id, plan))
+        assert loop.drain(5.0)
+        plan_b = session.sql(
+            "select g, count(v) as n from t group by g"
+        ).logical_plan()
+        loop.get_sender().post(JobQueued("job-b", session.session_id, plan_b))
+        assert loop.drain(5.0)
+        # job-b is queued behind job-a; let the queue wait accumulate
+        assert state.task_manager.get_job_status("job-b")["state"] == "queued"
+        time.sleep(0.4)
+        for _ in range(200):
+            if not run_one_task():
+                if state.task_manager.get_job_status("job-b")["state"] in (
+                    "completed", "failed",
+                ):
+                    break
+                time.sleep(0.01)
+        assert state.task_manager.get_job_status("job-b")["state"] == "completed"
+
+        detail = state.task_manager.get_job_detail("job-b")
+        events = state.events.for_job("job-b")
+        report = job_report(detail, [], events)
+        findings = [
+            f for f in report["doctor"] if f["code"] == "admission_queued_job"
+        ]
+        assert findings, f"no admission finding in {report['doctor']}"
+        ev = findings[0]["evidence"]
+        assert ev["queue_wait_ms"] >= 300
+        assert ev["pool"] == "doc-pool"
+        assert report["critical_path"]["breakdown"][
+            "admission_queue_wait_ms"
+        ] == pytest.approx(ev["queue_wait_ms"])
+        # ...and job-a, never queued, stays quiet
+        report_a = job_report(
+            state.task_manager.get_job_detail("job-a"),
+            [],
+            state.events.for_job("job-a"),
+        )
+        assert not [
+            f
+            for f in report_a["doctor"]
+            if f["code"] == "admission_queued_job"
+        ]
+    finally:
+        loop.stop()
+        state.executor_manager.close()
+        state.events.close()
+
+
+def test_e2e_sampling_off_still_yields_breakdown():
+    """Degradation contract (pinned): with obs.sample_rate=0 the job has
+    NO spans at all — the profile's span-derived columns stay null, but
+    the critical-path breakdown is complete from the scheduler-side
+    anchors + persisted stage metrics alone."""
+    ctx = _mk_cluster({"ballista.obs.sample_rate": "0.0"})
+    try:
+        _register_t(ctx, n=100)
+        ctx.sql("select g, sum(x) as s from t group by g").collect()
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        cp, prof, prog = _critical_path_http(scheduler, job_id)
+        # no spans: the span-joined columns are null...
+        assert prof["span_count"] == 0
+        for row in prof["stages"]:
+            assert row["wall_ms"] is None
+            assert row["task_time_ms"] is None
+            assert row["queue_wait_ms"] is None
+        # ...but the journal + persisted-stage-metric path still yields a
+        # full breakdown that sums to wall-clock
+        assert cp["complete"] is True
+        assert cp["coverage"] == pytest.approx(1.0, abs=0.05)
+        assert cp["breakdown"]["compute_ms"] > 0
+        assert len(cp["critical_path"]) >= 2
+        assert isinstance(prof["doctor"], list)
+        assert prog["tasks_done"] == prog["tasks_total"]
+    finally:
+        ctx.close()
+
+
+def test_progress_and_critical_path_survive_cache_eviction():
+    """A finished job's progress/critical_path read from the PERSISTED
+    graph (decoded copy) once complete_job evicted the cache entry —
+    the timing anchors must come back from the synthetic metrics."""
+    ctx = _mk_cluster()
+    try:
+        _register_t(ctx, n=100)
+        ctx.sql("select g, sum(x) as s from t group by g").collect()
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        tm = scheduler.server.state.task_manager
+        # completion already evicted the entry; prove it and read anyway
+        assert job_id not in tm.active_job_ids()
+        detail = tm.get_job_detail(job_id)
+        assert detail["submitted_us"] > 0  # from __job_timing__, not decode time
+        cp = compute_critical_path(detail)
+        assert cp["complete"] is True
+        assert cp["coverage"] == pytest.approx(1.0, abs=0.05)
+        prog = tm.get_job_progress(job_id)
+        assert prog["tasks_done"] == prog["tasks_total"] > 0
+        assert prog["elapsed_s"] and prog["elapsed_s"] > 0
+    finally:
+        ctx.close()

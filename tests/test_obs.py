@@ -276,7 +276,15 @@ def test_chrome_trace_export_shape():
     out = chrome_trace(spans, "j")
     metas = [e for e in out["traceEvents"] if e["ph"] == "M"]
     slices = [e for e in out["traceEvents"] if e["ph"] == "X"]
-    assert {m["args"]["name"] for m in metas} == {"scheduler", "executor:e1"}
+    proc_metas = [m for m in metas if m["name"] == "process_name"]
+    assert {m["args"]["name"] for m in proc_metas} == {
+        "scheduler", "executor:e1",
+    }
+    # every (pid, tid) also carries thread_name metadata (ISSUE 13)
+    thread_metas = [m for m in metas if m["name"] == "thread_name"]
+    assert {(m["pid"], m["tid"]) for m in thread_metas} == {
+        (e["pid"], e["tid"]) for e in slices
+    }
     assert len(slices) == 2
     # ts is microseconds
     assert slices[0]["ts"] == 1000.0 and slices[0]["dur"] == 5000.0
