@@ -58,6 +58,10 @@ SHUFFLE_LOCAL_TRANSPORT = "ballista.shuffle.local_transport"
 SHUFFLE_FETCH_BATCHED = "ballista.shuffle.fetch_batched"
 SHUFFLE_LOCALITY_ENABLED = "ballista.shuffle.locality_enabled"
 SHUFFLE_LOCALITY_WAIT_S = "ballista.shuffle.locality_wait_seconds"
+# Streaming pipelined execution (docs/user-guide/shuffle.md
+# "Pipelined execution")
+SHUFFLE_PIPELINED = "ballista.shuffle.pipelined"
+SHUFFLE_PIPELINED_MIN_FRACTION = "ballista.shuffle.pipelined_min_fraction"
 # Adaptive query execution (see docs/user-guide/aqe.md)
 AQE_ENABLED = "ballista.aqe.enabled"
 AQE_COALESCE_ENABLED = "ballista.aqe.coalesce_enabled"
@@ -148,6 +152,13 @@ def _parse_local_transport(v: str) -> str:
     if mode not in ("auto", "off"):
         raise ValueError(f"local_transport must be auto|off, got {v!r}")
     return mode
+
+
+def _parse_min_fraction(v: str) -> float:
+    f = float(v)
+    if not (0.0 < f <= 1.0):
+        raise ValueError(f"min fraction must be in (0, 1], got {v!r}")
+    return f
 
 
 def _parse_priority(v: str) -> str:
@@ -496,6 +507,32 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "half of locality placement; 0 = prefer but never wait)",
             float,
             "1.0",
+        ),
+        ConfigEntry(
+            SHUFFLE_PIPELINED,
+            "streaming pipelined execution: a downstream stage whose "
+            "shuffle inputs are all streamable (no sort / hash-join "
+            "build between the shuffle read and the stage root) starts "
+            "once ballista.shuffle.pipelined_min_fraction of each "
+            "input's map tasks have COMMITTED, tailing the remaining "
+            "map output as it lands instead of waiting for the stage "
+            "barrier.  Committed-task granularity: only first-"
+            "completion-wins winners are ever streamed from, so "
+            "speculation/retry semantics are unchanged.  Off by "
+            "default: stage transitions, dispatch order and wire "
+            "traffic are byte-identical to the barrier scheduler",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            SHUFFLE_PIPELINED_MIN_FRACTION,
+            "fraction of each input's map tasks that must have "
+            "committed before a streamable consumer stage starts on "
+            "partial input (pipelined execution); lower starts "
+            "consumers earlier but holds their slots longer while they "
+            "stall on producers",
+            _parse_min_fraction,
+            "0.25",
         ),
         ConfigEntry(
             AQE_ENABLED,
@@ -1008,6 +1045,14 @@ class BallistaConfig:
     @property
     def shuffle_locality_wait_seconds(self) -> float:
         return self._get(SHUFFLE_LOCALITY_WAIT_S)
+
+    @property
+    def shuffle_pipelined(self) -> bool:
+        return self._get(SHUFFLE_PIPELINED)
+
+    @property
+    def shuffle_pipelined_min_fraction(self) -> float:
+        return self._get(SHUFFLE_PIPELINED_MIN_FRACTION)
 
     @property
     def aqe_enabled(self) -> bool:

@@ -36,6 +36,12 @@ class PollLoop:
         self.executor = executor
         self.scheduler = scheduler
         self.poll_interval_s = poll_interval_s
+        # pipelined execution (ISSUE 15): pull-mode tailing fetches read
+        # the scheduler's shuffle-location feed by polling
+        # GetShuffleLocationDelta through this loop's stub
+        from ..shuffle import delta_store
+
+        delta_store.configure_scheduler(lambda: self.scheduler)
         self._statuses: "queue.Queue[pb.TaskStatus]" = queue.Queue()
         self._free_count = executor.concurrent_tasks
         self._count_lock = threading.Lock()

@@ -37,6 +37,27 @@ def _sum_metric(metrics, key: str) -> int:
     return sum(int(values.get(key, 0)) for _, values in metrics)
 
 
+def _has_tailing_reader(msg) -> bool:
+    """Reflection walk over a plan proto: does any ShuffleReaderExecNode
+    carry ``tail=True`` (pipelined execution)?  Generic over node shapes
+    so new operators never need to register here."""
+    if isinstance(msg, pb.ShuffleReaderExecNode):
+        return bool(msg.tail)
+    for fd, value in msg.ListFields():
+        if fd.type != fd.TYPE_MESSAGE:
+            continue
+        # singular sub-message vs repeated container, told apart by the
+        # message surface itself (fd.label is deprecated); map fields
+        # iterate KEYS (scalars), which the hasattr guard skips
+        children = [value] if hasattr(value, "ListFields") else value
+        if any(
+            hasattr(v, "ListFields") and _has_tailing_reader(v)
+            for v in children
+        ):
+            return True
+    return False
+
+
 class LoggingMetricsCollector:
     """Prints the per-partition stage plan with metrics (reference:
     executor/src/metrics/mod.rs:28-60)."""
@@ -342,7 +363,25 @@ class Executor:
         absorbs them into its store on completion).  Device stages need
         this process's XLA client and keep the thread path on a real
         accelerator — the measured residual risk
-        (tests/test_executor_isolation.py device-stage latency test)."""
+        (tests/test_executor_isolation.py device-stage latency test).
+        Pipelined TAILING tasks also keep the thread path: they stream
+        the scheduler's shuffle-location feed through THIS process's
+        delta-store mirror, which a task-runner subprocess (no scheduler
+        stub, no push notifications) cannot reach.  The plan walk is
+        gated on the session's pipelined knob (which the scheduler
+        stamps into the props whenever it could have produced a tailing
+        plan), so the default-off dispatch path never pays a second
+        plan parse."""
+        if task.props.get("ballista.shuffle.pipelined", "").lower() in (
+            "true", "1", "yes",
+        ):
+            try:
+                if _has_tailing_reader(
+                    pb.PhysicalPlanNode.FromString(task.plan)
+                ):
+                    return False
+            except Exception:  # noqa: BLE001 - undecodable: fail in-thread
+                return False
         props = dict(task.props)
         if props.get("ballista.tpu.enable", "true").lower() in (
             "true", "1", "yes",

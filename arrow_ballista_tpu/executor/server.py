@@ -77,6 +77,18 @@ class ExecutorGrpcService:
                 ok = False
         return pb.CancelTasksResult(cancelled=ok)
 
+    def UpdateShuffleLocations(
+        self, request: pb.UpdateShuffleLocationsParams, context
+    ) -> pb.UpdateShuffleLocationsResult:
+        """Streaming pipelined execution (ISSUE 15): fresh map-output
+        location deltas for feeds this executor's tailing consumer tasks
+        are streaming; merged into the process-wide mirror."""
+        from ..shuffle import delta_store
+
+        for d in request.deltas:
+            delta_store.apply_delta_proto(d)
+        return pb.UpdateShuffleLocationsResult(success=True)
+
 
 class Heartbeater:
     """Periodic HeartBeatFromExecutor (reference: `:401-431`).
@@ -179,6 +191,12 @@ class ExecutorServer:
         self._scheduler_stubs: Dict[str, SchedulerGrpcStub] = {
             f"{scheduler_host}:{scheduler_port}": self.scheduler
         }
+        # pipelined execution: tailing fetches poll the scheduler's
+        # shuffle-location feed when a push notification hasn't arrived
+        # yet (catch-up for the startup race and lost pushes)
+        from ..shuffle import delta_store
+
+        delta_store.configure_scheduler(lambda: self.scheduler)
         # the telemetry piggyback is the one obs piece on by default: the
         # sampler is O(1) per beat (the work-dir disk walk is throttled)
         self.telemetry = TelemetrySampler(

@@ -326,9 +326,22 @@ def compute_critical_path(
         _split_window(window_us, sums, total_task_ns, seg)
         cursor = max(cursor, window_end)
         if not final_link:
-            barrier_us = max(0, end - cursor)
+            # barrier wait ends where the next critical stage STARTED: a
+            # pipelined consumer dispatches before this stage's last
+            # commit, and from that point the wall is the consumer's
+            # active window (its fetch-wait metrics attribute the
+            # stall-on-producer), not barrier.  Barrier-scheduled jobs
+            # have next_dispatch >= end, so their numbers are unchanged;
+            # a next stage without anchors degrades to the full tail.
+            next_disp = _timing(stages[chain[i + 1]]).get("dispatch_us") or {}
+            cap = (
+                min(end, max(min(next_disp.values()), cursor))
+                if next_disp
+                else end
+            )
+            barrier_us = max(0, cap - cursor)
             seg["barrier_wait_ms"] = round(barrier_us / _US_PER_MS, 3)
-            cursor = max(cursor, end)
+            cursor = max(cursor, cap)
         for c in CATEGORIES[3:]:
             breakdown[c] += seg[c]
             seg[c] = round(seg[c], 3)

@@ -176,7 +176,7 @@ def _rule_admission_queued(cp, events, out: List[dict]) -> None:
     )
 
 
-def _rule_barrier_dominated(cp, out: List[dict]) -> None:
+def _rule_barrier_dominated(cp, detail, out: List[dict]) -> None:
     barrier = (cp.get("breakdown") or {}).get("barrier_wait_ms", 0.0)
     wall = cp.get("wall_clock_ms") or 0.0
     if barrier < BARRIER_MIN_MS or barrier < BARRIER_FRACTION * max(wall, 1.0):
@@ -186,6 +186,36 @@ def _rule_barrier_dominated(cp, out: List[dict]) -> None:
         for r in cp.get("critical_path", [])
         if (r.get("segments") or {}).get("barrier_wait_ms", 0.0) > 0
     ]
+    # streamable/pipeline-breaker classification of the barrier
+    # producers' CONSUMERS (the scheduler's classify_shuffle_inputs walk,
+    # carried on the job detail): the upside is only reachable where the
+    # consumer can legally start on partial input
+    rows = {
+        int(r["stage_id"]): r for r in (detail or {}).get("stages", [])
+    }
+    consumers: Dict[str, str] = {}
+    for sid in stages:
+        for c in (rows.get(int(sid)) or {}).get("output_links", []):
+            pl = (rows.get(int(c)) or {}).get("pipeline") or {}
+            streamable = int(sid) in (pl.get("streamable_inputs") or [])
+            consumers[str(c)] = (
+                "streamable" if streamable else "pipeline_breaker"
+            )
+    reachable = any(v == "streamable" for v in consumers.values())
+    if reachable or not consumers:
+        suggestion = (
+            "enable pipelined execution (ballista.shuffle.pipelined=true): "
+            "streamable consumers start once ballista.shuffle."
+            "pipelined_min_fraction of map output has committed — "
+            f"estimated upside up to {barrier:.0f} ms"
+        )
+    else:
+        suggestion = (
+            "the consumers are pipeline breakers (sort / hash-join "
+            "build), so ballista.shuffle.pipelined cannot overlap this "
+            "window — AQE coalescing and speculation shrink the stage "
+            "tails instead"
+        )
     out.append(
         _finding(
             "barrier_dominated_job",
@@ -193,13 +223,13 @@ def _rule_barrier_dominated(cp, out: List[dict]) -> None:
             f"{barrier:.0f} ms ({100 * barrier / max(wall, 1.0):.0f}% of "
             "wall-clock) was stage-barrier wait: partial map output "
             "existed while consumers sat idle",
-            "pipelined/streaming execution could overlap this window — "
-            f"estimated upside up to {barrier:.0f} ms; until then, AQE "
-            "coalescing and speculation shrink the stage tails",
+            suggestion,
             barrier_wait_ms=barrier,
             wall_clock_ms=wall,
             pipelining_upside_ms=barrier,
             producer_stages=stages,
+            consumer_classification=consumers,
+            upside_reachable=reachable,
         )
     )
 
@@ -264,7 +294,7 @@ def diagnose(
     stage id (job-level findings first within a severity)."""
     out: List[dict] = []
     _rule_admission_queued(cp, events, out)
-    _rule_barrier_dominated(cp, out)
+    _rule_barrier_dominated(cp, detail, out)
     _rule_skewed_stages(detail, profile, out)
     _rule_fetch_bound(cp, out)
     _rule_compile_dominated(cp, out)

@@ -66,9 +66,15 @@ LOCALITY_OP = "__locality_placement__"
 STAGE_TIMING_OP = "__stage_timing__"
 TASK_DISPATCH_OP = "__task_dispatch_us__"
 TASK_FINISH_OP = "__task_finish_us__"
+# Pipelined execution marker (ISSUE 15): {"tail_inputs": n, "partial_start":
+# 1} on stages that STARTED on partial map output — the progress endpoint
+# excludes their (stall-inflated) task runtimes from the ETA median and
+# the doctor reports the run as pipelined
+PIPELINED_OP = "__pipelined__"
 _SYNTHETIC_OPS = (
     STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
     AQE_OP, LOCALITY_OP, STAGE_TIMING_OP, TASK_DISPATCH_OP, TASK_FINISH_OP,
+    PIPELINED_OP,
 )
 
 

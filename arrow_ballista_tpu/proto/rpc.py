@@ -31,12 +31,21 @@ _SCHEDULER_METHODS = {
     # graceful decommission (ISSUE 6): same message shapes as
     # ExecutorStopped — executor_id + reason in, empty ack out
     "DecommissionExecutor": (pb.ExecutorStoppedParams, pb.ExecutorStoppedResult),
+    # streaming pipelined execution (ISSUE 15): pull-mode executors poll
+    # the scheduler's shuffle-location feed for their tailing tasks
+    "GetShuffleLocationDelta": (
+        pb.ShuffleLocationDeltaParams, pb.ShuffleLocationDelta,
+    ),
 }
 
 _EXECUTOR_METHODS = {
     "LaunchTask": (pb.LaunchTaskParams, pb.LaunchTaskResult),
     "StopExecutor": (pb.StopExecutorParams, pb.StopExecutorResult),
     "CancelTasks": (pb.CancelTasksParams, pb.CancelTasksResult),
+    # streaming pipelined execution (ISSUE 15): push-mode feed deltas
+    "UpdateShuffleLocations": (
+        pb.UpdateShuffleLocationsParams, pb.UpdateShuffleLocationsResult,
+    ),
 }
 
 _KV_METHODS = {

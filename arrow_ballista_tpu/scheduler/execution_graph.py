@@ -124,6 +124,12 @@ class ExecutionGraph:
         # controller's per-pool concurrency accounting, and so
         # fill_reservations can keep ordering dispatch by fair share.
         self._init_tenant(config)
+        # streaming pipelined execution (ballista.shuffle.pipelined):
+        # streamable consumer stages start on partial map output, tailing
+        # the scheduler's per-producer shuffle-location feed.  In-memory
+        # only — partially-resolved stages persist as Unresolved, so a
+        # restarted scheduler re-resolves against real state.
+        self._init_pipelining(config)
         # adaptive query execution (scheduler/adaptive.py): persisted in
         # the graph proto so restart/HA adoption replays decisions for
         # stages that resolve after the failover
@@ -205,6 +211,30 @@ class ExecutionGraph:
             self.tenant_pool = "default"
             self.tenant_priority = "batch"
 
+    def _init_pipelining(self, config) -> None:
+        if config is not None:
+            self.pipelined_enabled = config.shuffle_pipelined
+            self.pipelined_min_fraction = config.shuffle_pipelined_min_fraction
+        else:
+            self.pipelined_enabled = False
+            self.pipelined_min_fraction = 0.25
+        # producer stage id -> {"locations": [PartitionLocation] (append-
+        # only, committed winners only), "complete": bool, "epoch": int}.
+        # The executor-side delta store mirrors it (push notifications in
+        # push mode, GetShuffleLocationDelta polls in pull mode).
+        self.shuffle_feeds: Dict[int, dict] = {}
+        # epoch survives feed invalidation (executor-loss rollback): a
+        # recreated feed starts at epoch+1 so executors' stale mirrors
+        # reset instead of merging two generations of locations
+        self.feed_epochs: Dict[int, int] = {}
+        # queued feed updates for the push fan-out; drained by the
+        # TaskManager after graph mutations commit (like pending_cancels)
+        self.pending_feed_deltas: List[dict] = []
+
+    def take_pending_feed_deltas(self) -> List[dict]:
+        out, self.pending_feed_deltas = self.pending_feed_deltas, []
+        return out
+
     def take_pending_cancels(self) -> List[tuple]:
         out, self.pending_cancels = self.pending_cancels, []
         return out
@@ -280,6 +310,8 @@ class ExecutionGraph:
                 resolved.ready_unix_ns = time.time_ns()
                 self.stages[sid] = resolved
                 changed = True
+        if self.pipelined_enabled and self._revive_partial():
+            changed = True
         for sid, stage in list(self.stages.items()):
             if isinstance(stage, ResolvedStage):
                 running = stage.to_running()
@@ -296,6 +328,201 @@ class ExecutionGraph:
         if changed and self.status == QUEUED:
             self.status = RUNNING
         return changed
+
+    # ------------------------------------------- pipelined execution
+    def _revive_partial(self) -> bool:
+        """Partial resolution (ballista.shuffle.pipelined): start a
+        consumer stage once ``pipelined_min_fraction`` of each STREAMABLE
+        input's map tasks have committed, resolving those inputs to
+        tailing readers over the producer's shuffle-location feed.
+        Pipeline-breaking inputs (sort, hash-join build) must still be
+        complete; AQE-rewritten stages keep the barrier (replans are
+        gated off for partially-started stages — exact-bytes stats don't
+        exist yet).  Committed-task granularity: the feed only ever
+        carries first-completion-wins winners, so a consumer can never
+        stream from a speculative loser."""
+        import math
+
+        from .planner import classify_shuffle_inputs
+
+        changed = False
+        for sid, stage in list(self.stages.items()):
+            if not isinstance(stage, UnresolvedStage) or stage.resolvable():
+                continue
+            if stage.aqe:
+                continue  # AQE-rewritten layout: barrier (gate, not break)
+            if any(
+                sh.selections is not None
+                for sh in find_unresolved_shuffles(stage.plan)
+            ):
+                continue
+            streamable, _breakers = classify_shuffle_inputs(stage.plan)
+            tail: set = set()
+            eligible = True
+            for in_sid, inp in stage.inputs.items():
+                if inp.complete:
+                    continue
+                if in_sid not in streamable:
+                    eligible = False  # a breaker input still running
+                    break
+                producer = self.stages.get(in_sid)
+                if not isinstance(producer, RunningStage):
+                    eligible = False  # not started / mid-rollback
+                    break
+                need = max(
+                    1,
+                    math.ceil(
+                        self.pipelined_min_fraction * producer.partitions
+                    ),
+                )
+                if producer.completed_tasks() < need:
+                    eligible = False
+                    break
+                tail.add(in_sid)
+            if not eligible or not tail:
+                continue
+            try:
+                resolved = stage.to_resolved(frozenset(tail))
+            except Exception:  # noqa: BLE001 - degrade to the barrier path
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "job %s: partial resolution of stage %s failed; "
+                    "keeping the stage barrier", self.job_id, sid,
+                )
+                continue
+            resolved.ready_unix_ns = time.time_ns()
+            self.stages[sid] = resolved
+            for in_sid in sorted(tail):
+                self._ensure_feed(in_sid, stage.inputs.get(in_sid))
+            self._journal(
+                "stage_partial_start",
+                stage=sid,
+                tail_inputs=sorted(tail),
+                min_fraction=self.pipelined_min_fraction,
+            )
+            changed = True
+        return changed
+
+    def _ensure_feed(self, sid: int, inp: Optional[StageInput]) -> None:
+        """Create the producer's shuffle-location feed, seeded with every
+        location committed so far (the consumer's accumulated StageInput
+        carries full executor metadata; repointed external-sentinel
+        locations ride through unchanged)."""
+        if sid in self.shuffle_feeds:
+            return
+        locations: List[PartitionLocation] = []
+        if inp is not None:
+            for q in sorted(inp.partition_locations):
+                locations.extend(
+                    sorted(inp.partition_locations[q], key=lambda l: l.path)
+                )
+        epoch = self.feed_epochs.get(sid, 0) + 1
+        self.feed_epochs[sid] = epoch
+        producer = self.stages.get(sid)
+        self.shuffle_feeds[sid] = {
+            "locations": locations,
+            "complete": isinstance(producer, CompletedStage),
+            "epoch": epoch,
+        }
+        self._queue_feed_delta(sid, 0, locations)
+
+    def _queue_feed_delta(
+        self, sid: int, from_index: int, locations: List[PartitionLocation]
+    ) -> None:
+        feed = self.shuffle_feeds.get(sid)
+        if feed is None:
+            return
+        self.pending_feed_deltas.append(
+            {
+                "stage": sid,
+                "from_index": from_index,
+                "locations": list(locations),
+                "complete": feed["complete"],
+                "epoch": feed["epoch"],
+                "valid": True,
+            }
+        )
+
+    def _append_feed(self, sid: int, locations: List[PartitionLocation]) -> None:
+        feed = self.shuffle_feeds.get(sid)
+        if feed is None:
+            return
+        start = len(feed["locations"])
+        feed["locations"].extend(locations)
+        self._queue_feed_delta(sid, start, locations)
+
+    def _complete_feed(self, sid: int) -> None:
+        feed = self.shuffle_feeds.get(sid)
+        if feed is None or feed["complete"]:
+            return
+        feed["complete"] = True
+        self._queue_feed_delta(sid, len(feed["locations"]), [])
+
+    def _invalidate_feed(self, sid: int) -> None:
+        """Tear a feed down (producer re-run / consumer rollback): stale
+        executor mirrors must abort their tails instead of merging two
+        generations of locations.  The epoch counter survives, so a
+        recreated feed supersedes every mirror of this one."""
+        if self.shuffle_feeds.pop(sid, None) is not None:
+            self.pending_feed_deltas.append(
+                {
+                    "stage": sid,
+                    "from_index": 0,
+                    "locations": [],
+                    "complete": False,
+                    "epoch": self.feed_epochs.get(sid, 0),
+                    "valid": False,
+                }
+            )
+
+    def _feed_serves_executor(self, sid: int, executor_id: str) -> bool:
+        feed = self.shuffle_feeds.get(sid)
+        return feed is not None and any(
+            l.executor_meta.id == executor_id for l in feed["locations"]
+        )
+
+    def shuffle_feed_delta(self, sid: int, from_index: int) -> dict:
+        """The ``GetShuffleLocationDelta`` payload for one producer feed
+        (pull-mode executors poll this; dict-shaped so the gRPC layer and
+        tests share it)."""
+        feed = self.shuffle_feeds.get(sid)
+        if feed is None:
+            return {
+                "stage": sid,
+                "from_index": 0,
+                "locations": [],
+                "complete": False,
+                "epoch": self.feed_epochs.get(sid, 0),
+                "valid": False,
+            }
+        locs = feed["locations"]
+        start = max(0, min(int(from_index), len(locs)))
+        return {
+            "stage": sid,
+            "from_index": start,
+            "locations": list(locs[start:]),
+            "complete": feed["complete"],
+            "epoch": feed["epoch"],
+            "valid": True,
+        }
+
+    def tailing_executors(self, sid: int) -> set:
+        """Executor ids currently running tasks of a consumer stage that
+        tails producer ``sid`` — the push-notification fan-out targets."""
+        out: set = set()
+        for stage in self.stages.values():
+            if (
+                isinstance(stage, RunningStage)
+                and sid in stage.tail_inputs
+            ):
+                for t in stage.task_statuses:
+                    if t is not None and t.state == "running" and t.executor_id:
+                        out.add(t.executor_id)
+                for si in stage.speculative_statuses.values():
+                    if si.executor_id:
+                        out.add(si.executor_id)
+        return out
 
     def _maybe_replan(self, stage: UnresolvedStage) -> None:
         """AQE coalesce/skew-split hook; an AQE bug must degrade to the
@@ -662,6 +889,17 @@ class ExecutionGraph:
                     consumer = self.stages.get(link)
                     if isinstance(consumer, UnresolvedStage):
                         consumer.complete_input(sid)
+                    elif sid in getattr(consumer, "tail_inputs", ()):
+                        # partially-started consumer: the producer is done
+                        # — flip its input complete so rollback/recovery
+                        # bookkeeping sees a finished input from here on
+                        inp = consumer.inputs.get(sid)
+                        if inp is not None:
+                            inp.complete = True
+                # the tailing feed (if any consumer streams this stage)
+                # ends here: executors finish their tails and the stage's
+                # last fragment becomes fetchable like any other
+                self._complete_feed(sid)
                 if sid == self._final_stage_id:
                     self._collect_job_output(completed, executor)
                     self.status = COMPLETED
@@ -883,7 +1121,10 @@ class ExecutionGraph:
         self, stage: RunningStage, info: TaskInfo, executor: ExecutorMetadata
     ) -> None:
         """Push one completed map task's shuffle partitions into consumer
-        stages' inputs (reference: execution_graph.rs:320-369)."""
+        stages' inputs (reference: execution_graph.rs:320-369).  Only
+        COMMITTED winners reach here (the first-completion-wins guard
+        drops losers before publication), so partially-started consumers
+        and the tailing feed can never stream from a losing attempt."""
         locations = [
             PartitionLocation(
                 PartitionId(self.job_id, stage.stage_id, p.partition_id),
@@ -898,6 +1139,15 @@ class ExecutionGraph:
             consumer = self.stages.get(link)
             if isinstance(consumer, UnresolvedStage):
                 consumer.add_input_partitions(stage.stage_id, locations)
+            elif stage.stage_id in getattr(consumer, "tail_inputs", ()):
+                # partially-started consumer: keep its StageInput current
+                # (rollback/recovery reads it) while the live stream rides
+                # the feed below
+                inp = consumer.inputs.get(stage.stage_id)
+                if inp is not None:
+                    for loc in locations:
+                        inp.add_partition(loc)
+        self._append_feed(stage.stage_id, locations)
 
     def _collect_job_output(
         self, stage: CompletedStage, executor: Optional[ExecutorMetadata]
@@ -984,12 +1234,18 @@ class ExecutionGraph:
 
         # 1) abandon the consumer's other in-flight tasks (their input
         #    set is about to change) and roll it back to Unresolved,
-        #    stripping ONLY the lost executor's locations for prod_sid
+        #    stripping ONLY the lost executor's locations for prod_sid.
+        #    A half-streamed consumer's tail feeds are invalidated so
+        #    executor mirrors abort instead of merging the re-run's
+        #    locations into the dead generation.
         for t in consumer.task_statuses:
             if t is not None and t.state == "running":
                 self.pending_cancels.append((t.executor_id, t.partition_id))
         for si in consumer.speculative_statuses.values():
             self.pending_cancels.append((si.executor_id, si.partition_id))
+        for f_sid in sorted(consumer.tail_inputs):
+            self._invalidate_feed(f_sid)
+        self._invalidate_feed(prod_sid)
         unresolved = consumer.to_resolved().to_unresolved()
         uinp = unresolved.inputs.get(prod_sid)
         if uinp is not None:
@@ -1481,6 +1737,16 @@ class ExecutionGraph:
                     )
                     for inp in stage.inputs.values()
                 )
+                # a tailing consumer whose FEED served the lost executor
+                # rolls back even when replica repoint cleaned its inputs:
+                # the stream already shipped dead locations executor-side,
+                # and a stream in flight cannot be patched (pipelined
+                # failure semantics ride the existing reset path)
+                if not lost and stage.tail_inputs:
+                    lost = any(
+                        self._feed_serves_executor(f_sid, executor_id)
+                        for f_sid in stage.tail_inputs
+                    )
                 if lost:
                     rollback_consumers.add(sid)
 
@@ -1488,8 +1754,29 @@ class ExecutionGraph:
         for sid in rollback_consumers:
             stage = self.stages[sid]
             if isinstance(stage, RunningStage):
+                if stage.tail_inputs:
+                    # half-streamed consumer: abort its in-flight tasks
+                    # (their tailing fetch plans reference the dead feed)
+                    # and tear the feeds down — the re-resolve recreates
+                    # them at the next epoch.  Barrier-path consumers keep
+                    # the pre-existing semantics (late statuses are
+                    # dropped by the rolled-back-stage guard).
+                    for t in stage.task_statuses:
+                        if t is not None and t.state == "running":
+                            self.pending_cancels.append(
+                                (t.executor_id, t.partition_id)
+                            )
+                    for si in stage.speculative_statuses.values():
+                        self.pending_cancels.append(
+                            (si.executor_id, si.partition_id)
+                        )
+                    for f_sid in sorted(stage.tail_inputs):
+                        self._invalidate_feed(f_sid)
                 stage = stage.to_resolved()
             assert isinstance(stage, ResolvedStage)
+            if stage.tail_inputs:
+                for f_sid in sorted(stage.tail_inputs):
+                    self._invalidate_feed(f_sid)
             unresolved = stage.to_unresolved()
             unresolved.remove_input_partitions(executor_id)
             # any input stage whose data was lost must re-run
@@ -1518,6 +1805,7 @@ class ExecutionGraph:
                 for i in victims:
                     running.task_statuses[i] = None
                 self.stages[sid] = running
+                self._invalidate_feed(sid)  # re-run supersedes the feed
                 affected.add(sid)
 
         # 5) bound the rollback: a stage reset more than
@@ -1595,6 +1883,11 @@ class ExecutionGraph:
             sp = g.stages.add()
             if isinstance(stage, RunningStage):
                 stage = stage.to_resolved()  # re-dispatch on restart
+            if isinstance(stage, ResolvedStage) and stage.tail_inputs:
+                # partially-resolved (pipelined): the location feed is
+                # in-memory only, so persist as Unresolved — a restarted
+                # scheduler re-resolves from the producers' real state
+                stage = stage.to_unresolved()
             if isinstance(stage, UnresolvedStage):
                 sp.unresolved.stage_id = sid
                 sp.unresolved.plan = BallistaCodec.encode_physical(stage.plan)
@@ -1692,6 +1985,10 @@ class ExecutionGraph:
         # tenant identity IS persisted: pool concurrency accounting and
         # fair dispatch ordering must survive restart / HA adoption
         self._init_tenant(None)
+        # pipelined execution is session-config derived and not persisted:
+        # a recovered/adopted graph runs the barrier scheduler (partial
+        # stages were stored as Unresolved, so nothing dangles)
+        self._init_pipelining(None)
         if g.tenant_json:
             try:
                 tenant = json.loads(g.tenant_json)

@@ -123,9 +123,19 @@ class UnresolvedStage:
     def resolvable(self) -> bool:
         return all(i.complete for i in self.inputs.values())
 
-    def to_resolved(self) -> "ResolvedStage":
+    def to_resolved(
+        self, tail_stage_ids: frozenset = frozenset()
+    ) -> "ResolvedStage":
+        """Resolve against the accumulated input locations.  With
+        ``tail_stage_ids`` (pipelined execution) those producers resolve
+        to TAILING readers instead — no static locations; the executor
+        streams the scheduler's shuffle-location feed — and the stage
+        starts while they are still running."""
+        tail = frozenset(tail_stage_ids)
         locations: Dict[int, List[List[PartitionLocation]]] = {}
         for shuffle in find_unresolved_shuffles(self.plan):
+            if shuffle.stage_id in tail:
+                continue
             inp = self.inputs.get(shuffle.stage_id)
             if inp is None or not inp.complete:
                 raise SchedulerError(
@@ -140,8 +150,8 @@ class UnresolvedStage:
                 for p in range(shuffle.output_partition_count)
             ]
         resolved_plan = (
-            remove_unresolved_shuffles(self.plan, locations)
-            if locations
+            remove_unresolved_shuffles(self.plan, locations, tail)
+            if locations or tail
             else self.plan
         )
         return ResolvedStage(
@@ -150,6 +160,7 @@ class UnresolvedStage:
             list(self.output_links),
             dict(self.inputs),
             aqe=dict(self.aqe),
+            tail_inputs=set(tail),
         )
 
 
@@ -164,6 +175,12 @@ class ResolvedStage:
     # dispatchable (every producer committed; graph build for leaves).
     # 0 = unknown (decoded graphs) — attribution degrades, never fails.
     ready_unix_ns: int = 0
+    # pipelined execution (ISSUE 15): producer stage ids this stage reads
+    # through TAILING readers (resolved before the producer completed).
+    # Empty on the barrier path.  In-memory only — a partially-resolved
+    # stage persists as Unresolved (see ExecutionGraph.encode) so a
+    # restarted scheduler re-resolves against real state.
+    tail_inputs: set = field(default_factory=set)
 
     @property
     def partitions(self) -> int:
@@ -178,6 +195,8 @@ class ResolvedStage:
             [None] * self.partitions,
             aqe=dict(self.aqe),
             ready_unix_ns=self.ready_unix_ns,
+            tail_inputs=set(self.tail_inputs),
+            started_on_partial=bool(self.tail_inputs),
         )
 
     def to_unresolved(self) -> UnresolvedStage:
@@ -277,6 +296,15 @@ class RunningStage:
     spec_dispatch_unix_ns: Dict[int, int] = field(default_factory=dict)
     # partition -> commit anchor (the winner's completion report)
     task_finish_unix_ns: Dict[int, int] = field(default_factory=dict)
+    # ---- pipelined execution (ISSUE 15) ----
+    # producer stage ids this stage reads through TAILING readers; fixed
+    # for the stage's lifetime (producer completion flips the matching
+    # StageInput.complete and the feed's complete flag instead)
+    tail_inputs: set = field(default_factory=set)
+    # True when the stage was dispatched on partial map output: its task
+    # runtimes include stall-on-producer, so the progress ETA median
+    # excludes them, and to_completed persists the __pipelined__ marker
+    started_on_partial: bool = False
 
     @property
     def partitions(self) -> int:
@@ -400,6 +428,16 @@ class RunningStage:
             from ..obs.export import LOCALITY_OP
 
             metrics[LOCALITY_OP] = dict(self.locality_stats)
+        if self.started_on_partial:
+            # the stage ran pipelined: persist the marker so progress/ETA
+            # and the doctor can tell stall-inflated runtimes apart after
+            # eviction/restart
+            from ..obs.export import PIPELINED_OP
+
+            metrics[PIPELINED_OP] = {
+                "partial_start": 1,
+                "tail_inputs": len(self.tail_inputs),
+            }
         return CompletedStage(
             self.stage_id,
             self.plan,
@@ -427,6 +465,7 @@ class RunningStage:
             self.stage_id, self.plan, list(self.output_links),
             dict(self.inputs), aqe=dict(self.aqe),
             ready_unix_ns=self.ready_unix_ns,
+            tail_inputs=set(self.tail_inputs),
         )
 
 

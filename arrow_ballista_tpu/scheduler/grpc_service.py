@@ -174,6 +174,28 @@ class SchedulerGrpcService:
             job_id=job_id, session_id=session_ctx.session_id
         )
 
+    def GetShuffleLocationDelta(
+        self, request: pb.ShuffleLocationDeltaParams, context
+    ) -> pb.ShuffleLocationDelta:
+        """Streaming pipelined execution (ISSUE 15): pull-mode executors
+        poll the per-producer shuffle-location feed for their tailing
+        consumer tasks (push mode gets the same deltas proactively via
+        UpdateShuffleLocations)."""
+        d = self.server.state.task_manager.get_shuffle_location_delta(
+            request.job_id, request.stage_id, request.from_index
+        )
+        resp = pb.ShuffleLocationDelta(
+            job_id=request.job_id,
+            stage_id=request.stage_id,
+            from_index=d["from_index"],
+            complete=d["complete"],
+            valid=d["valid"],
+            epoch=d["epoch"],
+        )
+        for loc in d["locations"]:
+            resp.locations.add().CopyFrom(loc.to_proto())
+        return resp
+
     def GetJobStatus(
         self, request: pb.GetJobStatusParams, context
     ) -> pb.GetJobStatusResult:

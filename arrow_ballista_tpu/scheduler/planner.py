@@ -139,6 +139,56 @@ class DistributedPlanner:
         )
 
 
+def classify_shuffle_inputs(plan: ExecutionPlan) -> tuple:
+    """Pipelined-execution eligibility walk (ISSUE 15): split a stage
+    plan's shuffle inputs into ``(streamable, breakers)`` — sets of
+    producing stage ids.
+
+    A shuffle input is *streamable* when no pipeline-breaking operator
+    sits between the shuffle read and the stage root, so the stage can
+    start consuming the producer's output before every map task has
+    committed: filter, project, union, limit, aggregates (partial OR
+    final — they consume a stream; a final agg still cannot EMIT early,
+    but it can overlap its reads with the producing stage's tail) and
+    the PROBE side of a hash join all pass through.  ``SortExec`` and
+    ``WindowExec`` (which sorts internally) are breakers, as is the
+    BUILD (left) side of any join — a build-side read gains nothing
+    from starting early and would pin a slot against the barrier
+    anyway.  Leaves are matched by ``stage_id`` attribute, so the walk
+    classifies both unresolved placeholders and already-resolved
+    readers (the doctor runs it over completed stages too).  A stage id
+    reachable both ways (self-join of one producer) classifies as a
+    breaker — partial start must be safe for EVERY read of that input.
+    """
+    from ..exec.joins import CrossJoinExec, HashJoinExec
+    from ..exec.operators import SortExec
+    from ..exec.window import WindowExec
+
+    streamable: set = set()
+    breakers: set = set()
+
+    def walk(node: ExecutionPlan, under_breaker: bool) -> None:
+        if isinstance(node, (UnresolvedShuffleExec, ShuffleReaderExec)):
+            (breakers if under_breaker else streamable).add(node.stage_id)
+            return
+        if isinstance(node, (SortExec, WindowExec)):
+            under_breaker = True
+        children = node.children()
+        if isinstance(node, (HashJoinExec, CrossJoinExec)) and children:
+            walk(children[0], True)  # build side barriers
+            for c in children[1:]:
+                walk(c, under_breaker)
+            return
+        for c in children:
+            walk(c, under_breaker)
+
+    walk(plan, False)
+    # an input read through BOTH a streamable and a breaker edge must
+    # barrier for the breaker read
+    streamable -= breakers
+    return streamable, breakers
+
+
 def find_unresolved_shuffles(plan: ExecutionPlan) -> List[UnresolvedShuffleExec]:
     out: List[UnresolvedShuffleExec] = []
     if isinstance(plan, UnresolvedShuffleExec):
@@ -151,6 +201,7 @@ def find_unresolved_shuffles(plan: ExecutionPlan) -> List[UnresolvedShuffleExec]
 def remove_unresolved_shuffles(
     plan: ExecutionPlan,
     partition_locations: Dict[int, List[List[PartitionLocation]]],
+    tail_stage_ids: frozenset = frozenset(),
 ) -> ExecutionPlan:
     """Swap every UnresolvedShuffleExec for a ShuffleReaderExec with the
     producing stage's real output locations.
@@ -160,10 +211,30 @@ def remove_unresolved_shuffles(
     source lists onto its coalesced/split task layout here, so two
     leaves reading the same producer stage can do so through different
     layouts (e.g. the split side and the duplicated side of a skew-split
-    join)."""
+    join).
+
+    ``tail_stage_ids`` (pipelined execution, ISSUE 15): producers whose
+    output is still GROWING — their leaves resolve to TAILING readers
+    that carry no static locations and instead stream the scheduler's
+    shuffle-location feed at execution time (``shuffle/delta_store``).
+    Only valid for selections-free leaves (partial resolution is gated
+    off for AQE-rewritten layouts)."""
     if isinstance(plan, UnresolvedShuffleExec):
         from ..shuffle.execution_plans import apply_read_selections
 
+        if plan.stage_id in tail_stage_ids:
+            if plan.selections is not None:
+                raise PlanError(
+                    f"stage {plan.stage_id}: cannot tail an AQE-rewritten "
+                    "shuffle read"
+                )
+            return ShuffleReaderExec(
+                plan.stage_id,
+                plan.schema,
+                [[] for _ in range(plan.output_partition_count)],
+                source_partition_count=plan.output_partition_count,
+                tail=True,
+            )
         locs = partition_locations.get(plan.stage_id)
         if locs is None:
             raise PlanError(
@@ -187,7 +258,10 @@ def remove_unresolved_shuffles(
     if not children:
         return plan
     return plan.with_new_children(
-        [remove_unresolved_shuffles(c, partition_locations) for c in children]
+        [
+            remove_unresolved_shuffles(c, partition_locations, tail_stage_ids)
+            for c in children
+        ]
     )
 
 

@@ -461,6 +461,13 @@ class TaskManager:
                 # locality placement outcome: tasks dispatched on their
                 # preferred (most-input-bytes) host vs anywhere else
                 row["locality_placement"] = dict(placement)
+            pipeline = self._pipeline_of(stage)
+            if pipeline:
+                # pipelined-execution classification (streamable vs
+                # pipeline-breaker inputs) + whether the stage actually
+                # started on partial input — the doctor's evidence for
+                # whether barrier_wait upside is reachable
+                row["pipeline"] = pipeline
             failures = getattr(stage, "task_failures", None)
             if failures:
                 row["failures"] = {p: list(h) for p, h in failures.items()}
@@ -490,6 +497,32 @@ class TaskManager:
         attempts_total = sum(a * n for a, n in histogram.items())
         detail["task_retries"] = max(detail["task_retries"], attempts_total)
         return detail
+
+    @staticmethod
+    def _pipeline_of(stage) -> dict:
+        """Per-stage pipelined-execution block for the job detail:
+        streamable/breaker input classification (planner walk — works on
+        unresolved placeholders and resolved readers alike) plus the
+        partial-start marker (live flag on Running stages, persisted
+        ``__pipelined__`` metric on Completed ones)."""
+        from ..obs.export import PIPELINED_OP
+        from .planner import classify_shuffle_inputs
+
+        out: dict = {}
+        if getattr(stage, "inputs", None):
+            try:
+                streamable, breakers = classify_shuffle_inputs(stage.plan)
+            except Exception:  # noqa: BLE001 - classification is advisory
+                streamable, breakers = set(), set()
+            if streamable or breakers:
+                out["streamable_inputs"] = sorted(streamable)
+                out["breaker_inputs"] = sorted(breakers)
+        partial = getattr(stage, "started_on_partial", False) or bool(
+            (getattr(stage, "stage_metrics", None) or {}).get(PIPELINED_OP)
+        )
+        if partial:
+            out["partial_start"] = True
+        return out
 
     def get_job_dot(self, job_id: str) -> Optional[str]:
         """GraphViz text of the job's stage DAG (reference: the UI's plan
@@ -559,15 +592,24 @@ class TaskManager:
                     )
                     row["running"] = active
                     running_now += active
-                    runtimes.extend(stage.completed_runtime_s)
+                    if stage.started_on_partial:
+                        # pipelined: these runtimes include stall-on-
+                        # producer, so they must not inflate the
+                        # observed-median ETA; the flag also tells
+                        # clients the "running" tasks are streaming a
+                        # producer that is NOT done yet
+                        row["partial_input"] = True
+                    else:
+                        runtimes.extend(stage.completed_runtime_s)
                     bytes_wire = sum(
                         b.get("wire", 0) for b in stage.task_bytes.values()
                     )
                 else:
-                    from ..obs.export import TASK_RUNTIME_OP
+                    from ..obs.export import PIPELINED_OP, TASK_RUNTIME_OP
 
-                    ms = stage.stage_metrics.get(TASK_RUNTIME_OP, {})
-                    runtimes.extend(v / 1e3 for v in ms.values())
+                    if not stage.stage_metrics.get(PIPELINED_OP):
+                        ms = stage.stage_metrics.get(TASK_RUNTIME_OP, {})
+                        runtimes.extend(v / 1e3 for v in ms.values())
                     bytes_wire = sum(
                         stage.output_partition_bytes().values()
                     )
@@ -643,6 +685,7 @@ class TaskManager:
         events: List[Tuple[str, str]] = []
         newly_quarantined: List[str] = []
         cancels: List[Tuple[str, PartitionId]] = []
+        feed_pushes: List[tuple] = []
         draining = self.executor_manager.is_draining(executor.id)
         for job_id, infos in per_job.items():
             entry = self._entry(job_id)
@@ -715,11 +758,13 @@ class TaskManager:
                         ):
                             newly_quarantined.append(info.executor_id)
                 cancels.extend(graph.take_pending_cancels())
+                feed_pushes.extend(self._collect_feed_pushes(graph))
                 self._persist(graph)
         if cancels:
             # after the locks drop: losing duplicate attempts / reaped
             # stragglers get a best-effort CancelTasks fan-out
             self.cancel_task_attempts(cancels)
+        self._push_shuffle_deltas(feed_pushes)
         for eid in newly_quarantined:
             for job_id, n in self.reset_executor_running_tasks(eid).items():
                 # one task_requeued per reset task: the event loop mints a
@@ -745,19 +790,67 @@ class TaskManager:
             return False
         return classify_failure(err) != FATAL
 
-    def cancel_task_attempts(
-        self, cancels: List[Tuple[str, PartitionId]]
+    # -------------------------------------------- pipelined feed plane
+    def get_shuffle_location_delta(
+        self, job_id: str, stage_id: int, from_index: int
+    ) -> dict:
+        """``GetShuffleLocationDelta`` body: one producer feed's delta
+        from ``from_index``.  Feeds live only on CACHED graphs (they are
+        in-memory scheduler state) — an evicted/restarted job reports
+        the feed invalid, which aborts the tail; the task's late status
+        is then dropped by the rolled-back-stage guards."""
+        invalid = {
+            "stage": stage_id,
+            "from_index": 0,
+            "locations": [],
+            "complete": False,
+            "epoch": 0,
+            "valid": False,
+        }
+        with self._cache_lock:
+            entry = self._cache.get(job_id)
+        if entry is None:
+            return invalid
+        with entry.lock:
+            graph = entry.graph
+            if graph is None:
+                return invalid
+            return graph.shuffle_feed_delta(stage_id, from_index)
+
+    def _collect_feed_pushes(self, graph: ExecutionGraph) -> List[tuple]:
+        """Under the job entry lock: drain the graph's queued feed
+        deltas and resolve push targets (executors currently running
+        tailing consumer tasks).  Deltas with no live target are simply
+        dropped — the executor-side poll fallback reads the same feed."""
+        deltas = graph.take_pending_feed_deltas()
+        out: List[tuple] = []
+        for d in deltas:
+            targets = graph.tailing_executors(d["stage"])
+            if targets:
+                out.append((graph.job_id, d, sorted(targets)))
+        return out
+
+    def _executor_fanout(
+        self,
+        items: List[Tuple[str, object]],
+        send,
+        thread_name: str,
+        log_label: str,
+        log_level: int = 30,  # logging.WARNING
     ) -> None:
-        """Best-effort CancelTasks fan-out for losing duplicate attempts
-        and reaped stragglers, grouped per executor over the pooled
-        channel cache (``proto/rpc.executor_stub``).  The RPCs run on a
-        detached thread: a cancel is advisory (the committed-partition
-        guard drops the loser's results either way), so a dead executor's
-        5s RPC timeout must never stall the event-loop thread issuing it.
-        Pull-mode executors expose no gRPC port and are skipped."""
-        per: Dict[str, List[PartitionId]] = {}
+        """Best-effort per-executor RPC fan-out shared by CancelTasks and
+        UpdateShuffleLocations: group ``(executor_id, payload)`` items,
+        resolve each executor's metadata once (unknown executors are
+        skipped — they may be gone — and pull-mode executors, which
+        expose no gRPC port, never receive pushes), then run
+        ``send(stub, payloads)`` per executor on ONE detached daemon
+        thread over the pooled channel cache.  Detached because every
+        payload here is advisory (guards/polls cover a lost RPC) and a
+        dead executor's RPC timeout must never stall the event-loop
+        thread issuing it; failures log at ``log_level`` and move on."""
+        per: Dict[str, List[object]] = {}
         metas: Dict[str, ExecutorMetadata] = {}
-        for executor_id, pid in cancels:
+        for executor_id, payload in items:
             if not executor_id:
                 continue
             if executor_id not in metas:
@@ -769,38 +862,76 @@ class TaskManager:
                     continue
             if not metas[executor_id].grpc_port:
                 continue
-            per.setdefault(executor_id, []).append(pid)
+            per.setdefault(executor_id, []).append(payload)
         if not per:
             return
-        threading.Thread(
-            target=self._cancel_fanout,
-            args=(per, metas),
-            name="cancel-tasks-fanout",
-            daemon=True,
-        ).start()
 
-    @staticmethod
-    def _cancel_fanout(
-        per: Dict[str, List[PartitionId]],
-        metas: Dict[str, ExecutorMetadata],
+        def run() -> None:
+            import logging
+
+            from ..proto.rpc import executor_stub
+
+            for executor_id, payloads in per.items():
+                meta = metas[executor_id]
+                try:
+                    send(
+                        executor_stub(meta.host, meta.grpc_port), payloads
+                    )
+                except Exception as e:  # noqa: BLE001 - advisory RPC
+                    logging.getLogger(__name__).log(
+                        log_level, "%s to %s failed: %s",
+                        log_label, executor_id, e,
+                    )
+
+        threading.Thread(target=run, name=thread_name, daemon=True).start()
+
+    def _push_shuffle_deltas(self, pushes: List[tuple]) -> None:
+        """Best-effort UpdateShuffleLocations fan-out (push mode) to the
+        executors running tailing consumer tasks; failures only log at
+        debug — the executor-side poll fallback reads the same feed."""
+        items = [
+            (eid, (job_id, delta))
+            for job_id, delta, targets in pushes
+            for eid in targets
+        ]
+
+        def send(stub, payloads) -> None:
+            params = pb.UpdateShuffleLocationsParams()
+            for job_id, delta in payloads:
+                m = params.deltas.add()
+                m.job_id = job_id
+                m.stage_id = delta["stage"]
+                m.from_index = delta["from_index"]
+                m.complete = delta["complete"]
+                m.valid = delta["valid"]
+                m.epoch = delta["epoch"]
+                for loc in delta["locations"]:
+                    m.locations.add().CopyFrom(loc.to_proto())
+            stub.UpdateShuffleLocations(params, timeout=5)
+
+        self._executor_fanout(
+            items, send, "shuffle-delta-fanout", "UpdateShuffleLocations",
+            log_level=10,  # logging.DEBUG
+        )
+
+    def cancel_task_attempts(
+        self, cancels: List[Tuple[str, PartitionId]]
     ) -> None:
-        import logging
+        """Best-effort CancelTasks fan-out for losing duplicate attempts
+        and reaped stragglers: a cancel is advisory (the
+        committed-partition guard drops the loser's results either way)."""
 
-        from ..proto.rpc import executor_stub
+        def send(stub, pids) -> None:
+            stub.CancelTasks(
+                pb.CancelTasksParams(
+                    partition_ids=[p.to_proto() for p in pids]
+                ),
+                timeout=5,
+            )
 
-        for executor_id, pids in per.items():
-            meta = metas[executor_id]
-            try:
-                executor_stub(meta.host, meta.grpc_port).CancelTasks(
-                    pb.CancelTasksParams(
-                        partition_ids=[p.to_proto() for p in pids]
-                    ),
-                    timeout=5,
-                )
-            except Exception as e:  # noqa: BLE001 - cancel is advisory
-                logging.getLogger(__name__).warning(
-                    "CancelTasks on %s failed: %s", executor_id, e
-                )
+        self._executor_fanout(
+            cancels, send, "cancel-tasks-fanout", "CancelTasks"
+        )
 
     def reset_executor_running_tasks(self, executor_id: str) -> Dict[str, int]:
         """Re-queue (with exclusion) every in-flight task on a quarantined
@@ -872,6 +1003,7 @@ class TaskManager:
         # job this returns the list untouched (byte-identical A/B).
         job_ids = self._admission_order(job_ids)
 
+        feed_pushes: List[tuple] = []
         for job_id in job_ids:
             if not free:
                 break
@@ -881,6 +1013,11 @@ class TaskManager:
                 if graph is None or graph.status in (COMPLETED, FAILED):
                     continue
                 graph.revive()
+                # partial resolution inside revive may have seeded a
+                # shuffle feed: drain its deltas (and push-notify any
+                # already-running tailing consumers) whether or not a
+                # task pops below
+                feed_pushes.extend(self._collect_feed_pushes(graph))
                 changed = False
                 start = len(assignments)
                 free_before = list(free)
@@ -919,6 +1056,7 @@ class TaskManager:
                         )
                         del assignments[start:]
                         free = free_before
+        self._push_shuffle_deltas(feed_pushes)
         return assignments, free + sidelined, pending
 
     def _admission_order(self, job_ids: List[str]) -> List[str]:
@@ -1010,6 +1148,18 @@ class TaskManager:
         # poll_work/launch builds TaskDefinition.props from session props)
         for k, v in self._session_settings(task.session_id).items():
             td.props[k] = v
+        # pipelined execution enabled by a SCHEDULER override (not the
+        # session): stamp the knob so the executor's worker-eligibility
+        # gate still recognizes tailing plans; sessions that set it ship
+        # it above, and default-off tasks carry nothing extra
+        from ..config import SHUFFLE_PIPELINED
+
+        if SHUFFLE_PIPELINED not in td.props and self.config_overrides.get(
+            SHUFFLE_PIPELINED
+        ):
+            td.props[SHUFFLE_PIPELINED] = self.config_overrides[
+                SHUFFLE_PIPELINED
+            ]
         return td
 
     def _session_settings(self, session_id: str) -> Dict[str, str]:

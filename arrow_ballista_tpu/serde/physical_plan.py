@@ -213,6 +213,10 @@ def physical_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             n.shuffle_reader.selections_json = json.dumps(plan.selections)
         if plan.source_partition_count:
             n.shuffle_reader.source_partition_count = plan.source_partition_count
+        if plan.tail:
+            # pipelined execution: the executor tails the scheduler's
+            # shuffle-location feed instead of reading static locations
+            n.shuffle_reader.tail = True
         return n
     if isinstance(plan, UnresolvedShuffleExec):
         n.unresolved_shuffle.stage_id = plan.stage_id
@@ -378,6 +382,7 @@ def physical_plan_from_proto(
             source_partition_count=(
                 n.shuffle_reader.source_partition_count or None
             ),
+            tail=bool(n.shuffle_reader.tail),
         )
     if kind == "unresolved_shuffle":
         return UnresolvedShuffleExec(

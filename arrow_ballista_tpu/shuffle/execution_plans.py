@@ -768,6 +768,13 @@ class ShuffleReaderExec(ExecutionPlan):
     with, so an executor-loss rollback reconstructs the REWRITTEN
     placeholder — a rolled-back consumer re-resolves with the same
     adaptive plan, not the original static one.
+
+    ``tail=True`` (streaming pipelined execution, ISSUE 15): the reader
+    was resolved BEFORE its producer stage completed — ``partition``
+    carries no static locations; execution tails the scheduler's
+    shuffle-location feed for this stage (``shuffle/delta_store.py``)
+    until the feed reports complete, streaming each committed map
+    fragment the moment it lands.
     """
 
     def __init__(
@@ -777,6 +784,7 @@ class ShuffleReaderExec(ExecutionPlan):
         partition: list[list[PartitionLocation]],
         selections: Optional[list[list[tuple[int, int, int]]]] = None,
         source_partition_count: Optional[int] = None,
+        tail: bool = False,
     ):
         super().__init__()
         self.stage_id = stage_id
@@ -784,6 +792,7 @@ class ShuffleReaderExec(ExecutionPlan):
         self.partition = partition
         self.selections = selections
         self.source_partition_count = source_partition_count
+        self.tail = tail
 
     @property
     def schema(self) -> pa.Schema:
@@ -804,6 +813,9 @@ class ShuffleReaderExec(ExecutionPlan):
         from ..obs import trace
         from .fetcher import FetchPolicy, ShuffleFetcher
 
+        if self.tail:
+            yield from self._execute_tail(partition, ctx)
+            return
         locations = self.partition[partition]
         if not locations:
             return
@@ -835,11 +847,54 @@ class ShuffleReaderExec(ExecutionPlan):
         finally:
             sp.finish()
 
+    def _execute_tail(
+        self, partition: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        """Pipelined read: stream the producer's growing location set
+        from the delta feed (committed winners only) until it completes.
+        The feed is keyed by the TASK's job id — a tailing reader never
+        travels outside a distributed task."""
+        from ..obs import trace
+        from .fetcher import FetchPolicy, TailingShuffleFetcher
+
+        policy = FetchPolicy.from_config(ctx.config)
+        sp = trace.manual_span(
+            "shuffle.fetch",
+            stage=self.stage_id,
+            partition=partition,
+            tail=True,
+        )
+        try:
+            fetcher = TailingShuffleFetcher(
+                ctx.job_id,
+                self.stage_id,
+                partition,
+                policy,
+                self.metrics,
+                cancel_event=ctx.cancel_event,
+                owner=ctx.work_dir,
+                trace_parent=sp.ctx,
+            )
+            rows = 0
+            for b in fetcher:
+                ctx.check_cancelled()
+                rows += b.num_rows
+                self.metrics.add("output_rows", b.num_rows)
+                yield b
+            sp.set_attr("rows", rows)
+        finally:
+            sp.finish()
+
     def with_new_children(self, children):
         assert not children
         return self
 
     def __str__(self) -> str:
+        if self.tail:
+            return (
+                f"ShuffleReaderExec: stage={self.stage_id} "
+                f"partitions={len(self.partition)} tail=true"
+            )
         n_loc = sum(len(p) for p in self.partition)
         aqe = (
             f" aqe_source_partitions={self.source_partition_count}"

@@ -961,6 +961,120 @@ class ShuffleFetcher:
         return total
 
 
+class TailingShuffleFetcher:
+    """Streaming pipelined fetch (ISSUE 15): one reader partition's tail
+    over a producer stage's shuffle-location feed.
+
+    Unlike :class:`ShuffleFetcher` — whose location set is fixed at
+    construction — this pipeline's locations ARRIVE over time: the
+    executor-side delta store (``shuffle/delta_store.py``) mirrors the
+    scheduler's per-producer feed (push notifications in push mode,
+    ``GetShuffleLocationDelta`` polls in pull mode), and this fetcher
+    streams each location the moment it lands, finishing when the feed
+    reports complete.  Locations are fetched sequentially in feed order
+    (they trickle in as map tasks commit, so a worker pool would mostly
+    idle); each one still gets the full :func:`retrying_fetch` treatment
+    — retry/backoff, replica failover, mid-stream resume and the
+    structured ``ShuffleFetchFailed`` that drives producer recovery.
+
+    Stall-on-producer time lands in ``fetch_wait_time_ns`` (accounted by
+    the delta store's tail), so the query doctor's attribution of a
+    pipelined consumer stays exact.  Registered with the active-fetcher
+    table like the static pipeline, so executor shutdown aborts it.
+    """
+
+    def __init__(
+        self,
+        job_id: str,
+        stage_id: int,
+        partition: int,
+        policy: FetchPolicy,
+        metrics,
+        cancel_event: Optional[threading.Event] = None,
+        owner: Optional[str] = None,
+        trace_parent=None,
+        fetch_fn: Optional[Callable[[object], Iterator[pa.RecordBatch]]] = None,
+    ) -> None:
+        self.owner = owner
+        self._job_id = job_id
+        self._stage_id = stage_id
+        self._partition = partition
+        self._policy = policy
+        self._metrics = _TeeMetrics(metrics)
+        self._cancel = cancel_event
+        self._trace_parent = trace_parent
+        self._fetch_fn = fetch_fn
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._consumed = False
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        if error is not None and self._error is None:
+            self._error = error
+        self._stop.set()
+
+    def __iter__(self) -> Iterator[pa.RecordBatch]:
+        if self._consumed:
+            raise RuntimeError(
+                "TailingShuffleFetcher is single-use; construct a new one"
+            )
+        self._consumed = True
+        return self._iterate()
+
+    def _iterate(self) -> Iterator[pa.RecordBatch]:
+        from . import delta_store
+
+        with _active_lock:
+            _active.add(self)
+        span_cm = (
+            obs_trace.span(
+                "shuffle.fetch.tail",
+                parent=self._trace_parent,
+                stage=self._stage_id,
+                partition=self._partition,
+            )
+            if self._trace_parent is not None
+            else obs_trace.NOOP
+        )
+        try:
+            with span_cm as sp:
+                total = 0
+                n_locs = 0
+                for loc in delta_store.tail_locations(
+                    self._job_id,
+                    self._stage_id,
+                    self._partition,
+                    stop_event=self._stop,
+                    cancel_event=self._cancel,
+                    metrics=self._metrics,
+                ):
+                    t0 = time.monotonic_ns()
+                    for batch in retrying_fetch(
+                        loc,
+                        self._policy,
+                        self._metrics,
+                        fetch_fn=self._fetch_fn,
+                        stop_event=self._stop,
+                    ):
+                        if self._error is not None:
+                            raise self._error
+                        yield batch
+                        nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                        self._metrics.add("bytes_fetched", nbytes)
+                        total += nbytes
+                    self._metrics.add(
+                        "fetch_time_ns", time.monotonic_ns() - t0
+                    )
+                    self._metrics.add("locations_fetched", 1)
+                    n_locs += 1
+                if self._error is not None:
+                    raise self._error
+                sp.set_attr("bytes", total)
+                sp.set_attr("locations", n_locs)
+        finally:
+            self.close()
+
+
 def _cancelled():
     from ..errors import Cancelled
 

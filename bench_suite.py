@@ -48,8 +48,14 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   acceptance < 2%), with the measured job's wall-clock category
   breakdown riding the record
 
+  plus the pipelined-execution A/B (pipelined_stage_speedup): a
+  barrier-dominated shuffle query (manufactured straggler map task +
+  reduce-side work) with ballista.shuffle.pipelined off vs on on
+  identical inputs — sha fingerprint identity enforced, wall-clock and
+  the doctor's measured barrier_wait before/after in the record
+
 Usage: python bench_suite.py
-[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|obs|all]
+[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|pipelined|obs|all]
 (default all)
 """
 
@@ -721,6 +727,31 @@ def bench_keyed() -> None:
     )
 
 
+def bench_pipelined() -> None:
+    """Streaming pipelined execution A/B (ISSUE 15): a barrier-dominated
+    shuffle query (manufactured straggler map task + reduce-side work)
+    with ballista.shuffle.pipelined off vs on over a real 2-executor
+    standalone cluster on identical inputs — sha row-fingerprint
+    identity enforced, wall-clock speedup and the doctor's measured
+    barrier_wait for both legs in the record (pipelined leg's
+    barrier_wait collapsing toward zero is the expected signature)."""
+    from benchmarks.pipelined_stage import run_pipelined_bench
+
+    _emit(
+        run_pipelined_bench(
+            n_rows=int(
+                float(os.environ.get("BENCH_PIPELINED_ROWS", "2e5"))
+            ),
+            straggler_ms=int(
+                os.environ.get("BENCH_PIPELINED_STRAGGLER_MS", "3000")
+            ),
+            reduce_delay_ms=int(
+                os.environ.get("BENCH_PIPELINED_REDUCE_MS", "1800")
+            ),
+        )
+    )
+
+
 def bench_obs() -> None:
     """Obs leg (ISSUE 13): disabled-path + enabled-path overhead with
     the query-doctor attribution pass in the picture (PR 3 methodology —
@@ -776,6 +807,8 @@ def main() -> None:
         bench_keyed()
     if which in ("concurrent", "all"):
         bench_concurrent()
+    if which in ("pipelined", "all"):
+        bench_pipelined()
     if which in ("obs", "all"):
         bench_obs()
 

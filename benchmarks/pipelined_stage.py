@@ -1,0 +1,210 @@
+"""Streaming pipelined execution A/B (ISSUE 15).
+
+Two entry points:
+
+* :func:`run_pipelined_bench` — the BENCH_SUITE leg: a barrier-dominated
+  shuffle query (one manufactured straggler map task holds the map stage
+  open; the reduce side carries manufactured per-task latency that a
+  pipelined scheduler can overlap with the straggler window) measured
+  with ``ballista.shuffle.pipelined`` off vs on over a real 2-executor
+  standalone cluster on IDENTICAL inputs.  Result identity is enforced
+  with a sha256 row fingerprint (PR 10 methodology); the record reports
+  wall-clock and the doctor's measured ``barrier_wait`` for both legs —
+  the pipelined leg's barrier wait collapsing toward zero is the
+  expected signature.
+
+* :func:`run_pipelining_smoke` — the tier-1 ``--bench-smoke`` gate: a
+  tiny 2-executor job with one manufactured slow map task, asserting
+  the pipelined leg's first reduce dispatch PRECEDES the last map
+  commit and that results are bit-identical to the barrier leg.
+
+The manufactured latencies are injection-point delays (``task.run``):
+the straggler models a slow map task, the reduce-side delay models
+reduce work that exists regardless of scheduling — pipelining wins
+exactly when that work overlaps the producer's tail instead of
+queueing behind the barrier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import pyarrow as pa
+
+BASE_CONFIG = {
+    "ballista.mesh.enable": "false",
+    "ballista.tpu.min_rows": "0",
+    "ballista.shuffle.partitions": "4",
+}
+
+SQL = "select g, sum(x) as s, count(x) as n from t group by g"
+
+
+def _fingerprint(table: pa.Table) -> str:
+    rows = sorted(zip(*[c.to_pylist() for c in table.columns]))
+    h = hashlib.sha256()
+    for row in rows:
+        h.update(repr(row).encode())
+    return h.hexdigest()
+
+
+def _stage_timing(detail: dict, sid: int) -> dict:
+    for row in detail.get("stages", []):
+        if row.get("stage_id") == sid:
+            return row.get("timing") or {}
+    return {}
+
+
+def _run_leg(
+    pipelined: bool,
+    n_rows: int,
+    straggler_ms: int,
+    reduce_delay_ms: int,
+    min_fraction: float = 0.25,
+):
+    """One standalone A/B leg; returns (fingerprint, wall_s, report,
+    detail)."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.obs.doctor import job_report
+    from arrow_ballista_tpu.testing import faults
+
+    cfg = dict(BASE_CONFIG)
+    cfg["ballista.shuffle.pipelined"] = "true" if pipelined else "false"
+    cfg["ballista.shuffle.pipelined_min_fraction"] = str(min_fraction)
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(cfg), num_executors=2, concurrent_tasks=4
+    )
+    try:
+        ctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array(
+                            [f"g{i % 23}" for i in range(n_rows)], pa.string()
+                        ),
+                        "x": pa.array(
+                            [float(i % 251) for i in range(n_rows)],
+                            pa.float64(),
+                        ),
+                    }
+                ),
+                4,
+            ),
+        )
+        if straggler_ms:
+            faults.arm(
+                "task.run",
+                times=1,
+                action="delay",
+                delay_ms=straggler_ms,
+                match=lambda stage_id=0, partition_id=0, speculative=False, **_:
+                    stage_id == 1 and partition_id == 1 and not speculative,
+            )
+        if reduce_delay_ms:
+            faults.arm(
+                "task.run",
+                times=-1,
+                action="delay",
+                delay_ms=reduce_delay_ms,
+                match=lambda stage_id=0, **_: stage_id == 2,
+            )
+        t0 = time.perf_counter()
+        result = ctx.sql(SQL).collect()
+        wall_s = time.perf_counter() - t0
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        detail = scheduler.server.state.task_manager.get_job_detail(job_id)
+        report = job_report(detail, [], [])
+        return _fingerprint(result), wall_s, report, detail
+    finally:
+        faults.clear()
+        ctx.close()
+
+
+def run_pipelined_bench(
+    n_rows: int = 200_000,
+    straggler_ms: int = 3000,
+    reduce_delay_ms: int = 1800,
+) -> dict:
+    """Barrier vs pipelined on identical inputs; returns the bench
+    record (``metric: pipelined_stage_speedup``)."""
+    fp_b, wall_b, rep_b, _ = _run_leg(
+        False, n_rows, straggler_ms, reduce_delay_ms
+    )
+    fp_p, wall_p, rep_p, detail_p = _run_leg(
+        True, n_rows, straggler_ms, reduce_delay_ms
+    )
+    assert fp_b == fp_p, (
+        f"pipelined leg changed the result: {fp_b} != {fp_p}"
+    )
+    barrier_b = (rep_b["critical_path"].get("breakdown") or {}).get(
+        "barrier_wait_ms", 0.0
+    )
+    barrier_p = (rep_p["critical_path"].get("breakdown") or {}).get(
+        "barrier_wait_ms", 0.0
+    )
+    rows = {r["stage_id"]: r for r in detail_p.get("stages", [])}
+    partial = bool(
+        (rows.get(2, {}).get("pipeline") or {}).get("partial_start")
+    )
+    return {
+        "metric": "pipelined_stage_speedup",
+        "value": round(wall_b / wall_p, 3),
+        "unit": "x (barrier wall / pipelined wall)",
+        "vs_baseline": round(wall_b / wall_p, 3),
+        "barrier_wall_s": round(wall_b, 3),
+        "pipelined_wall_s": round(wall_p, 3),
+        "barrier_wait_ms_barrier_leg": round(barrier_b, 1),
+        "barrier_wait_ms_pipelined_leg": round(barrier_p, 1),
+        "barrier_wait_drop_pct": round(
+            100.0 * (1.0 - barrier_p / barrier_b), 1
+        )
+        if barrier_b > 0
+        else None,
+        "consumer_started_on_partial_input": partial,
+        "fingerprint": fp_p,
+        "n_rows": n_rows,
+        "straggler_ms": straggler_ms,
+        "reduce_delay_ms": reduce_delay_ms,
+    }
+
+
+def run_pipelining_smoke(straggler_ms: int = 800) -> dict:
+    """Tier-1 ``--bench-smoke`` gate: the pipelined leg's first reduce
+    dispatch precedes the last map commit and results are bit-identical
+    to the barrier leg.  Assertions run inside; the returned record is
+    informational."""
+    fp_b, _wall_b, _rep_b, _ = _run_leg(False, 20_000, straggler_ms, 0)
+    fp_p, _wall_p, rep_p, detail = _run_leg(True, 20_000, straggler_ms, 0)
+    assert fp_b == fp_p, f"pipelined result diverged: {fp_b} != {fp_p}"
+    rows = {r["stage_id"]: r for r in detail.get("stages", [])}
+    assert (rows.get(2, {}).get("pipeline") or {}).get("partial_start"), (
+        "consumer never started on partial input"
+    )
+    map_fin = _stage_timing(detail, 1).get("finish_us") or {}
+    red_disp = _stage_timing(detail, 2).get("dispatch_us") or {}
+    assert map_fin and red_disp, "timing anchors missing"
+    first_reduce_dispatch = min(red_disp.values())
+    last_map_commit = max(map_fin.values())
+    assert first_reduce_dispatch < last_map_commit, (
+        "pipelined leg's first reduce dispatch did not precede the last "
+        f"map commit ({first_reduce_dispatch} >= {last_map_commit})"
+    )
+    return {
+        "results_identical": True,
+        "first_reduce_dispatch_before_last_map_commit_ms": round(
+            (last_map_commit - first_reduce_dispatch) / 1e3, 1
+        ),
+        "barrier_wait_ms_pipelined_leg": round(
+            (rep_p["critical_path"].get("breakdown") or {}).get(
+                "barrier_wait_ms", 0.0
+            ),
+            1,
+        ),
+        "fingerprint": fp_p,
+    }

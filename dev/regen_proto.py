@@ -85,6 +85,59 @@ NEW_FIELDS = [
     # final stage to stash it in
     ("ExecutionGraphProto", "submitted_unix_us", 17, F.TYPE_UINT64, F.LABEL_OPTIONAL),
     ("ExecutionGraphProto", "planning_us", 18, F.TYPE_UINT64, F.LABEL_OPTIONAL),
+    # streaming pipelined execution (ISSUE 15): a reader resolved before
+    # its producer completed carries no static locations — it TAILS the
+    # scheduler's shuffle-location feed at execution time
+    ("ShuffleReaderExecNode", "tail", 6, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+]
+
+# Messages added by descriptor mutation (same idempotent scheme as
+# NEW_FIELDS): (message name, [(field, number, type, label, type_name)]).
+# type_name is required for TYPE_MESSAGE fields and must be fully
+# qualified (".ballista_tpu.X").
+NEW_MESSAGES = [
+    # streaming pipelined execution (ISSUE 15): incremental map-output
+    # location deltas.  The scheduler pushes UpdateShuffleLocations to
+    # push-mode executors running tailing consumers; pull-mode executors
+    # poll GetShuffleLocationDelta.
+    (
+        "ShuffleLocationDeltaParams",
+        [
+            ("job_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, None),
+            ("stage_id", 2, F.TYPE_UINT32, F.LABEL_OPTIONAL, None),
+            ("from_index", 3, F.TYPE_UINT32, F.LABEL_OPTIONAL, None),
+        ],
+    ),
+    (
+        "ShuffleLocationDelta",
+        [
+            ("job_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, None),
+            ("stage_id", 2, F.TYPE_UINT32, F.LABEL_OPTIONAL, None),
+            ("from_index", 3, F.TYPE_UINT32, F.LABEL_OPTIONAL, None),
+            (
+                "locations", 4, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+                ".ballista_tpu.PartitionLocation",
+            ),
+            ("complete", 5, F.TYPE_BOOL, F.LABEL_OPTIONAL, None),
+            ("valid", 6, F.TYPE_BOOL, F.LABEL_OPTIONAL, None),
+            ("epoch", 7, F.TYPE_UINT32, F.LABEL_OPTIONAL, None),
+        ],
+    ),
+    (
+        "UpdateShuffleLocationsParams",
+        [
+            (
+                "deltas", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+                ".ballista_tpu.ShuffleLocationDelta",
+            ),
+        ],
+    ),
+    (
+        "UpdateShuffleLocationsResult",
+        [
+            ("success", 1, F.TYPE_BOOL, F.LABEL_OPTIONAL, None),
+        ],
+    ),
 ]
 
 HEADER = '''# -*- coding: utf-8 -*-
@@ -120,24 +173,39 @@ def extract_blob(path: str) -> bytes:
     return ballista_pb2.DESCRIPTOR.serialized_pb
 
 
+def _add_field(msg, fname, number, ftype, label, type_name=None) -> int:
+    if any(f.name == fname or f.number == number for f in msg.field):
+        return 0
+    f = msg.field.add()
+    f.name = fname
+    f.number = number
+    f.type = ftype
+    f.label = label
+    if type_name:
+        f.type_name = type_name
+    f.json_name = re.sub(r"_(\w)", lambda m: m.group(1).upper(), fname)
+    return 1
+
+
 def mutate(blob: bytes) -> tuple[bytes, int]:
     fd = descriptor_pb2.FileDescriptorProto()
     fd.ParseFromString(blob)
     by_name = {m.name: m for m in fd.message_type}
     added = 0
+    for msg_name, fields in NEW_MESSAGES:
+        msg = by_name.get(msg_name)
+        if msg is None:
+            msg = fd.message_type.add()
+            msg.name = msg_name
+            by_name[msg_name] = msg
+            added += 1
+        for fname, number, ftype, label, type_name in fields:
+            added += _add_field(msg, fname, number, ftype, label, type_name)
     for msg_name, fname, number, ftype, label in NEW_FIELDS:
         msg = by_name.get(msg_name)
         if msg is None:
             raise SystemExit(f"message {msg_name} not found in descriptor")
-        if any(f.name == fname or f.number == number for f in msg.field):
-            continue
-        f = msg.field.add()
-        f.name = fname
-        f.number = number
-        f.type = ftype
-        f.label = label
-        f.json_name = re.sub(r"_(\w)", lambda m: m.group(1).upper(), fname)
-        added += 1
+        added += _add_field(msg, fname, number, ftype, label)
     return fd.SerializeToString(), added
 
 
@@ -174,6 +242,11 @@ def check() -> None:
     undocumented = [
         f"{msg}.{fname}"
         for msg, fname, *_ in NEW_FIELDS
+        if not documented(msg, fname)
+    ] + [
+        f"{msg}.{fname}"
+        for msg, fields in NEW_MESSAGES
+        for fname, *_ in fields
         if not documented(msg, fname)
     ]
     if undocumented:
@@ -247,6 +320,18 @@ def main() -> None:
             "assert abs(back.queued_seconds - 1.25) < 1e-9\n"
             "eg3 = pb.ExecutionGraphProto(tenant_json='{\"pool\":\"a\"}')\n"
             "assert pb.ExecutionGraphProto.FromString(eg3.SerializeToString()).tenant_json\n"
+            "sd = pb.ShuffleLocationDelta(job_id='j', stage_id=3, from_index=2,\n"
+            "                             complete=True, valid=True, epoch=5)\n"
+            "sd.locations.add().path = '/a'\n"
+            "back = pb.ShuffleLocationDelta.FromString(sd.SerializeToString())\n"
+            "assert back.stage_id == 3 and back.epoch == 5 and back.locations[0].path == '/a'\n"
+            "up = pb.UpdateShuffleLocationsParams()\n"
+            "up.deltas.add().job_id = 'j'\n"
+            "assert pb.UpdateShuffleLocationsParams.FromString(up.SerializeToString()).deltas[0].job_id == 'j'\n"
+            "dp = pb.ShuffleLocationDeltaParams(job_id='j', stage_id=1, from_index=4)\n"
+            "assert pb.ShuffleLocationDeltaParams.FromString(dp.SerializeToString()).from_index == 4\n"
+            "srt = pb.ShuffleReaderExecNode(tail=True)\n"
+            "assert pb.ShuffleReaderExecNode.FromString(srt.SerializeToString()).tail\n"
             "print('round-trip smoke OK')\n",
         ],
         cwd=REPO,

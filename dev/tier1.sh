@@ -93,6 +93,18 @@ EOF
   [ $rc -eq 0 ] && rc=$smoke_rc
   timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
 import json
+from benchmarks.pipelined_stage import run_pipelining_smoke
+
+# pipelined-execution smoke: tiny 2-executor job with one manufactured
+# slow map task — the pipelined leg's first reduce dispatch must precede
+# the last map commit and results must be bit-identical to the barrier
+# leg (asserted inside)
+print(json.dumps({"bench_smoke": "pipelined", **run_pipelining_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
 from benchmarks.obs_doctor import run_doctor_smoke
 
 # query-doctor smoke: tiny standalone job with a manufactured straggler

@@ -1,0 +1,821 @@
+"""Streaming pipelined execution (ISSUE 15).
+
+Unit coverage for the scheduler's partial-resolution state machine
+(committed-task granularity, streamable/breaker classification, the
+knob-off byte-identity contract), the per-producer shuffle-location
+feed + its executor-side mirror (epoch fencing, gap tolerance, tailing
+iteration), failure semantics (executor loss of a streamed-from
+producer, speculation races), and an end-to-end standalone A/B proving
+bit-identical results with the consumer dispatched before the last map
+commit.
+"""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.exec.planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+from arrow_ballista_tpu.scheduler.execution_stage import (
+    CompletedStage,
+    RunningStage,
+    TaskInfo,
+    UnresolvedStage,
+)
+from arrow_ballista_tpu.scheduler.planner import classify_shuffle_inputs
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.shuffle import delta_store
+from arrow_ballista_tpu.shuffle.execution_plans import ShuffleReaderExec
+
+EXEC1 = ExecutorMetadata("exec-1", "127.0.0.1", 50051, 50052)
+EXEC2 = ExecutorMetadata("exec-2", "127.0.0.2", 50051, 50052)
+
+PIPELINED = {
+    "ballista.shuffle.pipelined": "true",
+    "ballista.shuffle.pipelined_min_fraction": "0.5",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_delta_store():
+    delta_store.reset()
+    yield
+    delta_store.reset()
+
+
+def make_ctx(partitions=4, extra=None):
+    cfg = {
+        "ballista.shuffle.partitions": str(partitions),
+        "ballista.tpu.enable": "false",
+    }
+    cfg.update(extra or {})
+    ctx = SessionContext(BallistaConfig(cfg))
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a", "c"] * 2, pa.string()),
+                "v": pa.array([float(i) for i in range(8)], pa.float64()),
+                "k": pa.array(list(range(8)), pa.int64()),
+            }
+        ),
+        partitions=4,
+    )
+    ctx.register_arrow_table(
+        "u",
+        pa.table(
+            {
+                "k": pa.array([1, 2, 5], pa.int64()),
+                "w": pa.array(["x", "y", "z"], pa.string()),
+            }
+        ),
+        partitions=2,
+    )
+    return ctx
+
+
+def make_graph(sql, extra=None, job_id="job1"):
+    ctx = make_ctx(extra=extra)
+    plan = PhysicalPlanner(ctx.config).create_physical_plan(
+        ctx.sql(sql).optimized_plan()
+    )
+    return ExecutionGraph(
+        "sched-1", job_id, ctx.session_id, plan, config=ctx.config
+    )
+
+
+def complete_task(graph, task, executor, tag="x"):
+    part = task.output_partitioning
+    if part is not None:
+        partitions = [
+            ShuffleWritePartition(
+                p, f"/fake/{tag}/{task.partition}/{p}.arrow", 1, 10, 100
+            )
+            for p in range(part.n)
+        ]
+    else:
+        partitions = [
+            ShuffleWritePartition(
+                task.partition.partition_id,
+                f"/fake/{tag}/{task.partition}/data.arrow",
+                1,
+                10,
+                100,
+            )
+        ]
+    info = TaskInfo(
+        task.partition,
+        "completed",
+        executor.id,
+        partitions=partitions,
+        attempt=task.attempt,
+        speculative=task.speculative,
+    )
+    return graph.update_task_status(info, executor)
+
+
+def pop_stage_tasks(graph, stage_id, executor=EXEC1, n=None):
+    out = []
+    while n is None or len(out) < n:
+        task = graph.pop_next_task(executor.id)
+        if task is None or task.partition.stage_id != stage_id:
+            assert task is None, f"unexpected task from stage {task.partition.stage_id}"
+            break
+        out.append(task)
+    return out
+
+
+GROUPBY = "select g, sum(v) as s from t group by g"
+
+
+# ------------------------------------------------------- classification
+def test_classification_agg_sort_join():
+    agg = make_graph(GROUPBY)
+    s, b = classify_shuffle_inputs(agg.stages[2].plan)
+    assert s == {1} and b == set()
+
+    srt = make_graph("select g from t order by g")
+    s, b = classify_shuffle_inputs(srt.stages[2].plan)
+    assert s == set() and b == {1}
+
+    join = make_graph("select t.g, u.w from t join u on t.k = u.k")
+    s, b = classify_shuffle_inputs(join.stages[3].plan)
+    # build (left) side barriers; probe side streams
+    assert b == {1} and s == {2}
+
+
+# -------------------------------------------- partial resolution (unit)
+def test_partial_resolution_at_min_fraction():
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    assert len(maps) == 4
+    # below the 0.5 fraction: consumer stays Unresolved
+    complete_task(graph, maps[0], EXEC1)
+    graph.revive()
+    assert isinstance(graph.stages[2], UnresolvedStage)
+    # at the fraction: consumer starts on partial input
+    complete_task(graph, maps[1], EXEC1)
+    graph.revive()
+    consumer = graph.stages[2]
+    assert isinstance(consumer, RunningStage)
+    assert consumer.tail_inputs == {1} and consumer.started_on_partial
+    # the feed holds the two committed map tasks' locations (4 output
+    # partitions each), is not complete, and queued its seed delta
+    feed = graph.shuffle_feeds[1]
+    assert len(feed["locations"]) == 8 and not feed["complete"]
+    deltas = graph.take_pending_feed_deltas()
+    assert deltas and deltas[0]["from_index"] == 0
+    # consumer tasks dispatch NOW, with tailing readers in the plan
+    ctask = graph.pop_next_task(EXEC2.id)
+    assert ctask is not None and ctask.partition.stage_id == 2
+    readers = [
+        n
+        for n in _walk(ctask.plan)
+        if isinstance(n, ShuffleReaderExec)
+    ]
+    assert readers and all(r.tail for r in readers)
+    # remaining map commits append to the feed; producer completion
+    # marks it complete and flips the consumer's input complete
+    complete_task(graph, maps[2], EXEC1)
+    complete_task(graph, maps[3], EXEC1)
+    feed = graph.shuffle_feeds[1]
+    assert len(feed["locations"]) == 16 and feed["complete"]
+    assert consumer.inputs[1].complete
+    assert isinstance(graph.stages[1], CompletedStage)
+
+
+def _walk(plan):
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(n.children())
+
+
+def test_breaker_consumer_keeps_barrier():
+    graph = make_graph("select g from t order by g", extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:3]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    assert isinstance(graph.stages[2], UnresolvedStage)
+    assert not graph.shuffle_feeds
+    complete_task(graph, maps[3], EXEC1)
+    assert isinstance(graph.stages[2], RunningStage)
+    assert not graph.stages[2].tail_inputs
+
+
+def test_join_tails_probe_only_after_build_completes():
+    graph = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k", extra=PIPELINED
+    )
+    graph.revive()
+    # complete ALL of the probe-side producer (stage 2) while the build
+    # side (stage 1) is incomplete: the consumer must keep the barrier
+    # (pop order is stage-id sorted: collect everything, bucket by stage)
+    tasks = {1: [], 2: []}
+    while True:
+        t = graph.pop_next_task(EXEC1.id)
+        if t is None:
+            break
+        tasks[t.partition.stage_id].append(t)
+    for t in tasks[2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    assert isinstance(graph.stages[3], UnresolvedStage)
+    # build side completes → consumer may start, tailing NOTHING (both
+    # inputs complete) — so it resolves on the normal barrier path
+    for t in tasks[1]:
+        complete_task(graph, t, EXEC1)
+    consumer = graph.stages[3]
+    assert isinstance(consumer, RunningStage) and not consumer.tail_inputs
+
+
+def test_join_streams_probe_while_build_complete_and_probe_partial():
+    graph = make_graph(
+        "select t.g, u.w from t join u on t.k = u.k", extra=PIPELINED
+    )
+    graph.revive()
+    tasks = {1: [], 2: []}
+    while True:
+        t = graph.pop_next_task(EXEC1.id)
+        if t is None:
+            break
+        tasks[t.partition.stage_id].append(t)
+    for t in tasks[1]:  # build side fully committed
+        complete_task(graph, t, EXEC1)
+    complete_task(graph, tasks[2][0], EXEC1)  # probe: 1 of 2 (>= 0.5)
+    graph.revive()
+    consumer = graph.stages[3]
+    assert isinstance(consumer, RunningStage)
+    assert consumer.tail_inputs == {2}
+
+
+# ------------------------------------------------- knob-off byte parity
+def test_knob_off_is_byte_identical():
+    def run(extra):
+        graph = make_graph(GROUPBY, extra=extra, job_id="jobX")
+        graph.session_id = "sess"  # normalize the per-ctx random id
+        graph.revive()
+        order = []
+        states = []
+        maps = pop_stage_tasks(graph, 1, n=4)
+        order.extend(str(t.partition) for t in maps)
+        for t in maps[:2]:
+            complete_task(graph, t, EXEC1)
+        graph.revive()
+        states.append({s: type(st).__name__ for s, st in graph.stages.items()})
+        # with the knob off nothing from stage 2 may dispatch yet
+        t = graph.pop_next_task(EXEC1.id)
+        order.append(str(t.partition) if t else "none")
+        if t is not None:
+            complete_task(graph, t, EXEC1)
+        for rest in maps[2:]:
+            complete_task(graph, rest, EXEC1)
+        states.append({s: type(st).__name__ for s, st in graph.stages.items()})
+        return graph, order, states
+
+    g_off, order_off, states_off = run({"ballista.shuffle.pipelined": "false"})
+    g_def, order_def, states_def = run(None)
+    assert order_off == order_def
+    assert states_off == states_def
+    assert _normalized(g_off) == _normalized(g_def)
+    assert not g_off.shuffle_feeds and not g_off.pending_feed_deltas
+
+
+def _normalized(graph) -> bytes:
+    """Encode with run-to-run volatile data (wall-clock anchors, task
+    runtimes and their skew reductions) zeroed, so byte comparison pins
+    exactly the SCHEDULING state: stage types, plans, locations,
+    attempts, statuses."""
+    from arrow_ballista_tpu.proto import pb
+
+    g = pb.ExecutionGraphProto.FromString(graph.encode())
+    g.submitted_unix_us = 0
+    g.planning_us = 0
+    volatile = (
+        "__stage_timing__", "__task_dispatch_us__", "__task_finish_us__",
+        "__task_runtime_ms__", "__stage_skew__",
+    )
+    for sp in g.stages:
+        if sp.WhichOneof("stage") != "completed":
+            continue
+        keep = [m for m in sp.completed.stage_metrics if m.operator_name not in volatile]
+        del sp.completed.stage_metrics[:]
+        for m in keep:
+            sp.completed.stage_metrics.add().CopyFrom(m)
+    return g.SerializeToString()
+
+
+# ------------------------------------------------- persistence contract
+def test_partial_stage_persists_as_unresolved():
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    assert isinstance(graph.stages[2], RunningStage)
+    decoded = ExecutionGraph.decode(graph.encode())
+    # the partially-started consumer went back to Unresolved (the feed
+    # is in-memory only); its accumulated input locations survived
+    stage = decoded.stages[2]
+    assert isinstance(stage, UnresolvedStage)
+    assert not stage.resolvable()
+    n_locs = sum(
+        len(l)
+        for l in stage.inputs[1].partition_locations.values()
+    )
+    assert n_locs == 8
+    assert decoded.pipelined_enabled is False
+
+
+# ------------------------------------------------------ failure semantics
+def test_executor_loss_of_streamed_producer_rolls_consumer_back():
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    ctask = graph.pop_next_task(EXEC2.id)
+    assert ctask is not None and ctask.partition.stage_id == 2
+    # the streamed-from producer's executor dies
+    assert graph.reset_stages(EXEC1.id) > 0
+    # consumer rolled back cleanly; feed invalidated; its in-flight task
+    # cancelled; the invalid tombstone queued for the executor mirror
+    assert isinstance(graph.stages[2], UnresolvedStage)
+    assert 1 not in graph.shuffle_feeds
+    assert (EXEC2.id, ctask.partition) in graph.pending_cancels
+    deltas = graph.take_pending_feed_deltas()
+    assert any(d["valid"] is False and d["stage"] == 1 for d in deltas)
+    # the producer re-runs and the job drains to completion with a clean
+    # reset ledger (one reset per affected stage)
+    for _ in range(200):
+        graph.revive()
+        task = graph.pop_next_task(EXEC2.id)
+        if task is None:
+            break
+        complete_task(graph, task, EXEC2, tag="rerun")
+    assert graph.status == "completed"
+    assert all(c < graph.stage_max_attempts for c in graph.stage_reset_counts.values())
+    # the recreated feed (if any consumer re-streamed) superseded the old
+    # epoch
+    assert graph.feed_epochs.get(1, 0) >= 1
+
+
+def test_speculative_loser_never_reaches_feed():
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.spec_enabled = True
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    assert isinstance(graph.stages[2], RunningStage)
+    stage = graph.stages[1]
+    # arm a duplicate for partition 2 (still running on EXEC1) and let
+    # the DUPLICATE win: its locations land in the feed exactly once,
+    # and the loser's late success is dropped as stale
+    p = maps[2].partition.partition_id
+    stage.speculation_requests[p] = EXEC1.id
+    dup = graph.pop_next_task(EXEC2.id)
+    assert dup is not None and dup.speculative
+    before = len(graph.shuffle_feeds[1]["locations"])
+    evs = complete_task(graph, dup, EXEC2, tag="dup")
+    assert "speculative_win" in evs
+    after = len(graph.shuffle_feeds[1]["locations"])
+    assert after == before + 4  # one committed map task, 4 partitions
+    # late loser success: stale, nothing appended
+    complete_task(graph, maps[2], EXEC1, tag="late-loser")
+    assert len(graph.shuffle_feeds[1]["locations"]) == after
+
+
+# -------------------------------------------------- delta store (mirror)
+class _Loc:
+    def __init__(self, partition, path):
+        self.partition_id = type(
+            "P", (), {"partition_id": partition}
+        )()
+        self.path = path
+
+
+def test_delta_store_epoch_fencing_and_gaps():
+    delta_store.apply_delta("j", 1, 0, [_Loc(0, "a")], False, True, 1)
+    delta_store.apply_delta("j", 1, 1, [_Loc(0, "b")], False, True, 1)
+    assert delta_store.feed_snapshot("j", 1)["locations"] == 2
+    # duplicate push (same range) dedups by index
+    delta_store.apply_delta("j", 1, 1, [_Loc(0, "b")], False, True, 1)
+    assert delta_store.feed_snapshot("j", 1)["locations"] == 2
+    # gapped push dropped (poll catches up)
+    delta_store.apply_delta("j", 1, 5, [_Loc(0, "z")], False, True, 1)
+    assert delta_store.feed_snapshot("j", 1)["locations"] == 2
+    # stale epoch dropped; newer epoch resets
+    delta_store.apply_delta("j", 1, 0, [_Loc(0, "old")], False, True, 0)
+    assert delta_store.feed_snapshot("j", 1)["locations"] == 2
+    delta_store.apply_delta("j", 1, 0, [_Loc(0, "new")], True, True, 2)
+    snap = delta_store.feed_snapshot("j", 1)
+    assert snap == {"locations": 1, "complete": True, "valid": True, "epoch": 2}
+
+
+def test_delta_store_tail_streams_and_completes():
+    got = []
+
+    def consume():
+        for loc in delta_store.tail_locations("j2", 7, 0, poll_interval_s=0.01):
+            got.append(loc.path)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    delta_store.apply_delta(
+        "j2", 7, 0, [_Loc(0, "a"), _Loc(1, "other")], False, True, 1
+    )
+    time.sleep(0.05)
+    delta_store.apply_delta("j2", 7, 2, [_Loc(0, "b")], True, True, 1)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    # only partition 0's locations surfaced, in feed order
+    assert got == ["a", "b"]
+
+
+def test_delta_store_epoch_zero_invalid_kills_any_generation():
+    """A scheduler that restarted (or evicted the job) answers polls
+    with {valid: False, epoch: 0} — "no such feed".  The mirror must
+    treat that as authoritative for ANY local generation (live feeds
+    start at epoch 1), or the tailing task would poll forever on a
+    wedged slot."""
+    delta_store.apply_delta("j4", 3, 0, [_Loc(0, "a")], False, True, 2)
+    delta_store.apply_delta("j4", 3, 0, [], False, False, 0)
+    assert delta_store.feed_snapshot("j4", 3)["valid"] is False
+    # ...while a STALE generation's invalid tombstone (delayed push
+    # racing a recreation) still drops
+    delta_store.apply_delta("j5", 3, 0, [_Loc(0, "a")], False, True, 3)
+    delta_store.apply_delta("j5", 3, 0, [], False, False, 2)
+    assert delta_store.feed_snapshot("j5", 3)["valid"] is True
+
+
+def test_delta_store_tail_aborts_on_epoch_splice():
+    """An in-flight tail pins the generation it is consuming: if the
+    mirror resets to a NEWER epoch under it (the re-run's seed beat the
+    cancel RPC), the tail must abort — its cursor indexes the dead
+    generation, and splicing would skip/duplicate locations."""
+    from arrow_ballista_tpu.errors import ExecutionError
+
+    delta_store.apply_delta("j6", 4, 0, [_Loc(0, "old-a")], False, True, 1)
+    it = delta_store.tail_locations("j6", 4, 0, poll_interval_s=0.01)
+    assert next(it).path == "old-a"
+    delta_store.apply_delta("j6", 4, 0, [_Loc(0, "new-a")], True, True, 2)
+    with pytest.raises(ExecutionError, match="superseded"):
+        next(it)
+
+
+def test_delta_store_invalid_feed_aborts_tail():
+    from arrow_ballista_tpu.errors import ExecutionError
+
+    delta_store.apply_delta("j3", 2, 0, [_Loc(0, "a")], False, True, 1)
+    it = delta_store.tail_locations("j3", 2, 0, poll_interval_s=0.01)
+    assert next(it).path == "a"
+    delta_store.apply_delta("j3", 2, 0, [], False, False, 1)
+    with pytest.raises(ExecutionError):
+        next(it)
+
+
+# ------------------------------------------------------ progress contract
+def test_progress_partial_stage_excluded_from_eta_median():
+    from arrow_ballista_tpu.scheduler.task_manager import TaskManager
+
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    ctask = graph.pop_next_task(EXEC2.id)
+    assert ctask is not None
+    consumer = graph.stages[2]
+    # a pathological "observed runtime" on the partial stage (stall on
+    # producer): must not leak into the ETA median
+    consumer.completed_runtime_s.append(3600.0)
+    prog = TaskManager._progress_of(graph)
+    rows = {r["stage_id"]: r for r in prog["stages"]}
+    assert rows[2].get("partial_input") is True
+    assert rows[2]["running"] == 1
+    # the producer's tasks took ~0s; a 3600s median would report hours
+    assert prog["eta_s"] is None or prog["eta_s"] < 100
+
+
+# ------------------------------------------------------- doctor evidence
+def test_doctor_barrier_rule_names_knob_and_classification():
+    from arrow_ballista_tpu.obs.doctor import diagnose
+
+    detail = {
+        "stages": [
+            {
+                "stage_id": 1,
+                "output_links": [2],
+                "pipeline": {"streamable_inputs": [], "breaker_inputs": []},
+            },
+            {
+                "stage_id": 2,
+                "output_links": [],
+                "pipeline": {"streamable_inputs": [1], "breaker_inputs": []},
+            },
+        ]
+    }
+    cp = {
+        "wall_clock_ms": 1000.0,
+        "breakdown": {"barrier_wait_ms": 600.0},
+        "critical_path": [
+            {"stage_id": 1, "segments": {"barrier_wait_ms": 600.0}},
+            {"stage_id": 2, "segments": {}},
+        ],
+    }
+    findings = diagnose(detail, {"stages": []}, cp, [])
+    barrier = [f for f in findings if f["code"] == "barrier_dominated_job"]
+    assert barrier
+    f = barrier[0]
+    assert "ballista.shuffle.pipelined" in f["suggestion"]
+    assert f["evidence"]["consumer_classification"] == {"2": "streamable"}
+    assert f["evidence"]["upside_reachable"] is True
+    # breaker-only consumers flip the suggestion
+    detail["stages"][1]["pipeline"] = {
+        "streamable_inputs": [],
+        "breaker_inputs": [1],
+    }
+    findings = diagnose(detail, {"stages": []}, cp, [])
+    f = [x for x in findings if x["code"] == "barrier_dominated_job"][0]
+    assert f["evidence"]["upside_reachable"] is False
+    assert "pipeline breakers" in f["suggestion"]
+
+
+# --------------------------------------------- process-isolation gating
+def test_tailing_task_never_routes_to_process_worker():
+    """A tailing reader streams THIS process's delta-store mirror; a
+    task-runner subprocess has neither the mirror nor a scheduler stub,
+    so tailing tasks must keep the thread path under
+    task_isolation=process (non-tailing tasks stay worker-eligible)."""
+    from arrow_ballista_tpu.executor.executor import Executor
+    from arrow_ballista_tpu.proto import pb
+    from arrow_ballista_tpu.serde import BallistaCodec
+
+    graph = make_graph(GROUPBY, extra=PIPELINED)
+    graph.revive()
+    maps = pop_stage_tasks(graph, 1, n=4)
+    for t in maps[:2]:
+        complete_task(graph, t, EXEC1)
+    graph.revive()
+    tail_task = graph.pop_next_task(EXEC2.id)
+    assert tail_task is not None and tail_task.partition.stage_id == 2
+    ex = Executor(EXEC2, "/tmp/ballista-test", task_isolation="process")
+    try:
+
+        def td_of(task, pipelined=True):
+            td = pb.TaskDefinition()
+            td.plan = BallistaCodec.encode_physical(task.plan)
+            td.props["ballista.tpu.enable"] = "false"
+            if pipelined:
+                td.props["ballista.shuffle.pipelined"] = "true"
+            return td
+
+        assert ex._worker_eligible(td_of(tail_task)) is False
+        # non-tailing task of the same pipelined session: worker-eligible
+        assert ex._worker_eligible(td_of(maps[0])) is True
+        # knob-off sessions skip the plan walk entirely (stay eligible)
+        assert ex._worker_eligible(td_of(maps[0], pipelined=False)) is True
+    finally:
+        # drop the process-wide local-transport identity this Executor
+        # registered, or later shuffle tests inherit a phantom host
+        ex.close()
+
+
+# --------------------------------------------------------- e2e standalone
+def _collect_sorted(table: pa.Table):
+    return sorted(zip(*[c.to_pylist() for c in table.columns]))
+
+
+def _run_standalone(pipelined: bool, straggler_ms: int = 0, policy=None):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import TaskSchedulingPolicy
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.testing import faults
+
+    cfg = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.mesh.enable": "false",
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.pipelined": "true" if pipelined else "false",
+        "ballista.shuffle.pipelined_min_fraction": "0.25",
+    }
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(cfg),
+        num_executors=2,
+        concurrent_tasks=2,
+        policy=policy or TaskSchedulingPolicy.PULL_STAGED,
+    )
+    try:
+        ctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array(
+                            [f"g{i % 13}" for i in range(2000)], pa.string()
+                        ),
+                        "x": pa.array(
+                            [float(i % 97) for i in range(2000)], pa.float64()
+                        ),
+                    }
+                ),
+                4,
+            ),
+        )
+        if straggler_ms:
+            faults.arm(
+                "task.run",
+                times=1,
+                action="delay",
+                delay_ms=straggler_ms,
+                match=lambda stage_id=0, partition_id=0, speculative=False, **_:
+                    stage_id == 1 and partition_id == 1 and not speculative,
+            )
+        result = ctx.sql(
+            "select g, sum(x) as s, count(x) as n from t group by g"
+        ).collect()
+        (job_id,) = ctx._job_ids
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        detail = scheduler.server.state.task_manager.get_job_detail(job_id)
+        return _collect_sorted(result), detail
+    finally:
+        faults.clear()
+        ctx.close()
+
+
+def _stage_timing(detail, sid):
+    for row in detail["stages"]:
+        if row["stage_id"] == sid:
+            return row.get("timing") or {}
+    return {}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_kill_streamed_producer_with_speculation_race():
+    """Seeded chaos (``dev/tier1.sh --chaos-smoke``): a pipelined job
+    with a manufactured straggler map task (speculation launches a
+    duplicate — a racing copy is in flight while consumers stream) loses
+    the executor serving already-streamed map output MID-STREAM.  The
+    consumer must roll back through the lost-shuffle/reset path, re-run
+    cleanly without double-counting rows (multiset-identical result) and
+    keep a clean ``stage_max_attempts`` ledger."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.scheduler.execution_stage import (
+        RunningStage as _Running,
+    )
+    from arrow_ballista_tpu.testing import faults
+
+    table = pa.table(
+        {
+            "g": pa.array([f"g{i % 17}" for i in range(4000)], pa.string()),
+            "x": pa.array([float(i % 101) for i in range(4000)], pa.float64()),
+        }
+    )
+    sql = "select g, sum(x) as s, count(x) as n from t group by g"
+    local = SessionContext(
+        BallistaConfig(
+            {"ballista.tpu.enable": "false", "ballista.mesh.enable": "false"}
+        )
+    )
+    local.register_arrow_table("t", table, partitions=4)
+    expected = _collect_sorted(local.sql(sql).collect())
+
+    cfg = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.mesh.enable": "false",
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.pipelined": "true",
+        "ballista.shuffle.pipelined_min_fraction": "0.25",
+        "ballista.speculation.enabled": "true",
+        "ballista.speculation.interval_seconds": "0.2",
+        "ballista.speculation.multiplier": "1.2",
+        "ballista.speculation.min_completed_fraction": "0.5",
+        "ballista.speculation.min_runtime_seconds": "0.5",
+    }
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(cfg), num_executors=3, concurrent_tasks=2
+    )
+    scheduler, executors = ctx._standalone_handles
+    em = scheduler.server.state.executor_manager
+    em.quarantine_threshold = 1000  # chaos wants retries, not quarantine
+    tm = scheduler.server.state.task_manager
+    try:
+        ctx.register_table("t", MemoryTable.from_table(table, 4))
+        # straggler map task: holds the producer stage open long enough
+        # for the consumer to start mid-stream AND for speculation to
+        # put a duplicate copy in flight
+        faults.arm(
+            "task.run",
+            times=1,
+            action="delay",
+            delay_ms=4000,
+            match=lambda stage_id=0, partition_id=0, speculative=False, **_:
+                stage_id == 1 and partition_id == 1 and not speculative,
+        )
+        result = {}
+
+        def run():
+            try:
+                result["table"] = ctx.sql(sql).collect()
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        # wait (seeded, deterministic trigger) for the consumer to start
+        # on partial input, then kill an executor whose map output it is
+        # streaming from
+        victim_eid = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and victim_eid is None:
+            job_ids = tm.active_job_ids()
+            for job_id in job_ids:
+                entry = tm._entry(job_id)
+                with entry.lock:
+                    graph = entry.graph
+                    if graph is None:
+                        continue
+                    consumer = graph.stages.get(2)
+                    feed = graph.shuffle_feeds.get(1)
+                    if (
+                        isinstance(consumer, _Running)
+                        and consumer.tail_inputs
+                        and feed is not None
+                        and feed["locations"]
+                    ):
+                        victim_eid = feed["locations"][0].executor_meta.id
+            if victim_eid is None:
+                time.sleep(0.02)
+        assert victim_eid is not None, "consumer never started on partial input"
+
+        scheduler.server.executor_lost(victim_eid, "chaos: injected kill")
+        for h in executors:
+            if h.id == victim_eid:
+                h.shutdown()
+        t.join(300)
+        assert not t.is_alive(), "job did not finish after producer kill"
+        assert "error" not in result, result.get("error")
+        assert _collect_sorted(result["table"]) == expected
+
+        (job_id,) = ctx._job_ids
+        detail = tm.get_job_detail(job_id)
+        # clean ledger: recovery consumed at most one reset per stage,
+        # far below the ballista.stage.max_attempts budget
+        assert all(v < 4 for v in detail["stage_resets"].values())
+    finally:
+        faults.clear()
+        ctx.close()
+
+
+def test_e2e_pipelined_matches_barrier_and_dispatches_early():
+    rows_barrier, _ = _run_standalone(False)
+    rows_pipelined, detail = _run_standalone(True, straggler_ms=1200)
+    assert rows_pipelined == rows_barrier
+    # the consumer stage ran pipelined...
+    rows = {r["stage_id"]: r for r in detail["stages"]}
+    assert (rows[2].get("pipeline") or {}).get("partial_start") is True
+    # ...and its first dispatch PRECEDED the producer's last commit (the
+    # straggler map task was still running)
+    map_fin = _stage_timing(detail, 1).get("finish_us") or {}
+    red_disp = _stage_timing(detail, 2).get("dispatch_us") or {}
+    assert map_fin and red_disp
+    assert min(red_disp.values()) < max(map_fin.values())
+
+
+def test_e2e_pipelined_push_mode():
+    """Push-staged scheduling exercises the UpdateShuffleLocations
+    notification fan-out (with the poll catch-up underneath): same
+    bit-identical + early-dispatch contract as the pull-mode e2e."""
+    from arrow_ballista_tpu.config import TaskSchedulingPolicy
+
+    push = TaskSchedulingPolicy.PUSH_STAGED
+    rows_barrier, _ = _run_standalone(False, policy=push)
+    rows_pipelined, detail = _run_standalone(
+        True, straggler_ms=1200, policy=push
+    )
+    assert rows_pipelined == rows_barrier
+    rows = {r["stage_id"]: r for r in detail["stages"]}
+    assert (rows[2].get("pipeline") or {}).get("partial_start") is True
+    map_fin = _stage_timing(detail, 1).get("finish_us") or {}
+    red_disp = _stage_timing(detail, 2).get("dispatch_us") or {}
+    assert map_fin and red_disp
+    assert min(red_disp.values()) < max(map_fin.values())
