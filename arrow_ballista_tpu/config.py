@@ -109,6 +109,15 @@ OBS_BUFFER_SPANS = "ballista.obs.buffer_spans"
 # per-session job-latency SLO: completed jobs slower than this feed
 # slo_breaches_total + the burn-rate gauge (0 = untracked)
 OBS_SLO_JOB_LATENCY_S = "ballista.obs.slo.job_latency_seconds"
+# Elastic executor lifecycle (see docs/user-guide/autoscaling.md)
+AUTOSCALER_ENABLED = "ballista.autoscaler.enabled"
+AUTOSCALER_MIN_EXECUTORS = "ballista.autoscaler.min_executors"
+AUTOSCALER_MAX_EXECUTORS = "ballista.autoscaler.max_executors"
+AUTOSCALER_SCALE_OUT_SUSTAIN_S = "ballista.autoscaler.scale_out_sustain_seconds"
+AUTOSCALER_SCALE_IN_IDLE_S = "ballista.autoscaler.scale_in_idle_seconds"
+AUTOSCALER_COOLDOWN_S = "ballista.autoscaler.cooldown_seconds"
+AUTOSCALER_LAUNCH_TIMEOUT_S = "ballista.autoscaler.launch_timeout_seconds"
+AUTOSCALER_SLO_BURN_THRESHOLD = "ballista.autoscaler.slo_burn_threshold"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -865,6 +874,69 @@ _ENTRIES: dict[str, ConfigEntry] = {
             float,
             "0",
         ),
+        ConfigEntry(
+            AUTOSCALER_ENABLED,
+            "closed-loop executor autoscaling on the scheduler: a policy "
+            "engine on the timer cadence reads admission queue depth, "
+            "slot deficit and SLO burn rate and launches/drains "
+            "executors through an ExecutorProvider; off = the scheduler "
+            "never manages capacity (the KEDA stub behavior)",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            AUTOSCALER_MIN_EXECUTORS,
+            "floor for the autoscaler's total-alive-executor target; the "
+            "loop launches up to this many at startup and never drains "
+            "below it",
+            int,
+            "1",
+        ),
+        ConfigEntry(
+            AUTOSCALER_MAX_EXECUTORS,
+            "ceiling for the autoscaler's total-alive-executor target; "
+            "scale-out decisions clamp here no matter the backlog",
+            int,
+            "4",
+        ),
+        ConfigEntry(
+            AUTOSCALER_SCALE_OUT_SUSTAIN_S,
+            "pressure (slot deficit / queued jobs / SLO burn) must "
+            "persist this many seconds before a scale-out fires — "
+            "hysteresis so a one-tick blip never launches an executor",
+            float,
+            "3",
+        ),
+        ConfigEntry(
+            AUTOSCALER_SCALE_IN_IDLE_S,
+            "the cluster must be completely idle (no running, pending or "
+            "queued work) this many seconds before a scale-in drains one "
+            "executor",
+            float,
+            "15",
+        ),
+        ConfigEntry(
+            AUTOSCALER_COOLDOWN_S,
+            "minimum seconds between successive scale-out decisions (and "
+            "separately between scale-ins) so the loop never flaps",
+            float,
+            "10",
+        ),
+        ConfigEntry(
+            AUTOSCALER_LAUNCH_TIMEOUT_S,
+            "a provider launch that has not registered within this many "
+            "seconds is abandoned, terminated, and counted against the "
+            "consecutive-launch-failure window",
+            float,
+            "60",
+        ),
+        ConfigEntry(
+            AUTOSCALER_SLO_BURN_THRESHOLD,
+            "scale out when the SLO burn-rate gauge sustains at or above "
+            "this value even without a slot deficit; 0 ignores burn rate",
+            float,
+            "0",
+        ),
     ]
 }
 
@@ -1213,6 +1285,18 @@ class BallistaConfig:
     @property
     def obs_slo_job_latency_seconds(self) -> float:
         return self._get(OBS_SLO_JOB_LATENCY_S)
+
+    @property
+    def autoscaler_enabled(self) -> bool:
+        return self._get(AUTOSCALER_ENABLED)
+
+    @property
+    def autoscaler_min_executors(self) -> int:
+        return self._get(AUTOSCALER_MIN_EXECUTORS)
+
+    @property
+    def autoscaler_max_executors(self) -> int:
+        return self._get(AUTOSCALER_MAX_EXECUTORS)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -21,6 +21,12 @@ import time
 import uuid
 
 CONFIG_KEYS = {
+    "executor_id": (
+        str, "",
+        "pre-assigned executor id (default: random).  Launch controllers "
+        "(the autoscaler's ExecutorProvider) set this so their handle and "
+        "the registration correlate",
+    ),
     "scheduler_host": (str, "localhost", "scheduler hostname"),
     "scheduler_port": (int, 50050, "scheduler gRPC port"),
     "bind_host": (str, "0.0.0.0", "local bind address"),
@@ -44,6 +50,11 @@ CONFIG_KEYS = {
     "job_data_clean_up_interval_seconds": (int, 0, "janitor period (0=off)"),
     "job_data_ttl_seconds": (int, 604800, "delete job dirs older than this"),
     "heartbeat_sidecar": (int, 1, "process-isolated liveness backstop (0=off)"),
+    "heartbeat_interval_seconds": (
+        float, 0.0,
+        "push-mode heartbeat cadence (0 = built-in default); autoscaled "
+        "executors beat faster so liveness tracks launches",
+    ),
     "telemetry_enabled": (int, 1, "piggyback a resource snapshot (CPU%, RSS, shuffle disk, queue occupancy, slots) on every heartbeat; 0 disables (push mode only)"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
@@ -188,7 +199,7 @@ def main(argv=None) -> None:
         else TaskSchedulingPolicy.PULL_STAGED
     )
     metadata = ExecutorMetadata(
-        id=uuid.uuid4().hex[:12],
+        id=cfg["executor_id"] or uuid.uuid4().hex[:12],
         host=external,
         flight_port=flight.port,
         grpc_port=cfg["bind_grpc_port"] if policy == TaskSchedulingPolicy.PUSH_STAGED else 0,
@@ -235,6 +246,11 @@ def main(argv=None) -> None:
     server = None
     loop = None
     if policy == TaskSchedulingPolicy.PUSH_STAGED:
+        server_kwargs = {}
+        if cfg["heartbeat_interval_seconds"] > 0:
+            server_kwargs["heartbeat_interval_s"] = cfg[
+                "heartbeat_interval_seconds"
+            ]
         server = ExecutorServer(
             executor,
             cfg["scheduler_host"],
@@ -242,6 +258,7 @@ def main(argv=None) -> None:
             on_shutdown=lambda reason: stop.update(flag=True),
             bind_host=cfg["bind_host"],
             telemetry_enabled=bool(cfg["telemetry_enabled"]),
+            **server_kwargs,
         ).start()
     else:
         loop = PollLoop(executor, stub).start()

@@ -45,6 +45,11 @@ ADMISSION_MIN_MS = 200.0
 # barrier-dominated job: barrier wait at least this fraction of wall
 BARRIER_FRACTION = 0.25
 BARRIER_MIN_MS = 50.0
+# underprovisioned cluster: scheduling delay (tasks runnable, no slot)
+# at least this much of wall-clock while work queued at admission and
+# the cluster below its executor ceiling
+UNDERPROVISIONED_FRACTION = 0.2
+UNDERPROVISIONED_MIN_MS = 200.0
 # locality-miss stage: at least this many tasks placed off their
 # preferred host, and more misses than hits
 LOCALITY_MIN_MISSES = 2
@@ -151,7 +156,7 @@ def _rule_compile_dominated(cp, out: List[dict]) -> None:
         )
 
 
-def _rule_admission_queued(cp, events, out: List[dict]) -> None:
+def _rule_admission_queued(cp, events, cluster, out: List[dict]) -> None:
     wait = (cp.get("breakdown") or {}).get("admission_queue_wait_ms", 0.0)
     wall = cp.get("wall_clock_ms") or 0.0
     if wait < ADMISSION_MIN_MS or wait < ADMISSION_FRACTION * max(wall, 1.0):
@@ -162,16 +167,79 @@ def _rule_admission_queued(cp, events, out: List[dict]) -> None:
             if e.get("pool"):
                 ev["pool"] = e["pool"]
             break
+    suggestion = (
+        "the cluster was saturated: raise the pool's weight "
+        "(ballista.tenant.weight), mark the session interactive "
+        "(ballista.tenant.priority), or add executors"
+    )
+    if cluster and cluster.get("scale_out_in_flight"):
+        # the autoscaler already reacted: launches are in flight, so the
+        # right next step is to wait for the capacity, not re-tune pools
+        ev["scale_out_in_flight"] = True
+        ev["autoscaler_launching"] = cluster.get("autoscaler_launching", 0)
+        suggestion += (
+            "; note: an autoscaler scale-out is already in flight "
+            f"({cluster.get('autoscaler_launching', 0)} executor(s) "
+            "launching) — queue wait should fall once they register"
+        )
     out.append(
         _finding(
             "admission_queued_job",
             "warn",
             f"job waited {wait:.0f} ms ({100 * wait / max(wall, 1.0):.0f}% "
             "of wall-clock) in the admission queue before planning",
-            "the cluster was saturated: raise the pool's weight "
-            "(ballista.tenant.weight), mark the session interactive "
-            "(ballista.tenant.priority), or add executors",
+            suggestion,
             **ev,
+        )
+    )
+
+
+def _rule_underprovisioned(cp, cluster, out: List[dict]) -> None:
+    """Sustained scheduling delay + work queued at the admission door
+    while the cluster sits below its executor ceiling: the job was slow
+    because capacity was missing, not because the plan was bad."""
+    if not cluster:
+        return
+    delay = (cp.get("breakdown") or {}).get("scheduling_delay_ms", 0.0)
+    wall = cp.get("wall_clock_ms") or 0.0
+    if (
+        delay < UNDERPROVISIONED_MIN_MS
+        or delay < UNDERPROVISIONED_FRACTION * max(wall, 1.0)
+    ):
+        return
+    queued = cluster.get("admission_queued_jobs", 0)
+    alive = cluster.get("alive_executors", 0)
+    max_executors = cluster.get("max_executors", 0)
+    if not queued or not max_executors or alive >= max_executors:
+        return
+    if cluster.get("autoscaler_enabled"):
+        suggestion = (
+            "the autoscaler has headroom "
+            f"({alive} alive < max_executors {max_executors}): check its "
+            "journal (autoscale_decision events) for launch failures or "
+            "backoff, or raise ballista.autoscaler.max_executors"
+        )
+    else:
+        suggestion = (
+            "enable ballista.autoscaler.enabled so the scheduler launches "
+            "executors when scheduling delay sustains, or add executors "
+            "manually"
+        )
+    out.append(
+        _finding(
+            "underprovisioned_cluster",
+            "warn",
+            f"job spent {delay:.0f} ms ({100 * delay / max(wall, 1.0):.0f}% "
+            "of wall-clock) waiting for task slots while "
+            f"{queued} job(s) queued at admission and only {alive} of "
+            f"{max_executors} allowed executor(s) were alive",
+            suggestion,
+            scheduling_delay_ms=delay,
+            wall_clock_ms=wall,
+            admission_queued_jobs=queued,
+            alive_executors=alive,
+            max_executors=max_executors,
+            autoscaler_enabled=bool(cluster.get("autoscaler_enabled")),
         )
     )
 
@@ -289,11 +357,16 @@ def diagnose(
     profile: dict,
     cp: dict,
     events: Optional[List[dict]] = None,
+    cluster: Optional[dict] = None,
 ) -> List[dict]:
     """Run every rule; returns findings sorted warn-first, then by
-    stage id (job-level findings first within a severity)."""
+    stage id (job-level findings first within a severity).  ``cluster``
+    is the scheduler's live context (alive/max executors, admission
+    queue depth, autoscaler state) for the capacity rules — REST/gRPC
+    handlers pass it, offline replays may not."""
     out: List[dict] = []
-    _rule_admission_queued(cp, events, out)
+    _rule_admission_queued(cp, events, cluster, out)
+    _rule_underprovisioned(cp, cluster, out)
     _rule_barrier_dominated(cp, detail, out)
     _rule_skewed_stages(detail, profile, out)
     _rule_fetch_bound(cp, out)
@@ -314,6 +387,7 @@ def job_report(
     detail: dict,
     spans: List[dict],
     events: Optional[List[dict]] = None,
+    cluster: Optional[dict] = None,
 ) -> dict:
     """One-stop diagnosis bundle: profile + critical path + findings.
     Shared by the REST handlers and the gRPC ``include_profile`` path so
@@ -321,7 +395,7 @@ def job_report(
     numbers."""
     profile = job_profile(detail, spans)
     cp = compute_critical_path(detail, events)
-    findings = diagnose(detail, profile, cp, events)
+    findings = diagnose(detail, profile, cp, events, cluster)
     profile["doctor"] = findings
     profile["breakdown"] = cp.get("breakdown")
     return {"profile": profile, "critical_path": cp, "doctor": findings}

@@ -51,6 +51,10 @@ CONFIG_KEYS = {
     "event_journal_rotate_bytes": (int, 4 << 20, "rotate the active journal segment past this size"),
     "event_journal_segments": (int, 4, "rotated journal segments kept before the oldest is deleted"),
     "telemetry_sample_seconds": (float, 5.0, "period of the cluster-aggregate telemetry sample (queue depth, slots, shuffle backlog) feeding /api/cluster/timeseries"),
+    "autoscaler_enabled": (int, 0, "1 = closed-loop executor autoscaling: launch on sustained slot deficit / queued jobs / SLO burn, drain on sustained idle, heal crashed children (see docs/user-guide/autoscaling.md)"),
+    "autoscaler_settings": (str, "", "comma-separated ballista.autoscaler.* key=value pairs for the policy (e.g. 'ballista.autoscaler.min_executors=1,ballista.autoscaler.max_executors=8')"),
+    "autoscaler_executor_slots": (int, 2, "task slots per autoscaler-launched executor (sizes the slot-deficit math)"),
+    "autoscaler_work_dir": (str, "", "work-dir root for autoscaler-launched executors (default: a fresh temp dir)"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "scheduler", "log file prefix"),
@@ -198,6 +202,28 @@ def main(argv=None) -> None:
         external = "127.0.0.1"
     server.scheduler_id = f"{external}:{cfg['bind_port']}"
     server.state.task_manager.scheduler_id = server.scheduler_id
+
+    # elastic lifecycle: the flag (or an explicit settings key) turns the
+    # loop on; the subprocess provider launches executors that dial the
+    # advertised curator address
+    autoscaler_settings = _parse_admission_defaults(cfg["autoscaler_settings"])
+    if cfg["autoscaler_enabled"]:
+        autoscaler_settings.setdefault("ballista.autoscaler.enabled", "true")
+    from .autoscaler import AutoscalerPolicy
+
+    if AutoscalerPolicy.enabled_in(autoscaler_settings):
+        from .autoscaler import LocalProcessProvider
+
+        provider = LocalProcessProvider(
+            external,
+            cfg["bind_port"],
+            task_slots=cfg["autoscaler_executor_slots"],
+            work_dir_root=cfg["autoscaler_work_dir"],
+        )
+        server.attach_autoscaler(provider, autoscaler_settings)
+        log.info(
+            "autoscaler enabled: %s", server.autoscaler.snapshot(),
+        )
 
     grpc_server = make_server()
     add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))

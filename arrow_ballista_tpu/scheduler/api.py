@@ -255,7 +255,13 @@ async function refresh() {
       `${metrics.executors_quarantined || 0} quarantined · ` +
       `${metrics.admission_queued_jobs || 0} queued · ` +
       `spec ${metrics.speculative_wins || 0}/${metrics.speculative_launched || 0} won · ` +
-      `${metrics.task_timeouts_total || 0} reaped`;
+      `${metrics.task_timeouts_total || 0} reaped` +
+      (metrics.autoscaler_desired_executors !== undefined
+        ? ` · autoscale ${metrics.autoscaler_alive_executors || 0}/` +
+          `${metrics.autoscaler_desired_executors} desired` +
+          ` (+${metrics.autoscaler_launching_executors || 0} launching, ` +
+          `-${metrics.autoscaler_draining_executors || 0} draining)`
+        : '');
     const etb = document.querySelector('#executors tbody');
     etb.innerHTML = '';
     for (const e of state.executors) {
@@ -520,6 +526,11 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
                 "slo": state.slo.snapshot(),
                 "admission": state.admission.health_summary(),
                 "events": state.events.stats(),
+                "autoscaler": (
+                    srv.autoscaler.snapshot()
+                    if getattr(srv, "autoscaler", None) is not None
+                    else {"enabled": False}
+                ),
             }
         )
 
@@ -602,7 +613,8 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             self._json(detail or {"error": "no such job"}, 404 if detail is None else 200)
             return
         report = job_report(
-            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id)
+            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id),
+            cluster=srv.doctor_cluster_context(),
         )
         self._json(report["profile"])
 
@@ -620,7 +632,8 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             self._json(detail)
             return
         report = job_report(
-            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id)
+            detail, self._job_spans(srv, job_id), self._job_events(srv, job_id),
+            cluster=srv.doctor_cluster_context(),
         )
         payload = report["critical_path"]
         payload["doctor"] = report["doctor"]

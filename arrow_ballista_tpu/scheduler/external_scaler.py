@@ -8,6 +8,14 @@ enough to saturate the HPA (`:47-58` hardcodes 1,000,000); the metric spec
 target is 10 per replica.  The one improvement over the stub: when the
 scheduler has no active jobs, inflight is reported as 0 so idle clusters
 can scale to the minimum.
+
+With the built-in autoscaler enabled (``ballista.autoscaler.enabled``,
+ISSUE 17), ``GetMetrics`` instead reports the policy's desired-replica
+demand — ``desired × target-per-replica``, so the HPA's division lands
+exactly on ``desired`` — and KEDA becomes a mirror of the same decision
+the built-in loop is executing rather than a second, competing
+controller.  The saturate-the-HPA stub is preserved verbatim when the
+autoscaler is off (the KEDA-only deployment mode).
 """
 
 from __future__ import annotations
@@ -45,12 +53,21 @@ class ExternalScalerService:
         )
 
     def GetMetrics(self, request, context) -> keda_pb.GetMetricsResponse:
-        # jobs held in the admission queue are demand the cluster could
-        # not absorb — exactly what an autoscaler must see as inflight
-        # (ROADMAP item 2 pairs with the admission front door here)
-        active = self.scheduler.state.task_manager.active_job_ids()
-        queued = self.scheduler.state.admission.queued_count()
-        value = MAX_INFLIGHT if (active or queued) else 0
+        autoscaler = getattr(self.scheduler, "autoscaler", None)
+        if autoscaler is not None:
+            # built-in loop on: report ITS desired-replica demand so the
+            # HPA (value / target) resolves to exactly `desired` — KEDA
+            # mirrors the policy instead of fighting it with the
+            # saturate-the-HPA stub below
+            value = autoscaler.desired * TARGET_PER_REPLICA
+        else:
+            # jobs held in the admission queue are demand the cluster
+            # could not absorb — exactly what an autoscaler must see as
+            # inflight (ROADMAP item 2 pairs with the admission front
+            # door here)
+            active = self.scheduler.state.task_manager.active_job_ids()
+            queued = self.scheduler.state.admission.queued_count()
+            value = MAX_INFLIGHT if (active or queued) else 0
         return keda_pb.GetMetricsResponse(
             metricValues=[
                 keda_pb.MetricValue(

@@ -235,7 +235,10 @@ class SchedulerGrpcService:
             return None
         journal = self.server.state.events
         events = journal.for_job(job_id) if journal.enabled else []
-        return job_report(detail, spans_for_job(job_id), events)
+        return job_report(
+            detail, spans_for_job(job_id), events,
+            cluster=self.server.doctor_cluster_context(),
+        )
 
     # ------------------------------------------------------------ lifecycle
     def ExecutorStopped(

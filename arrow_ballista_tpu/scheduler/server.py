@@ -74,6 +74,8 @@ class SchedulerServer:
         event_journal_dir: str = "",
         event_journal_rotate_bytes: Optional[int] = None,
         event_journal_segments: Optional[int] = None,
+        autoscaler_settings: Optional[Dict[str, str]] = None,
+        executor_provider=None,
     ):
         self.scheduler_id = scheduler_id
         self.policy = policy
@@ -118,6 +120,17 @@ class SchedulerServer:
         self._spec_timer: Optional[threading.Thread] = None
         self._telemetry_timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # elastic lifecycle (ISSUE 17): None unless explicitly enabled AND
+        # a provider is supplied — the knob-off scheduler carries no
+        # autoscaler object at all, so the default path is unchanged
+        self.autoscaler = None
+        from .autoscaler import AutoscalerPolicy
+
+        if (
+            executor_provider is not None
+            and AutoscalerPolicy.enabled_in(autoscaler_settings)
+        ):
+            self.attach_autoscaler(executor_provider, autoscaler_settings)
 
     # ------------------------------------------------------------ lifecycle
     def init(self) -> "SchedulerServer":
@@ -144,9 +157,24 @@ class SchedulerServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         self.event_loop.stop()
         self.state.executor_manager.close()
         self.state.events.close()
+
+    def attach_autoscaler(
+        self, provider, settings: Optional[Dict[str, str]] = None
+    ):
+        """Wire the elastic lifecycle loop onto this scheduler.  Callable
+        before OR after ``init()`` (the timer re-checks each tick), which
+        lets standalone mode attach once its port is actually bound."""
+        from .autoscaler import Autoscaler, AutoscalerPolicy
+
+        self.autoscaler = Autoscaler(
+            self, provider, AutoscalerPolicy.from_settings(settings or {})
+        )
+        return self.autoscaler
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Wait until the event loop has processed everything queued (test
@@ -326,6 +354,14 @@ class SchedulerServer:
                     self.event_loop.get_sender().post(AdmissionPulse())
             except Exception:  # noqa: BLE001 - timer must never die
                 log.exception("speculation timer iteration failed")
+            if self.autoscaler is not None:
+                # the autoscaler rides the same cadence; its own tick()
+                # contains provider failures, but belt-and-braces here —
+                # this thread also drives speculation and admission
+                try:
+                    self.autoscaler.tick()
+                except Exception:  # noqa: BLE001
+                    log.exception("autoscaler tick failed")
 
     def _telemetry_loop(self) -> None:
         """Record the cluster-aggregate series (queue depth, running
@@ -369,6 +405,35 @@ class SchedulerServer:
         }
         state.telemetry.record_cluster(metrics)
         return metrics
+
+    def doctor_cluster_context(self) -> Dict[str, object]:
+        """Live capacity context for the query doctor's cluster rules
+        (underprovisioned_cluster, the scale-out-in-flight note on
+        admission_queued_job) — shared by the REST and gRPC report
+        handlers so both surfaces diagnose from identical numbers."""
+        em = self.state.executor_manager
+        ctx: Dict[str, object] = {
+            "alive_executors": len(em.get_alive_executors()),
+            "admission_queued_jobs": self.state.admission.queued_count(),
+            "autoscaler_enabled": self.autoscaler is not None,
+            "max_executors": 0,
+        }
+        if self.autoscaler is not None:
+            launching = self.autoscaler.scale_out_in_flight()
+            ctx["max_executors"] = self.autoscaler.policy.max_executors
+            ctx["scale_out_in_flight"] = launching
+            ctx["autoscaler_launching"] = self.autoscaler._count_phase(
+                "launching"
+            )
+        else:
+            # knob off: diagnose against the default ceiling so the
+            # doctor can still say "this cluster could have scaled"
+            from ..config import AUTOSCALER_MAX_EXECUTORS, BallistaConfig
+
+            ctx["max_executors"] = BallistaConfig({})._get(
+                AUTOSCALER_MAX_EXECUTORS
+            )
+        return ctx
 
     # --------------------------------------------------------- HA failover
     SCHEDULER_HB_PREFIX = "scheduler:"

@@ -40,7 +40,15 @@ def new_standalone_scheduler(
     executor_timeout_s: float = 180.0,
     event_journal_dir: str = "",
     telemetry_sample_s: float = 1.0,
+    autoscaler_settings: Optional[dict] = None,
+    executor_provider_factory=None,
+    **server_kwargs,
 ) -> StandaloneScheduler:
+    """``executor_provider_factory`` is ``(host, port) -> ExecutorProvider``
+    — a factory because the scheduler's port doesn't exist until the gRPC
+    server binds, and a subprocess provider needs that address to hand to
+    the executors it launches.  ``None`` with autoscaling enabled builds a
+    :class:`LocalProcessProvider` against the bound port."""
     backend = backend or MemoryBackend()
     scheduler_id = f"localhost:{uuid.uuid4().hex[:6]}"
     server = SchedulerServer(
@@ -53,6 +61,7 @@ def new_standalone_scheduler(
         # standalone exists for tests/local runs: sample the cluster
         # aggregates tightly so short-lived clusters still get history
         telemetry_sample_s=telemetry_sample_s,
+        **server_kwargs,
     ).init()
     grpc_server = make_server()
     add_scheduler_servicer(grpc_server, SchedulerGrpcService(server))
@@ -65,5 +74,15 @@ def new_standalone_scheduler(
     # the scheduler id doubles as the curator address executors report to
     server.scheduler_id = f"127.0.0.1:{port}"
     server.state.task_manager.scheduler_id = server.scheduler_id
+    from .autoscaler import AutoscalerPolicy
+
+    if AutoscalerPolicy.enabled_in(autoscaler_settings):
+        if executor_provider_factory is None:
+            from .autoscaler import LocalProcessProvider
+
+            provider = LocalProcessProvider("127.0.0.1", port)
+        else:
+            provider = executor_provider_factory("127.0.0.1", port)
+        server.attach_autoscaler(provider, autoscaler_settings)
     log.info("standalone scheduler up at 127.0.0.1:%d (%s)", port, policy.value)
     return StandaloneScheduler(server, grpc_server, port)

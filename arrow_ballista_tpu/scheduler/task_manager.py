@@ -1489,6 +1489,38 @@ class TaskManager:
                 running += graph.running_tasks()
         return pending, running
 
+    def unreplicated_shuffle_bytes(self) -> Dict[str, int]:
+        """Per-executor bytes of completed shuffle output that has NO
+        external-store replica and is still referenced by an active job —
+        exactly what a graceful drain must upload before the executor can
+        retire.  The autoscaler's scale-in victim selection minimizes
+        this (cheapest executor to move).  Cached graphs only
+        (scrape-time: must never hit the backend)."""
+        out: Dict[str, int] = {}
+        with self._cache_lock:
+            entries = list(self._cache.values())
+        for entry in entries:
+            with entry.lock:
+                graph = entry.graph
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                for stage in graph.stages.values():
+                    for info in getattr(stage, "task_statuses", None) or []:
+                        if info is None or info.state != "completed":
+                            continue
+                        if not info.executor_id:
+                            continue
+                        pending = sum(
+                            p.num_bytes
+                            for p in info.partitions
+                            if not p.replica_path and p.num_bytes > 0
+                        )
+                        if pending:
+                            out[info.executor_id] = (
+                                out.get(info.executor_id, 0) + pending
+                            )
+        return out
+
     def list_jobs(self) -> List[dict]:
         """Job table for the REST API: active, completed and failed jobs
         with their states (reference exposes this via /api/state +

@@ -5,8 +5,11 @@ stage rollback) is only trustworthy if its failure paths can be exercised
 deterministically.  This module plants named **injection points** on the
 hot paths — task launch (``scheduler.launch_task``), task execution
 (``executor.execute_task``), the process-isolated worker loop
-(``executor.task_runner``), shuffle fetch (``shuffle.fetch``) and the
-executor heartbeat (``executor.heartbeat``) — that are free when disarmed
+(``executor.task_runner``), shuffle fetch (``shuffle.fetch``), the
+executor heartbeat (``executor.heartbeat``) and the autoscaler's provider
+launch (``executor.launch`` — ``raise`` models a fleet-API refusal,
+``delay`` a slow cold-start that must trip the launch timeout without
+hanging the tick) — that are free when disarmed
 (one attribute read) and raise :class:`FaultInjected` (or kill the
 process, for worker-crash simulation) when armed.
 

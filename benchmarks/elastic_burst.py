@@ -1,0 +1,429 @@
+"""Elastic executor lifecycle A/B under a bursty open-loop load (ISSUE 17).
+
+Two entry points:
+
+* :func:`run_elastic_bench` — the BENCH_SUITE leg: an open-loop burst of
+  identical group-by jobs (fixed arrival schedule, submitted whether or
+  not earlier jobs finished — the honest way to measure a system under
+  load it does not control) against (a) a FIXED cluster of 2 subprocess
+  executors and (b) the same scheduler with the closed-loop autoscaler
+  (``min=2, max=4``) on an IDENTICAL schedule.  Per-task service time is
+  manufactured with the ``task.run`` delay fault (armed in the executor
+  children via ``BALLISTA_FAULTS``), so the workload is slot-bound — the
+  regime where capacity actually helps — rather than CPU-bound on the
+  bench host.  The record reports per-job latency quantiles, the breathe
+  cycle (peak alive executors, scale-out/in journal events), and the
+  doctor's ``admission_queued_job`` count per leg; result identity is a
+  sha256 multiset over every job's rows.
+
+* :func:`run_autoscaler_smoke` — the tier-1 ``--bench-smoke`` gate: a
+  tiny burst against ``min=1``, asserting one scale-out, one drain-based
+  scale-in after the idle cooldown, zero failed tasks and the journal
+  events (``autoscale_decision``/``executor_launched``/
+  ``executor_retired``) present.
+
+Both legs run real subprocess executors through the same
+:class:`LocalProcessProvider` (the fixed leg just launches them once and
+never again), so executor mechanics are identical and the ONLY variable
+is the control loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import tempfile
+import threading
+import time
+
+import pyarrow as pa
+
+BASE_CONFIG = {
+    "ballista.mesh.enable": "false",
+    "ballista.tpu.min_rows": "0",
+    "ballista.shuffle.partitions": "4",
+    "ballista.admission.enabled": "true",
+}
+
+SQL = "select g, sum(x) as s, count(x) as n from t group by g"
+
+# fast policy for bench/smoke clusters: decisions in hundreds of ms, not
+# the production-default tens of seconds
+FAST_POLICY = {
+    "ballista.autoscaler.enabled": "true",
+    "ballista.autoscaler.scale_out_sustain_seconds": "0.5",
+    "ballista.autoscaler.scale_in_idle_seconds": "2",
+    "ballista.autoscaler.cooldown_seconds": "1",
+    "ballista.autoscaler.launch_timeout_seconds": "60",
+}
+
+
+def _fingerprint(table: pa.Table) -> str:
+    rows = sorted(zip(*[c.to_pylist() for c in table.columns]))
+    h = hashlib.sha256()
+    for row in rows:
+        h.update(repr(row).encode())
+    return h.hexdigest()
+
+
+def _quantile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class _Cluster:
+    """One leg's scheduler + subprocess executors.  ``max_executors=None``
+    means FIXED: launch ``min_executors`` children directly through the
+    provider and never touch them again (no autoscaler object at all —
+    the knob-off scheduler)."""
+
+    def __init__(
+        self,
+        min_executors: int,
+        max_executors,
+        task_delay_ms: int,
+        task_slots: int = 2,
+    ):
+        from arrow_ballista_tpu.config import TaskSchedulingPolicy
+        from arrow_ballista_tpu.scheduler.autoscaler import (
+            ExecutorSpec,
+            LocalProcessProvider,
+        )
+        from arrow_ballista_tpu.scheduler.standalone import (
+            new_standalone_scheduler,
+        )
+
+        self.journal_dir = tempfile.mkdtemp(prefix="ballista-burst-journal-")
+        env = {}
+        if task_delay_ms:
+            # service time manufactured INSIDE the executor children: the
+            # env-armed task.run delay makes every task slot-bound
+            env["BALLISTA_FAULTS"] = f"task.run:-1:delay={task_delay_ms}"
+        extra_args = ["--task-isolation", "thread"]
+        elastic = max_executors is not None
+
+        def factory(host, port):
+            return LocalProcessProvider(
+                host, port, task_slots=task_slots,
+                env=env, extra_args=extra_args,
+            )
+
+        settings = None
+        if elastic:
+            settings = dict(FAST_POLICY)
+            settings["ballista.autoscaler.min_executors"] = str(min_executors)
+            settings["ballista.autoscaler.max_executors"] = str(max_executors)
+        self.handle = new_standalone_scheduler(
+            TaskSchedulingPolicy.PUSH_STAGED,
+            event_journal_dir=self.journal_dir,
+            speculation_interval_s=0.2,
+            autoscaler_settings=settings,
+            executor_provider_factory=factory if elastic else None,
+        )
+        self.server = self.handle.server
+        self.provider = None
+        if not elastic:
+            self.provider = factory(self.handle.host, self.handle.port)
+            for i in range(min_executors):
+                self.provider.launch(ExecutorSpec(f"fixed-{i}", task_slots))
+        self._wait_alive(min_executors)
+
+    def _wait_alive(self, n: int, timeout_s: float = 90.0) -> None:
+        em = self.server.state.executor_manager
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(em.get_alive_executors()) >= n:
+                return
+            time.sleep(0.2)
+        raise RuntimeError(
+            f"only {len(em.get_alive_executors())} of {n} executor(s) "
+            f"registered within {timeout_s:.0f}s"
+        )
+
+    def events(self, kind: str):
+        return [
+            e for e in self.server.state.events.tail(10_000)
+            if e.get("kind") == kind
+        ]
+
+    def close(self) -> None:
+        try:
+            self.handle.shutdown()
+        finally:
+            if self.provider is not None:
+                self.provider.close()
+
+
+def _run_leg(
+    elastic: bool,
+    n_jobs: int,
+    interarrival_s: float,
+    task_delay_ms: int,
+    n_rows: int,
+    min_executors: int = 2,
+    max_executors: int = 4,
+) -> dict:
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.obs.doctor import job_report
+
+    cluster = _Cluster(
+        min_executors, max_executors if elastic else None, task_delay_ms
+    )
+    srv = cluster.server
+    peak_alive = min_executors
+    try:
+        ctx = BallistaContext.remote(
+            "127.0.0.1", cluster.handle.port, BallistaConfig(dict(BASE_CONFIG))
+        )
+        ctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array([f"g{i % 23}" for i in range(n_rows)]),
+                        "x": pa.array(
+                            [float(i % 251) for i in range(n_rows)]
+                        ),
+                    }
+                ),
+                4,
+            ),
+        )
+        latencies, fingerprints, errors = [], [], []
+        lock = threading.Lock()
+
+        def one_job() -> None:
+            t0 = time.perf_counter()
+            try:
+                result = ctx.sql(SQL).collect()
+            except Exception as e:  # noqa: BLE001 - recorded, asserted later
+                with lock:
+                    errors.append(repr(e))
+                return
+            wall = time.perf_counter() - t0
+            with lock:
+                latencies.append(wall)
+                fingerprints.append(_fingerprint(result))
+
+        threads = []
+        t_start = time.perf_counter()
+        for i in range(n_jobs):
+            # open loop: arrivals follow the schedule, not the completions
+            target = t_start + i * interarrival_s
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one_job, name=f"burst-{i}")
+            th.start()
+            threads.append(th)
+            alive = len(srv.state.executor_manager.get_alive_executors())
+            peak_alive = max(peak_alive, alive)
+        while any(th.is_alive() for th in threads):
+            alive = len(srv.state.executor_manager.get_alive_executors())
+            peak_alive = max(peak_alive, alive)
+            time.sleep(0.1)
+        for th in threads:
+            th.join()
+        burst_wall = time.perf_counter() - t_start
+        srv.drain()
+
+        # per-job diagnosis with the LIVE cluster context — the doctor's
+        # admission_queued_job count is the "did users feel the queue"
+        # signal the elastic leg must silence
+        admission_findings = 0
+        task_retries = 0
+        resets = 0
+        for job_id in sorted(ctx._job_ids):
+            detail = srv.state.task_manager.get_job_detail(job_id)
+            if detail is None or "stages" not in detail:
+                continue
+            events = srv.state.events.for_job(job_id)
+            report = job_report(
+                detail, [], events, cluster=srv.doctor_cluster_context()
+            )
+            admission_findings += sum(
+                1 for f in report["doctor"]
+                if f["code"] == "admission_queued_job"
+            )
+            task_retries += sum(
+                r.get("task_retries") or 0 for r in detail["stages"]
+            )
+            resets += srv.state.task_manager._with_graph(
+                job_id, lambda g: sum(g.stage_reset_counts.values())
+            ) or 0
+
+        # scale-in back to the floor: wait out the idle window so the
+        # breathe cycle completes inside the leg
+        if elastic:
+            deadline = time.monotonic() + 60
+            em = srv.state.executor_manager
+            while time.monotonic() < deadline:
+                # a draining victim is still "alive" until ExecutorStopped:
+                # wait for the whole retire, not just the decision
+                if len(em.get_alive_executors()) <= min_executors:
+                    break
+                time.sleep(0.3)
+            # executor_retired is emitted when poll() observes the drained
+            # child's exit — a tick or two after ExecutorStopped
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                snap = srv.autoscaler.snapshot()
+                if snap["draining"] == 0 and snap["launching"] == 0:
+                    break
+                time.sleep(0.3)
+        latencies.sort()
+        return {
+            "errors": errors,
+            "fingerprints": sorted(fingerprints),
+            "latency_p50_s": round(_quantile(latencies, 0.50), 3),
+            "latency_p99_s": round(_quantile(latencies, 0.99), 3),
+            "latency_max_s": round(max(latencies), 3) if latencies else 0.0,
+            "burst_wall_s": round(burst_wall, 3),
+            "peak_alive_executors": peak_alive,
+            "final_alive_executors": len(
+                srv.state.executor_manager.get_alive_executors()
+            ),
+            "admission_queued_findings": admission_findings,
+            "task_retries": task_retries,
+            "stage_resets": resets,
+            "scale_out_events": len(
+                [e for e in cluster.events("autoscale_decision")
+                 if e.get("action") == "scale_out"]
+            ),
+            "scale_in_events": len(
+                [e for e in cluster.events("autoscale_decision")
+                 if e.get("action") == "scale_in"]
+            ),
+            "launched_events": len(cluster.events("executor_launched")),
+            "retired_events": len(cluster.events("executor_retired")),
+        }
+    finally:
+        try:
+            ctx.close()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.close()
+
+
+def run_elastic_bench(
+    n_jobs: int = 18,
+    interarrival_s: float = 0.7,
+    task_delay_ms: int = 600,
+    n_rows: int = 40_000,
+) -> dict:
+    """Fixed-2 vs elastic (2→4) on an identical open-loop burst; returns
+    the bench record (``metric: elastic_burst_p99_speedup``)."""
+    fixed = _run_leg(
+        False, n_jobs, interarrival_s, task_delay_ms, n_rows
+    )
+    elastic = _run_leg(
+        True, n_jobs, interarrival_s, task_delay_ms, n_rows
+    )
+    assert not fixed["errors"], f"fixed leg had job errors: {fixed['errors']}"
+    assert not elastic["errors"], (
+        f"elastic leg had job errors: {elastic['errors']}"
+    )
+    assert fixed["fingerprints"] == elastic["fingerprints"], (
+        "elastic leg changed the results"
+    )
+    # the breathe cycle: 2 → >2 → 2
+    assert elastic["peak_alive_executors"] > 2, (
+        f"cluster never scaled out (peak {elastic['peak_alive_executors']})"
+    )
+    assert elastic["final_alive_executors"] <= 2, (
+        f"cluster never scaled back in "
+        f"({elastic['final_alive_executors']} alive at end)"
+    )
+    # scale-in must be invisible to the work: zero failures, zero recompute
+    assert elastic["task_retries"] == 0, (
+        f"elastic leg retried {elastic['task_retries']} task(s)"
+    )
+    assert elastic["stage_resets"] == 0, (
+        f"elastic leg recomputed {elastic['stage_resets']} stage(s)"
+    )
+    # bounded interactive latency: the elastic leg must not be slower
+    # (small tolerance: the legs share a host and a clock)
+    # the doctor's queue finding quiets down with the autoscaler: fewer
+    # jobs feel the admission queue than on the fixed cluster
+    assert (
+        elastic["admission_queued_findings"]
+        < max(1, fixed["admission_queued_findings"])
+    ), (
+        f"admission_queued findings not reduced: elastic "
+        f"{elastic['admission_queued_findings']} vs fixed "
+        f"{fixed['admission_queued_findings']}"
+    )
+    assert elastic["latency_p99_s"] <= fixed["latency_p99_s"] * 1.10, (
+        f"elastic p99 {elastic['latency_p99_s']}s worse than fixed "
+        f"{fixed['latency_p99_s']}s"
+    )
+    speedup = (
+        fixed["latency_p99_s"] / elastic["latency_p99_s"]
+        if elastic["latency_p99_s"]
+        else 0.0
+    )
+    return {
+        "metric": "elastic_burst_p99_speedup",
+        "value": round(speedup, 3),
+        "unit": "x (fixed-2 p99 / elastic p99, identical open-loop burst)",
+        "vs_baseline": round(speedup, 3),
+        "fixed_p50_s": fixed["latency_p50_s"],
+        "fixed_p99_s": fixed["latency_p99_s"],
+        "elastic_p50_s": elastic["latency_p50_s"],
+        "elastic_p99_s": elastic["latency_p99_s"],
+        "peak_alive_executors": elastic["peak_alive_executors"],
+        "final_alive_executors": elastic["final_alive_executors"],
+        "scale_out_events": elastic["scale_out_events"],
+        "scale_in_events": elastic["scale_in_events"],
+        "admission_queued_findings_fixed": fixed["admission_queued_findings"],
+        "admission_queued_findings_elastic": elastic[
+            "admission_queued_findings"
+        ],
+        "elastic_task_retries": elastic["task_retries"],
+        "elastic_stage_resets": elastic["stage_resets"],
+        "n_jobs": n_jobs,
+        "interarrival_s": interarrival_s,
+        "task_delay_ms": task_delay_ms,
+    }
+
+
+def run_autoscaler_smoke(
+    n_jobs: int = 4,
+    task_delay_ms: int = 300,
+    n_rows: int = 8_000,
+) -> dict:
+    """Tier-1 ``--bench-smoke`` gate: tiny burst against 1 executor —
+    one scale-out observed, one drain-based scale-in after the idle
+    cooldown, zero failed tasks, journal events present.  Assertions run
+    inside; the returned record is informational."""
+    leg = _run_leg(
+        True, n_jobs, 0.2, task_delay_ms, n_rows,
+        min_executors=1, max_executors=2,
+    )
+    assert not leg["errors"], f"smoke jobs failed: {leg['errors']}"
+    assert leg["peak_alive_executors"] >= 2, (
+        f"no scale-out observed (peak {leg['peak_alive_executors']})"
+    )
+    assert leg["final_alive_executors"] <= 1, (
+        f"no scale-in observed ({leg['final_alive_executors']} alive)"
+    )
+    assert leg["scale_out_events"] >= 1, "no scale_out journal decision"
+    assert leg["scale_in_events"] >= 1, "no scale_in journal decision"
+    assert leg["launched_events"] >= 2, "executor_launched events missing"
+    assert leg["retired_events"] >= 1, "executor_retired event missing"
+    assert leg["task_retries"] == 0, (
+        f"{leg['task_retries']} task(s) retried during the breathe cycle"
+    )
+    return {
+        "breathe_cycle": "1->%d->%d" % (
+            leg["peak_alive_executors"], leg["final_alive_executors"]
+        ),
+        "scale_out_events": leg["scale_out_events"],
+        "scale_in_events": leg["scale_in_events"],
+        "launched": leg["launched_events"],
+        "retired": leg["retired_events"],
+        "p99_s": leg["latency_p99_s"],
+    }

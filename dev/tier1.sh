@@ -115,6 +115,18 @@ print(json.dumps({"bench_smoke": "doctor", **run_doctor_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.elastic_burst import run_autoscaler_smoke
+
+# autoscaler smoke: tiny burst against a 1-executor elastic cluster —
+# one scale-out, one drain-based scale-in after the idle cooldown, zero
+# failed tasks, autoscale_decision/executor_launched/executor_retired
+# journal events present (asserted inside)
+print(json.dumps({"bench_smoke": "autoscaler", **run_autoscaler_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi
