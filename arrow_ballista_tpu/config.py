@@ -118,6 +118,13 @@ AUTOSCALER_SCALE_IN_IDLE_S = "ballista.autoscaler.scale_in_idle_seconds"
 AUTOSCALER_COOLDOWN_S = "ballista.autoscaler.cooldown_seconds"
 AUTOSCALER_LAUNCH_TIMEOUT_S = "ballista.autoscaler.launch_timeout_seconds"
 AUTOSCALER_SLO_BURN_THRESHOLD = "ballista.autoscaler.slo_burn_threshold"
+# Plan-fingerprint result/shuffle cache + learned per-plan policy
+# (see docs/user-guide/plan-cache.md)
+CACHE_ENABLED = "ballista.cache.enabled"
+CACHE_MAX_BYTES = "ballista.cache.max_bytes"
+CACHE_TTL_S = "ballista.cache.ttl_seconds"
+CACHE_POLICY_ENABLED = "ballista.cache.policy.enabled"
+CACHE_POLICY_SHADOW_FRACTION = "ballista.cache.policy.shadow_fraction"
 
 
 class TaskSchedulingPolicy(str, Enum):
@@ -937,6 +944,53 @@ _ENTRIES: dict[str, ConfigEntry] = {
             float,
             "0",
         ),
+        ConfigEntry(
+            CACHE_ENABLED,
+            "scheduler-side plan-fingerprint result/shuffle cache: a "
+            "stage whose producer subtree's canonical fingerprint (plus "
+            "source snapshot identity) matches a cached entry resolves "
+            "against the cached partitions in the external store and the "
+            "producer subtree is never dispatched; off = planning and "
+            "dispatch are byte-identical to a cache-less scheduler",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            CACHE_MAX_BYTES,
+            "total bytes the plan cache may pin in the external store; "
+            "exceeding it evicts least-recently-used entries until under "
+            "budget (0 = unbounded)",
+            int,
+            "1073741824",
+        ),
+        ConfigEntry(
+            CACHE_TTL_S,
+            "seconds a plan-cache entry stays servable after its last "
+            "store/hit; expired entries are evicted lazily at lookup and "
+            "store time (0 = no TTL)",
+            float,
+            "3600",
+        ),
+        ConfigEntry(
+            CACHE_POLICY_ENABLED,
+            "self-tuning per-plan policy store: after each job the "
+            "doctor's findings are recorded under the plan's shape "
+            "fingerprint, and the next submit of a matching plan merges "
+            "the learned knob overrides BENEATH explicit session "
+            "settings; a shadow fraction stays at baseline and an "
+            "override whose measured latency regresses vs the shadow "
+            "population is rolled back automatically",
+            _parse_bool,
+            "false",
+        ),
+        ConfigEntry(
+            CACHE_POLICY_SHADOW_FRACTION,
+            "fraction of matching submits the policy store leaves at "
+            "baseline (no overrides) to keep an unbiased comparison "
+            "population for rollback decisions",
+            float,
+            "0.1",
+        ),
     ]
 }
 
@@ -1297,6 +1351,26 @@ class BallistaConfig:
     @property
     def autoscaler_max_executors(self) -> int:
         return self._get(AUTOSCALER_MAX_EXECUTORS)
+
+    @property
+    def cache_enabled(self) -> bool:
+        return self._get(CACHE_ENABLED)
+
+    @property
+    def cache_max_bytes(self) -> int:
+        return self._get(CACHE_MAX_BYTES)
+
+    @property
+    def cache_ttl_seconds(self) -> float:
+        return self._get(CACHE_TTL_S)
+
+    @property
+    def cache_policy_enabled(self) -> bool:
+        return self._get(CACHE_POLICY_ENABLED)
+
+    @property
+    def cache_policy_shadow_fraction(self) -> float:
+        return self._get(CACHE_POLICY_SHADOW_FRACTION)
 
     def to_dict(self) -> dict[str, str]:
         return dict(self.settings)

@@ -461,6 +461,10 @@ def render_explain_analyze(report: dict) -> str:
     lines.append("└─ stages:")
     for row in profile.get("stages", []):
         bits = [f"{row.get('partitions', '?')} task(s)"]
+        if row.get("cache"):
+            # plan-cache serve: output restored from a fingerprint-
+            # matched prior run, no tasks dispatched for this stage
+            bits.append(f"cache hit ({row['cache'].get('bytes', 0):,}B)")
         if row.get("task_retries"):
             bits.append(f"{row['task_retries']} retr.")
         if row.get("shuffle_bytes_fetched"):

@@ -71,10 +71,15 @@ TASK_FINISH_OP = "__task_finish_us__"
 # excludes their (stall-inflated) task runtimes from the ETA median and
 # the doctor reports the run as pipelined
 PIPELINED_OP = "__pipelined__"
+# Plan-cache marker (ISSUE 18): {"cache_hit": 1, "bytes": n} on stages
+# resolved straight from cached shuffle output — zero tasks dispatched;
+# job detail/profile lift it into row["cache"] so a hit is visible
+# everywhere the doctor's numbers are
+CACHE_OP = "__cache__"
 _SYNTHETIC_OPS = (
     STAGE_SKEW_OP, TASK_RUNTIME_OP, TASK_BYTES_WIRE_OP, TASK_BYTES_RAW_OP,
     AQE_OP, LOCALITY_OP, STAGE_TIMING_OP, TASK_DISPATCH_OP, TASK_FINISH_OP,
-    PIPELINED_OP,
+    PIPELINED_OP, CACHE_OP,
 )
 
 
@@ -409,6 +414,11 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             # adaptive re-planning outcome: how the observed shuffle
             # stats reshaped this stage's task layout
             row["aqe"] = dict(aqe)
+        served = metrics.get(CACHE_OP) or r.get("cache")
+        if served:
+            # plan-cache serve outcome: this stage's output came from a
+            # fingerprint-matched prior run — zero tasks dispatched
+            row["cache"] = dict(served)
         spec = r.get("speculation")
         if spec:
             # straggler mitigation rollup: duplicates launched for this

@@ -46,6 +46,9 @@ CONFIG_KEYS = {
     "aqe_enabled": (int, 0, "1 = adaptive query execution (re-plan stages from observed shuffle stats) as the cluster-wide default; an explicit session ballista.aqe.* setting wins"),
     "admission_enabled": (int, 0, "1 = multi-tenant admission control (queue, weighted fair release, ClusterSaturated shed) as the cluster-wide default; an explicit session ballista.admission.* setting wins unless pinned via --admission-defaults"),
     "admission_defaults": (str, "", "comma-separated ballista.admission.* key=value pairs PINNED cluster-wide (e.g. 'ballista.admission.max_queued_jobs=200,ballista.admission.shed_policy=oldest'); pinned limits ignore session settings so no tenant can rewrite another tenant's gates"),
+    "cache_enabled": (int, 0, "1 = plan-fingerprint result/shuffle cache (serve repeat subplans from the external store without re-running their stages) as the cluster-wide default; an explicit session ballista.cache.* setting wins"),
+    "cache_policy_enabled": (int, 0, "1 = learned per-plan policy (merge measured knob overrides beneath explicit session settings on repeat submissions) as the cluster-wide default"),
+    "cache_settings": (str, "", "comma-separated ballista.cache.* key=value pairs seeded cluster-wide (e.g. 'ballista.cache.max_bytes=268435456,ballista.cache.ttl_seconds=600')"),
     "obs_enabled": (int, 0, "1 = trace every session's jobs even without ballista.obs.enabled"),
     "event_journal_dir": (str, "", "directory for the append-only structured event journal (empty = disabled; see /api/jobs/{id}/events and /api/events/tail)"),
     "event_journal_rotate_bytes": (int, 4 << 20, "rotate the active journal segment past this size"),
@@ -189,6 +192,9 @@ def main(argv=None) -> None:
         aqe_force_enabled=bool(cfg["aqe_enabled"]),
         admission_force_enabled=bool(cfg["admission_enabled"]),
         admission_defaults=_parse_admission_defaults(cfg["admission_defaults"]),
+        cache_force_enabled=bool(cfg["cache_enabled"]),
+        cache_policy_force_enabled=bool(cfg["cache_policy_enabled"]),
+        cache_settings=_parse_admission_defaults(cfg["cache_settings"]),
         drain_timeout_s=cfg["drain_timeout_seconds"],
         telemetry_sample_s=cfg["telemetry_sample_seconds"],
         event_journal_dir=cfg["event_journal_dir"],

@@ -166,7 +166,11 @@ async function showDetail(jobId) {
           op + ': ' + Object.entries(m).map(([k, v]) => `${k}=${v}`).join(' ')
         ).join(' · '))
       : '';
-    const mets = [aqe, keyed, opMets].filter(Boolean).join(' · ') || '—';
+    // plan-cache badge: this stage (and its elided upstream) was served
+    // from a fingerprint-matched prior run — zero tasks dispatched
+    const cached = s.cache
+      ? `served from cache (${s.cache.bytes || 0} B)` : '';
+    const mets = [cached, aqe, keyed, opMets].filter(Boolean).join(' · ') || '—';
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
             `<td>${done}</td>` +
             `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +
@@ -242,10 +246,11 @@ function dagSvg(stages) {
 }
 async function refresh() {
   try {
-    const [state, jobs, metrics] = await Promise.all([
+    const [state, jobs, metrics, cache] = await Promise.all([
       fetch('/api/state').then(r => r.json()),
       fetch('/api/jobs').then(r => r.json()),
       fetch('/api/metrics').then(r => r.json()),
+      fetch('/api/cache').then(r => r.json()).catch(() => null),
     ]);
     document.getElementById('meta').textContent =
       `version ${state.version} · uptime ${state.uptime_seconds}s · ` +
@@ -261,6 +266,11 @@ async function refresh() {
           `${metrics.autoscaler_desired_executors} desired` +
           ` (+${metrics.autoscaler_launching_executors || 0} launching, ` +
           `-${metrics.autoscaler_draining_executors || 0} draining)`
+        : '') +
+      (cache && cache.cache
+        ? ` · plan cache ${cache.cache.entry_count} entr` +
+          `${cache.cache.entry_count === 1 ? 'y' : 'ies'} · ` +
+          `${cache.cache.hits} hit(s)`
         : '');
     const etb = document.querySelector('#executors tbody');
     etb.innerHTML = '';
@@ -389,6 +399,17 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
             # per-pool weights, lanes, queue depth, running share and
             # lifetime admitted/shed counters
             self._json(srv.state.admission.snapshot())
+            return
+        if path == "/api/cache":
+            # plan-fingerprint result cache + learned policy store
+            # (ISSUE 18): entry table with hit/byte accounting plus the
+            # per-plan override/rollback ledger
+            self._json(
+                {
+                    "cache": srv.state.plan_cache.snapshot(),
+                    "policy": srv.state.policy_store.snapshot(),
+                }
+            )
             return
         if path == "/api/cluster/timeseries":
             self._cluster_timeseries(srv)
@@ -531,8 +552,20 @@ class SchedulerApiHandler(BaseHTTPRequestHandler):
                     if getattr(srv, "autoscaler", None) is not None
                     else {"enabled": False}
                 ),
+                "cache": self._cache_summary(state),
             }
         )
+
+    @staticmethod
+    def _cache_summary(state) -> dict:
+        """Slim plan-cache block for /api/cluster/health: the counters
+        and sizes without the per-entry table (that's /api/cache)."""
+        snap = state.plan_cache.snapshot()
+        snap.pop("entries", None)
+        snap["policy_plans"] = state.policy_store.snapshot().get(
+            "plan_count", 0
+        )
+        return snap
 
     def _cluster_timeseries(self, srv) -> None:
         """``?metric=<name>[&executor=<id>]`` returns that series'

@@ -151,6 +151,17 @@ class ExecutionGraph:
         # drop site — commit, failure, reset, reap — reconciles with the
         # per-stage spec_stats rollup)
         self.spec_wasted_pending = 0
+        # plan-fingerprint cache (scheduler/plan_cache.py): stages served
+        # straight from cached shuffle output (sid -> fingerprint) and
+        # stages elided because every consumer is served/elided (revive
+        # skips them — they never dispatch).  Persisted (cache_json) so
+        # restart/HA adoption keeps skipping the elided subtree instead
+        # of waiting forever on inputs nobody will produce.
+        self.cache_served: Dict[int, str] = {}
+        self.cache_elided: set = set()
+        # fingerprints whose cached files turned out to be lost; drained
+        # by the TaskManager (like pending_cancels) to evict the entries
+        self.pending_cache_invalidations: List[str] = []
         # tracing: set by the scheduler at submit when the session has
         # ballista.obs.enabled (and the job is sampled); in-memory only —
         # a trace does not survive scheduler restart
@@ -239,6 +250,12 @@ class ExecutionGraph:
         out, self.pending_cancels = self.pending_cancels, []
         return out
 
+    def take_pending_cache_invalidations(self) -> List[str]:
+        out, self.pending_cache_invalidations = (
+            self.pending_cache_invalidations, [],
+        )
+        return out
+
     def take_pending_events(self) -> List[dict]:
         out, self.pending_events = self.pending_events, []
         return out
@@ -262,7 +279,11 @@ class ExecutionGraph:
         return self.status == COMPLETED
 
     def is_complete(self) -> bool:
-        return all(isinstance(s, CompletedStage) for s in self.stages.values())
+        return all(
+            isinstance(s, CompletedStage)
+            for sid, s in self.stages.items()
+            if sid not in self.cache_elided
+        )
 
     def available_tasks(self) -> int:
         return sum(
@@ -301,6 +322,8 @@ class ExecutionGraph:
         runs here, just before ``to_resolved()``."""
         changed = False
         for sid, stage in list(self.stages.items()):
+            if sid in self.cache_elided:
+                continue  # every consumer is cache-served: never dispatch
             if isinstance(stage, UnresolvedStage) and stage.resolvable():
                 self._maybe_replan(stage)
                 resolved = stage.to_resolved()
@@ -313,6 +336,8 @@ class ExecutionGraph:
         if self.pipelined_enabled and self._revive_partial():
             changed = True
         for sid, stage in list(self.stages.items()):
+            if sid in self.cache_elided:
+                continue
             if isinstance(stage, ResolvedStage):
                 running = stage.to_running()
                 if self.locality_enabled:
@@ -1263,7 +1288,12 @@ class ExecutionGraph:
 
         # 2) re-run just the producer tasks whose output lived there
         n_rerun = 0
-        if isinstance(producer, CompletedStage):
+        if prod_sid in self.cache_served:
+            # the "producer" never ran — it was served from the plan
+            # cache and its files vanished: forget the serve, rebirth
+            # the elided subtree, recompute through normal dispatch
+            n_rerun = self._revert_cache_served(prod_sid)
+        elif isinstance(producer, CompletedStage):
             running = producer.to_running()
             if executor_id == EXTERNAL_EXECUTOR_ID:
                 # the external store lost data: re-run the map tasks
@@ -1295,6 +1325,54 @@ class ExecutionGraph:
             map_tasks_rerun=n_rerun,
         )
         return ["job_updated"] + ["task_requeued"] * n_rerun
+
+    def _revert_cache_served(self, sid: int) -> int:
+        """A cache-served stage's cached partitions vanished: forget the
+        serve — the stage and its elided upstream subtree revert to
+        their born state and recompute through the normal dispatch path.
+        The subtree is self-contained by construction (serving requires
+        every interior stage's consumers to stay inside it), so rebirth
+        cannot strand or double-feed any outside consumer.  Returns the
+        number of stages reborn."""
+        stage = self.stages.get(sid)
+        if not isinstance(stage, CompletedStage):
+            self.cache_served.pop(sid, None)
+            return 0
+        fp = self.cache_served.pop(sid, "")
+        if fp:
+            self.pending_cache_invalidations.append(fp)
+        reborn = {sid}
+        frontier = [sid]
+        while frontier:
+            cur = self.stages.get(frontier.pop())
+            if cur is None:
+                continue
+            for sh in find_unresolved_shuffles(cur.plan):
+                if sh.stage_id in self.cache_elided:
+                    self.cache_elided.discard(sh.stage_id)
+                    reborn.add(sh.stage_id)
+                    frontier.append(sh.stage_id)
+        for s in sorted(reborn):
+            cur = self.stages[s]
+            deps = [sh.stage_id for sh in find_unresolved_shuffles(cur.plan)]
+            if deps:
+                self.stages[s] = UnresolvedStage(
+                    s,
+                    cur.plan,
+                    list(cur.output_links),
+                    {d: StageInput() for d in deps},
+                )
+            else:
+                born = ResolvedStage(s, cur.plan, list(cur.output_links), {})
+                born.ready_unix_ns = time.time_ns()
+                self.stages[s] = born
+        self._journal(
+            "cache_lost",
+            stage=sid,
+            fingerprint=fp,
+            stages_reborn=sorted(reborn),
+        )
+        return len(reborn)
 
     # --------------------------------------- speculation/deadline scan
     def scan_speculation(
@@ -1869,6 +1947,15 @@ class ExecutionGraph:
         for sid in sorted(self.stage_reset_counts):
             g.stage_reset_ids.append(sid)
             g.stage_reset_counts.append(self.stage_reset_counts[sid])
+        if self.cache_served or self.cache_elided:
+            g.cache_json = json.dumps(
+                {
+                    "served": {
+                        str(s): fp for s, fp in self.cache_served.items()
+                    },
+                    "elided": sorted(self.cache_elided),
+                }
+            )
         if self.status == QUEUED:
             g.status.queued.SetInParent()
         elif self.status == RUNNING:
@@ -1976,6 +2063,18 @@ class ExecutionGraph:
         self.stage_reset_counts = dict(
             zip(g.stage_reset_ids, g.stage_reset_counts)
         )
+        self.cache_served = {}
+        self.cache_elided = set()
+        self.pending_cache_invalidations = []
+        if g.cache_json:
+            try:
+                c = json.loads(g.cache_json)
+                self.cache_served = {
+                    int(k): v for k, v in (c.get("served") or {}).items()
+                }
+                self.cache_elided = set(c.get("elided") or [])
+            except (ValueError, TypeError, AttributeError):
+                pass
         # speculation/deadline policy is session-config derived and not
         # persisted: a recovered/adopted graph runs without it until its
         # stages complete (timing anchors are gone anyway); locality

@@ -69,6 +69,9 @@ class SchedulerServer:
         aqe_force_enabled: bool = False,
         admission_force_enabled: bool = False,
         admission_defaults: Optional[Dict[str, str]] = None,
+        cache_force_enabled: bool = False,
+        cache_policy_force_enabled: bool = False,
+        cache_settings: Optional[Dict[str, str]] = None,
         drain_timeout_s: float = 30.0,
         telemetry_sample_s: float = 5.0,
         event_journal_dir: str = "",
@@ -95,6 +98,9 @@ class SchedulerServer:
             aqe_force_enabled=aqe_force_enabled,
             admission_force_enabled=admission_force_enabled,
             admission_defaults=admission_defaults,
+            cache_force_enabled=cache_force_enabled,
+            cache_policy_force_enabled=cache_policy_force_enabled,
+            cache_settings=cache_settings,
             event_journal_dir=event_journal_dir,
             event_journal_rotate_bytes=event_journal_rotate_bytes,
             event_journal_segments=event_journal_segments,

@@ -52,6 +52,9 @@ class SchedulerState:
         aqe_force_enabled: bool = False,
         admission_force_enabled: bool = False,
         admission_defaults: Optional[Dict[str, str]] = None,
+        cache_force_enabled: bool = False,
+        cache_policy_force_enabled: bool = False,
+        cache_settings: Optional[Dict[str, str]] = None,
         event_journal_dir: str = "",
         event_journal_rotate_bytes: Optional[int] = None,
         event_journal_segments: Optional[int] = None,
@@ -120,12 +123,17 @@ class SchedulerState:
         # scheduler flags seed cluster-wide defaults that an EXPLICIT
         # session setting still wins over (session settings ship sparse)
         overrides: Dict[str, str] = dict(admission_defaults or {})
+        overrides.update(cache_settings or {})
         if overrides:
             BallistaConfig(overrides)  # fail fast on a bad operator knob
         if aqe_force_enabled:
             overrides["ballista.aqe.enabled"] = "true"
         if admission_force_enabled:
             overrides["ballista.admission.enabled"] = "true"
+        if cache_force_enabled:
+            overrides["ballista.cache.enabled"] = "true"
+        if cache_policy_force_enabled:
+            overrides["ballista.cache.policy.enabled"] = "true"
         # multi-tenant front door (ISSUE 12): the admission queue +
         # weighted fair release.  Always constructed; it only ever acts
         # on jobs whose merged config has ballista.admission.enabled, so
@@ -142,6 +150,21 @@ class SchedulerState:
             events=self.events,
             pinned_settings=overrides,
         )
+        # plan-fingerprint result/shuffle cache + learned per-plan policy
+        # (ISSUE 18).  Always constructed — both layers are gated per-job
+        # by ballista.cache.enabled / ballista.cache.policy.enabled, so a
+        # default-off scheduler plans and dispatches byte-identically to
+        # one without them.  Cached partitions live beside the external
+        # shuffle store under the scheduler work dir.
+        import os as _os
+
+        from .plan_cache import PlanCache
+        from .policy_store import PolicyStore
+
+        self.plan_cache = PlanCache(_os.path.join(work_dir, "plan_cache"))
+        self.policy_store = PolicyStore(
+            _os.path.join(work_dir, "policy_store.json")
+        )
         self.task_manager = TaskManager(
             backend, self.executor_manager, scheduler_id, launcher, work_dir,
             registry=self.metrics,
@@ -149,6 +172,8 @@ class SchedulerState:
             slo=self.slo,
             config_overrides=overrides or None,
             admission=self.admission,
+            plan_cache=self.plan_cache,
+            policy_store=self.policy_store,
         )
         self.session_manager = SessionManager(backend, session_builder)
         # straggler mitigation: the periodic scan body (invoked on the

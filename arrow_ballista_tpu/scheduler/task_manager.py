@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from ..errors import SchedulerError
 from ..exec.operators import ExecutionPlan
 from ..obs import trace
-from ..obs.export import AQE_OP, LOCALITY_OP
+from ..obs.export import AQE_OP, CACHE_OP, LOCALITY_OP
 from ..obs.recorder import trace_store
 from ..obs.registry import MetricsRegistry
 from ..proto import pb
@@ -100,6 +100,8 @@ class TaskManager:
         slo=None,
         config_overrides: Optional[Dict[str, str]] = None,
         admission=None,
+        plan_cache=None,
+        policy_store=None,
     ):
         from ..obs.events import EventJournal
 
@@ -121,6 +123,16 @@ class TaskManager:
         # bare TaskManager (tests) gets a disabled journal
         self.events = events if events is not None else EventJournal()
         self.slo = slo
+        # plan-fingerprint result/shuffle cache + learned per-plan policy
+        # (scheduler/plan_cache.py, scheduler/policy_store.py); None for
+        # bare TaskManagers and when the owning state never enables them.
+        # Both are gated per-job by the session config knobs, so a wired
+        # store with ballista.cache.enabled=false is still a no-op.
+        self.plan_cache = plan_cache
+        self.policy_store = policy_store
+        # job_id -> learned props to stamp onto TaskDefinitions for keys
+        # the session didn't set (mirrors the SHUFFLE_PIPELINED stamp)
+        self._policy_props: Dict[str, Dict[str, str]] = {}
         self._cache: Dict[str, JobEntry] = {}
         self._cache_lock = threading.Lock()
         # scheduler-lifetime counters live in the unified registry
@@ -221,6 +233,34 @@ class TaskManager:
                 e.graph = None
             raise
 
+    def _cache_sync(self, graph: ExecutionGraph) -> None:
+        """Plan-cache upkeep after task-status updates commit (caller
+        holds the job entry lock): pin newly-completed eligible stages
+        under their fingerprints, and evict entries the lost-shuffle
+        recovery path proved hollow.  Best-effort — a cache failure must
+        never fail the status update."""
+        if self.plan_cache is None:
+            return
+        cfg = getattr(graph, "cache_config", None)
+        if cfg is not None:
+            from .plan_cache import store_completed
+
+            try:
+                store_completed(graph, self.plan_cache, cfg)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "plan-cache store failed for %s", graph.job_id
+                )
+        take = getattr(graph, "take_pending_cache_invalidations", None)
+        if take is not None:
+            for fp in take():
+                try:
+                    self.plan_cache.invalidate(fp)
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------ recovery
     def recover_active_jobs(self) -> List[str]:
         """Resume every ActiveJobs graph from the backend (scheduler
@@ -293,6 +333,27 @@ class TaskManager:
         return out
 
     # -------------------------------------------------------------- submit
+    def _policy_consult(
+        self, job_id: str, plan: ExecutionPlan, config
+    ) -> Tuple[str, str, Dict[str, str]]:
+        """Shape-fingerprint the raw submitted plan (no source-snapshot
+        identity — knob choices don't depend on the data) and ask the
+        policy store which arm this run lands on.  Any failure degrades
+        to baseline: the policy layer must never fail a submit."""
+        from .plan_cache import plan_fingerprint
+
+        try:
+            fp = plan_fingerprint(plan, with_snapshot=False)
+        except Exception:
+            return "", "baseline", {}
+        try:
+            overrides, arm = self.policy_store.overrides_for(
+                job_id, fp, config.cache_policy_shadow_fraction
+            )
+        except Exception:
+            return fp, "baseline", {}
+        return fp, arm, dict(overrides)
+
     def submit_job(
         self,
         job_id: str,
@@ -308,10 +369,26 @@ class TaskManager:
         # EXPLICIT session setting still wins over (session settings are
         # sparse — only user-set keys ship), so per-session A/B toggles
         # like ballista.aqe.enabled=false keep working under the flag
-        settings = self._session_settings(session_id)
+        session_settings = self._session_settings(session_id)
+        settings = session_settings
         if self.config_overrides:
             settings = {**self.config_overrides, **settings}
         config = BallistaConfig(settings)
+        # learned per-plan policy (ISSUE 18 layer 2): overrides sit ABOVE
+        # cluster-flag defaults but BENEATH explicit session settings, so
+        # a user's deliberate knob always wins over what the store learned
+        policy_fp, policy_arm, policy_overrides = "", "baseline", {}
+        if self.policy_store is not None and config.cache_policy_enabled:
+            policy_fp, policy_arm, policy_overrides = self._policy_consult(
+                job_id, plan, config
+            )
+            if policy_overrides:
+                settings = {
+                    **self.config_overrides,
+                    **policy_overrides,
+                    **session_settings,
+                }
+                config = BallistaConfig(settings)
         if self.admission is not None and self.admission.take_cancel_intent(
             job_id
         ):
@@ -326,6 +403,35 @@ class TaskManager:
         # PollWork may dispatch first-stage tasks the moment the entry is
         # cached, and those TaskDefinitions must already carry the trace
         graph.trace_id = trace_id
+        # policy bookkeeping rides the in-memory graph only (decoded
+        # graphs degrade to baseline — getattr defaults downstream)
+        graph.policy_fp = policy_fp
+        graph.policy_arm = policy_arm
+        graph.policy_overrides = dict(policy_overrides)
+        if policy_overrides:
+            self._policy_props[job_id] = dict(policy_overrides)
+            self.events.emit(
+                "policy_applied",
+                job=job_id,
+                trace=trace_id,
+                fingerprint=policy_fp,
+                overrides=dict(policy_overrides),
+            )
+        # result/shuffle cache (ISSUE 18 layer 1): serve matching stage
+        # subtrees straight from the external store BEFORE revive() can
+        # resolve/dispatch them; a serve failure must never fail a submit
+        if self.plan_cache is not None and config.cache_enabled:
+            graph.cache_config = config
+            try:
+                from .plan_cache import try_serve
+
+                try_serve(graph, self.plan_cache, config)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "plan-cache serve failed for %s", job_id
+                )
         graph.revive()
         self.events.emit(
             "job_submitted",
@@ -347,6 +453,12 @@ class TaskManager:
                 with self._cache_lock:
                     self._cache.pop(job_id, None)
                 raise
+        if graph.status == COMPLETED:
+            # full-plan cache hit: every stage was served, no task will
+            # ever run, so no task-status update will drive completion —
+            # close the job out right here (moves it to CompletedJobs,
+            # records SLO/policy observations, emits the job span)
+            self.complete_job(job_id)
         return graph
 
     def get_job_status(self, job_id: str) -> Optional[dict]:
@@ -454,6 +566,13 @@ class TaskManager:
                 # adaptive re-plan outcome (tasks before/after, rewrite
                 # counts) — also persisted inside stage_metrics[__aqe__]
                 row["aqe"] = dict(aqe)
+            served = (getattr(stage, "stage_metrics", None) or {}).get(
+                CACHE_OP
+            )
+            if served:
+                # plan-cache serve outcome: the stage (and its elided
+                # upstream subtree) never dispatched a task
+                row["cache"] = dict(served)
             placement = getattr(stage, "locality_stats", None) or (
                 getattr(stage, "stage_metrics", None) or {}
             ).get(LOCALITY_OP)
@@ -568,10 +687,11 @@ class TaskManager:
             out["error"] = graph.error
         total = done = running_now = 0
         runtimes: List[float] = []
+        cache_elided = getattr(graph, "cache_elided", None) or set()
+        cache_served = getattr(graph, "cache_served", None) or {}
         for sid in sorted(graph.stages):
             stage = graph.stages[sid]
             n = stage.partitions
-            total += n
             row = {
                 "stage_id": sid,
                 "state": type(stage).__name__.replace("Stage", ""),
@@ -580,6 +700,17 @@ class TaskManager:
                 "running": 0,
                 "pending": n,
             }
+            if sid in cache_elided:
+                # upstream of a cache-served stage: will never dispatch a
+                # task — excluded from the task totals so a (partially)
+                # served job's done/total fraction still reaches 1.0
+                row["pending"] = 0
+                row["cache_elided"] = True
+                out["stages"].append(row)
+                continue
+            total += n
+            if sid in cache_served:
+                row["cache_served"] = True
             if isinstance(stage, (RunningStage, CompletedStage)):
                 completed = stage.completed_tasks()
                 row["completed"] = completed
@@ -759,6 +890,7 @@ class TaskManager:
                             newly_quarantined.append(info.executor_id)
                 cancels.extend(graph.take_pending_cancels())
                 feed_pushes.extend(self._collect_feed_pushes(graph))
+                self._cache_sync(graph)
                 self._persist(graph)
         if cancels:
             # after the locks drop: losing duplicate attempts / reaped
@@ -1160,6 +1292,12 @@ class TaskManager:
             td.props[SHUFFLE_PIPELINED] = self.config_overrides[
                 SHUFFLE_PIPELINED
             ]
+        # learned policy overrides (plan-cache layer 2) merged beneath
+        # the session at submit: sessions don't ship them, so stamp any
+        # key the session (or obs forcing above) didn't already set
+        for k, v in self._policy_props.get(task.partition.job_id, {}).items():
+            if k not in td.props:
+                td.props[k] = v
         return td
 
     def _session_settings(self, session_id: str) -> Dict[str, str]:
@@ -1295,9 +1433,62 @@ class TaskManager:
         self.events.emit(
             "job_completed", job=graph.job_id, trace=graph.trace_id, **fields
         )
+        self._policy_record(graph, latency_s)
+
+    def _policy_record(
+        self, graph: ExecutionGraph, latency_s: float
+    ) -> None:
+        """Feed the completed job's measured latency + doctor findings
+        into the per-plan policy store and journal any rollbacks it
+        triggers.  Best-effort: diagnosis runs the same report bundle
+        the REST profile serves, and any failure inside it degrades to
+        recording the latency with no findings."""
+        if self.policy_store is None:
+            return
+        fp = getattr(graph, "policy_fp", "") or ""
+        if not fp:
+            return
+        arm = getattr(graph, "policy_arm", "baseline") or "baseline"
+        self._policy_props.pop(graph.job_id, None)
+        findings: List[str] = []
+        if arm != "applied":
+            # findings steer what gets LEARNED; applied runs only need
+            # the latency sample, so skip the diagnosis cost for them
+            try:
+                from ..obs.doctor import job_report
+                from ..obs.recorder import spans_for_job
+
+                detail = self._detail_of(graph)
+                ev = (
+                    self.events.for_job(graph.job_id)
+                    if getattr(self.events, "enabled", False)
+                    else []
+                )
+                report = job_report(detail, spans_for_job(graph.job_id), ev)
+                findings = [
+                    f.get("code")
+                    for f in report.get("doctor") or []
+                    if f.get("code")
+                ]
+            except Exception:
+                findings = []
+        try:
+            rollbacks = self.policy_store.record_job(
+                fp, arm, latency_s, findings
+            )
+        except Exception:
+            return
+        for rb in rollbacks:
+            self.events.emit(
+                "policy_rollback",
+                job=graph.job_id,
+                trace=graph.trace_id,
+                **rb,
+            )
 
     def fail_job(self, job_id: str, error: str) -> None:
         self._admission_finished(job_id)
+        self._policy_props.pop(job_id, None)
         entry = self._entry(job_id)
         with entry.lock:
             graph = self._load(job_id, entry)

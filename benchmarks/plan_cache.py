@@ -1,0 +1,347 @@
+"""Plan-fingerprint cache + learned per-plan policy A/B (ISSUE 18).
+
+Three entry points:
+
+* :func:`run_cache_bench` — the BENCH_SUITE leg: a zipf-distributed
+  query mix (a hot quartile of templates dominates the stream, the tail
+  appears once or twice) submitted to a real standalone cluster with
+  ``ballista.cache.enabled`` off vs on, IDENTICAL inputs and submission
+  order.  Result identity is enforced per template with a sha256 row
+  fingerprint (PR 10 methodology); the record reports the hot-repeat
+  speedup (repeat submissions of an already-seen plan vs the same
+  submissions on the cache-less leg) and the measured hit rate.
+
+* :func:`run_policy_bench` — the self-tuning leg: a barrier-dominated
+  workload (manufactured straggler map task + reduce-side latency, the
+  ISSUE 15 methodology) submitted repeatedly with all-default settings
+  vs ``ballista.cache.policy.enabled=true``.  The first policy-leg run
+  executes at baseline and the doctor's ``barrier_dominated_job``
+  finding teaches the store ``ballista.shuffle.pipelined=true``; later
+  runs apply it and their median must beat the all-defaults median.
+
+* :func:`run_plan_cache_smoke` — the tier-1 ``--bench-smoke`` gate:
+  tiny inputs; asserts the repeat submission serves from cache with
+  zero dispatched tasks and bit-identical rows, that re-registering
+  different data invalidates the match (fresh, correct results), and
+  that the knob-off leg never consults the cache.
+
+Every query carries a run-unique tag inside a predicate literal so
+fingerprints never collide across bench invocations (the standalone
+scheduler's plan cache lives in a shared work dir and persists).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+import uuid
+
+import pyarrow as pa
+
+BASE_CONFIG = {
+    "ballista.mesh.enable": "false",
+    "ballista.tpu.min_rows": "0",
+    "ballista.shuffle.partitions": "4",
+}
+
+
+def _fingerprint(table: pa.Table) -> str:
+    rows = sorted(zip(*[c.to_pylist() for c in table.columns]))
+    h = hashlib.sha256()
+    for row in rows:
+        h.update(repr(row).encode())
+    return h.hexdigest()
+
+
+def _table(n_rows: int, groups: int = 23) -> pa.Table:
+    return pa.table(
+        {
+            "g": pa.array(
+                [f"g{i % groups}" for i in range(n_rows)], pa.string()
+            ),
+            "x": pa.array(
+                [float(i % 251) for i in range(n_rows)], pa.float64()
+            ),
+        }
+    )
+
+
+def _open_ctx(extra_config: dict, table: pa.Table, num_executors: int = 2):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+
+    cfg = dict(BASE_CONFIG)
+    cfg.update(extra_config)
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig(cfg),
+        num_executors=num_executors,
+        concurrent_tasks=4,
+    )
+    ctx.register_table("t", MemoryTable.from_table(table, 4))
+    return ctx
+
+
+def _cache_counters(ctx) -> dict:
+    scheduler, _ = ctx._standalone_handles
+    snap = scheduler.server.state.plan_cache.snapshot()
+    return {k: snap[k] for k in ("hits", "misses", "stores", "evictions")}
+
+
+def _zipf_sequence(
+    n_templates: int, n_submits: int, seed: int
+) -> list[int]:
+    """Zipf-ish template stream: weight 1/(k+1), so the first quartile
+    of templates dominates the submissions."""
+    rng = random.Random(seed)
+    weights = [1.0 / (k + 1) for k in range(n_templates)]
+    seq = rng.choices(range(n_templates), weights=weights, k=n_submits)
+    # make sure every template appears at least once (the cold tail)
+    for k in range(n_templates):
+        if k not in seq:
+            seq[rng.randrange(n_submits)] = k
+    return seq
+
+
+def run_cache_bench(
+    n_rows: int = 300_000,
+    n_templates: int = 8,
+    n_submits: int = 24,
+    seed: int = 18,
+) -> dict:
+    tag = uuid.uuid4().hex[:8]
+    templates = [
+        f"select g, sum(x) as s, count(x) as n from t "
+        f"where g <> '{tag}-none' and x > {k} group by g"
+        for k in range(n_templates)
+    ]
+    seq = _zipf_sequence(n_templates, n_submits, seed)
+    table = _table(n_rows)
+
+    def leg(cache_on: bool):
+        ctx = _open_ctx(
+            {"ballista.cache.enabled": "true" if cache_on else "false"},
+            table,
+        )
+        try:
+            before = _cache_counters(ctx)
+            walls, shas = [], {}
+            for k in seq:
+                t0 = time.perf_counter()
+                result = ctx.sql(templates[k]).collect()
+                walls.append(time.perf_counter() - t0)
+                sha = _fingerprint(result)
+                assert shas.setdefault(k, sha) == sha, (
+                    f"template {k} row fingerprint drifted within leg"
+                )
+            after = _cache_counters(ctx)
+            counters = {k: after[k] - before[k] for k in after}
+            return walls, shas, counters
+        finally:
+            ctx.close()
+
+    walls_off, shas_off, _ = leg(False)
+    walls_on, shas_on, counters = leg(True)
+    assert shas_off == shas_on, "cache leg changed query results"
+
+    seen: set = set()
+    repeat_idx = []
+    for i, k in enumerate(seq):
+        if k in seen:
+            repeat_idx.append(i)
+        seen.add(k)
+    assert repeat_idx, "zipf stream produced no repeats"
+    hot_off = sum(walls_off[i] for i in repeat_idx) / len(repeat_idx)
+    hot_on = sum(walls_on[i] for i in repeat_idx) / len(repeat_idx)
+    speedup = hot_off / hot_on if hot_on > 0 else float("inf")
+    lookups = counters["hits"] + counters["misses"]
+    hit_rate = counters["hits"] / lookups if lookups else 0.0
+    return {
+        "metric": "plan_cache_hot_speedup",
+        "value": round(speedup, 2),
+        "unit": "x repeat-submission speedup",
+        "vs_baseline": round(speedup, 3),
+        "hit_rate": round(hit_rate, 3),
+        "submits": n_submits,
+        "templates": n_templates,
+        "repeat_submits": len(repeat_idx),
+        "hot_repeat_mean_s_off": round(hot_off, 4),
+        "hot_repeat_mean_s_on": round(hot_on, 4),
+        "wall_total_s_off": round(sum(walls_off), 3),
+        "wall_total_s_on": round(sum(walls_on), 3),
+        "counters": counters,
+        "result_identity": "sha256 row fingerprints equal across legs",
+    }
+
+
+def _run_barrier_job(ctx, sql, straggler_ms: int, reduce_delay_ms: int):
+    from arrow_ballista_tpu.testing import faults
+
+    if straggler_ms:
+        faults.arm(
+            "task.run",
+            times=1,
+            action="delay",
+            delay_ms=straggler_ms,
+            match=lambda stage_id=0, partition_id=0, speculative=False, **_:
+                stage_id == 1 and partition_id == 1 and not speculative,
+        )
+    if reduce_delay_ms:
+        faults.arm(
+            "task.run",
+            times=-1,
+            action="delay",
+            delay_ms=reduce_delay_ms,
+            match=lambda stage_id=0, **_: stage_id == 2,
+        )
+    try:
+        t0 = time.perf_counter()
+        result = ctx.sql(sql).collect()
+        return time.perf_counter() - t0, _fingerprint(result)
+    finally:
+        faults.clear()
+
+
+def run_policy_bench(
+    n_rows: int = 40_000,
+    repeats: int = 5,
+    straggler_ms: int = 900,
+    reduce_delay_ms: int = 300,
+) -> dict:
+    import statistics
+
+    tag = uuid.uuid4().hex[:8]
+    sql = (
+        f"select g, sum(x) as s, count(x) as n from t "
+        f"where g <> '{tag}-none' group by g"
+    )
+    table = _table(n_rows)
+
+    def leg(policy_on: bool):
+        extra = (
+            {
+                "ballista.cache.policy.enabled": "true",
+                "ballista.cache.policy.shadow_fraction": "0",
+            }
+            if policy_on
+            else {}
+        )
+        ctx = _open_ctx(extra, table)
+        walls, shas = [], set()
+        try:
+            for _ in range(repeats):
+                wall, sha = _run_barrier_job(
+                    ctx, sql, straggler_ms, reduce_delay_ms
+                )
+                # the scheduler records findings on completion; drain so
+                # the next submit sees what this one learned
+                scheduler, _ = ctx._standalone_handles
+                scheduler.server.drain()
+                walls.append(wall)
+                shas.add(sha)
+            assert len(shas) == 1, "policy leg changed query results"
+            snap = scheduler.server.state.policy_store.snapshot()
+            return walls, shas.pop(), snap
+        finally:
+            ctx.close()
+
+    walls_def, sha_def, _ = leg(False)
+    walls_pol, sha_pol, snap = leg(True)
+    assert sha_def == sha_pol, "policy overrides changed query results"
+
+    learned = {}
+    for row in snap.get("plans", []):
+        learned.update(row.get("overrides") or {})
+    assert learned.get("ballista.shuffle.pipelined") == "true", (
+        f"policy store learned nothing useful: {snap}"
+    )
+    # run 0 of the policy leg executes at baseline (nothing learned yet);
+    # the applied population is every later run
+    med_def = statistics.median(walls_def)
+    med_applied = statistics.median(walls_pol[1:])
+    speedup = med_def / med_applied if med_applied > 0 else float("inf")
+    return {
+        "metric": "plan_policy_autotune_speedup",
+        "value": round(speedup, 2),
+        "unit": "x vs all-default settings",
+        "vs_baseline": round(speedup, 3),
+        "defaults_median_s": round(med_def, 3),
+        "applied_median_s": round(med_applied, 3),
+        "learned_overrides": learned,
+        "repeats": repeats,
+        "result_identity": "sha256 row fingerprints equal across legs",
+    }
+
+
+def run_plan_cache_smoke(n_rows: int = 4_000) -> dict:
+    """Tier-1 gate: repeat hit with zero dispatched tasks + identical
+    rows, snapshot invalidation, knob-off leg untouched."""
+    from arrow_ballista_tpu.context import MemoryTable
+
+    tag = uuid.uuid4().hex[:8]
+    sql = (
+        f"select g, sum(x) as s, count(x) as n from t "
+        f"where g <> '{tag}-none' group by g"
+    )
+
+    # knob-off leg: two submissions, cache never consulted
+    ctx = _open_ctx({"ballista.cache.enabled": "false"}, _table(n_rows))
+    try:
+        before = _cache_counters(ctx)
+        off_shas = {_fingerprint(ctx.sql(sql).collect()) for _ in range(2)}
+        delta = {
+            k: v - before[k] for k, v in _cache_counters(ctx).items()
+        }
+        assert len(off_shas) == 1
+        assert not any(delta.values()), (
+            f"knob-off leg touched the plan cache: {delta}"
+        )
+    finally:
+        ctx.close()
+
+    ctx = _open_ctx({"ballista.cache.enabled": "true"}, _table(n_rows))
+    try:
+        before = _cache_counters(ctx)
+        sha1 = _fingerprint(ctx.sql(sql).collect())
+        j1 = sorted(ctx._job_ids)[0]
+        sha2 = _fingerprint(ctx.sql(sql).collect())
+        (j2,) = [j for j in ctx._job_ids if j != j1]
+        assert sha1 == sha2, "cache hit changed query results"
+        assert sha1 in off_shas, "cache leg differs from knob-off leg"
+        scheduler, _ = ctx._standalone_handles
+        scheduler.server.drain()
+        tm = scheduler.server.state.task_manager
+        d2 = tm.get_job_detail(j2)
+        assert d2["state"] == "completed"
+        served = [r for r in d2["stages"] if r.get("cache")]
+        assert served, f"repeat submit dispatched tasks: {d2['stages']}"
+        delta = {
+            k: v - before[k] for k, v in _cache_counters(ctx).items()
+        }
+        assert delta["hits"] >= 1 and delta["stores"] >= 1, delta
+
+        # invalidation: different data under the same name and shape
+        # must recompute, not serve the stale entry
+        flipped = pa.table(
+            {
+                "g": _table(n_rows)["g"],
+                "x": pa.array(
+                    [float((i + 1) % 251) for i in range(n_rows)],
+                    pa.float64(),
+                ),
+            }
+        )
+        ctx.register_table("t", MemoryTable.from_table(flipped, 4))
+        sha3 = _fingerprint(ctx.sql(sql).collect())
+        assert sha3 != sha1, "stale cached result served after data change"
+        return {
+            "hit_stages": [r["stage_id"] for r in served],
+            "cache_bytes": sum(
+                (r["cache"] or {}).get("bytes", 0) for r in served
+            ),
+            "counters": delta,
+            "invalidated_on_data_change": True,
+        }
+    finally:
+        ctx.close()

@@ -111,6 +111,11 @@ def _fmt_value(rec: Optional[dict]) -> str:
     before, after = rec.get("tasks_before"), rec.get("tasks_after")
     if isinstance(before, int) and isinstance(after, int):
         s += f" [{before}→{after} tasks]"
+    # plan-cache records carry the measured hit rate — the speedup only
+    # means something next to how often the cache actually served
+    hit_rate = rec.get("hit_rate")
+    if isinstance(hit_rate, (int, float)):
+        s += f" [hit rate {100 * hit_rate:.0f}%]"
     # wall-clock attribution: the obs leg's record carries the measured
     # job's category breakdown — show where the time went, top two
     breakdown = rec.get("breakdown")

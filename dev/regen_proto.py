@@ -89,6 +89,11 @@ NEW_FIELDS = [
     # its producer completed carries no static locations — it TAILS the
     # scheduler's shuffle-location feed at execution time
     ("ShuffleReaderExecNode", "tail", 6, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+    # plan-fingerprint result/shuffle cache (ISSUE 18): cache-served and
+    # cache-elided stage ids persist with the graph, so restart/HA
+    # adoption keeps skipping the elided subtree instead of waiting
+    # forever on inputs nobody will produce
+    ("ExecutionGraphProto", "cache_json", 19, F.TYPE_STRING, F.LABEL_OPTIONAL),
 ]
 
 # Messages added by descriptor mutation (same idempotent scheme as

@@ -127,6 +127,18 @@ print(json.dumps({"bench_smoke": "autoscaler", **run_autoscaler_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.plan_cache import run_plan_cache_smoke
+
+# plan-cache smoke: repeat submission of an identical query must serve
+# from the fingerprint cache with zero dispatched tasks and identical
+# rows; re-registering different data must invalidate; the knob-off leg
+# must never touch the cache (asserted inside)
+print(json.dumps({"bench_smoke": "plan_cache", **run_plan_cache_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi
