@@ -35,6 +35,7 @@ TPU_HIGHCARD_MODE = "ballista.tpu.highcard_mode"
 TPU_DEVICE_ENCODE = "ballista.tpu.device_encode"
 TPU_KEYED_BUFFER_MB = "ballista.tpu.keyed_buffer_mb"
 TPU_READAHEAD = "ballista.tpu.readahead"
+TPU_WHOLE_STAGE_FUSION = "ballista.tpu.whole_stage_fusion"
 MESH_ENABLE = "ballista.mesh.enable"
 MESH_DEVICES = "ballista.mesh.devices"
 MESH_EXCHANGE_MAX_ROWS = "ballista.mesh.exchange_max_rows"
@@ -332,6 +333,19 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "(overlaps scan/decode IO with device compute); 0 disables",
             int,
             "2",
+        ),
+        ConfigEntry(
+            TPU_WHOLE_STAGE_FUSION,
+            "compile a fusion-eligible map stage (scan→filter→project→"
+            "partial-agg, plus the shuffle partition-id column when a "
+            "shuffle hint is installed) into ONE jitted dispatch instead "
+            "of per-operator dispatches; segment boundaries come from the "
+            "measured routing table (fusion_max_ops/fusion_min_rows) and "
+            "any trace failure degrades segment-by-segment to the "
+            "per-operator path; off keeps today's dispatch sequence "
+            "byte-identical",
+            _parse_bool,
+            "false",
         ),
         ConfigEntry(
             MESH_ENABLE,
@@ -1083,6 +1097,10 @@ class BallistaConfig:
     @property
     def tpu_readahead(self) -> int:
         return self._get(TPU_READAHEAD)
+
+    @property
+    def tpu_whole_stage_fusion(self) -> bool:
+        return self._get(TPU_WHOLE_STAGE_FUSION)
 
     @property
     def tpu_min_rows(self) -> int:

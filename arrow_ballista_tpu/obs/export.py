@@ -496,6 +496,22 @@ def job_profile(detail: dict, spans: List[dict]) -> dict:
             }
             if any(keyed.values()):
                 row["tpu"].update(keyed)
+            # whole-stage fusion (ballista.tpu.whole_stage_fusion):
+            # segments the planner produced and the widest fused run —
+            # counters sum across a stage's tasks, so on a 1-partition
+            # stage fused_segments == 1 pins compute + pid derivation
+            # in ONE dispatch
+            fusion = {
+                "fused_segments": tpu.get("fused_segments", 0),
+                "fused_ops_per_dispatch": tpu.get(
+                    "fused_ops_per_dispatch", 0
+                ),
+                "fused_dispatches": tpu.get("fused_dispatches", 0),
+                "fused_pid_in_kernel": tpu.get("fused_pid_in_kernel", 0),
+                "fused_degraded": tpu.get("fused_degraded", 0),
+            }
+            if any(fusion.values()):
+                row["tpu"].update(fusion)
         stages.append(row)
 
     out = {

@@ -2435,38 +2435,48 @@ def _add64_limbs(ahi, alo, bhi, blo):
 _PID_KERNEL_CACHE: dict = {}
 
 
-def make_partition_id_kernel(n_cols: int, n_out: int):
-    """Jitted ``(hi, lo, is_null) x n_cols -> int32 partition ids``.
+def partition_id_hash(args, n_out: int):
+    """Traceable body of the partition-id kernel: flattened
+    ``(hi, lo, is_null)`` limb triples -> int32 partition ids.
 
     Per column: ``hv = (x * 0x9E3779B97F4A7C15) mod 2^64``,
     ``hv ^= hv >> 32`` (both limbs uint32: the xorshift is one limb
     xor), nulls replaced by the host's constant; columns combine as
     ``h = h * 31 + hv``; the result is ``h mod n_out`` with the 64-bit
-    mod folded through ``2^32 mod n``.
+    mod folded through ``2^32 mod n``.  Usable inside a larger jit (the
+    whole-stage fused runner derives the shuffle pid column in the same
+    trace as the agg kernels) or via the jitted wrapper below.
     """
-    key = (n_cols, n_out)
-    cached = _PID_KERNEL_CACHE.get(key)
-    if cached is not None:
-        return cached
+    n_cols = len(args) // 3
     mul_hi = jnp.uint32(_HASH_MUL[0])
     mul_lo = jnp.uint32(_HASH_MUL[1])
     null_hi = jnp.uint32(_NULL_HASH[0])
     null_lo = jnp.uint32(_NULL_HASH[1])
     m = jnp.uint32(n_out)
     pow32_mod = jnp.uint32((1 << 32) % n_out)
+    hhi = jnp.zeros_like(args[0])
+    hlo = jnp.zeros_like(args[0])
+    for c in range(n_cols):
+        vhi, vlo, is_null = args[3 * c : 3 * c + 3]
+        phi, plo = _mul64_limbs(vhi, vlo, mul_hi, mul_lo)
+        plo = plo ^ phi  # hv ^= hv >> 32
+        phi = jnp.where(is_null, null_hi, phi)
+        plo = jnp.where(is_null, null_lo, plo)
+        thi, tlo = _mul64_limbs(hhi, hlo, jnp.uint32(0), jnp.uint32(31))
+        hhi, hlo = _add64_limbs(thi, tlo, phi, plo)
+    return (((hhi % m) * pow32_mod + (hlo % m)) % m).astype(jnp.int32)
+
+
+def make_partition_id_kernel(n_cols: int, n_out: int):
+    """Jitted ``(hi, lo, is_null) x n_cols -> int32 partition ids``
+    (see :func:`partition_id_hash` for the hash definition)."""
+    key = (n_cols, n_out)
+    cached = _PID_KERNEL_CACHE.get(key)
+    if cached is not None:
+        return cached
 
     def kernel(*args):
-        hhi = jnp.zeros_like(args[0])
-        hlo = jnp.zeros_like(args[0])
-        for c in range(n_cols):
-            vhi, vlo, is_null = args[3 * c : 3 * c + 3]
-            phi, plo = _mul64_limbs(vhi, vlo, mul_hi, mul_lo)
-            plo = plo ^ phi  # hv ^= hv >> 32
-            phi = jnp.where(is_null, null_hi, phi)
-            plo = jnp.where(is_null, null_lo, plo)
-            thi, tlo = _mul64_limbs(hhi, hlo, jnp.uint32(0), jnp.uint32(31))
-            hhi, hlo = _add64_limbs(thi, tlo, phi, plo)
-        return (((hhi % m) * pow32_mod + (hlo % m)) % m).astype(jnp.int32)
+        return partition_id_hash(args, n_out)
 
     cached = jax.jit(kernel)
     _PID_KERNEL_CACHE[key] = cached
@@ -2514,6 +2524,22 @@ def _pid_limbs(v: pa.Array) -> Optional[tuple]:
     hi = (x >> np.uint64(32)).astype(np.uint32)
     lo = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     return hi, lo, is_null
+
+
+def pid_limb_args(columns, pad_to: int) -> Optional[list]:
+    """Flattened ``(hi, lo, is_null)`` limb arrays, padded to ``pad_to``,
+    for a list of arrow key columns — or None when any column has no
+    device hash.  Host prep for :func:`partition_id_hash` inside a
+    larger trace (the whole-stage fused runner derives the shuffle pid
+    lane in the same dispatch as the agg kernels)."""
+    args: list = []
+    for col in columns:
+        limbs = _pid_limbs(col)
+        if limbs is None:
+            return None
+        for a in limbs:
+            args.append(_pad(a, pad_to))
+    return args or None
 
 
 def device_partition_ids(

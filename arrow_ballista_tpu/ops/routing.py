@@ -43,13 +43,24 @@ SCHEMA = "ballista.routing/v1"
 #     to the device-keyed fused path: False everywhere measured so far
 #     (KERNELBENCH_r05 segment_reduce: keyed 2.2M rows/s vs scatter
 #     140-240M on the cpu platform; BENCH_SUITE_r05 q3 SF10 keyed =
-#     0.036x CPU on chip).
+#     0.036x CPU on chip);
+#   fusion_max_ops — widest operator run the whole-stage fusion planner
+#     packs into one traced segment before forcing a capacity cut (the
+#     pre-table _FUSED_MAX_ENTRIES unroll discipline applied to operator
+#     count: XLA programs linear in fused-op count stay cheap to this
+#     width on every platform measured);
+#   fusion_min_rows — below this many stage input rows a fused dispatch
+#     does not amortize its trace/launch overhead and the per-batch
+#     streamed path runs instead (matches the pre-table small-input
+#     routing floor).
 _DEFAULTS = {
     "matmul_max_cap": 8192,
     "matmul_max_elems": 1 << 36,
     "highcard_min_groups": 1 << 16,
     "highcard_ratio": 0.05,
     "keyed_route_auto": False,
+    "fusion_max_ops": 8,
+    "fusion_min_rows": 2048,
 }
 
 # the emitted per-platform section: exactly these keys (a unit test pins
@@ -64,6 +75,8 @@ class RoutingTable:
     highcard_min_groups: int
     highcard_ratio: float
     keyed_route_auto: bool
+    fusion_max_ops: int
+    fusion_min_rows: int
     source: str = "builtin defaults (pre-table ops/ constants)"
 
 

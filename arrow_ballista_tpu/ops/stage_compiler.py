@@ -154,6 +154,28 @@ def _highcard_ratio() -> float:
     from . import routing
 
     return routing.value("highcard_ratio")
+
+
+# Whole-stage fusion bounds (ballista.tpu.whole_stage_fusion) load from
+# the same measured table; non-None module values override (tests).
+_FUSION_MAX_OPS: Optional[int] = None
+_FUSION_MIN_ROWS: Optional[int] = None
+
+
+def _fusion_max_ops() -> int:
+    if _FUSION_MAX_OPS is not None:
+        return _FUSION_MAX_OPS
+    from . import routing
+
+    return routing.value("fusion_max_ops")
+
+
+def _fusion_min_rows() -> int:
+    if _FUSION_MIN_ROWS is not None:
+        return _FUSION_MIN_ROWS
+    from . import routing
+
+    return routing.value("fusion_min_rows")
 # Build-key spans up to this many slots use the dense direct-probe join
 # table ([span] i32 = 256 MiB HBM at the cap) instead of searchsorted's
 # log2(m) sequential gather passes (BENCH_SUITE_r05 starjoin row).
@@ -1008,6 +1030,12 @@ class TpuStageExec(ExecutionPlan):
         # (exprs, n_out) installed by a downstream ShuffleWriterExec so
         # the hash-partition ids ride the device instead of the host
         self._shuffle_hint = None
+        # whole-stage fusion (ballista.tpu.whole_stage_fusion): set per
+        # execute from the ops/fusion.py plan — _fuse_pid asks the fused
+        # runner to derive the shuffle pid column inside its trace, and
+        # _fused_pids carries the result to _materialize
+        self._fuse_pid = False
+        self._fused_pids = None
 
         # raw kernel kept for mesh gang execution: shard_map needs the
         # untraced function to wrap with the cross-chip reduction
@@ -1133,6 +1161,45 @@ class TpuStageExec(ExecutionPlan):
         bit-for-bit by construction; keys the kernel can't hash (strings,
         computed expressions) simply leave the hint unused."""
         self._shuffle_hint = (list(exprs), int(n_out))
+
+    def _fused_pid_spec(self):
+        """``(slots, n_out)`` when the shuffle pid column can be derived
+        INSIDE the fused dispatch, else None.
+
+        Eligible exactly when every hint key is a host-encoded group
+        column with a device-hashable type: the group table then holds
+        every kept group's key codes at dispatch time, so decoding them
+        feeds the same ``partition_id_hash`` the post-materialize kernel
+        would run — over identical values, hence bit-identical pids —
+        without a second dispatch.  ``slots`` is ``[(enc_slot, out_pos),
+        ...]`` in hint-key order (the hash combine is order-sensitive).
+        """
+        hint = self._shuffle_hint
+        if hint is None or not self.fused.group_exprs:
+            return None
+        exprs, n_out = hint
+        if not exprs or n_out <= 0 or n_out > K.PID_MAX_PARTITIONS:
+            return None
+        slots = []
+        for e in exprs:
+            if not isinstance(e, pe.Col) or not (
+                0 <= e.index < len(self._group_plan)
+            ):
+                return None
+            kind, slot = self._group_plan[e.index]
+            if kind != "enc":
+                return None
+            t = self._schema.field(e.index).type
+            if not (
+                pa.types.is_integer(t)
+                or pa.types.is_floating(t)
+                or pa.types.is_boolean(t)
+                or pa.types.is_date(t)
+                or pa.types.is_timestamp(t)
+            ):
+                return None
+            slots.append((slot, e.index))
+        return slots, n_out
 
     # ------------------------------------------------------------ execute
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
@@ -1295,6 +1362,28 @@ class TpuStageExec(ExecutionPlan):
             if fused.join is None and not self._needs_keyed
             else None
         )
+        # whole-stage fusion plan (ballista.tpu.whole_stage_fusion;
+        # default off keeps today's dispatch sequence byte-identical):
+        # when every compute op lands in segment 0, batches are retained
+        # and the stage executes as ONE fused dispatch even without a
+        # cache key — with the shuffle pid column derived inside the
+        # same trace when the pid op fused too
+        self._fuse_pid = False
+        fusion_retain = False
+        if (
+            fused.join is None
+            and not self._needs_keyed
+            and self.config.tpu_whole_stage_fusion
+        ):
+            from .fusion import plan_segments, stage_ops
+
+            fplan = plan_segments(stage_ops(self), _fusion_max_ops())
+            self.metrics.add("fused_segments", len(fplan.segments))
+            self.metrics.add(
+                "fused_ops_per_dispatch", fplan.max_segment_ops
+            )
+            fusion_retain = fplan.compute_fused()
+            self._fuse_pid = fplan.pid_fused()
         if ck is not None:
             cached = device_cache.get(ck[0], partition, ck[1])
             if cached is not None:
@@ -1304,6 +1393,7 @@ class TpuStageExec(ExecutionPlan):
                         host_states = self._run_fused(
                             entries, cap,
                             group_table if fused.group_exprs else None,
+                            key_encoders,
                         )
                 self.metrics.add("cache_hits", 1)
                 yield from self._materialize(
@@ -1482,6 +1572,25 @@ class TpuStageExec(ExecutionPlan):
                         batch, n, n_pad, build
                     )
                 with self.metrics.timer("device_time_ns"):
+                    if ck is None and fusion_retain:
+                        # fusion-only retention (whole-stage fusion on a
+                        # non-cache-eligible stage): the entries are
+                        # consumed ONCE by the fused dispatch right
+                        # after this loop, so everything stays on host —
+                        # no per-batch eager device op at all; the one
+                        # jitted call transfers its operands in bulk
+                        tail = np.arange(n_pad, dtype=np.int32) < n
+                        args = [
+                            tail if i in trivial_idx else a
+                            for i, a in enumerate(args)
+                        ]
+                        seg_h = (
+                            np.zeros(n_pad, dtype=np.int32)
+                            if seg is None
+                            else seg
+                        )
+                        entries.append((seg_h, tail, args))
+                        continue
                     # device-built row tail mask, shared by the global
                     # valid slot and every all-true leaf companion: two
                     # eager ops replace n_pad*(1+n_trivial) host→HBM
@@ -1497,9 +1606,10 @@ class TpuStageExec(ExecutionPlan):
                         else jax.device_put(seg)
                     )
                     if ck is not None:
-                        # retained for the device cache AND the fused
-                        # single-dispatch run after the loop — no
-                        # per-batch kernel dispatch at all
+                        # retained for the device cache (and the fused
+                        # single-dispatch run after the loop): each arg
+                        # pins on device because the entries outlive
+                        # this query
                         args = [
                             a if a is tail else jax.device_put(a)
                             for a in args
@@ -1520,10 +1630,19 @@ class TpuStageExec(ExecutionPlan):
             # lives INSIDE the device timer: device_time_ns covers
             # queue + compute + result fetch (VERDICT round-2 weakness #2)
             with self.metrics.timer("device_time_ns"):
-                if ck is not None and entries:
+                if (ck is not None or fusion_retain) and entries:
                     host_states = self._run_fused(
                         entries, cap,
                         group_table if fused.group_exprs else None,
+                        key_encoders,
+                        # below the measured amortization floor a fused
+                        # dispatch costs more than it saves: stream the
+                        # retained entries per-batch instead (the cache
+                        # path keeps its unconditional fused call)
+                        stream=(
+                            ck is None
+                            and n_rows_in < _fusion_min_rows()
+                        ),
                     )
                 else:
                     host_states = self._fetch_states(
@@ -2358,7 +2477,10 @@ class TpuStageExec(ExecutionPlan):
         packed = K.pack_for_fetch(self.specs, acc, self._mode, keep=keep)
         return K.unpack_host(self.specs, np.asarray(packed), self._mode)
 
-    def _run_fused(self, entries, cap: int, group_table) -> Optional[list]:
+    def _run_fused(
+        self, entries, cap: int, group_table, key_encoders=None,
+        stream: bool = False,
+    ) -> Optional[list]:
         """ONE jitted dispatch for the whole query over retained entries:
         per-entry kernel → cross-entry combine → packed fetch layout.
 
@@ -2378,8 +2500,9 @@ class TpuStageExec(ExecutionPlan):
         # join-kernel variant must never replay through this runner,
         # which builds the sorted-probe form
         assert self.fused.join is None, "fused runner is join-free"
+        self._fused_pids = None
         n_groups = group_table.n_groups if group_table is not None else None
-        if len(entries) > _FUSED_MAX_ENTRIES:
+        if stream or len(entries) > _FUSED_MAX_ENTRIES:
             acc = None
             _, kernel = self._kernel_for(cap)
             for seg, valid, args in entries:
@@ -2387,31 +2510,85 @@ class TpuStageExec(ExecutionPlan):
                 acc = K.combine_states(self.specs, acc, out, self._mode)
             return self._fetch_states(acc, n_groups)
         keep = None if n_groups is None else _keep_bucket(n_groups)
+        # shuffle-pid-in-kernel (whole-stage fusion): the group table is
+        # complete at dispatch time, so every group's hint-key values
+        # decode NOW and their hash rides the same trace — the stage's
+        # compute + partition-id derivation become ONE dispatch
+        pid_args = None
+        pid_static = None
+        if (
+            self._fuse_pid
+            and group_table is not None
+            and key_encoders is not None
+        ):
+            spec = self._fused_pid_spec()
+            if spec is not None:
+                slots, n_out = spec
+                arrs = [
+                    key_encoders[slot].decode(
+                        group_table.codes_for(np.arange(n_groups), slot),
+                        self._schema.field(pos).type,
+                    )
+                    for slot, pos in slots
+                ]
+                pid_args = K.pid_limb_args(arrs, min(keep, cap))
+                if pid_args is not None:
+                    pid_static = (len(slots), n_out)
         shapes = tuple(int(e[1].shape[0]) for e in entries)
         n_args = len(entries[0][2])
-        fn = self._fused_for(cap, shapes, n_args, keep)
+        fn = self._fused_for(cap, shapes, n_args, keep, pid_static)
         flat = []
         for seg, valid, args in entries:
             flat.append(seg)
             flat.append(valid)
             flat.extend(args)
-        packed = fn(*flat)
+        if pid_static is not None:
+            flat.extend(pid_args)
+        try:
+            packed = fn(*flat)
+        except Exception:
+            # trace/compile failure of the unrolled program: degrade to
+            # the per-batch dispatch loop instead of failing the stage
+            # (knob-off keeps the pre-fusion failure path: the execute()
+            # ladder falls back to the CPU operators)
+            if not self.config.tpu_whole_stage_fusion:
+                raise
+            self.metrics.add("fused_degraded", 1)
+            acc = None
+            _, kernel = self._kernel_for(cap)
+            for seg, valid, args in entries:
+                out = kernel(seg, valid, *args)
+                acc = K.combine_states(self.specs, acc, out, self._mode)
+            return self._fetch_states(acc, n_groups)
         self.metrics.add("fused_dispatches", 1)
-        return K.unpack_host(self.specs, np.asarray(packed), self._mode)
+        packed_np = np.asarray(packed)
+        if pid_static is not None:
+            # last packed row is the int pid lane; peel it for
+            # _materialize and hand the rest to the normal unpack
+            self._fused_pids = packed_np[-1].astype(np.int64)
+            packed_np = packed_np[:-1]
+            self.metrics.add("fused_pid_in_kernel", 1)
+        return K.unpack_host(self.specs, packed_np, self._mode)
 
-    def _fused_for(self, cap: int, shapes: tuple, n_args: int, keep):
+    def _fused_for(
+        self, cap: int, shapes: tuple, n_args: int, keep, pid=None
+    ):
         """Jitted (kernel×entries → combine → pack) runner, cached on the
         stage signature + per-entry row buckets (pow2, so distinct traces
-        stay logarithmic in partition size)."""
+        stay logarithmic in partition size).  ``pid`` (static
+        ``(n_key_cols, n_out)`` or None) extends the trace with the
+        shuffle partition-id hash over trailing limb args, appended to
+        the packed fetch as one extra integer row."""
         key = (
             self._sig[:2] + (cap,) + self._sig[3:]
-            + ("fusedall", shapes, n_args, keep)
+            + ("fusedall", shapes, n_args, keep, pid)
             + K.algo_cache_token()
         )
         cached = _KERNEL_CACHE.get(key)
         self._note_kernel_cache(cached is not None)
         if cached is None:
             import jax
+            import jax.numpy as jnp
 
             raw, _ = self._kernel_for(cap)
             specs, mode = self.specs, self._mode
@@ -2426,7 +2603,16 @@ class TpuStageExec(ExecutionPlan):
                     args = flat[i * stride + 2:(i + 1) * stride]
                     out = raw(seg, valid, *args)
                     acc = K.combine_states(specs, acc, out, mode)
-                return K.pack_states(specs, acc, mode, keep)
+                packed = K.pack_states(specs, acc, mode, keep)
+                if pid is not None:
+                    pids = K.partition_id_hash(
+                        flat[n_entries * stride:], pid[1]
+                    )
+                    packed = jnp.concatenate(
+                        [packed, pids[None, :].astype(packed.dtype)],
+                        axis=0,
+                    )
+                return packed
 
             cached = jax.jit(fn)
             _KERNEL_CACHE[key] = cached
@@ -2754,7 +2940,16 @@ class TpuStageExec(ExecutionPlan):
         self.metrics.add("input_rows", n_rows_in)
         hint = self._shuffle_hint
         if hint is not None and out.num_rows:
-            pids = K.device_partition_ids(out, hint[0], hint[1])
+            fp = self._fused_pids
+            if fp is not None:
+                # already derived INSIDE the fused dispatch over the full
+                # group table — select the kept groups' ids; bit-identical
+                # to the separate kernel by construction (same limb prep,
+                # same hash, identical decoded key values)
+                self._fused_pids = None
+                pids = fp[:n_groups][keep]
+            else:
+                pids = K.device_partition_ids(out, hint[0], hint[1])
             if pids is not None:
                 from ..exec.operators import SHUFFLE_PID_COLUMN
 

@@ -157,6 +157,14 @@ async function showDetail(jobId) {
       ? `device-encode ${tm.device_encode_batches || 0} batch(es) · ` +
         `${tm.fused_keyed_dispatches || 0} fused keyed dispatch(es)`
       : '';
+    // whole-stage fusion badge: segments the fusion planner produced and
+    // the widest fused run (fused-pid marks pid derivation in-trace)
+    const fusion = tm.fused_segments
+      ? `fused ${tm.fused_segments} segment(s) · ` +
+        `${tm.fused_ops_per_dispatch || 0} ops/dispatch` +
+        (tm.fused_pid_in_kernel ? ' · fused-pid' : '') +
+        (tm.fused_degraded ? ` · ${tm.fused_degraded} degraded` : '')
+      : '';
     const opMets = s.metrics
       ? esc(Object.entries(s.metrics)
           // __-prefixed operators are the skew-analytics payloads
@@ -170,7 +178,7 @@ async function showDetail(jobId) {
     // from a fingerprint-matched prior run — zero tasks dispatched
     const cached = s.cache
       ? `served from cache (${s.cache.bytes || 0} B)` : '';
-    const mets = [cached, aqe, keyed, opMets].filter(Boolean).join(' · ') || '—';
+    const mets = [cached, aqe, keyed, fusion, opMets].filter(Boolean).join(' · ') || '—';
     html += `<tr><td>${s.stage_id}</td><td>${esc(s.state)}</td>` +
             `<td>${done}</td>` +
             `<td><span class="bar"><i style="width:${pct}%"></i></span></td>` +

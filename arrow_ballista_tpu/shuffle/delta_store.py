@@ -200,8 +200,38 @@ def tail_locations(
     metrics=None,
     poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
 ) -> Iterator[object]:
+    """Yield ``partition``'s map-side locations one by one as they land
+    (flattening wrapper over :func:`tail_location_batches`)."""
+    for chunk in tail_location_batches(
+        job_id,
+        stage_id,
+        partition,
+        stop_event=stop_event,
+        cancel_event=cancel_event,
+        metrics=metrics,
+        poll_interval_s=poll_interval_s,
+    ):
+        yield from chunk
+
+
+def tail_location_batches(
+    job_id: str,
+    stage_id: int,
+    partition: int,
+    stop_event: Optional[threading.Event] = None,
+    cancel_event: Optional[threading.Event] = None,
+    metrics=None,
+    poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+) -> Iterator[list]:
     """Yield ``partition``'s map-side locations as they land in the feed,
     finishing when the feed is complete and drained.
+
+    Each yielded item is one backlog DRAIN: every location that had
+    accumulated in the feed since the previous drain, already filtered
+    to ``partition``.  A consumer that kept pace sees singleton lists; a
+    consumer that fell behind (slow first fetch, late start against an
+    almost-complete feed) sees the whole backlog at once and can fan it
+    out over a concurrent fetch pool instead of draining in feed order.
 
     Starvation (stall-on-producer) is accounted into the owning
     operator's ``fetch_wait_time_ns`` so the doctor's attribution stays
@@ -263,10 +293,14 @@ def tail_locations(
             if ev is not None and ev.is_set():
                 raise exc
         if batch:
-            for loc in batch:
-                pid = getattr(loc, "partition_id", None)
-                if pid is None or pid.partition_id == partition:
-                    yield loc
+            chunk = [
+                loc
+                for loc in batch
+                if (pid := getattr(loc, "partition_id", None)) is None
+                or pid.partition_id == partition
+            ]
+            if chunk:
+                yield chunk
             continue
         if still_starved:
             # nothing arrived inside the wait window: fall back to a poll

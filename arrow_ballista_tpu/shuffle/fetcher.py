@@ -971,11 +971,17 @@ class TailingShuffleFetcher:
     scheduler's per-producer feed (push notifications in push mode,
     ``GetShuffleLocationDelta`` polls in pull mode), and this fetcher
     streams each location the moment it lands, finishing when the feed
-    reports complete.  Locations are fetched sequentially in feed order
-    (they trickle in as map tasks commit, so a worker pool would mostly
-    idle); each one still gets the full :func:`retrying_fetch` treatment
-    — retry/backoff, replica failover, mid-stream resume and the
-    structured ``ShuffleFetchFailed`` that drives producer recovery.
+    reports complete.  A consumer keeping pace with its producers sees
+    one location per feed drain and fetches it inline; a consumer that
+    fell behind (slow first fetch, late start against an almost-complete
+    feed) drains a multi-location BACKLOG and fans it out over the
+    standard :class:`ShuffleFetcher` pool so the wire is never idle
+    while queued locations wait their turn
+    (``ballista.shuffle.fetch_concurrency=1`` pins the ordered
+    sequential drain).  Either way each location gets the full
+    :func:`retrying_fetch` treatment — retry/backoff, replica failover,
+    mid-stream resume and the structured ``ShuffleFetchFailed`` that
+    drives producer recovery.
 
     Stall-on-producer time lands in ``fetch_wait_time_ns`` (accounted by
     the delta store's tail), so the query doctor's attribution of a
@@ -1040,7 +1046,7 @@ class TailingShuffleFetcher:
             with span_cm as sp:
                 total = 0
                 n_locs = 0
-                for loc in delta_store.tail_locations(
+                for chunk in delta_store.tail_location_batches(
                     self._job_id,
                     self._stage_id,
                     self._partition,
@@ -1048,25 +1054,47 @@ class TailingShuffleFetcher:
                     cancel_event=self._cancel,
                     metrics=self._metrics,
                 ):
-                    t0 = time.monotonic_ns()
-                    for batch in retrying_fetch(
-                        loc,
-                        self._policy,
-                        self._metrics,
-                        fetch_fn=self._fetch_fn,
-                        stop_event=self._stop,
-                    ):
-                        if self._error is not None:
-                            raise self._error
-                        yield batch
-                        nbytes = int(getattr(batch, "nbytes", 0) or 0)
-                        self._metrics.add("bytes_fetched", nbytes)
-                        total += nbytes
-                    self._metrics.add(
-                        "fetch_time_ns", time.monotonic_ns() - t0
-                    )
-                    self._metrics.add("locations_fetched", 1)
-                    n_locs += 1
+                    if len(chunk) > 1 and self._policy.concurrency > 1:
+                        # backlog drain: fan the queued locations out over
+                        # the concurrent pool (it accounts bytes/locations/
+                        # fetch_time/peak itself; pass the unwrapped
+                        # metrics so the registry tee isn't paid twice)
+                        pool = ShuffleFetcher(
+                            chunk,
+                            self._policy,
+                            self._metrics._inner,
+                            cancel_event=self._cancel,
+                            fetch_fn=self._fetch_fn,
+                            owner=self.owner,
+                            trace_parent=self._trace_parent,
+                        )
+                        for batch in pool:
+                            if self._error is not None:
+                                raise self._error
+                            yield batch
+                            total += int(getattr(batch, "nbytes", 0) or 0)
+                        n_locs += len(chunk)
+                        continue
+                    for loc in chunk:
+                        t0 = time.monotonic_ns()
+                        for batch in retrying_fetch(
+                            loc,
+                            self._policy,
+                            self._metrics,
+                            fetch_fn=self._fetch_fn,
+                            stop_event=self._stop,
+                        ):
+                            if self._error is not None:
+                                raise self._error
+                            yield batch
+                            nbytes = int(getattr(batch, "nbytes", 0) or 0)
+                            self._metrics.add("bytes_fetched", nbytes)
+                            total += nbytes
+                        self._metrics.add(
+                            "fetch_time_ns", time.monotonic_ns() - t0
+                        )
+                        self._metrics.add("locations_fetched", 1)
+                        n_locs += 1
                 if self._error is not None:
                     raise self._error
                 sp.set_attr("bytes", total)

@@ -54,8 +54,15 @@ is appended to BENCH_SUITE_r05.json so the results ship with the repo.
   identical inputs — sha fingerprint identity enforced, wall-clock and
   the doctor's measured barrier_wait before/after in the record
 
+  plus the whole-stage fusion A/B (fusion_q3_rows_per_sec /
+  fusion_scan_rows_per_sec): q3's map-stage shape and a scan-heavy
+  scalar shape with ballista.tpu.whole_stage_fusion on vs off on
+  identical inputs — ONE jitted dispatch per map task vs the per-batch
+  dispatch sequence, sha row-fingerprint identity enforced, with the
+  fused_segments / fused_ops_per_dispatch plan shape in the record
+
 Usage: python bench_suite.py
-[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|pipelined|obs|all]
+[q6|q3|starjoin|full22|window|h2o|shuffle|aqe|keyed|concurrent|pipelined|obs|fusion|all]
 (default all)
 """
 
@@ -752,6 +759,39 @@ def bench_pipelined() -> None:
     )
 
 
+def bench_fusion() -> None:
+    """Whole-stage fusion A/B (ISSUE 19): q3-shaped grouped map stage
+    and a scan-heavy scalar shape, ballista.tpu.whole_stage_fusion on vs
+    off on identical inputs — the fused leg plans one segment and runs
+    each task's kernels + combine + pack as ONE jitted dispatch, with
+    bit-identical results enforced per record."""
+    from benchmarks.whole_stage_fusion import (
+        run_fusion_q3_bench,
+        run_fusion_scan_bench,
+    )
+
+    _emit(
+        run_fusion_q3_bench(
+            n_rows=int(float(os.environ.get("BENCH_FUSION_ROWS", "131072"))),
+            batch_rows=int(
+                os.environ.get("BENCH_FUSION_BATCH_ROWS", "4096")
+            ),
+            iters=int(os.environ.get("BENCH_FUSION_ITERS", "5")),
+        )
+    )
+    _emit(
+        run_fusion_scan_bench(
+            n_rows=int(
+                float(os.environ.get("BENCH_FUSION_SCAN_ROWS", "32768"))
+            ),
+            batch_rows=int(
+                os.environ.get("BENCH_FUSION_SCAN_BATCH_ROWS", "1024")
+            ),
+            iters=int(os.environ.get("BENCH_FUSION_ITERS", "5")),
+        )
+    )
+
+
 def bench_obs() -> None:
     """Obs leg (ISSUE 13): disabled-path + enabled-path overhead with
     the query-doctor attribution pass in the picture (PR 3 methodology —
@@ -809,6 +849,8 @@ def main() -> None:
         bench_concurrent()
     if which in ("pipelined", "all"):
         bench_pipelined()
+    if which in ("fusion", "all"):
+        bench_fusion()
     if which in ("obs", "all"):
         bench_obs()
 

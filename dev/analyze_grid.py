@@ -50,11 +50,12 @@ def emit_routing_table(rows, inputs) -> dict:
 
     Per platform present in the rows:
 
-    * ``matmul_max_cap`` / ``matmul_max_elems`` — largest capacity (and
-      rows x capacity product) where the matmul segment reduction beat
-      BOTH sort and scatter; default when the grid never shows matmul
-      winning (the cpu platform) since ``segment_algo`` never picks
-      matmul there anyway.
+    * ``matmul_max_cap`` / ``matmul_max_elems`` — largest capacity where
+      the matmul segment reduction beat BOTH sort and scatter at EVERY
+      measured row count (a capacity crossover must hold across row
+      counts — one row-count outlier, e.g. BLAS threading kicking in at
+      8M rows on the cpu box, must not move a threshold applied to every
+      batch size); default when no capacity wins consistently.
     * ``keyed_route_auto`` — True only when the keyed reduction (the
       fused ``keyed_fused`` cell when the grid has it, else the
       pre-fusion ``keyed`` cell) beats every alternative at the
@@ -64,6 +65,18 @@ def emit_routing_table(rows, inputs) -> dict:
     * detector bounds (``highcard_min_groups`` / ``highcard_ratio``)
       keep the builtin defaults — no grid bench measures the detector
       itself yet.
+    * whole-stage fusion bounds (ISSUE 19): ``fusion_min_rows`` — the
+      amortization floor below which one fused dispatch costs more than
+      it saves — is judged from the keyed_fused-vs-keyed pairs (the
+      one-dispatch vs 3-dispatch form of the SAME reduction, the grid's
+      direct measurement of dispatch-fusion payoff): the floor becomes
+      the smallest measured row count from which the fused form wins at
+      every larger measured row count, and stays at the builtin when
+      the fused form already wins at the grid's smallest cell (the grid
+      cannot see below its own floor).  ``fusion_max_ops`` keeps the
+      builtin default — it is the _FUSED_MAX_ENTRIES unroll discipline
+      applied to operator count, and no grid cell measures op-count
+      scaling yet.
     """
     from arrow_ballista_tpu.ops import routing
 
@@ -83,25 +96,32 @@ def emit_routing_table(rows, inputs) -> dict:
                 cells[(r["rows"], r["capacity"])][r["algo"]] = r[
                     "rows_per_sec"
                 ]
-        mm_cap = mm_elems = None
+        # per-capacity verdict: matmul must win at EVERY measured row
+        # count for that capacity to count toward the crossover — the
+        # threshold steers every batch size, so one row-count outlier
+        # cannot set it
+        mm_by_cap: dict = {}
         for (n, cap), algos in sorted(cells.items()):
             others = [v for a, v in algos.items() if a != "matmul"]
-            if "matmul" in algos and others and algos["matmul"] > max(
-                others
-            ):
-                mm_cap = max(mm_cap or 0, cap)
-                mm_elems = max(mm_elems or 0, n * cap)
-        if mm_cap is not None:
-            vals["matmul_max_cap"] = mm_cap
-            vals["matmul_max_elems"] = mm_elems
+            if "matmul" not in algos or not others:
+                continue
+            won = algos["matmul"] > max(others)
+            all_won, elems = mm_by_cap.get(cap, (True, 0))
+            mm_by_cap[cap] = (all_won and won, max(elems, n * cap))
+        mm_caps = [c for c, (won, _e) in mm_by_cap.items() if won]
+        if mm_caps:
+            vals["matmul_max_cap"] = max(mm_caps)
+            vals["matmul_max_elems"] = max(
+                mm_by_cap[c][1] for c in mm_caps
+            )
             evidence["matmul_max_cap"] = evidence["matmul_max_elems"] = (
-                "largest segment_reduce cell where matmul beat "
-                "sort+scatter"
+                "largest capacity where matmul beat sort+scatter at "
+                "every measured row count"
             )
         else:
             evidence["matmul_max_cap"] = evidence["matmul_max_elems"] = (
-                "builtin default: matmul won no measured cell on this "
-                "platform"
+                "builtin default: matmul won no measured capacity "
+                "consistently across row counts on this platform"
             )
         highcard = [
             (k, algos)
@@ -123,6 +143,65 @@ def emit_routing_table(rows, inputs) -> dict:
                 "high-cardinality segment_reduce cell(s)"
                 % ("beat" if keyed_wins else "lost to", len(highcard))
             )
+        # whole-stage fusion amortization floor: keyed_fused vs keyed is
+        # the grid's one-dispatch vs 3-dispatch pair for the same
+        # reduction — where the fused form wins, a fused dispatch pays
+        # for itself at that input size
+        fused_won: dict = {}
+        for (n, _cap), algos in cells.items():
+            if "keyed_fused" in algos and "keyed" in algos:
+                ok = algos["keyed_fused"] >= algos["keyed"]
+                fused_won[n] = fused_won.get(n, True) and ok
+        evidence["fusion_max_ops"] = (
+            "builtin default: the _FUSED_MAX_ENTRIES unroll discipline "
+            "applied to operator count (no grid cell measures op-count "
+            "scaling)"
+        )
+        if fused_won:
+            sizes = sorted(fused_won)
+            # smallest size from which the fused form wins at every
+            # larger measured size
+            floor = None
+            for i, n in enumerate(sizes):
+                if all(fused_won[m] for m in sizes[i:]):
+                    floor = n
+                    break
+            if floor is None:
+                won = [n for n in sizes if fused_won[n]]
+                lost = [n for n in sizes if not fused_won[n]]
+                if won:
+                    evidence["fusion_min_rows"] = (
+                        "builtin default kept: no stable amortization "
+                        "floor — keyed_fused beat the 3-dispatch keyed "
+                        "form at %s rows but lost at %s rows, so the "
+                        "win does not hold through the largest "
+                        "measured size"
+                        % (
+                            ", ".join(str(n) for n in won),
+                            ", ".join(str(n) for n in lost),
+                        )
+                    )
+                else:
+                    evidence["fusion_min_rows"] = (
+                        "builtin default: keyed_fused never beat the "
+                        "3-dispatch keyed form at any measured row "
+                        "count (%s rows)"
+                        % ", ".join(str(n) for n in sizes)
+                    )
+            elif floor == sizes[0]:
+                evidence["fusion_min_rows"] = (
+                    "builtin default kept: keyed_fused beat the "
+                    "3-dispatch keyed form at every measured row count "
+                    "(smallest cell %d rows; the grid cannot see below "
+                    "its own floor)" % floor
+                )
+            else:
+                vals["fusion_min_rows"] = int(floor)
+                evidence["fusion_min_rows"] = (
+                    "smallest measured row count from which keyed_fused "
+                    "beat the 3-dispatch keyed form at every larger "
+                    "size (lost below %d rows)" % floor
+                )
         platforms[platform] = {**vals, "evidence": evidence}
 
     return {

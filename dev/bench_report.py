@@ -111,6 +111,14 @@ def _fmt_value(rec: Optional[dict]) -> str:
     before, after = rec.get("tasks_before"), rec.get("tasks_after")
     if isinstance(before, int) and isinstance(after, int):
         s += f" [{before}→{after} tasks]"
+    # whole-stage fusion records carry the fused plan shape — how many
+    # segments the planner cut and how many operators ride one dispatch
+    fm = rec.get("fused_metrics")
+    if isinstance(fm, dict) and fm.get("fused_segments"):
+        s += (
+            f" [{fm['fused_segments']} seg · "
+            f"{fm.get('fused_ops_per_dispatch', 0)} ops/dispatch]"
+        )
     # plan-cache records carry the measured hit rate — the speedup only
     # means something next to how often the cache actually served
     hit_rate = rec.get("hit_rate")

@@ -139,6 +139,19 @@ print(json.dumps({"bench_smoke": "plan_cache", **run_plan_cache_smoke()}))
 EOF
   smoke_rc=$?
   [ $rc -eq 0 ] && rc=$smoke_rc
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from benchmarks.whole_stage_fusion import run_fusion_smoke
+
+# whole-stage fusion smoke: tiny q3-shaped + scan-heavy stages — the
+# fused leg must plan ONE segment covering >1 operator and execute it
+# as ONE dispatch per task (zero host round-trips between fused ops),
+# bit-identical to the knob-off per-batch leg (asserted inside)
+print(json.dumps({"bench_smoke": "whole_stage_fusion",
+                  **run_fusion_smoke()}))
+EOF
+  smoke_rc=$?
+  [ $rc -eq 0 ] && rc=$smoke_rc
   echo "--- benchmark trajectory (root BENCH_*.json snapshots) ---"
   timeout -k 10 60 python dev/bench_report.py || true
 fi

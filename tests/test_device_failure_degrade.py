@@ -60,7 +60,7 @@ def test_stage_jax_runtime_error_degrades_to_cpu(monkeypatch):
     t = _table()
     want = _oracle(t)
 
-    def boom(self, entries, cap, group_table):
+    def boom(self, entries, cap, group_table, *args, **kwargs):
         raise SC._JaxRuntimeError("INTERNAL: tpu_compile_helper SIGKILL")
 
     monkeypatch.setattr(SC.TpuStageExec, "_run_fused", boom)
@@ -77,7 +77,7 @@ def test_stage_plain_runtime_error_propagates(monkeypatch):
     # become a fallback
     t = _table()
 
-    def boom(self, entries, cap, group_table):
+    def boom(self, entries, cap, group_table, *args, **kwargs):
         raise RuntimeError("logic bug, not a device failure")
 
     monkeypatch.setattr(SC.TpuStageExec, "_run_fused", boom)

@@ -1,0 +1,347 @@
+"""Whole-stage XLA fusion (ISSUE 19): the fusion planner enumerates
+segment boundaries over a ``_FusedStage`` subplan, and a fusion-eligible
+map stage executes each segment as ONE jitted dispatch — including the
+shuffle-write partition-id column when a shuffle hint is installed.
+
+Covers: the planner's partition-exactly-once invariant (property test
+over random op lists), every cut-forcing case (non-traceable op,
+pipeline breaker, capacity overflow), fusion-on vs fusion-off
+sha-identical row fingerprints across filter / project / join /
+partial-agg query shapes, and in-kernel pid parity with the host
+partitioner oracle.
+"""
+
+import hashlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import BallistaConfig, SessionContext
+from arrow_ballista_tpu.catalog import MemoryTable
+from arrow_ballista_tpu.ops.fusion import (
+    FusionOp,
+    plan_segments,
+    stage_ops,
+)
+
+FUSION = {"ballista.tpu.whole_stage_fusion": "true",
+          "ballista.mesh.enable": "false"}
+
+
+# ----------------------------------------------------------------- planner
+def _random_ops(rng, n):
+    ops = []
+    for i in range(n):
+        ops.append(FusionOp(
+            kind=f"op{i}",
+            traceable=bool(rng.uniform() > 0.2),
+            pipeline_breaker=bool(rng.uniform() > 0.8),
+        ))
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_planner_partitions_exactly_once(seed):
+    """Property: every enumerated plan partitions the op list exactly
+    once — concatenating the segments reproduces the input ops in order,
+    with no op dropped, duplicated, or reordered."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(0, 24))
+    ops = _random_ops(rng, n)
+    max_ops = int(rng.integers(1, 9))
+    plan = plan_segments(ops, max_ops)
+    flat = [op for seg in plan.segments for op in seg]
+    assert flat == ops
+    assert all(len(seg) >= 1 for seg in plan.segments)
+    # capacity respected for traceable runs
+    for seg in plan.segments:
+        if all(op.traceable for op in seg):
+            assert len(seg) <= max_ops
+
+
+def test_planner_non_traceable_forces_own_segment():
+    ops = [FusionOp("scan"), FusionOp("udf", traceable=False),
+           FusionOp("agg")]
+    plan = plan_segments(ops, 8)
+    assert [len(s) for s in plan.segments] == [1, 1, 1]
+    assert ("non_traceable" in [r for _, r in plan.cuts])
+    # the untraceable op sits alone
+    assert plan.segments[1] == (ops[1],)
+    assert not plan.compute_fused()
+
+
+def test_planner_pipeline_breaker_cuts_before():
+    ops = [FusionOp("scan"), FusionOp("filter"),
+           FusionOp("join", pipeline_breaker=True), FusionOp("agg")]
+    plan = plan_segments(ops, 8)
+    assert plan.segments[0] == (ops[0], ops[1])
+    # the breaker starts a fresh segment (and agg fuses into it)
+    assert plan.segments[1] == (ops[2], ops[3])
+    assert ("pipeline_breaker" in [r for _, r in plan.cuts])
+
+
+def test_planner_capacity_overflow_splits():
+    ops = [FusionOp(f"op{i}") for i in range(7)]
+    plan = plan_segments(ops, 3)
+    assert [len(s) for s in plan.segments] == [3, 3, 1]
+    assert [r for _, r in plan.cuts] == ["capacity", "capacity"]
+    assert plan.max_segment_ops == 3
+
+
+def test_planner_single_segment_when_all_traceable():
+    ops = [FusionOp("scan"), FusionOp("filter"), FusionOp("agg")]
+    plan = plan_segments(ops, 8)
+    assert len(plan.segments) == 1
+    assert plan.compute_fused()
+    assert plan.max_segment_ops == 3
+
+
+# ------------------------------------------------------------ query parity
+def _reg(ctx, name, table, partitions=1):
+    ctx.register_table(name, MemoryTable.from_table(table, partitions))
+
+
+def _ctx(tpu: bool, **extra) -> SessionContext:
+    settings = {
+        "ballista.tpu.enable": "true" if tpu else "false",
+        "ballista.tpu.min_rows": "0",
+        "ballista.shuffle.partitions": "1",
+        "ballista.mesh.enable": "false",
+    }
+    settings.update({k: str(v) for k, v in extra.items()})
+    return SessionContext(BallistaConfig(settings))
+
+
+def _stage_metrics(plan) -> dict:
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+    agg: dict = {}
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, TpuStageExec):
+            for k, v in node.metrics.values.items():
+                agg[k] = agg.get(k, 0) + v
+        stack.extend(node.children())
+    return agg
+
+
+def _run(ctx, sql):
+    df = ctx.sql(sql)
+    plan = df.physical_plan()
+    table = ctx.execute(plan)
+    return table, _stage_metrics(plan)
+
+
+def _fingerprint(table: pa.Table) -> str:
+    """Order-insensitive sha over the row set (rows sorted by repr)."""
+    cols = table.column_names
+    rows = sorted(
+        repr(tuple(table.column(c)[i].as_py() for c in cols))
+        for i in range(table.num_rows)
+    )
+    h = hashlib.sha256()
+    for r in rows:
+        h.update(r.encode())
+    return h.hexdigest()
+
+
+def _mktable(n=6000, groups=9, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, groups, n), pa.int64()),
+        "v": pa.array(rng.uniform(-100, 100, n), pa.float64()),
+        "q": pa.array(rng.integers(1, 50, n).astype(np.float64)),
+    })
+
+
+SHAPES = {
+    "filter": "select k, sum(v), count(v) from t where q < 30 group by k",
+    "project": ("select k, sum(v * q), min(v + q) from t "
+                "where v > -50 group by k"),
+    "partial_agg": "select k, sum(v), count(*), min(q), max(v) from t "
+                   "group by k",
+    "scalar": "select sum(v), count(*), min(v) from t where q < 25",
+}
+
+
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+def test_fusion_on_off_sha_identical(shape):
+    sql = SHAPES[shape]
+    t = _mktable()
+    c_off, c_on = _ctx(True), _ctx(True, **FUSION)
+    _reg(c_off, "t", t)
+    _reg(c_on, "t", t)
+    off, m_off = _run(c_off, sql)
+    on, m_on = _run(c_on, sql)
+    assert _fingerprint(off) == _fingerprint(on)
+    assert m_off.get("fused_segments", 0) == 0          # knob-off: no planner
+    assert m_on.get("fused_segments", 0) >= 1, m_on
+    assert m_on.get("fused_ops_per_dispatch", 0) >= 2, m_on
+
+
+def test_fusion_join_shape_sha_identical():
+    n = 5000
+    rng = np.random.default_rng(2)
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, 100, n), pa.int64()),
+        "grp": pa.array(rng.integers(0, 5, n), pa.int64()),
+        "x": pa.array(rng.uniform(0, 1, n), pa.float64()),
+    })
+    dim = pa.table({
+        "pk": pa.array(np.arange(100), pa.int64()),
+        "dv": pa.array(np.linspace(0.5, 1.5, 100)),
+    })
+    sql = ("select grp, sum(x * dv), count(*) from dim, fact "
+           "where pk = fk group by grp")
+    c_off, c_on = _ctx(True), _ctx(True, **FUSION)
+    for c in (c_off, c_on):
+        _reg(c, "fact", fact)
+        _reg(c, "dim", dim)
+    off, _ = _run(c_off, sql)
+    on, _ = _run(c_on, sql)
+    assert _fingerprint(off) == _fingerprint(on)
+
+
+def test_fusion_matches_cpu_oracle():
+    t = _mktable(seed=3)
+    c_cpu, c_on = _ctx(False), _ctx(True, **FUSION)
+    _reg(c_cpu, "t", t)
+    _reg(c_on, "t", t)
+    cpu, _ = _run(c_cpu, SHAPES["partial_agg"])
+    on, _ = _run(c_on, SHAPES["partial_agg"])
+    assert _fingerprint(cpu) == _fingerprint(on)
+
+
+def test_knob_off_is_byte_identical():
+    """Knob off must leave today's dispatch sequence untouched: batches
+    from a knob-off run equal (pa equals — byte-level) a run on a config
+    that never mentions the knob."""
+    t = _mktable(seed=4)
+    c_base, c_off = _ctx(True), _ctx(
+        True, **{"ballista.tpu.whole_stage_fusion": "false"}
+    )
+    _reg(c_base, "t", t)
+    _reg(c_off, "t", t)
+    base, mb = _run(c_base, SHAPES["partial_agg"])
+    off, mo = _run(c_off, SHAPES["partial_agg"])
+    bb, ob = base.combine_chunks().to_batches(), off.combine_chunks().to_batches()
+    assert len(bb) == len(ob)
+    for x, y in zip(bb, ob):
+        assert x.equals(y)
+    assert mo.get("fused_segments", 0) == 0
+
+
+# -------------------------------------------------------- pid in the kernel
+def _find_stage(plan):
+    from arrow_ballista_tpu.ops.stage_compiler import TpuStageExec
+
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, TpuStageExec):
+            return node
+        stack.extend(node.children())
+    return None
+
+
+def _stage_with_hint(n_out=4, fusion=True, n=4000, groups=50):
+    from arrow_ballista_tpu.exec import expressions as pe
+
+    ctx = _ctx(True, **(FUSION if fusion else {}))
+    t = _mktable(n=n, groups=groups, seed=5)
+    _reg(ctx, "t", t)
+    df = ctx.sql(SHAPES["partial_agg"])
+    plan = df.physical_plan()
+    st = _find_stage(plan)
+    assert st is not None
+    st.install_shuffle_hint([pe.Col(0, "k")], n_out)
+    return ctx, st
+
+
+def test_fused_pid_matches_host_partitioner():
+    """The pid column derived INSIDE the fused kernel is bit-identical
+    to the host partitioner oracle over the stage's output keys."""
+    from arrow_ballista_tpu.exec import expressions as pe
+    from arrow_ballista_tpu.exec.operators import (
+        SHUFFLE_PID_COLUMN,
+        TaskContext,
+        hash_partition_indices,
+    )
+
+    n_out = 4
+    ctx, st = _stage_with_hint(n_out=n_out)
+    batches = list(st.execute(0, TaskContext(config=ctx.config)))
+    m = st.metrics.values
+    assert m.get("fused_pid_in_kernel", 0) >= 1, m
+    assert m.get("fused_segments", 0) == 1, m
+    out = pa.Table.from_batches(batches)
+    assert SHUFFLE_PID_COLUMN in out.column_names
+    stripped = out.drop([SHUFFLE_PID_COLUMN])
+    for b_out, b_strip in zip(
+        out.combine_chunks().to_batches(),
+        stripped.combine_chunks().to_batches(),
+    ):
+        oracle = hash_partition_indices(
+            b_strip, [pe.Col(0, "k")], n_out
+        )
+        got = np.asarray(b_out.column(SHUFFLE_PID_COLUMN))
+        np.testing.assert_array_equal(got, oracle)
+
+
+def test_fused_pid_off_matches_on():
+    """Hinted stage output (pid column included) is identical whether the
+    pid came from the fused kernel or the separate device dispatch."""
+    from arrow_ballista_tpu.exec.operators import TaskContext
+
+    ctx_on, st_on = _stage_with_hint(fusion=True)
+    ctx_off, st_off = _stage_with_hint(fusion=False)
+    on = pa.Table.from_batches(
+        list(st_on.execute(0, TaskContext(config=ctx_on.config)))
+    )
+    off = pa.Table.from_batches(
+        list(st_off.execute(0, TaskContext(config=ctx_off.config)))
+    )
+    assert st_on.metrics.values.get("fused_pid_in_kernel", 0) >= 1
+    assert st_off.metrics.values.get("fused_pid_in_kernel", 0) == 0
+    assert _fingerprint(on) == _fingerprint(off)
+
+
+def test_trace_failure_degrades_not_fails(monkeypatch):
+    """A fused-trace failure degrades to the per-batch device loop —
+    the stage still completes with correct results."""
+    from arrow_ballista_tpu.ops import stage_compiler as SC
+
+    t = _mktable(seed=6)
+    c_cpu, c_on = _ctx(False), _ctx(True, **FUSION)
+    _reg(c_cpu, "t", t)
+    _reg(c_on, "t", t)
+    cpu, _ = _run(c_cpu, SHAPES["partial_agg"])
+
+    real = SC.TpuStageExec._fused_for
+
+    def broken(self, *a, **kw):
+        fn = real(self, *a, **kw)
+
+        def boom(*args):
+            raise RuntimeError("injected trace failure")
+
+        return boom
+
+    monkeypatch.setattr(SC.TpuStageExec, "_fused_for", broken)
+    on, m = _run(c_on, SHAPES["partial_agg"])
+    assert _fingerprint(cpu) == _fingerprint(on)
+    assert m.get("fused_degraded", 0) >= 1, m
+
+
+def test_stage_ops_enumerates_shuffle_pid():
+    """stage_ops includes the shuffle_pid op exactly when a hint is
+    installed, and marks it traceable when the pid spec is derivable."""
+    ctx, st = _stage_with_hint()
+    kinds = [op.kind for op in stage_ops(st)]
+    assert "shuffle_pid" in kinds
+    pid_op = [op for op in stage_ops(st) if op.kind == "shuffle_pid"][0]
+    assert pid_op.traceable
+    st._shuffle_hint = None
+    assert "shuffle_pid" not in [op.kind for op in stage_ops(st)]
