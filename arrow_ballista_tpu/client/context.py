@@ -54,19 +54,42 @@ class BallistaContext:
         port: int,
         config: Optional[BallistaConfig] = None,
         _standalone_handles: Optional[tuple] = None,
+        endpoints: Optional[List] = None,
     ):
-        self.host = host
-        self.port = port
         self.config = config or BallistaConfig()
-        self.stub = SchedulerGrpcStub(make_channel(host, port))
+        # scheduler failover (ISSUE 20): `endpoints` lists BACKUP
+        # schedulers ("host:port" strings or (host, port) pairs) sharing
+        # the primary's state backend.  Idempotent RPCs rotate to the
+        # next endpoint on a transient failure; with no extras the list
+        # is just the primary and behavior matches a single-endpoint
+        # client.
+        eps: List[tuple] = [(host, int(port))]
+        for ep in endpoints or []:
+            if isinstance(ep, str):
+                h, _, p = ep.rpartition(":")
+                eps.append((h, int(p)))
+            else:
+                eps.append((str(ep[0]), int(ep[1])))
+        self._endpoints: List[tuple] = []
+        for ep in eps:
+            if ep not in self._endpoints:
+                self._endpoints.append(ep)
+        self._endpoint_idx = 0
+        self._stubs: dict = {}
+        self.host, self.port = self._endpoints[0]
+        self.stub = self._stub_for(self._endpoints[0])
         self._session = SessionContext(self.config)
         self._session.ballista_context = self
         self._standalone_handles = _standalone_handles
         self._job_ids: set[str] = set()
 
-        # mint a server-side session id (reference: context.rs:103-119)
-        result = self.stub.ExecuteQuery(
-            pb.ExecuteQueryParams(settings=self._settings()), timeout=20
+        # mint a server-side session id (reference: context.rs:103-119);
+        # an empty-query bootstrap is idempotent, so it rides the retry/
+        # rotation path like every other session RPC
+        result = self._call(
+            "ExecuteQuery",
+            pb.ExecuteQueryParams(settings=self._settings()),
+            timeout=20,
         )
         self.session_id = result.session_id
         self._session.session_id = result.session_id
@@ -74,9 +97,12 @@ class BallistaContext:
     # ------------------------------------------------------------- factory
     @staticmethod
     def remote(
-        host: str, port: int, config: Optional[BallistaConfig] = None
+        host: str,
+        port: int,
+        config: Optional[BallistaConfig] = None,
+        endpoints: Optional[List] = None,
     ) -> "BallistaContext":
-        return BallistaContext(host, port, config)
+        return BallistaContext(host, port, config, endpoints=endpoints)
 
     @staticmethod
     def standalone(
@@ -202,6 +228,75 @@ class BallistaContext:
             for k, v in self.config.to_dict().items()
         ]
 
+    def _stub_for(self, endpoint: tuple) -> SchedulerGrpcStub:
+        stub = self._stubs.get(endpoint)
+        if stub is None:
+            stub = SchedulerGrpcStub(make_channel(endpoint[0], endpoint[1]))
+            self._stubs[endpoint] = stub
+        return stub
+
+    def _rotate_endpoint(self) -> None:
+        self._endpoint_idx = (self._endpoint_idx + 1) % len(self._endpoints)
+        self.host, self.port = self._endpoints[self._endpoint_idx]
+        self.stub = self._stub_for(self._endpoints[self._endpoint_idx])
+        log.warning(
+            "rotating to scheduler endpoint %s:%d", self.host, self.port
+        )
+
+    @staticmethod
+    def _retryable(e) -> bool:
+        """Transient failures worth retrying: the scheduler is down/
+        restarting (UNAVAILABLE) or wedged past the RPC deadline
+        (DEADLINE_EXCEEDED).  Everything else — bad plan, unknown
+        session, internal errors — surfaces immediately."""
+        import grpc
+
+        code = e.code() if hasattr(e, "code") else None
+        return code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+
+    def _call(self, method: str, request, timeout: float):
+        """One scheduler RPC with bounded transient-failure retry
+        (``ballista.client.rpc_retries``) and, with multiple endpoints,
+        rotation to the next scheduler per retry — the client-session
+        failover path (ISSUE 20).  Only idempotent RPCs go through here
+        (status polls, session bootstrap, token-carrying submits).
+        Sleeps ride the same jittered exponential backoff as the status
+        poll so a mass failover doesn't thunder onto the survivor.
+        ``rpc_retries=0`` with a single endpoint restores the old
+        fail-fast behavior exactly (one attempt, error raised raw)."""
+        import grpc
+
+        retries = max(0, self.config.client_rpc_retries)
+        attempts = retries + 1
+        if len(self._endpoints) > 1:
+            # enough attempts to visit every endpoint at least twice —
+            # a takeover needs one failed dial to notice the primary
+            # died and one rotation to land on the adopting backup
+            attempts = max(attempts, 2 * len(self._endpoints))
+        from ..scheduler.task_status import PollBackoff
+
+        backoff = PollBackoff(
+            self.config.client_poll_interval_seconds,
+            self.config.client_poll_max_interval_seconds,
+        )
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                return getattr(self.stub, method)(request, timeout=timeout)
+            except grpc.RpcError as e:
+                if not self._retryable(e):
+                    raise
+                last = e
+                if attempt + 1 >= attempts:
+                    break
+                if len(self._endpoints) > 1:
+                    self._rotate_endpoint()
+                time.sleep(backoff.next_delay())
+        raise last
+
     def _collect_distributed(self, plan) -> pa.Table:
         import os
 
@@ -223,15 +318,22 @@ class BallistaContext:
     def execute_logical_plan(self, plan) -> str:
         import grpc
 
+        params = pb.ExecuteQueryParams(
+            logical_plan=BallistaCodec.encode_logical(plan),
+            settings=self._settings(),
+            session_id=self.session_id,
+        )
+        if max(0, self.config.client_rpc_retries) > 0 or len(self._endpoints) > 1:
+            # a submit that may be RETRIED must not double-run: the
+            # scheduler dedups on this client-minted token, so every
+            # attempt of this call returns the same job id.  A
+            # retry-disabled single-endpoint client sends no token and
+            # its request bytes match the pre-failover client exactly.
+            import uuid
+
+            params.idempotency_token = uuid.uuid4().hex
         try:
-            result = self.stub.ExecuteQuery(
-                pb.ExecuteQueryParams(
-                    logical_plan=BallistaCodec.encode_logical(plan),
-                    settings=self._settings(),
-                    session_id=self.session_id,
-                ),
-                timeout=60,
-            )
+            result = self._call("ExecuteQuery", params, timeout=60)
         except grpc.RpcError as e:
             raise ExecutionError(
                 f"query submission failed: {e.details() if hasattr(e, 'details') else e}"
@@ -262,8 +364,17 @@ class BallistaContext:
         its pool + queue position, and a timeout message splits the
         deadline into time-spent-queued vs time-spent-running — a job
         that starved in a saturated queue reads differently from one
-        that wedged mid-execution."""
+        that wedged mid-execution.
+
+        Failover-aware: GetJobStatus is idempotent, so a transient RPC
+        failure (scheduler restarting, or mid-takeover by a backup) does
+        NOT kill the wait — the poll keeps going, rotating endpoints
+        when the context has spares, until the job resolves or the
+        deadline passes.  An adopted job reports queued/running from the
+        survivor and the wait reattaches transparently."""
         import json
+
+        import grpc
 
         from ..scheduler.task_status import (
             PollBackoff,
@@ -281,12 +392,27 @@ class BallistaContext:
         running_since: Optional[float] = None
         last_queued: dict = {}
         while True:
-            result = self.stub.GetJobStatus(
-                pb.GetJobStatusParams(
-                    job_id=job_id, include_progress=progress is not None
-                ),
-                timeout=20,
-            )
+            try:
+                result = self._call(
+                    "GetJobStatus",
+                    pb.GetJobStatusParams(
+                        job_id=job_id, include_progress=progress is not None
+                    ),
+                    timeout=20,
+                )
+            except grpc.RpcError as e:
+                # _call exhausted its attempts on a TRANSIENT error (a
+                # non-retryable one raised out of the except above): the
+                # scheduler may still be coming back — keep polling
+                # until the job deadline, not the RPC budget, expires
+                if not self._retryable(e) or time.monotonic() > deadline:
+                    raise
+                log.warning(
+                    "scheduler unreachable while waiting for job %s; "
+                    "retrying until the %.0fs deadline", job_id, timeout_s,
+                )
+                backoff.sleep(deadline)
+                continue
             status = job_status_from_proto(result.status)
             state = status["state"]
             if state == "queued":
@@ -321,7 +447,8 @@ class BallistaContext:
         ``/api/jobs/{id}/profile`` and ``/critical_path`` serve."""
         import json
 
-        result = self.stub.GetJobStatus(
+        result = self._call(
+            "GetJobStatus",
             pb.GetJobStatusParams(job_id=job_id, include_profile=True),
             timeout=20,
         )

@@ -91,6 +91,7 @@ EXECUTOR_QUARANTINE_BACKOFF_S = "ballista.executor.quarantine_backoff_seconds"
 CLIENT_JOB_TIMEOUT_S = "ballista.client.job_timeout_seconds"
 CLIENT_POLL_INTERVAL_S = "ballista.client.poll_interval_seconds"
 CLIENT_POLL_MAX_INTERVAL_S = "ballista.client.poll_max_interval_seconds"
+CLIENT_RPC_RETRIES = "ballista.client.rpc_retries"
 # Multi-tenant admission control (see docs/user-guide/multi-tenancy.md)
 TENANT_ID = "ballista.tenant.id"
 TENANT_PRIORITY = "ballista.tenant.priority"
@@ -765,6 +766,15 @@ _ENTRIES: dict[str, ConfigEntry] = {
             "2.0",
         ),
         ConfigEntry(
+            CLIENT_RPC_RETRIES,
+            "extra attempts for a transient (UNAVAILABLE / "
+            "DEADLINE_EXCEEDED) scheduler RPC failure before the error "
+            "surfaces; with multiple endpoints each retry also rotates "
+            "to the next scheduler",
+            int,
+            "3",
+        ),
+        ConfigEntry(
             TENANT_ID,
             "tenant pool this session's jobs belong to for admission "
             "control and weighted fair scheduling; empty = the shared "
@@ -1297,6 +1307,10 @@ class BallistaConfig:
     @property
     def client_poll_max_interval_seconds(self) -> float:
         return self._get(CLIENT_POLL_MAX_INTERVAL_S)
+
+    @property
+    def client_rpc_retries(self) -> int:
+        return self._get(CLIENT_RPC_RETRIES)
 
     @property
     def tenant_id(self) -> str:

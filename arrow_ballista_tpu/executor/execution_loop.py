@@ -50,6 +50,23 @@ class PollLoop:
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "PollLoop":
+        # bootstrap poll: register metadata synchronously (can_accept_task
+        # False so no task is handed out before the loop thread exists).
+        # Without it the first real poll races anything that looks the
+        # executor up right after start() — decommission, REST state, tests.
+        try:
+            self.scheduler.PollWork(
+                pb.PollWorkParams(
+                    metadata=self._registration(),
+                    can_accept_task=False,
+                    task_status=[],
+                ),
+                timeout=20,
+            )
+        except grpc.RpcError as e:
+            # scheduler unreachable at start is tolerated in pull mode —
+            # the loop below keeps retrying
+            log.debug("bootstrap PollWork failed (%s); loop will retry", e.code())
         self._thread = threading.Thread(
             target=self._run, name=f"poll-loop-{self.executor.id}", daemon=True
         )
@@ -67,9 +84,8 @@ class PollLoop:
         if self._thread is not None:
             self._thread.join(timeout)
 
-    # ---------------------------------------------------------------- loop
-    def _run(self) -> None:
-        registration = pb.ExecutorRegistration(
+    def _registration(self) -> pb.ExecutorRegistration:
+        return pb.ExecutorRegistration(
             id=self.executor.metadata.id,
             host=self.executor.metadata.host,
             has_host=bool(self.executor.metadata.host),
@@ -77,6 +93,10 @@ class PollLoop:
             grpc_port=self.executor.metadata.grpc_port,
             specification=self.executor.metadata.specification.to_proto(),
         )
+
+    # ---------------------------------------------------------------- loop
+    def _run(self) -> None:
+        registration = self._registration()
         while not self._stop.is_set():
             statuses = self._drain_statuses()
             with self._count_lock:

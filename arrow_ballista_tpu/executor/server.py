@@ -105,11 +105,13 @@ class Heartbeater:
         scheduler: SchedulerGrpcStub,
         interval_s: float = HEARTBEAT_INTERVAL_S,
         telemetry=None,
+        on_reregister: Optional[Callable[[], None]] = None,
     ):
         self.executor_id = executor_id
         self.scheduler = scheduler
         self.interval_s = interval_s
         self.telemetry = telemetry
+        self.on_reregister = on_reregister
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -155,7 +157,17 @@ class Heartbeater:
                     import json as _json
 
                     params.spans_json = _json.dumps(drained).encode()
-            self.scheduler.HeartBeatFromExecutor(params, timeout=10)
+            result = self.scheduler.HeartBeatFromExecutor(params, timeout=10)
+            if getattr(result, "reregister", False) and self.on_reregister:
+                # the scheduler restarted and lost our metadata (memory
+                # backend) while this process survived — re-register so
+                # slots/endpoints rebuild instead of heartbeating into
+                # a registry that can never dispatch to us
+                log.info("scheduler requested re-registration; re-registering")
+                try:
+                    self.on_reregister()
+                except Exception:  # noqa: BLE001 - next beat retries
+                    log.warning("re-registration failed", exc_info=True)
         except FaultInjected as e:
             # injected dropped beat: skip this interval, next one retries
             log.warning("heartbeat suppressed by fault injection: %s", e)
@@ -207,7 +219,7 @@ class ExecutorServer:
         )
         self.heartbeater = Heartbeater(
             executor.id, self.scheduler, heartbeat_interval_s,
-            telemetry=self.telemetry,
+            telemetry=self.telemetry, on_reregister=self._register,
         )
         self._tasks: "queue.Queue" = queue.Queue()
         self._statuses: "queue.Queue" = queue.Queue()
@@ -236,20 +248,7 @@ class ExecutorServer:
         self._grpc_server.start()
 
         # 2. register with the scheduler (reference: `:162-178`)
-        meta = self.executor.metadata
-        registration = pb.ExecutorRegistration(
-            id=meta.id,
-            host=meta.host,
-            has_host=bool(meta.host),
-            flight_port=meta.flight_port,
-            grpc_port=self.grpc_port,
-            specification=meta.specification.to_proto(),
-        )
-        result = self.scheduler.RegisterExecutor(
-            pb.RegisterExecutorParams(metadata=registration), timeout=20
-        )
-        if not result.success:
-            raise RuntimeError("scheduler refused executor registration")
+        self._register()
 
         # 3. heartbeats + worker pool + status reporter
         self.heartbeater.start()
@@ -265,6 +264,26 @@ class ExecutorServer:
         reporter.start()
         self._threads.append(reporter)
         return self
+
+    def _register(self) -> None:
+        """Send RegisterExecutor — on startup and again whenever a
+        heartbeat answer carries ``reregister`` (a restarted scheduler
+        adopted this surviving process but lost its metadata).  In push
+        mode registration also re-mints the slot reservations."""
+        meta = self.executor.metadata
+        registration = pb.ExecutorRegistration(
+            id=meta.id,
+            host=meta.host,
+            has_host=bool(meta.host),
+            flight_port=meta.flight_port,
+            grpc_port=self.grpc_port,
+            specification=meta.specification.to_proto(),
+        )
+        result = self.scheduler.RegisterExecutor(
+            pb.RegisterExecutorParams(metadata=registration), timeout=20
+        )
+        if not result.success:
+            raise RuntimeError("scheduler refused executor registration")
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()

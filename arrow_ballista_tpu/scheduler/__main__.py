@@ -18,7 +18,6 @@ import os
 import signal
 import sys
 import time
-import uuid
 
 
 CONFIG_KEYS = {
@@ -46,6 +45,7 @@ CONFIG_KEYS = {
     "aqe_enabled": (int, 0, "1 = adaptive query execution (re-plan stages from observed shuffle stats) as the cluster-wide default; an explicit session ballista.aqe.* setting wins"),
     "admission_enabled": (int, 0, "1 = multi-tenant admission control (queue, weighted fair release, ClusterSaturated shed) as the cluster-wide default; an explicit session ballista.admission.* setting wins unless pinned via --admission-defaults"),
     "admission_defaults": (str, "", "comma-separated ballista.admission.* key=value pairs PINNED cluster-wide (e.g. 'ballista.admission.max_queued_jobs=200,ballista.admission.shed_policy=oldest'); pinned limits ignore session settings so no tenant can rewrite another tenant's gates"),
+    "admission_wal_enabled": (int, 0, "1 = journal queued admission jobs + cancel intents through the state backend so a restarted (or adopting) scheduler re-enqueues them in submit order; durability follows the backend (sqlite/etcd survive process death)"),
     "cache_enabled": (int, 0, "1 = plan-fingerprint result/shuffle cache (serve repeat subplans from the external store without re-running their stages) as the cluster-wide default; an explicit session ballista.cache.* setting wins"),
     "cache_policy_enabled": (int, 0, "1 = learned per-plan policy (merge measured knob overrides beneath explicit session settings on repeat submissions) as the cluster-wide default"),
     "cache_settings": (str, "", "comma-separated ballista.cache.* key=value pairs seeded cluster-wide (e.g. 'ballista.cache.max_bytes=268435456,ballista.cache.ttl_seconds=600')"),
@@ -57,7 +57,8 @@ CONFIG_KEYS = {
     "autoscaler_enabled": (int, 0, "1 = closed-loop executor autoscaling: launch on sustained slot deficit / queued jobs / SLO burn, drain on sustained idle, heal crashed children (see docs/user-guide/autoscaling.md)"),
     "autoscaler_settings": (str, "", "comma-separated ballista.autoscaler.* key=value pairs for the policy (e.g. 'ballista.autoscaler.min_executors=1,ballista.autoscaler.max_executors=8')"),
     "autoscaler_executor_slots": (int, 2, "task slots per autoscaler-launched executor (sizes the slot-deficit math)"),
-    "autoscaler_work_dir": (str, "", "work-dir root for autoscaler-launched executors (default: a fresh temp dir)"),
+    "autoscaler_work_dir": (str, "", "work-dir root for autoscaler-launched executors (default: a fresh temp dir); a RESTARTED scheduler pointed at the same directory adopts surviving children via their persisted pid files instead of launching a duplicate fleet"),
+    "autoscaler_heartbeat_seconds": (float, 5.0, "heartbeat interval passed to autoscaler-launched executors (must be comfortably below --executor-timeout-seconds)"),
     "log_level_setting": (str, "INFO", "log filter"),
     "log_dir": (str, "", "write logs to a file here instead of stdout"),
     "log_file_name_prefix": (str, "scheduler", "log file prefix"),
@@ -176,7 +177,16 @@ def main(argv=None) -> None:
         log.info("observability forced on (--obs-enabled)")
 
     backend = make_backend(cfg)
-    scheduler_id = f"{cfg['bind_host']}:{cfg['bind_port']}:{uuid.uuid4().hex[:6]}"
+    # the curator address executors dial back: must be reachable, never
+    # the 0.0.0.0 wildcard.  It is also the STABLE scheduler identity —
+    # fixed before init() so the first liveness heartbeat, active-job
+    # recovery and admission-WAL replay all run under the same id a
+    # previous incarnation used (a uuid-suffixed id would strand its
+    # heartbeats and WAL entries every restart).
+    external = cfg["external_host"] or cfg["bind_host"]
+    if external == "0.0.0.0":
+        external = "127.0.0.1"
+    scheduler_id = f"{external}:{cfg['bind_port']}"
     server = SchedulerServer(
         scheduler_id,
         backend,
@@ -192,6 +202,7 @@ def main(argv=None) -> None:
         aqe_force_enabled=bool(cfg["aqe_enabled"]),
         admission_force_enabled=bool(cfg["admission_enabled"]),
         admission_defaults=_parse_admission_defaults(cfg["admission_defaults"]),
+        admission_wal_enabled=bool(cfg["admission_wal_enabled"]),
         cache_force_enabled=bool(cfg["cache_enabled"]),
         cache_policy_force_enabled=bool(cfg["cache_policy_enabled"]),
         cache_settings=_parse_admission_defaults(cfg["cache_settings"]),
@@ -201,13 +212,6 @@ def main(argv=None) -> None:
         event_journal_rotate_bytes=cfg["event_journal_rotate_bytes"],
         event_journal_segments=cfg["event_journal_segments"],
     ).init()
-    # the curator address executors dial back: must be reachable, never
-    # the 0.0.0.0 wildcard
-    external = cfg["external_host"] or cfg["bind_host"]
-    if external == "0.0.0.0":
-        external = "127.0.0.1"
-    server.scheduler_id = f"{external}:{cfg['bind_port']}"
-    server.state.task_manager.scheduler_id = server.scheduler_id
 
     # elastic lifecycle: the flag (or an explicit settings key) turns the
     # loop on; the subprocess provider launches executors that dial the
@@ -225,6 +229,7 @@ def main(argv=None) -> None:
             cfg["bind_port"],
             task_slots=cfg["autoscaler_executor_slots"],
             work_dir_root=cfg["autoscaler_work_dir"],
+            heartbeat_interval_s=cfg["autoscaler_heartbeat_seconds"],
         )
         server.attach_autoscaler(provider, autoscaler_settings)
         log.info(

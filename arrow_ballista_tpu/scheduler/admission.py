@@ -160,6 +160,10 @@ class AdmissionController:
         self.events = events if events is not None else EventJournal()
         self.registry = registry or MetricsRegistry()
         self._lock = threading.Lock()
+        # durable queue journal (ISSUE 20): attached by SchedulerState
+        # when --admission-wal-enabled; None keeps every hook a no-op
+        # and the submit path byte-identical to pre-WAL behavior
+        self.wal = None
         self._pools: Dict[str, _Pool] = {}
         # job_id -> (pool, priority) for every admitted-and-not-yet-
         # terminal job; priority matters for capacity accounting —
@@ -336,6 +340,8 @@ class AdmissionController:
                     return decision
             pool.lanes[priority if priority in pool.lanes else BATCH].append(qj)
             self._queued[job_id] = qj
+            if self.wal is not None:
+                self.wal.append(qj, pool.weight, pool.max_running)
             self._queued_counter.inc()
             decision.queued = True
             decision.position = self._position_locked(qj)
@@ -504,6 +510,84 @@ class AdmissionController:
             self._running[job_id] = (pool_name, priority)
             self._refresh_gauges_locked()
 
+    # ---------------------------------------------------- durability (WAL)
+    def attach_wal(self, wal) -> None:
+        """Arm the durable queue journal (:class:`~.queue_wal.
+        AdmissionWal`).  Every queue mutation from here on writes
+        through; ``None`` (the default) keeps behavior byte-identical
+        to a WAL-less scheduler."""
+        self.wal = wal
+
+    def wal_discard(self, job_id: str) -> None:
+        """The job reached a durable downstream state (its graph was
+        persisted, or it went terminal): its WAL entry is now redundant.
+        Deliberately NOT called at :meth:`release` — a crash between
+        release and graph persistence must still replay the job."""
+        if self.wal is not None:
+            self.wal.discard(job_id)
+
+    def restore(
+        self,
+        job_id: str,
+        session_id: str,
+        plan,
+        pool_name: str,
+        priority: str,
+        pool_weight: float,
+        pool_max_running: int,
+        enqueued_unix: float,
+        max_wait_s: float,
+    ) -> bool:
+        """WAL replay: re-enqueue one journaled job in arrival order
+        (the caller iterates entries sorted by sequence).  Queue-wait
+        age survives the restart — ``enqueued_mono`` is back-dated by
+        the wall-clock elapsed so ``max_queue_wait_seconds`` expiry
+        still fires on schedule.  DRR deficits deliberately restart at
+        zero: they are burst credit, not queue position.  Returns False
+        for jobs admission already tracks (idempotent replay)."""
+        now_mono = time.monotonic()
+        with self._lock:
+            if job_id in self._queued or job_id in self._running:
+                return False
+            pool = self._pools.get(pool_name)
+            if pool is None:
+                pool = self._pools[pool_name] = _Pool(pool_name)
+                # journaled pool parameters seed a pool the restarted
+                # scheduler hasn't seen yet; a live pool keeps whatever
+                # the latest real submission configured
+                pool.weight = max(MIN_POOL_WEIGHT, pool_weight)
+                pool.max_running = pool_max_running
+            qj = QueuedJob(
+                job_id=job_id,
+                session_id=session_id,
+                plan=plan,
+                pool=pool.name,
+                priority=priority,
+                enqueued_mono=now_mono - max(0.0, time.time() - enqueued_unix),
+                enqueued_unix=enqueued_unix,
+                max_wait_s=max_wait_s,
+            )
+            pool.lanes[priority if priority in pool.lanes else BATCH].append(qj)
+            self._queued[job_id] = qj
+            self.events.emit(
+                "job_requeued",
+                job=job_id,
+                pool=pool.name,
+                priority=qj.priority,
+                position=self._position_locked(qj),
+            )
+            self._refresh_gauges_locked()
+            return True
+
+    def restore_cancel_intent(self, job_id: str) -> None:
+        """WAL replay: re-arm a cancel intent that raced the crash."""
+        with self._lock:
+            self._cancel_intents[job_id] = time.monotonic()
+            while len(self._cancel_intents) > MAX_CANCEL_INTENTS:
+                evicted, _ = self._cancel_intents.popitem(last=False)
+                if self.wal is not None:
+                    self.wal.discard_intent(evicted)
+
     # ----------------------------------------------------------- shedding
     def _shed_locked(
         self, qj: QueuedJob, reason: str, now_mono: float
@@ -511,6 +595,8 @@ class AdmissionController:
         """Remove one queued job and account the shed; returns the
         structured error the caller fails it with."""
         self._queued.pop(qj.job_id, None)
+        if self.wal is not None:
+            self.wal.discard(qj.job_id)
         pool = self._pools.get(qj.pool)
         wait = now_mono - qj.enqueued_mono
         if pool is not None:
@@ -572,6 +658,8 @@ class AdmissionController:
             qj = self._queued.pop(job_id, None)
             if qj is None:
                 return None
+            if self.wal is not None:
+                self.wal.discard(job_id)
             pool = self._pools.get(qj.pool)
             if pool is not None:
                 for lane in pool.lanes.values():
@@ -588,12 +676,19 @@ class AdmissionController:
         of running it.  Bounded — stale intents for bogus ids age out."""
         with self._lock:
             self._cancel_intents[job_id] = time.monotonic()
+            if self.wal is not None:
+                self.wal.put_intent(job_id)
             while len(self._cancel_intents) > MAX_CANCEL_INTENTS:
-                self._cancel_intents.popitem(last=False)
+                evicted, _ = self._cancel_intents.popitem(last=False)
+                if self.wal is not None:
+                    self.wal.discard_intent(evicted)
 
     def take_cancel_intent(self, job_id: str) -> bool:
         with self._lock:
-            return self._cancel_intents.pop(job_id, None) is not None
+            taken = self._cancel_intents.pop(job_id, None) is not None
+            if taken and self.wal is not None:
+                self.wal.discard_intent(job_id)
+            return taken
 
     # ------------------------------------------------------------- queries
     def queued_count(self) -> int:

@@ -128,12 +128,66 @@ class ExecutorProvider(abc.ABC):
         ``{executor_id: None}`` while running, exit code once dead."""
 
 
+PID_FILE = "executor.pid"
+
+
+class _AdoptedProcess:
+    """Popen-shaped wrapper around a pid this scheduler did not spawn:
+    a child that survived its parent's crash (ISSUE 20 orphan adoption).
+    ``os.waitpid`` cannot reap a non-child, so ``poll`` uses signal-0
+    liveness and reports a synthetic ``-1`` exit code once dead."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._returncode is not None:
+            return self._returncode
+        try:
+            os.kill(self.pid, 0)
+        except OSError:
+            self._returncode = -1  # exit code unknowable for a non-child
+            return self._returncode
+        return None
+
+    def terminate(self) -> None:
+        import signal
+
+        os.kill(self.pid, signal.SIGTERM)
+
+    def kill(self) -> None:
+        import signal
+
+        os.kill(self.pid, signal.SIGKILL)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = time.monotonic() + (timeout if timeout is not None else 0)
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if timeout is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired(
+                    cmd=f"adopted pid {self.pid}", timeout=timeout
+                )
+            time.sleep(0.05)
+
+
 class LocalProcessProvider(ExecutorProvider):
     """Subprocess-backed provider: each ``launch`` spawns
     ``python -m arrow_ballista_tpu.executor`` in push mode on random
     ports, pre-assigned its executor id (``--executor-id``) so the
     scheduler-side handle and the registration correlate.  Child stdout
-    goes to ``<work_dir>/<executor_id>/launch.log``."""
+    goes to ``<work_dir>/<executor_id>/launch.log``.
+
+    Every launch persists ``<work_dir>/<executor_id>/executor.pid`` so a
+    scheduler restarted over the same ``work_dir_root`` ADOPTS surviving
+    children instead of launching a duplicate fleet (ISSUE 20): the
+    constructor scans for pid files, verifies liveness (and, where /proc
+    exists, that the pid still runs *this* executor id — a pid-reuse
+    guard), wraps live ones in :class:`_AdoptedProcess`, and reaps stale
+    files for dead ones."""
 
     def __init__(
         self,
@@ -158,6 +212,69 @@ class LocalProcessProvider(ExecutorProvider):
         self.env = dict(env or {})
         self._lock = threading.Lock()
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._adopted: List[str] = []
+        self._adopt_orphans()
+
+    # -------------------------------------------------- orphan adoption
+    def _pid_path(self, executor_id: str) -> str:
+        return os.path.join(self.work_dir_root, executor_id, PID_FILE)
+
+    def _remove_pid_file(self, executor_id: str) -> None:
+        try:
+            os.unlink(self._pid_path(executor_id))
+        except OSError:
+            pass
+
+    @staticmethod
+    def _pid_runs_executor(pid: int, executor_id: str) -> bool:
+        """True when ``pid`` is alive AND (where verifiable) still runs
+        the executor module with this id — a recycled pid must not be
+        adopted as a fleet member."""
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return False
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            return True  # no /proc (or raced an exit): liveness-only
+        return (
+            b"--executor-id" in argv
+            and executor_id.encode() in argv
+        )
+
+    def _adopt_orphans(self) -> None:
+        """Scan ``work_dir_root`` for pid files left by a previous
+        scheduler process; adopt live children, reap dead ones."""
+        try:
+            entries = sorted(os.listdir(self.work_dir_root))
+        except OSError:
+            return
+        for eid in entries:
+            path = os.path.join(self.work_dir_root, eid, PID_FILE)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    pid = int(f.read().split()[0])
+            except (OSError, ValueError, IndexError):
+                continue
+            if self._pid_runs_executor(pid, eid):
+                with self._lock:
+                    self._procs[eid] = _AdoptedProcess(pid)
+                    self._adopted.append(eid)
+                log.info("adopted orphan executor %s (pid %d)", eid, pid)
+            else:
+                self._remove_pid_file(eid)
+                log.info(
+                    "reaped stale pid file for dead executor %s (pid %d)",
+                    eid, pid,
+                )
+
+    def adopted_ids(self) -> List[str]:
+        """Executor ids adopted from a previous scheduler's fleet (the
+        autoscaler folds these into its managed set and desired count)."""
+        with self._lock:
+            return list(self._adopted)
 
     def launch(self, spec: ExecutorSpec) -> ExecutorHandle:
         # deterministic failure/cold-start testing (ISSUE 17 satellite):
@@ -202,6 +319,14 @@ class LocalProcessProvider(ExecutorProvider):
             )
         with self._lock:
             self._procs[spec.executor_id] = proc
+        try:
+            # handle persistence (ISSUE 20): lets a restarted scheduler
+            # adopt this child instead of double-launching its capacity
+            with open(self._pid_path(spec.executor_id), "w",
+                      encoding="utf-8") as f:
+                f.write(f"{proc.pid}\n")
+        except OSError:
+            log.warning("could not persist pid file for %s", spec.executor_id)
         log.info(
             "launched executor %s (pid %d, slots %d)",
             spec.executor_id, proc.pid, spec.task_slots or self.task_slots,
@@ -211,6 +336,7 @@ class LocalProcessProvider(ExecutorProvider):
     def terminate(self, handle: ExecutorHandle) -> None:
         with self._lock:
             proc = self._procs.pop(handle.executor_id, None)
+        self._remove_pid_file(handle.executor_id)
         proc = proc or handle.backend
         if proc is None or proc.poll() is not None:
             return
@@ -248,6 +374,7 @@ class LocalProcessProvider(ExecutorProvider):
             if rc is not None:
                 with self._lock:
                     self._procs.pop(eid, None)
+                self._remove_pid_file(eid)
         return out
 
     def close(self) -> None:
@@ -255,6 +382,8 @@ class LocalProcessProvider(ExecutorProvider):
         with self._lock:
             procs = dict(self._procs)
             self._procs.clear()
+        for eid in procs:
+            self._remove_pid_file(eid)
         for proc in procs.values():
             try:
                 proc.terminate()
@@ -321,6 +450,7 @@ class _Managed:
     handle: Optional[ExecutorHandle] = None
     error: str = ""
     cancelled: bool = False  # timed out before launch() returned
+    adopted: bool = False  # orphan re-adopted after a scheduler restart
 
 
 class Autoscaler:
@@ -343,6 +473,44 @@ class Autoscaler:
         self._lock = threading.Lock()
         self._managed: Dict[str, _Managed] = {}
         self.desired = max(0, self.policy.min_executors)
+        # orphan adoption (ISSUE 20): children that survived a scheduler
+        # crash re-enter the managed set as LAUNCHING — they count
+        # against actuation immediately (no double-launch storm while
+        # they re-register) and flip ALIVE on their next heartbeat/
+        # registration exactly like a fresh launch.  ``desired`` is
+        # re-derived from the adopted fleet so the first tick neither
+        # drains nor duplicates surviving capacity.
+        adopted = []
+        getter = getattr(provider, "adopted_ids", None)
+        if callable(getter):
+            try:
+                adopted = list(getter())
+            except Exception:  # noqa: BLE001 - provider may be sick
+                log.exception("adopted_ids() failed; adopting nothing")
+        if adopted:
+            now = time.monotonic()
+            for eid in adopted:
+                self._managed[eid] = _Managed(
+                    executor_id=eid,
+                    phase=LAUNCHING,
+                    started_mono=now,
+                    handle=ExecutorHandle(eid),
+                    adopted=True,
+                )
+            self.desired = min(
+                self.policy.max_executors,
+                max(self.policy.min_executors, len(adopted)),
+            )
+            log.info(
+                "adopted %d surviving executor(s) %s; desired=%d",
+                len(adopted), sorted(adopted), self.desired,
+            )
+            self.state.events.emit(
+                "autoscale_decision",
+                action="adopt",
+                executors=sorted(adopted),
+                desired=self.desired,
+            )
         self._pressure_since: Optional[float] = None
         self._idle_since: Optional[float] = None
         self._last_scale_out = float("-inf")
@@ -423,6 +591,7 @@ class Autoscaler:
                     "executor_launched",
                     executor=rec.executor_id,
                     wait_s=round(now - rec.started_mono, 3),
+                    adopted=rec.adopted,
                 )
                 log.info(
                     "executor %s registered %.1fs after launch",

@@ -31,6 +31,10 @@ class Keyspace(str, Enum):
     # executor-manager watches Heartbeats with an empty prefix and decodes
     # every event as ExecutorHeartbeat protobuf
     Schedulers = "schedulers"
+    # durable admission-queue WAL: "q:"-prefixed entries keyed by submit
+    # order (zero-padded sequence), "c:"-prefixed cancel intents and
+    # "t:"-prefixed submit idempotency tokens (see queue_wal.py)
+    QueueWal = "queue_wal"
 
 
 class WatchEvent:

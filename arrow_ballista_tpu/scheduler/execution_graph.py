@@ -310,6 +310,22 @@ class ExecutionGraph:
                 )
         return n
 
+    def running_tasks_by_executor(self) -> Dict[str, int]:
+        """Dispatched tasks grouped by the executor running them — the
+        ground truth the restart-time slot reconcile rebuilds the durable
+        slot counts from."""
+        per: Dict[str, int] = {}
+        for s in self.stages.values():
+            if not isinstance(s, RunningStage):
+                continue
+            for t in s.task_statuses:
+                if t is not None and t.state == "running" and t.executor_id:
+                    per[t.executor_id] = per.get(t.executor_id, 0) + 1
+            for t in s.speculative_statuses.values():
+                if t.state == "running" and t.executor_id:
+                    per[t.executor_id] = per.get(t.executor_id, 0) + 1
+        return per
+
     # ------------------------------------------------------------ revive
     def revive(self) -> bool:
         """Resolve every resolvable stage and start every resolved stage

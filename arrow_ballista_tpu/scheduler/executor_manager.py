@@ -581,6 +581,38 @@ class ExecutorManager:
             if eid in alive
         )
 
+    def reconcile_slots(self, running: Dict[str, int]) -> Dict[str, int]:
+        """Rebuild the durable slot counts from ground truth: for every
+        registered executor, available = task_slots − tasks actually
+        running on it (``running``, from the persisted graphs of EVERY
+        curator).  Slot counts outlive the scheduler process, so
+        reservations held by a process that died (SIGKILL before the
+        tasks launched, or whose re-armed tasks went back to pending on
+        recovery) leak forever otherwise — on a small fleet that is a
+        permanent dispatch deadlock.  Runs under the global Slots lock;
+        a live peer's reserved-but-not-yet-launched slots are the one
+        window this can momentarily overcount, which costs brief
+        oversubscription rather than a wedge.  Returns {executor_id:
+        reclaimed} for executors whose count changed."""
+        changed: Dict[str, int] = {}
+        lk = self.backend.lock(Keyspace.Slots, "global")
+        with lk:
+            txn = []
+            for meta in self.executors():
+                want = max(
+                    0,
+                    meta.specification.task_slots
+                    - running.get(meta.id, 0),
+                )
+                raw = self.backend.get(Keyspace.Slots, meta.id)
+                have = _slots_from(raw) if raw is not None else 0
+                if have != want:
+                    txn.append((Keyspace.Slots, meta.id, _slots_bytes(want)))
+                    changed[meta.id] = want - have
+            if txn:
+                self._fenced_txn(lk, txn)
+        return changed
+
 
 def _slots_bytes(n: int) -> bytes:
     return json.dumps({"slots": n}).encode()

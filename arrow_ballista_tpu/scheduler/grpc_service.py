@@ -102,7 +102,17 @@ class SchedulerGrpcService:
 
         from .executor_manager import ExecutorHeartbeat
 
-        self.server.state.executor_manager.save_heartbeat(
+        em = self.server.state.executor_manager
+        # a scheduler restarted on a memory backend has heartbeats but no
+        # metadata for surviving (adopted) executors: tell them to
+        # re-register so slots/endpoints rebuild, instead of silently
+        # heartbeating into a registry that can never dispatch to them
+        reregister = False
+        try:
+            em.get_executor_metadata(request.executor_id)
+        except Exception:  # noqa: BLE001 - unknown executor
+            reregister = True
+        em.save_heartbeat(
             ExecutorHeartbeat(request.executor_id, time.time(), "active")
         )
         if request.spans_json:
@@ -115,7 +125,7 @@ class SchedulerGrpcService:
             self.server.state.telemetry.record_executor(
                 request.executor_id, request.telemetry_json
             )
-        return pb.HeartBeatResult(reregister=False)
+        return pb.HeartBeatResult(reregister=reregister)
 
     def UpdateTaskStatus(
         self, request: pb.UpdateTaskStatusParams, context
@@ -167,12 +177,51 @@ class SchedulerGrpcService:
         else:
             plan = session_ctx.sql(request.sql).logical_plan()
 
-        job_id = self.server.state.task_manager.generate_job_id()
+        token = request.idempotency_token
+        if token:
+            # a retried submit (client failover, ISSUE 20) re-attaches to
+            # the job its first attempt already created instead of
+            # double-running it; the check-then-mint runs under a token-
+            # scoped backend lock so two racing retries agree on one id
+            from .backend import Keyspace
+            from .queue_wal import lookup_token, record_token, token_key
+
+            backend = self.server.state.backend
+            with backend.lock(Keyspace.QueueWal, token_key(token)):
+                prior = lookup_token(backend, token)
+                if prior is not None:
+                    log.info(
+                        "deduplicated resubmit of job %s (token %s)",
+                        prior, token,
+                    )
+                    return pb.ExecuteQueryResult(
+                        job_id=prior, session_id=session_ctx.session_id
+                    )
+                job_id = self.server.state.task_manager.generate_job_id()
+                record_token(backend, token, job_id)
+            self._maybe_purge_tokens()
+        else:
+            job_id = self.server.state.task_manager.generate_job_id()
         self.server.submit_job(job_id, session_ctx.session_id, plan)
         log.info("queued job %s (session %s)", job_id, session_ctx.session_id)
         return pb.ExecuteQueryResult(
             job_id=job_id, session_id=session_ctx.session_id
         )
+
+    _token_submits = 0
+
+    def _maybe_purge_tokens(self) -> None:
+        """Opportunistic TTL sweep of idempotency tokens — every ~100
+        tokened submits, so the keyspace cannot grow unbounded."""
+        self._token_submits += 1
+        if self._token_submits % 100:
+            return
+        from .queue_wal import purge_stale_tokens
+
+        try:
+            purge_stale_tokens(self.server.state.backend)
+        except Exception:  # noqa: BLE001 - sweep must not fail a submit
+            log.warning("idempotency-token purge failed", exc_info=True)
 
     def GetShuffleLocationDelta(
         self, request: pb.ShuffleLocationDeltaParams, context

@@ -69,6 +69,7 @@ class SchedulerServer:
         aqe_force_enabled: bool = False,
         admission_force_enabled: bool = False,
         admission_defaults: Optional[Dict[str, str]] = None,
+        admission_wal_enabled: bool = False,
         cache_force_enabled: bool = False,
         cache_policy_force_enabled: bool = False,
         cache_settings: Optional[Dict[str, str]] = None,
@@ -98,6 +99,7 @@ class SchedulerServer:
             aqe_force_enabled=aqe_force_enabled,
             admission_force_enabled=admission_force_enabled,
             admission_defaults=admission_defaults,
+            admission_wal_enabled=admission_wal_enabled,
             cache_force_enabled=cache_force_enabled,
             cache_policy_force_enabled=cache_policy_force_enabled,
             cache_settings=cache_settings,
@@ -147,6 +149,33 @@ class SchedulerServer:
         recovered = self.state.task_manager.recover_active_jobs()
         if recovered:
             log.info("recovered %d active job(s): %s", len(recovered), recovered)
+        # queued (pre-planning) jobs + buffered cancel intents come back
+        # from the admission WAL in submit order (no-op when the WAL
+        # knob is off)
+        # slot counts are durable: reservations held by the process that
+        # died leaked with it (its re-armed tasks are pending again), so
+        # rebuild every executor's count from the persisted graphs —
+        # without this a small fleet restarts into a dispatch deadlock.
+        # Runs before the WAL replay so a replayed admission cannot race
+        # its fresh reservations against the rebuild.
+        reclaimed = self.state.executor_manager.reconcile_slots(
+            self.state.task_manager.running_tasks_by_executor()
+        )
+        if reclaimed:
+            log.info("reconciled leaked executor slots: %s", reclaimed)
+        requeued = self.replay_admission_wal()
+        if requeued:
+            log.info(
+                "replayed %d queued job(s) from the admission WAL: %s",
+                len(requeued), requeued,
+            )
+        if recovered and self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+            # revive is not an offer: nothing else re-offers a recovered
+            # job's re-armed tasks until some unrelated event happens by
+            from .query_stage_scheduler import JobSubmitted
+
+            for job_id in recovered:
+                self.event_loop.get_sender().post(JobSubmitted(job_id))
         self._reaper = threading.Thread(
             target=self._reaper_loop, name="executor-reaper", daemon=True
         )
@@ -486,16 +515,104 @@ class SchedulerServer:
             if now - ts <= timeout:
                 continue
             jobs = self.state.task_manager.take_over_jobs(peer)
+            # the dead peer's QUEUED jobs (never planned, graph-less)
+            # come over too: replay its admission-WAL entries under this
+            # scheduler's curatorship, in the peer's submit order
+            requeued = self.replay_admission_wal(curator=peer)
             # one survivor wins the takeover lock; clearing the heartbeat
             # makes the adoption idempotent across sweeps
             self.state.backend.delete(Keyspace.Schedulers, key)
-            if jobs:
+            if jobs or requeued:
                 log.warning(
-                    "adopted %d job(s) from dead scheduler %s: %s",
-                    len(jobs), peer, jobs,
+                    "adopted %d job(s) + %d queued job(s) from dead "
+                    "scheduler %s: %s",
+                    len(jobs), len(requeued), peer, jobs + requeued,
                 )
                 adopted.extend(jobs)
+                adopted.extend(requeued)
+            if jobs:
+                # the dead peer's reservations leaked with it; its
+                # adopted jobs' tasks are pending again, so rebuild the
+                # slot counts and re-offer (revive alone never offers)
+                reclaimed = self.state.executor_manager.reconcile_slots(
+                    self.state.task_manager.running_tasks_by_executor()
+                )
+                if reclaimed:
+                    log.info(
+                        "reconciled leaked executor slots on takeover: %s",
+                        reclaimed,
+                    )
+                if self.policy == TaskSchedulingPolicy.PUSH_STAGED:
+                    from .query_stage_scheduler import JobSubmitted
+
+                    for job_id in jobs:
+                        self.event_loop.get_sender().post(JobSubmitted(job_id))
         return adopted
+
+    def replay_admission_wal(self, curator: Optional[str] = None) -> List[str]:
+        """Re-enqueue every WAL-journaled queued job owned by ``curator``
+        (default: this scheduler — the restart path; the reaper passes a
+        dead peer's id on takeover).  Entries replay in submit order;
+        jobs that already reached a durable downstream state (graph
+        persisted or terminal) are stale and dropped instead.  Buffered
+        cancel intents re-arm the same way, so a cancel raced with the
+        crash still wins.  No-op unless ``--admission-wal-enabled``."""
+        wal = self.state.admission_wal
+        if wal is None:
+            return []
+        me = self.state.task_manager.scheduler_id
+        target = me if curator is None else curator
+        admission = self.state.admission
+        restored: List[str] = []
+        for key, rec in wal.load(target):
+            job_id = rec.get("job_id") or ""
+            if not job_id:
+                continue
+            if any(
+                self.state.backend.get(ks, job_id) is not None
+                for ks in (
+                    Keyspace.ActiveJobs,
+                    Keyspace.CompletedJobs,
+                    Keyspace.FailedJobs,
+                )
+            ):
+                # the job made it past the queue before the crash (its
+                # graph persisted / went terminal): the entry is stale
+                wal.register(job_id, key)
+                wal.discard(job_id)
+                continue
+            if target != me:
+                # takeover: re-stamp so a second failover replays again
+                rec = wal.rewrite_curator(key, rec, me)
+            try:
+                plan = wal.decode_plan(rec)
+            except Exception:  # noqa: BLE001 - poison entry must not wedge boot
+                log.exception("dropping undecodable admission WAL entry %s", key)
+                wal.register(job_id, key)
+                wal.discard(job_id)
+                continue
+            if admission.restore(
+                job_id,
+                rec.get("session_id") or "",
+                plan,
+                rec.get("pool") or "default",
+                rec.get("priority") or "batch",
+                float(rec.get("pool_weight") or 1.0),
+                int(rec.get("pool_max_running") or 0),
+                float(rec.get("enqueued_unix") or time.time()),
+                float(rec.get("max_wait_s") or 0.0),
+            ):
+                wal.register(job_id, key)
+                restored.append(job_id)
+        for job_id in wal.load_intents(target):
+            admission.restore_cancel_intent(job_id)
+            if target != me:
+                wal.put_intent(job_id)  # re-stamp to the adopting curator
+        if restored:
+            from .query_stage_scheduler import AdmissionPulse
+
+            self.event_loop.get_sender().post(AdmissionPulse())
+        return restored
 
     def _expire_dead_executors(self) -> None:
         """Heartbeat-timeout expiry ONLY posts ExecutorLost: the loss

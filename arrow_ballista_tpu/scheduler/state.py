@@ -52,6 +52,7 @@ class SchedulerState:
         aqe_force_enabled: bool = False,
         admission_force_enabled: bool = False,
         admission_defaults: Optional[Dict[str, str]] = None,
+        admission_wal_enabled: bool = False,
         cache_force_enabled: bool = False,
         cache_policy_force_enabled: bool = False,
         cache_settings: Optional[Dict[str, str]] = None,
@@ -175,6 +176,20 @@ class SchedulerState:
             plan_cache=self.plan_cache,
             policy_store=self.policy_store,
         )
+        # durable admission queue (ISSUE 20): journal queued jobs +
+        # cancel intents through the state backend so a restarted or
+        # adopting scheduler replays them in submit order.  Off by
+        # default — admission.wal stays None and every hook is a no-op.
+        # The curator resolves lazily off the task manager because
+        # __main__ finalizes the stable scheduler id after construction.
+        self.admission_wal = None
+        if admission_wal_enabled:
+            from .queue_wal import AdmissionWal
+
+            self.admission_wal = AdmissionWal(
+                backend, lambda: self.task_manager.scheduler_id
+            )
+            self.admission.attach_wal(self.admission_wal)
         self.session_manager = SessionManager(backend, session_builder)
         # straggler mitigation: the periodic scan body (invoked on the
         # event-loop thread via the SpeculationScan event); the force
@@ -301,6 +316,10 @@ class SchedulerState:
         self.task_manager.submit_job(
             job_id, session_ctx.session_id, physical, trace_id=trace_id
         )
+        # graph persisted (or terminal): the queue WAL entry is now
+        # redundant — dropping it here (not at release) closes the
+        # release→persist crash window
+        self.admission.wal_discard(job_id)
 
     def _maybe_start_trace(self, job_id: str, session_ctx: SessionContext) -> str:
         """Mint the job's trace id when the session asks for observability

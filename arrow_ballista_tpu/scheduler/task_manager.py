@@ -285,10 +285,12 @@ class TaskManager:
 
     def _admission_adopt(self, graph: ExecutionGraph) -> None:
         """Restart/HA adoption: re-register a recovered admission-managed
-        job with the controller so pool concurrency accounting survives
-        (the admission queue itself does NOT survive a restart — queued
-        jobs were never planned or persisted, and their clients' retries
-        re-enter the front door)."""
+        job with the controller so pool concurrency accounting survives.
+        Queued (pre-planning) jobs are recovered separately: with
+        ``--admission-wal-enabled`` the queue WAL replays them in submit
+        order (``SchedulerServer.replay_admission_wal``); without it
+        they are lost and their clients' retries re-enter the front
+        door."""
         if self.admission is not None and graph.admission_enabled:
             self.admission.adopt_running(
                 graph.job_id, graph.tenant_pool, graph.tenant_priority
@@ -1085,6 +1087,22 @@ class TaskManager:
                     self._persist(graph)
         return affected
 
+    def running_tasks_by_executor(self) -> Dict[str, int]:
+        """Dispatched tasks per executor across every ActiveJobs graph in
+        the backend (all curators — with a shared backend a peer's
+        in-flight work counts too).  Input for the restart-time slot
+        reconcile."""
+        per: Dict[str, int] = {}
+        for job_id in self.backend.scan_keys(Keyspace.ActiveJobs):
+            entry = self._entry(job_id)
+            with entry.lock:
+                graph = self._load(job_id, entry)
+                if graph is None or graph.status in (COMPLETED, FAILED):
+                    continue
+                for eid, n in graph.running_tasks_by_executor().items():
+                    per[eid] = per.get(eid, 0) + n
+        return per
+
     # ------------------------------------------------------------ dispatch
     def fill_reservations(
         self, reservations: List[ExecutorReservation]
@@ -1488,6 +1506,10 @@ class TaskManager:
 
     def fail_job(self, job_id: str, error: str) -> None:
         self._admission_finished(job_id)
+        if self.admission is not None:
+            # a job failed out of the queue/admit window reached its
+            # terminal state: its queue-WAL entry must not replay it
+            self.admission.wal_discard(job_id)
         self._policy_props.pop(job_id, None)
         entry = self._entry(job_id)
         with entry.lock:

@@ -1,0 +1,237 @@
+"""Scheduler crash/failover chaos harness (ISSUE 20).
+
+Utilities shared by ``benchmarks/scheduler_chaos.py`` and the chaos-
+marked tests: spawn a REAL scheduler process (``python -m
+arrow_ballista_tpu.scheduler``), SIGKILL it mid-burst, restart it (or
+fail over to a backup) and audit the outcome through the client RPCs,
+the REST API and the on-disk event journal.
+
+Everything here runs the scheduler as a *subprocess* — a SIGKILL must
+take down an actual process with no chance to flush, or the crash
+window being tested (queue admitted but graph unpersisted, intents in
+memory, children orphaned) does not exist.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (the usual bind-and-release race is
+    acceptable for tests: the scheduler binds it back within ms)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fingerprint(table: pa.Table) -> str:
+    """Order-insensitive sha256 over the rows — result identity across
+    legs/restarts without depending on partition interleave."""
+    rows = sorted(zip(*[c.to_pylist() for c in table.columns]))
+    h = hashlib.sha256()
+    for row in rows:
+        h.update(repr(row).encode())
+    return h.hexdigest()
+
+
+def read_journal(path: str, kind: Optional[str] = None) -> List[dict]:
+    """Read a scheduler's event-journal directory offline (segment files
+    oldest → active), tolerating torn tail lines — the journal outlives
+    the process that wrote it, which is the whole point here."""
+    from ..obs.events import ACTIVE_NAME, _SEGMENT_RE
+
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    seqs = sorted(
+        int(_SEGMENT_RE.match(n).group(1))
+        for n in names
+        if _SEGMENT_RE.match(n)
+    )
+    files = [os.path.join(path, f"events-{s}.jsonl") for s in seqs]
+    if ACTIVE_NAME in names:
+        files.append(os.path.join(path, ACTIVE_NAME))
+    out: List[dict] = []
+    for fp in files:
+        try:
+            with open(fp, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except Exception:  # noqa: BLE001 - torn tail
+                        continue
+                    if isinstance(ev, dict) and (
+                        kind is None or ev.get("kind") == kind
+                    ):
+                        out.append(ev)
+        except OSError:
+            continue
+    return out
+
+
+def kill_orphans(work_dir_root: str) -> int:
+    """SIGKILL every executor child recorded in ``executor.pid`` files
+    under an autoscaler work dir — test cleanup for fleets whose
+    scheduler died and was never restarted.  Returns the kill count."""
+    killed = 0
+    try:
+        entries = os.listdir(work_dir_root)
+    except OSError:
+        return 0
+    for eid in entries:
+        pid_path = os.path.join(work_dir_root, eid, "executor.pid")
+        try:
+            with open(pid_path, encoding="utf-8") as f:
+                pid = int(f.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            killed += 1
+        except OSError:
+            pass
+        try:
+            os.unlink(pid_path)
+        except OSError:
+            pass
+    return killed
+
+
+class SchedulerProc:
+    """One scheduler subprocess.  ``kill()`` is SIGKILL — the process
+    gets no chance to flush, drain or deregister, exactly like an OOM
+    kill or node loss; ``stop()`` is the graceful SIGTERM path."""
+
+    def __init__(
+        self,
+        port: int,
+        rest_port: int = 0,
+        args: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        log_path: str = "",
+    ):
+        self.port = port
+        self.rest_port = rest_port
+        cmd = [
+            sys.executable, "-m", "arrow_ballista_tpu.scheduler",
+            "--bind-host", "127.0.0.1",
+            "--bind-port", str(port),
+            "--rest-port", str(rest_port),
+            *(args or []),
+        ]
+        full_env = {**os.environ, **(env or {})}
+        # same PYTHONPATH pinning as LocalProcessProvider: the harness
+        # may import the package via a sys.path edit
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        existing = full_env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            full_env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else "")
+            )
+        self.log_path = log_path
+        sink = open(log_path, "ab") if log_path else subprocess.DEVNULL  # noqa: SIM115
+        self.proc = subprocess.Popen(  # noqa: S603 - our own binary
+            cmd,
+            stdout=sink,
+            stderr=subprocess.STDOUT if log_path else subprocess.DEVNULL,
+            env=full_env,
+        )
+        if log_path:
+            sink.close()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        """Block until the scheduler answers a session-bootstrap
+        ExecuteQuery (the cheapest end-to-end readiness probe: gRPC
+        bound + state backend open + session manager serving)."""
+        import grpc
+
+        from ..proto import pb
+        from ..proto.rpc import SchedulerGrpcStub, make_channel
+
+        deadline = time.monotonic() + timeout_s
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"scheduler on port {self.port} exited rc="
+                    f"{self.proc.returncode} before becoming ready"
+                    + (f" (log: {self.log_path})" if self.log_path else "")
+                )
+            try:
+                stub = SchedulerGrpcStub(make_channel("127.0.0.1", self.port))
+                stub.ExecuteQuery(pb.ExecuteQueryParams(), timeout=5)
+                return
+            except grpc.RpcError as e:
+                last = e
+                time.sleep(0.2)
+        raise RuntimeError(
+            f"scheduler on port {self.port} not ready in {timeout_s:.0f}s: {last}"
+        )
+
+    def rest_get(self, route: str, timeout_s: float = 10.0) -> dict:
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.rest_port}{route}"
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310
+            return json.loads(resp.read().decode())
+
+    def wait_alive_executors(self, n: int, timeout_s: float = 90.0) -> None:
+        """Poll ``/api/state`` until ``n`` executors report alive."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                state = self.rest_get("/api/state")
+                alive = sum(1 for e in state["executors"] if e["alive"])
+                if alive >= n:
+                    return
+            except Exception:  # noqa: BLE001 - scheduler may be mid-boot
+                pass
+            time.sleep(0.3)
+        raise RuntimeError(
+            f"scheduler on port {self.port}: {n} executor(s) never registered"
+        )
+
+    def kill(self) -> float:
+        """SIGKILL; returns the kill timestamp (``time.time()``, the
+        clock the event journal stamps — MTTR math subtracts it from
+        journal event timestamps)."""
+        t = time.time()
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        self.proc.wait(timeout=10)
+        return t
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        if self.proc.poll() is not None:
+            return
+        try:
+            self.proc.terminate()
+        except OSError:
+            return
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)

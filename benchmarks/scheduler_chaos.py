@@ -1,0 +1,517 @@
+"""Scheduler crash/failover chaos soak (ISSUE 20).
+
+Two entry points:
+
+* :func:`run_chaos_bench` — the BENCH_SUITE legs: a burst of DISTINCT
+  group-by jobs is submitted to a real scheduler subprocess with
+  admission pinned to one-running-job (so a deep queue exists by
+  construction), the scheduler is SIGKILLed mid-burst, and the run
+  continues through (a) a RESTART of the same process on the same
+  state/db/work dirs and (b) a FAILOVER to a live backup scheduler
+  sharing the state backend.  Every job must complete with rows
+  sha-identical to a local single-process run, the queued backlog must
+  be re-admitted in submit order (admission WAL), the autoscaler fleet
+  must be ADOPTED rather than relaunched (pid files), and no
+  (stage, partition) may be committed twice for one job.  The record
+  reports MTTR: SIGKILL → first post-recovery admission dispatch.
+
+* :func:`run_chaos_smoke` — the tier-1 ``--chaos-smoke`` gate: the
+  restart leg at small scale with the same assertions.
+
+Everything runs out-of-process (``python -m arrow_ballista_tpu
+.scheduler`` + its subprocess executor fleet) because the whole point
+is process death: SIGKILL must land on a real pid with no chance to
+flush, and recovery must read ONLY what the state backend, the pid
+files and the event journal durably recorded.
+
+The numbers are integers-stored-as-float (every sum is exactly
+representable), so fingerprints are bit-stable across partition orders,
+restarts and schedulers — any mismatch is a real wrong answer, not
+float re-association.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+BASE_CONFIG = {
+    "ballista.tpu.enable": "false",
+    "ballista.mesh.enable": "false",
+    "ballista.shuffle.partitions": "2",
+    "ballista.client.job_timeout_seconds": "300",
+}
+
+N_ROWS = 6000
+
+# min == max: the fleet size is pinned, so any post-kill launch is a
+# double-launch bug, not a scale-out — exactly what adoption must prevent
+AUTOSCALER_SETTINGS = ",".join(
+    [
+        "ballista.autoscaler.min_executors=2",
+        "ballista.autoscaler.max_executors=2",
+        "ballista.autoscaler.scale_out_sustain_seconds=0.5",
+        "ballista.autoscaler.cooldown_seconds=1",
+        "ballista.autoscaler.scale_in_idle_seconds=3600",
+        "ballista.autoscaler.launch_timeout_seconds=90",
+    ]
+)
+
+
+def _sql(i: int) -> str:
+    # distinct plan per job: a shared-fingerprint burst could mask
+    # cross-job result mixups after replay
+    return f"select g, sum(x) + {i} as s, count(x) as n from t group by g"
+
+
+def _table() -> pa.Table:
+    return pa.table(
+        {
+            "g": pa.array([f"g{i % 23}" for i in range(N_ROWS)]),
+            "x": pa.array([float(i % 251) for i in range(N_ROWS)]),
+        }
+    )
+
+
+def _expected_fingerprints(n_jobs: int) -> List[str]:
+    """Ground truth from a local single-process run of every job."""
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import SessionContext
+    from arrow_ballista_tpu.testing.chaos import fingerprint
+
+    ctx = SessionContext(BallistaConfig(dict(BASE_CONFIG)))
+    ctx.register_arrow_table("t", _table(), 2)
+    return [fingerprint(ctx.sql(_sql(i)).collect()) for i in range(n_jobs)]
+
+
+def _scheduler_args(
+    backend_args: List[str],
+    work_dir: str,
+    autoscaler_work_dir: str,
+    journal_dir: str,
+    executor_timeout_s: int,
+) -> List[str]:
+    return [
+        *backend_args,
+        "--scheduler-policy", "push-staged",
+        "--work-dir", work_dir,
+        "--admission-enabled", "1",
+        "--admission-defaults", "ballista.admission.max_running_jobs=1",
+        "--admission-wal-enabled", "1",
+        "--autoscaler-enabled", "1",
+        "--autoscaler-settings", AUTOSCALER_SETTINGS,
+        "--autoscaler-executor-slots", "2",
+        "--autoscaler-work-dir", autoscaler_work_dir,
+        "--autoscaler-heartbeat-seconds", "1.5",
+        "--event-journal-dir", journal_dir,
+        "--executor-timeout-seconds", str(executor_timeout_s),
+    ]
+
+
+def _submit_burst(ctx, n_jobs: int) -> List[str]:
+    return [
+        ctx.execute_logical_plan(ctx.sql(_sql(i)).plan) for i in range(n_jobs)
+    ]
+
+
+def _start_waiters(ctx, job_ids: List[str], timeout_s: float):
+    """One waiter thread per job, started BEFORE the kill — the waits
+    must ride through the outage on the client retry/rotation path."""
+    results: Dict[int, dict] = {}
+    lock = threading.Lock()
+
+    def wait_one(idx: int, jid: str) -> None:
+        try:
+            status = ctx.wait_for_job(jid, timeout_s=timeout_s)
+            with lock:
+                results[idx] = {"status": status}
+        except Exception as e:  # noqa: BLE001 - asserted on later
+            with lock:
+                results[idx] = {"error": repr(e)}
+
+    threads = [
+        threading.Thread(target=wait_one, args=(i, jid), name=f"wait-{i}")
+        for i, jid in enumerate(job_ids)
+    ]
+    for th in threads:
+        th.start()
+    return threads, results
+
+
+def _wait_journal(
+    journal_dir: str, kind: str, n: int, timeout_s: float = 90.0
+) -> List[dict]:
+    """Poll the on-disk journal until ``n`` events of ``kind`` exist.
+
+    ExecuteQuery acks BEFORE admission runs (submit posts JobQueued to
+    the scheduler event loop), so "submit returned" does NOT mean "WAL
+    entry written" — the journal is the observable proof the queue
+    (and its WAL shadow) actually holds the burst before we kill.
+    """
+    from arrow_ballista_tpu.testing.chaos import read_journal
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        events = read_journal(journal_dir, kind)
+        if len(events) >= n:
+            return events
+        time.sleep(0.1)
+    raise RuntimeError(
+        f"journal {journal_dir}: only {len(read_journal(journal_dir, kind))}"
+        f" of {n} {kind!r} events within {timeout_s:.0f}s"
+    )
+
+
+def _audit_leg(
+    leg: str,
+    job_ids: List[str],
+    results: Dict[int, dict],
+    expected: List[str],
+    pre_journal: str,
+    post_journal: str,
+    t_kill: float,
+) -> dict:
+    """Shared post-mortem: completion, result identity, replay order,
+    duplicate commits, MTTR.  Raises AssertionError on any violation."""
+    from arrow_ballista_tpu.testing.chaos import read_journal
+
+    errors = {
+        i: r["error"] for i, r in results.items() if "error" in r
+    }
+    assert not errors, f"{leg}: jobs failed to complete: {errors}"
+    assert len(results) == len(job_ids), (
+        f"{leg}: {len(job_ids) - len(results)} waiter(s) never returned"
+    )
+
+    # result identity + duplicate partition commits, from the final
+    # committed output locations
+    duplicate_commits = 0
+    mismatches = []
+    for i, jid in enumerate(job_ids):
+        status = results[i]["status"]
+        assert status["state"] == "completed", (
+            f"{leg}: job {jid} ended {status['state']!r}"
+        )
+        commits = Counter(
+            (loc.partition_id.stage_id, loc.partition_id.partition_id)
+            for loc in status.get("locations", [])
+        )
+        duplicate_commits += sum(c - 1 for c in commits.values() if c > 1)
+        fp = results[i]["fp"]
+        if fp != expected[i]:
+            mismatches.append(jid)
+    assert duplicate_commits == 0, (
+        f"{leg}: {duplicate_commits} duplicate partition commit(s)"
+    )
+    assert not mismatches, (
+        f"{leg}: result fingerprints diverged from the local run for "
+        f"{mismatches}"
+    )
+
+    # replay order: every job requeued after the kill must come back in
+    # submit order, and every job neither admitted nor finished before
+    # the kill must be among them
+    admitted_pre = {
+        e.get("job")
+        for e in read_journal(pre_journal, "job_admitted")
+        if e.get("ts", 0) <= t_kill
+    }
+    requeued = [
+        e.get("job")
+        for e in read_journal(post_journal, "job_requeued")
+        if e.get("ts", 0) > t_kill
+    ]
+    submit_index = {jid: i for i, jid in enumerate(job_ids)}
+    order = [submit_index[j] for j in requeued if j in submit_index]
+    assert order == sorted(order), (
+        f"{leg}: WAL replay broke submit order: {requeued}"
+    )
+    expected_requeue = [j for j in job_ids if j not in admitted_pre]
+    missing = [j for j in expected_requeue if j not in requeued]
+    assert not missing, (
+        f"{leg}: queued jobs lost across the crash (never requeued): "
+        f"{missing}"
+    )
+
+    admitted_post = [
+        e.get("ts", 0)
+        for e in read_journal(post_journal, "job_admitted")
+        if e.get("ts", 0) > t_kill
+    ]
+    assert admitted_post, f"{leg}: no admission dispatch after the kill"
+    return {
+        "leg": leg,
+        "jobs": len(job_ids),
+        "completed": len(job_ids),
+        "failed": 0,
+        "duplicate_partition_commits": duplicate_commits,
+        "requeued": len(requeued),
+        "admitted_before_kill": len(admitted_pre),
+        "mttr_first_dispatch_s": round(min(admitted_post) - t_kill, 3),
+    }
+
+
+def _fetch_outputs(ctx, job_ids: List[str], results: Dict[int, dict]) -> None:
+    from arrow_ballista_tpu.testing.chaos import fingerprint
+
+    for i in range(len(job_ids)):
+        if "status" in results.get(i, {}):
+            results[i]["fp"] = fingerprint(
+                ctx.fetch_job_output(results[i]["status"])
+            )
+
+
+# ------------------------------------------------------------------ legs
+def run_restart_leg(
+    n_jobs: int = 10,
+    task_delay_ms: int = 200,
+    seed: int = 7,
+    job_timeout_s: float = 240.0,
+) -> dict:
+    """SIGKILL the only scheduler mid-burst, restart it on the same
+    sqlite db + work dirs, and require full recovery: WAL replay in
+    order, orphan-fleet adoption (no relaunch), all jobs completing
+    sha-identical."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.testing.chaos import (
+        SchedulerProc,
+        free_port,
+        kill_orphans,
+        read_journal,
+    )
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix="ballista-chaos-restart-")
+    db = os.path.join(root, "state.db")
+    wd = os.path.join(root, "plans")
+    wd_as = os.path.join(root, "fleet")
+    jdir = os.path.join(root, "journal")
+    args = _scheduler_args(
+        ["--config-backend", "sqlite", "--db-path", db],
+        wd, wd_as, jdir, executor_timeout_s=30,
+    )
+    env = {"BALLISTA_FAULTS": f"task.run:-1:delay={task_delay_ms}"}
+    port = free_port()
+    expected = _expected_fingerprints(n_jobs)
+
+    sched = SchedulerProc(
+        port, free_port(), args=args, env=env,
+        log_path=os.path.join(root, "scheduler-a.log"),
+    )
+    sched2: Optional[SchedulerProc] = None
+    try:
+        sched.wait_ready()
+        sched.wait_alive_executors(2)
+        ctx = BallistaContext.remote(
+            "127.0.0.1", port, BallistaConfig(dict(BASE_CONFIG))
+        )
+        ctx.register_table("t", MemoryTable.from_table(_table(), 2))
+        job_ids = _submit_burst(ctx, n_jobs)
+        threads, results = _start_waiters(ctx, job_ids, job_timeout_s)
+
+        # the kill gate: the whole burst durably queued, at least one
+        # job dispatched, then a seeded mid-execution jitter
+        _wait_journal(jdir, "job_queued", n_jobs)
+        _wait_journal(jdir, "job_admitted", 1)
+        time.sleep(rng.uniform(0.3, 0.9))
+        t_kill = sched.kill()
+
+        sched2 = SchedulerProc(
+            port, sched.rest_port, args=args, env=env,
+            log_path=os.path.join(root, "scheduler-b.log"),
+        )
+        sched2.wait_ready()
+        t_ready = time.time()
+        for th in threads:
+            th.join(timeout=job_timeout_s + 30)
+        _fetch_outputs(ctx, job_ids, results)
+
+        record = _audit_leg(
+            "restart", job_ids, results, expected, jdir, jdir, t_kill
+        )
+        record["scheduler_ready_s"] = round(t_ready - t_kill, 3)
+
+        # adoption, not relaunch: the restarted scheduler must report
+        # the SAME two executors alive and the journal must show an
+        # adopt decision with zero post-kill launches
+        adopts = [
+            e for e in read_journal(jdir, "autoscale_decision")
+            if e.get("action") == "adopt" and e.get("ts", 0) > t_kill
+        ]
+        assert adopts, "restart: no orphan-adoption decision in journal"
+        # adopted children re-register and flip ALIVE (journalled with
+        # adopted=true); any NON-adopted launch after the kill is a
+        # duplicate fleet
+        launches_post = [
+            e for e in read_journal(jdir, "executor_launched")
+            if e.get("ts", 0) > t_kill and not e.get("adopted")
+        ]
+        assert not launches_post, (
+            f"restart: double-launch storm after adoption: {launches_post}"
+        )
+        state = sched2.rest_get("/api/state")
+        alive = sum(1 for e in state["executors"] if e["alive"])
+        assert alive == 2, f"restart: expected 2 alive executors, saw {alive}"
+        record["adopted_executors"] = len(adopts[0].get("executors", []))
+        record["post_kill_launches"] = 0
+        ctx.close()
+        return record
+    finally:
+        for s in (sched2, sched):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 - cleanup
+                    pass
+        kill_orphans(wd_as)
+
+
+def run_takeover_leg(
+    n_jobs: int = 10,
+    task_delay_ms: int = 200,
+    seed: int = 11,
+    job_timeout_s: float = 240.0,
+) -> dict:
+    """SIGKILL the primary mid-burst with a live backup sharing the
+    state backend: the client rotates endpoints, the backup declares the
+    primary dead, adopts its jobs, replays its admission WAL and runs
+    the backlog to completion on its own fleet."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.scheduler.backend import MemoryBackend
+    from arrow_ballista_tpu.scheduler.kvstore import KvStoreHandle
+    from arrow_ballista_tpu.testing.chaos import (
+        SchedulerProc,
+        free_port,
+        kill_orphans,
+    )
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix="ballista-chaos-takeover-")
+    kv = KvStoreHandle(MemoryBackend(), "127.0.0.1", 0).start()
+    dirs = {
+        name: os.path.join(root, name)
+        for name in ("plans-a", "fleet-a", "journal-a",
+                     "plans-b", "fleet-b", "journal-b")
+    }
+    backend_args = ["--config-backend", "etcd",
+                    "--etcd-urls", f"127.0.0.1:{kv.port}"]
+    env = {"BALLISTA_FAULTS": f"task.run:-1:delay={task_delay_ms}"}
+    port_a, port_b = free_port(), free_port()
+    expected = _expected_fingerprints(n_jobs)
+
+    # executor timeout 5s everywhere: the backup's reaper sweeps every
+    # 5s and declares a peer scheduler dead after 3 missed sweeps
+    # (15s) — while each scheduler's own 1.5s-heartbeat fleet stays
+    # comfortably alive
+    sched_a = SchedulerProc(
+        port_a, free_port(),
+        args=_scheduler_args(
+            backend_args, dirs["plans-a"], dirs["fleet-a"],
+            dirs["journal-a"], executor_timeout_s=5,
+        ),
+        env=env, log_path=os.path.join(root, "scheduler-a.log"),
+    )
+    sched_b: Optional[SchedulerProc] = None
+    try:
+        sched_a.wait_ready()
+        sched_b = SchedulerProc(
+            port_b, free_port(),
+            args=_scheduler_args(
+                backend_args, dirs["plans-b"], dirs["fleet-b"],
+                dirs["journal-b"], executor_timeout_s=5,
+            ),
+            env=env, log_path=os.path.join(root, "scheduler-b.log"),
+        )
+        sched_b.wait_ready()
+        # both fleets registered (shared backend: each REST view sees 4)
+        sched_a.wait_alive_executors(4)
+
+        ctx = BallistaContext.remote(
+            "127.0.0.1", port_a, BallistaConfig(dict(BASE_CONFIG)),
+            endpoints=[f"127.0.0.1:{port_b}"],
+        )
+        ctx.register_table("t", MemoryTable.from_table(_table(), 2))
+        job_ids = _submit_burst(ctx, n_jobs)
+        threads, results = _start_waiters(ctx, job_ids, job_timeout_s)
+
+        _wait_journal(dirs["journal-a"], "job_queued", n_jobs)
+        _wait_journal(dirs["journal-a"], "job_admitted", 1)
+        time.sleep(rng.uniform(0.3, 0.9))
+        t_kill = sched_a.kill()
+
+        for th in threads:
+            th.join(timeout=job_timeout_s + 30)
+        _fetch_outputs(ctx, job_ids, results)
+
+        record = _audit_leg(
+            "takeover", job_ids, results, expected,
+            dirs["journal-a"], dirs["journal-b"], t_kill,
+        )
+        state = sched_b.rest_get("/api/state")
+        record["backup_alive_executors"] = sum(
+            1 for e in state["executors"] if e["alive"]
+        )
+        ctx.close()
+        return record
+    finally:
+        for s in (sched_b, sched_a):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 - cleanup
+                    pass
+        kill_orphans(dirs["fleet-a"])
+        kill_orphans(dirs["fleet-b"])
+        kv.stop()
+
+
+# ------------------------------------------------------------- entry points
+def run_chaos_smoke() -> dict:
+    """Tier-1 gate (``dev/tier1.sh --chaos-smoke``): the restart leg at
+    small scale — full kill/restart mechanics, minutes not tens of."""
+    return run_restart_leg(n_jobs=5, task_delay_ms=150, job_timeout_s=180.0)
+
+
+def run_chaos_bench(n_jobs: int = 10, task_delay_ms: int = 200) -> List[dict]:
+    """Both BENCH_SUITE legs, as JSON-Lines-ready records."""
+    restart = run_restart_leg(n_jobs=n_jobs, task_delay_ms=task_delay_ms)
+    takeover = run_takeover_leg(n_jobs=n_jobs, task_delay_ms=task_delay_ms)
+    records = []
+    for leg in (restart, takeover):
+        records.append(
+            {
+                "metric": f"scheduler_chaos_{leg['leg']}_mttr_s",
+                "value": leg["mttr_first_dispatch_s"],
+                "unit": "s (SIGKILL -> first post-recovery admission dispatch)",
+                **leg,
+            }
+        )
+    return records
+
+
+def main() -> None:
+    records = run_chaos_bench()
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SUITE_r20.json")
+    with open(out, "w", encoding="utf-8") as f:
+        for rec in records:
+            line = json.dumps(rec)
+            print(line)
+            f.write(line + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,14 @@ NEW_FIELDS = [
     # adoption keeps skipping the elided subtree instead of waiting
     # forever on inputs nobody will produce
     ("ExecutionGraphProto", "cache_json", 19, F.TYPE_STRING, F.LABEL_OPTIONAL),
+    # scheduler crash/failover survival (ISSUE 20): a client-minted
+    # idempotency token on ExecuteQuery lets a retried submit (endpoint
+    # rotation after UNAVAILABLE) re-attach to the job the first attempt
+    # may already have created, instead of double-running it
+    ("ExecuteQueryParams", "idempotency_token", 5, F.TYPE_STRING, F.LABEL_OPTIONAL),
+    # a scheduler that lost its in-memory executor registry (memory
+    # backend restart) answers heartbeats with reregister=true; proto
+    # already declares HeartBeatResult.reregister — no mutation needed
 ]
 
 # Messages added by descriptor mutation (same idempotent scheme as
@@ -337,6 +345,8 @@ def main() -> None:
             "assert pb.ShuffleLocationDeltaParams.FromString(dp.SerializeToString()).from_index == 4\n"
             "srt = pb.ShuffleReaderExecNode(tail=True)\n"
             "assert pb.ShuffleReaderExecNode.FromString(srt.SerializeToString()).tail\n"
+            "eq = pb.ExecuteQueryParams(idempotency_token='tok-1')\n"
+            "assert pb.ExecuteQueryParams.FromString(eq.SerializeToString()).idempotency_token == 'tok-1'\n"
             "print('round-trip smoke OK')\n",
         ],
         cwd=REPO,

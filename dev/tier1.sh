@@ -9,10 +9,13 @@
 #                  on tiny inputs after the tests — a compile/regression
 #                  smoke for the benchmark harnesses themselves, NOT a
 #                  measurement and NOT part of default tier-1.
-#   --chaos-smoke  additionally run the bounded random-kill soak (pytest
+#   --chaos-smoke  additionally run the bounded chaos soaks (pytest
 #                  -m chaos): executors are drained/killed at random
-#                  during small queries, which must still complete with
-#                  correct results.  Seeded via BALLISTA_CHAOS_SEED.
+#                  during small queries, and the scheduler itself is
+#                  SIGKILLed mid-burst and restarted (admission-WAL
+#                  replay + orphan-fleet adoption) — everything must
+#                  still complete with correct results.  Seeded via
+#                  BALLISTA_CHAOS_SEED.
 set -o pipefail
 cd "$(dirname "$0")/.."
 BENCH_SMOKE=0
@@ -156,8 +159,8 @@ EOF
   timeout -k 10 60 python dev/bench_report.py || true
 fi
 if [ "$CHAOS_SMOKE" = "1" ]; then
-  echo "--- chaos smoke (bounded random kill/drain soak) ---"
-  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+  echo "--- chaos smoke (bounded kill/drain + scheduler-kill soaks) ---"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
   chaos_rc=$?
   [ $rc -eq 0 ] && rc=$chaos_rc

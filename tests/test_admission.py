@@ -428,6 +428,10 @@ def test_client_timeout_distinguishes_queued_from_running():
     ctx = BallistaContext.__new__(BallistaContext)
     ctx.stub = _FakeStub()
     ctx.config = BallistaConfig()  # wait_for_job reads the poll-backoff knobs
+    ctx.host, ctx.port = "127.0.0.1", 50050
+    ctx._endpoints = [(ctx.host, ctx.port)]  # _call reads the failover list
+    ctx._endpoint_idx = 0
+    ctx._stubs = {}
     with pytest.raises(ExecutionError) as ei:
         ctx.wait_for_job("j-queued", timeout_s=0.25)
     msg = str(ei.value)

@@ -13,6 +13,7 @@ Three layers:
   SIGKILL chaos test (``chaos`` marker, excluded from default tier-1).
 """
 
+import os
 import threading
 import time
 
@@ -793,3 +794,146 @@ def test_sigkill_mid_burst_heals_and_results_identical(tmp_path):
         if ctx is not None:
             ctx.close()
         handle.shutdown()
+
+
+# ------------------------------------------------- orphan adoption (ISSUE 20)
+def _orphan(work_dir_root, executor_id):
+    """A real surviving child process whose cmdline carries its executor
+    id (the adoption liveness check reads /proc/<pid>/cmdline), plus its
+    persisted pid file — exactly what a SIGKILLed scheduler leaves."""
+    import subprocess
+    import sys
+
+    from arrow_ballista_tpu.scheduler.autoscaler import PID_FILE
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)",
+         "--executor-id", executor_id],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    d = os.path.join(work_dir_root, executor_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, PID_FILE), "w", encoding="utf-8") as f:
+        f.write(f"{proc.pid}\n")
+    # wait for the exec: until then /proc/<pid>/cmdline still shows the
+    # forked parent's argv and the adoption identity check would (
+    # correctly) refuse the pid
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        try:
+            with open(f"/proc/{proc.pid}/cmdline", "rb") as f:
+                if executor_id.encode() in f.read():
+                    break
+        except OSError:
+            pass
+        time.sleep(0.02)
+    return proc
+
+
+def test_provider_adopts_orphans_and_reaps_stale_pid_files(tmp_path):
+    import subprocess
+    import sys
+
+    from arrow_ballista_tpu.scheduler.autoscaler import (
+        PID_FILE,
+        LocalProcessProvider,
+    )
+
+    work = str(tmp_path / "fleet")
+    child = _orphan(work, "scale-adopted1")
+    # a child that died WITH the old scheduler: pid file, no process
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    d = os.path.join(work, "scale-dead1")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, PID_FILE), "w", encoding="utf-8") as f:
+        f.write(f"{dead.pid}\n")
+
+    provider = LocalProcessProvider("127.0.0.1", 1, work_dir_root=work)
+    try:
+        assert provider.adopted_ids() == ["scale-adopted1"]
+        # dead child: pid file reaped, not adopted
+        assert not os.path.exists(os.path.join(d, PID_FILE))
+        # the adopted handle is poll/terminate-able like a launched one
+        assert provider.poll().get("scale-adopted1") is None
+    finally:
+        provider.close()
+    assert child.wait(timeout=10) is not None
+    # terminate removed the adopted pid file too
+    assert not os.path.exists(
+        os.path.join(work, "scale-adopted1", PID_FILE)
+    )
+
+
+def test_adoption_reconciles_desired_without_relaunch(sched, tmp_path):
+    """Satellite 4: after a restart the autoscaler re-derives desired
+    from the surviving fleet and must NOT double-launch while the
+    adopted children re-register; KEDA's external scaler reports the
+    same re-derived desired."""
+    from arrow_ballista_tpu.proto import keda_pb
+    from arrow_ballista_tpu.scheduler.autoscaler import (
+        ALIVE,
+        LAUNCHING,
+        LocalProcessProvider,
+    )
+    from arrow_ballista_tpu.scheduler.external_scaler import (
+        TARGET_PER_REPLICA,
+        ExternalScalerService,
+    )
+
+    work = str(tmp_path / "fleet")
+    _orphan(work, "scale-adopted1")
+    _orphan(work, "scale-adopted2")
+    provider = LocalProcessProvider("127.0.0.1", 1, work_dir_root=work)
+    launches = []
+    real_launch = provider.launch
+    provider.launch = lambda spec: (launches.append(spec.executor_id),
+                                    real_launch(spec))[1]
+    try:
+        asc = _attach(sched, provider, min_executors=1, max_executors=4)
+        # desired re-derived from the adopted fleet, not reset to min
+        assert asc.desired == 2
+        assert sorted(asc._managed) == ["scale-adopted1", "scale-adopted2"]
+        assert all(
+            m.adopted and m.phase == LAUNCHING
+            for m in asc._managed.values()
+        )
+        adopt = [
+            e for e in _events(sched, "autoscale_decision")
+            if e.get("action") == "adopt"
+        ]
+        assert adopt and adopt[0]["desired"] == 2
+
+        # KEDA mirrors the re-derived desired
+        svc = ExternalScalerService(sched)
+        got = svc.GetMetrics(keda_pb.GetMetricsRequest(), None)
+        assert got.metricValues[0].metricValue == 2 * TARGET_PER_REPLICA
+
+        # ticks while the adopted children re-register: no launch storm
+        _force_signals(
+            asc, alive_total=0, alive_effective=0, queued_jobs=0
+        )
+        t0 = time.monotonic()
+        asc.tick(t0)
+        asc.tick(t0 + 1.0)
+        assert launches == []
+
+        # one child re-registers → its record flips ALIVE (journalled
+        # as an adopted launch, distinct from a real one)
+        sched.state.executor_manager.register_executor(
+            ExecutorMetadata(
+                "scale-adopted1", "127.0.0.1", 51001, 51002,
+                ExecutorSpecification(2),
+            )
+        )
+        _force_signals(asc, alive_total=1, alive_effective=1)
+        asc.tick(t0 + 2.0)
+        assert asc._managed["scale-adopted1"].phase == ALIVE
+        flips = [
+            e for e in _events(sched, "executor_launched")
+            if e.get("executor") == "scale-adopted1"
+        ]
+        assert flips and flips[0]["adopted"] is True
+        assert launches == []
+    finally:
+        provider.close()

@@ -1,0 +1,147 @@
+"""Scheduler-kill chaos soak (ISSUE 20) — tier-1 ``--chaos-smoke`` gate.
+
+Runs the restart leg of ``benchmarks/scheduler_chaos.py`` end to end: a
+real ``python -m arrow_ballista_tpu.scheduler`` subprocess with a
+subprocess executor fleet is SIGKILLed mid-burst and restarted on the
+same sqlite db + work dirs.  The leg itself asserts the recovery
+contract (every job completes sha-identical to a local run, the queued
+backlog replays in submit order from the admission WAL, the orphaned
+fleet is adopted instead of relaunched, zero duplicate partition
+commits); the test just runs it and sanity-checks the record.
+
+Slow by construction (two scheduler boots + an executor fleet), so it
+rides the ``chaos`` marker, not the default tier-1 sweep.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def test_scheduler_kill_restart_soak():
+    from benchmarks.scheduler_chaos import run_chaos_smoke
+
+    record = run_chaos_smoke()
+    assert record["failed"] == 0
+    assert record["completed"] == record["jobs"]
+    assert record["duplicate_partition_commits"] == 0
+    assert record["post_kill_launches"] == 0
+    assert record["mttr_first_dispatch_s"] > 0
+
+
+def test_plan_cache_and_policy_survive_process_death(tmp_path):
+    """Satellite 3 (ISSUE 20): the plan-fingerprint cache's on-disk
+    ``index.json`` and the learned policy store both live under the
+    scheduler work dir — after a SIGKILL (no flush window) a restarted
+    scheduler must reload them: the repeat submission of an identical
+    plan serves from cache, and the policy ledger keeps its pre-crash
+    job history."""
+    import os
+
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.config import BallistaConfig
+    from arrow_ballista_tpu.context import MemoryTable
+    from arrow_ballista_tpu.testing.chaos import (
+        SchedulerProc,
+        fingerprint,
+        free_port,
+        kill_orphans,
+    )
+
+    root = str(tmp_path)
+    wd = os.path.join(root, "work")
+    wd_as = os.path.join(root, "fleet")
+    args = [
+        "--config-backend", "sqlite",
+        "--db-path", os.path.join(root, "state.db"),
+        "--work-dir", wd,
+        "--scheduler-policy", "push-staged",
+        "--cache-enabled", "1",
+        "--cache-policy-enabled", "1",
+        "--autoscaler-enabled", "1",
+        "--autoscaler-settings",
+        "ballista.autoscaler.min_executors=1,"
+        "ballista.autoscaler.max_executors=1,"
+        "ballista.autoscaler.scale_in_idle_seconds=3600",
+        "--autoscaler-work-dir", wd_as,
+        "--autoscaler-heartbeat-seconds", "1.5",
+        "--executor-timeout-seconds", "30",
+    ]
+    port = free_port()
+    sql = "select g, sum(x) as s, count(x) as n from t group by g"
+    config = BallistaConfig(
+        {
+            "ballista.tpu.enable": "false",
+            "ballista.mesh.enable": "false",
+            "ballista.shuffle.partitions": "2",
+            "ballista.client.job_timeout_seconds": "180",
+        }
+    )
+
+    s1 = SchedulerProc(
+        port, free_port(), args=args,
+        log_path=os.path.join(root, "sched-1.log"),
+    )
+    s2 = None
+    try:
+        s1.wait_ready()
+        s1.wait_alive_executors(1)
+        ctx = BallistaContext.remote("127.0.0.1", port, config)
+        ctx.register_table(
+            "t",
+            MemoryTable.from_table(
+                pa.table(
+                    {
+                        "g": pa.array([f"g{i % 13}" for i in range(3000)]),
+                        "x": pa.array([float(i % 89) for i in range(3000)]),
+                    }
+                ),
+                2,
+            ),
+        )
+        r1 = ctx.sql(sql).collect()
+
+        # both durable artifacts exist BEFORE the kill: the restart must
+        # reload them, not rebuild them
+        index = os.path.join(wd, "plan_cache", "index.json")
+        policy = os.path.join(wd, "policy_store.json")
+        assert os.path.exists(index), "plan cache never persisted its index"
+        assert os.path.exists(policy), "policy store never persisted"
+        before = s1.rest_get("/api/cache")
+        assert before["cache"]["entries"], before
+        jobs_before = sum(
+            p.get("jobs") or 0 for p in before["policy"].get("plans", [])
+        )
+        assert jobs_before >= 1, before
+
+        s1.kill()
+
+        s2 = SchedulerProc(
+            port, s1.rest_port, args=args,
+            log_path=os.path.join(root, "sched-2.log"),
+        )
+        s2.wait_ready()
+        s2.wait_alive_executors(1)
+        r2 = ctx.sql(sql).collect()
+        assert fingerprint(r1) == fingerprint(r2)
+        after = s2.rest_get("/api/cache")
+        # the repeat submission was served from the RELOADED cache …
+        assert after["cache"]["hits"] >= 1, after
+        # … and the policy ledger kept its pre-crash history
+        jobs_after = sum(
+            p.get("jobs") or 0 for p in after["policy"].get("plans", [])
+        )
+        assert jobs_after >= jobs_before, after
+        ctx.close()
+    finally:
+        for s in (s2, s1):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 - cleanup
+                    pass
+        kill_orphans(wd_as)

@@ -1,0 +1,465 @@
+"""Durable admission queue + client failover regressions (ISSUE 20).
+
+Unit level: the AdmissionWal record classes (queued jobs, cancel
+intents, idempotency tokens) round-trip through a state backend.
+
+Server level: a scheduler with ``admission_wal_enabled`` journals its
+queue through the backend; a RESTARTED scheduler (same id, same sqlite
+file) replays it in submit order, a TAKEOVER (different id, explicit
+curator) adopts it with curator re-stamping, and buffered cancel
+intents survive both — the satellite regression for the in-memory
+OrderedDict that previously evaporated on restart.  The knob-off A/B
+pins the default path: no WAL object, zero QueueWal keys, byte-
+identical submits.
+
+Client level: the bounded transient-retry helper (single endpoint), the
+endpoint-rotation failover path, the ``rpc_retries=0`` fail-fast A/B,
+and the idempotency-token dedup on retried ExecuteQuery.
+"""
+
+import time
+
+import grpc
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.config import BallistaConfig, TaskSchedulingPolicy
+from arrow_ballista_tpu.scheduler.backend import (
+    Keyspace,
+    MemoryBackend,
+    SqliteBackend,
+)
+from arrow_ballista_tpu.scheduler.queue_wal import (
+    AdmissionWal,
+    lookup_token,
+    purge_stale_tokens,
+    record_token,
+)
+from arrow_ballista_tpu.scheduler.server import SchedulerServer
+from arrow_ballista_tpu.scheduler.task_manager import NoopLauncher
+from arrow_ballista_tpu.serde.scheduler_types import (
+    ExecutorMetadata,
+    ExecutorSpecification,
+)
+
+ADMISSION_ON = {
+    "ballista.admission.enabled": "true",
+    "ballista.admission.max_running_jobs": "1",
+    "ballista.shuffle.partitions": "2",
+    "ballista.tpu.enable": "false",
+}
+
+
+def _plan(ctx, sql="select g, sum(v) as s from t group by g"):
+    return ctx.sql(sql).logical_plan()
+
+
+def _session(server, **extra):
+    settings = dict(ADMISSION_ON)
+    settings.update({k: str(v) for k, v in extra.items()})
+    ctx = server.state.session_manager.create_session(settings)
+    ctx.register_arrow_table(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(["a", "b", "a"], pa.string()),
+                "v": pa.array([1.0, 2.0, 3.0], pa.float64()),
+            }
+        ),
+        partitions=2,
+    )
+    return ctx
+
+
+def _server(backend, scheduler_id, work_dir, wal=True):
+    server = SchedulerServer(
+        scheduler_id,
+        backend,
+        TaskSchedulingPolicy.PULL_STAGED,
+        launcher=NoopLauncher(),
+        work_dir=work_dir,
+        reaper_interval_s=3600.0,
+        admission_wal_enabled=wal,
+    )
+    server.init()
+    server.state.executor_manager.register_executor(
+        ExecutorMetadata(
+            "wal-exec", "127.0.0.1", 50061, 50062, ExecutorSpecification(4)
+        )
+    )
+    return server
+
+
+def _submit(server, ctx, job_id):
+    server.submit_job(job_id, ctx.session_id, _plan(ctx))
+    assert server.drain(5.0)
+
+
+# ------------------------------------------------------------------- unit
+def test_wal_records_roundtrip():
+    from arrow_ballista_tpu.context import SessionContext
+    from arrow_ballista_tpu.scheduler.admission import QueuedJob
+
+    backend = MemoryBackend()
+    wal = AdmissionWal(backend, lambda: "sched-u")
+    ctx = SessionContext(BallistaConfig(dict(ADMISSION_ON)))
+    ctx.register_arrow_table("t", pa.table({"v": pa.array([1.0])}), 1)
+    plan = ctx.sql("select sum(v) as s from t").logical_plan()
+
+    for i in range(3):
+        wal.append(
+            QueuedJob(f"j{i}", "sess", plan, "default", "batch",
+                      0.0, time.time(), 0.0),
+            pool_weight=2.0, pool_max_running=1,
+        )
+    loaded = wal.load("sched-u")
+    assert [rec["job_id"] for _, rec in loaded] == ["j0", "j1", "j2"]
+    assert loaded[0][1]["pool_weight"] == 2.0
+    # the plan survives the base64/protobuf round trip
+    assert AdmissionWal.decode_plan(loaded[0][1]) is not None
+    assert wal.load("someone-else") == []
+
+    wal.discard("j1")
+    assert [r["job_id"] for _, r in wal.load("sched-u")] == ["j0", "j2"]
+
+    # a new WAL over the same backend continues the global sequence:
+    # late entries always sort after adopted ones
+    wal2 = AdmissionWal(backend, lambda: "sched-u")
+    wal2.append(
+        QueuedJob("j3", "sess", plan, "default", "batch",
+                  0.0, time.time(), 0.0),
+        1.0, 0,
+    )
+    assert [r["job_id"] for _, r in wal2.load("sched-u")] == ["j0", "j2", "j3"]
+
+    wal.put_intent("j-cancel")
+    assert wal.load_intents("sched-u") == ["j-cancel"]
+    wal.discard_intent("j-cancel")
+    assert wal.load_intents("sched-u") == []
+
+
+def test_token_helpers_and_ttl_purge():
+    backend = MemoryBackend()
+    assert lookup_token(backend, "tok-a") is None
+    record_token(backend, "tok-a", "job-a")
+    assert lookup_token(backend, "tok-a") == "job-a"
+    # expired tokens age out; fresh ones survive the sweep
+    backend.put(Keyspace.QueueWal, "t:tok-old", b"job-old 5")
+    assert purge_stale_tokens(backend) == 1
+    assert lookup_token(backend, "tok-old") is None
+    assert lookup_token(backend, "tok-a") == "job-a"
+
+
+# ----------------------------------------------------------- server level
+def test_restart_replays_queue_in_submit_order(tmp_path):
+    db = str(tmp_path / "wal.db")
+    a = _server(SqliteBackend(db), "sched-wal", str(tmp_path / "w"))
+    try:
+        ctx = _session(a)
+        for jid in ("job-1", "job-2", "job-3"):
+            _submit(a, ctx, jid)
+        assert a.state.task_manager.get_job_status("job-1")["state"] == "running"
+        # only the QUEUED jobs are journaled; the admitted one's entry
+        # was discarded when its graph reached the durable store
+        keys = a.state.backend.get_from_prefix(Keyspace.QueueWal, "q:")
+        assert {r["job_id"] for r in
+                (__import__("json").loads(v) for _, v in keys)} == {
+            "job-2", "job-3",
+        }
+    finally:
+        a.stop()
+
+    # the restart: same scheduler id over the same sqlite file
+    b = _server(SqliteBackend(db), "sched-wal", str(tmp_path / "w"))
+    try:
+        tm = b.state.task_manager
+        # the recovered running job still holds the concurrency gate, so
+        # the replayed queue keeps its original order behind it
+        assert tm.get_job_status("job-1")["state"] == "running"
+        st2 = tm.get_job_status("job-2")
+        st3 = tm.get_job_status("job-3")
+        assert (st2["state"], st2["queue_position"]) == ("queued", 1)
+        assert (st3["state"], st3["queue_position"]) == ("queued", 2)
+    finally:
+        b.stop()
+
+
+def test_takeover_replays_peer_queue_and_restamps_curator(tmp_path):
+    db = str(tmp_path / "wal.db")
+    a = _server(SqliteBackend(db), "sched-1", str(tmp_path / "w"))
+    try:
+        ctx = _session(a)
+        for jid in ("job-1", "job-2", "job-3"):
+            _submit(a, ctx, jid)
+    finally:
+        a.stop()
+
+    b = _server(SqliteBackend(db), "sched-2", str(tmp_path / "w"))
+    try:
+        # init() replayed nothing (no entries curated by sched-2) …
+        assert b.state.admission.queued_count() == 0
+        # … the takeover path replays the dead peer's queue in order
+        restored = b.replay_admission_wal(curator="sched-1")
+        assert restored == ["job-2", "job-3"]
+        # entries are re-stamped to the survivor so a SECOND failover
+        # would replay them again
+        wal = b.state.admission_wal
+        assert [r["job_id"] for _, r in wal.load("sched-2")] == [
+            "job-2", "job-3",
+        ]
+        assert wal.load("sched-1") == []
+    finally:
+        b.stop()
+
+
+def test_cancel_intent_survives_restart(tmp_path):
+    """Satellite regression: cancel intents lived only in an in-memory
+    OrderedDict and evaporated on restart — a cancel that raced the
+    crash lost, and the job ran anyway."""
+    db = str(tmp_path / "wal.db")
+    a = _server(SqliteBackend(db), "sched-wal", str(tmp_path / "w"))
+    try:
+        ctx = _session(a)
+        _submit(a, ctx, "job-1")
+        # cancel arrives in the admit window: no queue entry, no graph
+        a.state.admission.mark_cancel_intent("job-ghost")
+    finally:
+        a.stop()
+
+    b = _server(SqliteBackend(db), "sched-wal", str(tmp_path / "w"))
+    try:
+        # the re-armed intent still wins after the restart …
+        assert b.state.admission.take_cancel_intent("job-ghost")
+        # … and consuming it cleans the WAL entry
+        assert b.state.admission_wal.load_intents("sched-wal") == []
+        assert not b.state.admission.take_cancel_intent("job-ghost")
+    finally:
+        b.stop()
+
+
+def test_wal_knob_off_is_byte_identical(tmp_path):
+    """A/B: with ``admission_wal_enabled`` off (the default) no WAL
+    object exists, no QueueWal key is ever written, and a restart
+    replays nothing — the pre-ISSUE-20 scheduler exactly."""
+    db = str(tmp_path / "wal.db")
+    a = _server(SqliteBackend(db), "sched-off", str(tmp_path / "w"), wal=False)
+    try:
+        ctx = _session(a)
+        for jid in ("job-1", "job-2"):
+            _submit(a, ctx, jid)
+        assert a.state.admission_wal is None
+        assert a.state.admission.wal is None
+        assert a.state.backend.get_from_prefix(Keyspace.QueueWal, "") == []
+        # the intent path is a no-op write, not a crash
+        a.state.admission.mark_cancel_intent("job-x")
+    finally:
+        a.stop()
+
+    b = _server(SqliteBackend(db), "sched-off", str(tmp_path / "w"), wal=False)
+    try:
+        assert b.replay_admission_wal() == []
+        assert b.state.admission.queued_count() == 0
+    finally:
+        b.stop()
+
+
+def test_idempotent_resubmit_returns_same_job(tmp_path):
+    """A retried ExecuteQuery carrying the same client-minted token
+    re-attaches to the first attempt's job instead of double-running."""
+    from arrow_ballista_tpu.proto import pb
+    from arrow_ballista_tpu.scheduler.grpc_service import (
+        SchedulerGrpcService,
+    )
+    from arrow_ballista_tpu.serde import BallistaCodec
+
+    server = _server(
+        MemoryBackend(), "sched-tok", str(tmp_path / "w"), wal=True
+    )
+    try:
+        svc = SchedulerGrpcService(server)
+        ctx = _session(server)
+        params = pb.ExecuteQueryParams(
+            logical_plan=BallistaCodec.encode_logical(_plan(ctx)),
+            settings=[
+                pb.KeyValuePair(key=k, value=v)
+                for k, v in ADMISSION_ON.items()
+            ],
+            session_id=ctx.session_id,
+            idempotency_token="tok-retry-1",
+        )
+        first = svc.ExecuteQuery(params, None)
+        second = svc.ExecuteQuery(params, None)
+        assert first.job_id and first.job_id == second.job_id
+        assert server.drain(5.0)
+        # exactly one submission reached the state machine
+        states = [
+            r for r in server.state.task_manager.list_jobs()
+            if r["job_id"] == first.job_id
+        ]
+        assert len(states) == 1
+        # a DIFFERENT token is a new submission
+        params.idempotency_token = "tok-retry-2"
+        third = svc.ExecuteQuery(params, None)
+        assert third.job_id != first.job_id
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ client level
+class _RpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return f"fake {self._code}"
+
+
+class _FlakyStub:
+    """Fails the first ``fail`` calls with ``code``, then succeeds."""
+
+    def __init__(self, fail, code=grpc.StatusCode.UNAVAILABLE, job_id="j-ok"):
+        self.fail = fail
+        self.code = code
+        self.calls = 0
+        self.job_id = job_id
+        self.seen = []
+
+    def _handle(self, request, timeout=0):
+        self.calls += 1
+        self.seen.append(request)
+        if self.calls <= self.fail:
+            raise _RpcError(self.code)
+        from arrow_ballista_tpu.proto import pb
+
+        return pb.ExecuteQueryResult(job_id=self.job_id, session_id="s")
+
+    ExecuteQuery = _handle
+    GetJobStatus = _handle
+
+
+def _client(stubs, retries=None):
+    """A BallistaContext shell wired onto fake per-endpoint stubs."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    cfg = {
+        "ballista.client.poll_interval_seconds": "0.01",
+        "ballista.client.poll_max_interval_seconds": "0.02",
+    }
+    if retries is not None:
+        cfg["ballista.client.rpc_retries"] = str(retries)
+    ctx = BallistaContext.__new__(BallistaContext)
+    ctx.config = BallistaConfig(cfg)
+    ctx._endpoints = list(stubs.keys())
+    ctx._endpoint_idx = 0
+    ctx._stubs = dict(stubs)
+    ctx.host, ctx.port = ctx._endpoints[0]
+    ctx.stub = stubs[ctx._endpoints[0]]
+    ctx.session_id = "s"
+    ctx._job_ids = set()
+    return ctx
+
+
+def test_single_endpoint_transient_rpc_retries():
+    """Satellite bugfix: a transient UNAVAILABLE no longer kills the
+    call even with one endpoint — bounded retries with backoff."""
+    stub = _FlakyStub(fail=2)
+    ctx = _client({("h1", 1): stub})
+    result = ctx._call("GetJobStatus", object(), timeout=1)
+    assert result.job_id == "j-ok"
+    assert stub.calls == 3  # 2 failures + the success
+
+
+def test_single_endpoint_non_retryable_raises_immediately():
+    stub = _FlakyStub(fail=5, code=grpc.StatusCode.INVALID_ARGUMENT)
+    ctx = _client({("h1", 1): stub})
+    with pytest.raises(grpc.RpcError):
+        ctx._call("GetJobStatus", object(), timeout=1)
+    assert stub.calls == 1
+
+
+def test_rpc_retries_zero_single_endpoint_fails_fast():
+    """A/B: ``rpc_retries=0`` with one endpoint restores the exact
+    pre-failover behavior — one attempt, raw error."""
+    stub = _FlakyStub(fail=1)
+    ctx = _client({("h1", 1): stub}, retries=0)
+    with pytest.raises(grpc.RpcError):
+        ctx._call("GetJobStatus", object(), timeout=1)
+    assert stub.calls == 1
+
+
+def test_rotation_fails_over_to_backup_endpoint():
+    dead = _FlakyStub(fail=10**6)  # the killed primary: never answers
+    backup = _FlakyStub(fail=0, job_id="j-backup")
+    ctx = _client({("primary", 1): dead, ("backup", 2): backup}, retries=0)
+    result = ctx._call("GetJobStatus", object(), timeout=1)
+    assert result.job_id == "j-backup"
+    # the context now points at the survivor for subsequent calls
+    assert (ctx.host, ctx.port) == ("backup", 2)
+
+
+def test_submit_token_minted_only_when_retry_possible(tmp_path):
+    """Knob-off byte-identity: a retry-disabled single-endpoint client
+    sends NO idempotency token (request bytes match the old client); a
+    retry-capable one mints a fresh token per logical submit."""
+    from arrow_ballista_tpu.context import SessionContext
+
+    sess = SessionContext(BallistaConfig(dict(ADMISSION_ON)))
+    sess.register_arrow_table("t", pa.table({"v": pa.array([1.0])}), 1)
+    plan = sess.sql("select sum(v) as s from t").logical_plan()
+
+    stub = _FlakyStub(fail=0)
+    ctx = _client({("h1", 1): stub}, retries=0)
+    ctx.execute_logical_plan(plan)
+    assert stub.seen[-1].idempotency_token == ""
+
+    stub2 = _FlakyStub(fail=0)
+    ctx2 = _client({("h1", 1): stub2}, retries=3)
+    ctx2.execute_logical_plan(plan)
+    tok1 = stub2.seen[-1].idempotency_token
+    ctx2.execute_logical_plan(plan)
+    tok2 = stub2.seen[-1].idempotency_token
+    assert tok1 and tok2 and tok1 != tok2
+
+
+def test_restart_reconciles_leaked_slots(tmp_path):
+    """Slot counts are durable (Keyspace.Slots), so reservations held by
+    a scheduler process that died leak — on a small fleet the restarted
+    scheduler would deadlock (reserve_slots forever returns []).  init()
+    rebuilds every executor's count from the persisted graphs."""
+    db = str(tmp_path / "state.db")
+    a = _server(SqliteBackend(db), "sched-slots", str(tmp_path / "wa"))
+    em = a.state.executor_manager
+    assert em.available_slots() == 4
+    taken = em.reserve_slots(3, "job-leak")
+    assert len(taken) == 3 and em.available_slots() == 1
+    a.stop()  # SIGKILL stand-in: the reservations are never given back
+
+    b = _server(SqliteBackend(db), "sched-slots", str(tmp_path / "wb"))
+    try:
+        # no graph holds running tasks, so the full width comes back
+        assert b.state.executor_manager.available_slots() == 4
+    finally:
+        b.stop()
+
+
+def test_reconcile_slots_respects_running_tasks(tmp_path):
+    """The rebuild is truth-based, not a blind reset: tasks genuinely
+    running (per the persisted graphs — any curator's) keep their
+    slots."""
+    backend = MemoryBackend()
+    a = _server(backend, "sched-truth", str(tmp_path / "w"))
+    try:
+        em = a.state.executor_manager
+        em.reserve_slots(4, "job-x")
+        assert em.available_slots() == 0
+        # 1 task still running on wal-exec per ground truth: 3 reclaimed
+        changed = em.reconcile_slots({"wal-exec": 1})
+        assert changed == {"wal-exec": 3}
+        assert em.available_slots() == 3
+        # already consistent: a second pass is a no-op
+        assert em.reconcile_slots({"wal-exec": 1}) == {}
+    finally:
+        a.stop()
